@@ -1,0 +1,75 @@
+"""Collective kernels vs XLA-collective oracles (reference test pattern:
+torch collectives as the oracle, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops import (
+    all_gather, all_gather_ref,
+    reduce_scatter, reduce_scatter_ref,
+    all_reduce, all_reduce_ref, AllReduceMethod,
+    p2p_put, ppermute_ref,
+)
+from triton_dist_tpu.utils.testing import spmd, assert_allclose
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+@pytest.mark.parametrize("mode", ["ring", "full_mesh"])
+def test_all_gather(tp8_mesh, tp8_ctx, mode):
+    x = _rand((64, 128))
+
+    f = spmd(tp8_mesh, lambda v: all_gather(v, ctx=tp8_ctx, mode=mode),
+             P("tp", None), P(None, None))
+    g = spmd(tp8_mesh, lambda v: all_gather_ref(v),
+             P("tp", None), P(None, None))
+    assert_allclose(f(x), g(x))
+
+
+def test_reduce_scatter(tp8_mesh, tp8_ctx):
+    x = _rand((64, 128))  # per-shard (8,128); rs over dim0 -> (1,128)? no:
+    # per-shard input must be (n*c, K): replicate the array instead.
+    f = spmd(tp8_mesh, lambda v: reduce_scatter(v, ctx=tp8_ctx),
+             P(None, None), P("tp", None))
+    g = spmd(tp8_mesh, lambda v: reduce_scatter_ref(v),
+             P(None, None), P("tp", None))
+    assert_allclose(f(x), g(x), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("method",
+                         [AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT])
+def test_all_reduce(tp8_mesh, tp8_ctx, method):
+    x = _rand((64, 128))
+    # Per-shard distinct values: shard the input then treat each shard as
+    # this device's contribution; compare against psum.
+    f = spmd(tp8_mesh, lambda v: all_reduce(v, ctx=tp8_ctx, method=method),
+             P("tp", None), P("tp", None))
+    g = spmd(tp8_mesh, lambda v: all_reduce_ref(v),
+             P("tp", None), P("tp", None))
+    assert_allclose(f(x), g(x), rtol=1e-4, atol=1e-4)
+
+
+def test_p2p_put_shift(tp8_mesh, tp8_ctx):
+    x = _rand((64, 128))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    f = spmd(tp8_mesh, lambda v: p2p_put(v, perm, ctx=tp8_ctx, axis="tp"),
+             P("tp", None), P("tp", None))
+    g = spmd(tp8_mesh, lambda v: ppermute_ref(v, perm, axis="tp"),
+             P("tp", None), P("tp", None))
+    assert_allclose(f(x), g(x))
+
+
+def test_p2p_put_partial(tp8_mesh, tp8_ctx):
+    """Non-receivers must see zeros."""
+    x = _rand((64, 128))
+    perm = [(0, 3), (1, 2)]
+    f = spmd(tp8_mesh, lambda v: p2p_put(v, perm, ctx=tp8_ctx, axis="tp"),
+             P("tp", None), P("tp", None))
+    g = spmd(tp8_mesh, lambda v: ppermute_ref(v, perm, axis="tp"),
+             P("tp", None), P("tp", None))
+    assert_allclose(f(x), g(x))

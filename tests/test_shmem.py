@@ -1,0 +1,147 @@
+"""Substrate tests: notify/wait, barrier, one-sided put.
+
+The acceptance tests for build stage 1 (SURVEY.md §7) — the analogue of
+reference tutorials 01 (notify/wait) and 02 (intra-node allgather
+primitive).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.shmem import symm_tensor, barrier_all
+from triton_dist_tpu.utils.testing import spmd, assert_allclose
+
+
+def test_symm_tensor(tp8_mesh):
+    ws = symm_tensor(tp8_mesh, (4, 128), jnp.float32, axis="tp")
+    assert ws.shape == (32, 128)
+    assert ws.dtype == jnp.float32
+
+
+def test_host_barrier(tp8_mesh):
+    barrier_all(tp8_mesh, axis="tp")  # must simply not deadlock
+
+
+def test_remote_put_ring(tp8_mesh, tp8_ctx):
+    """Tutorial-01/02 analogue: every device puts its buffer to its right
+    neighbour; result equals a ring shift."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, *, ctx):
+        n = dl.num_ranks("tp")
+        me = dl.rank("tp")
+        right = jax.lax.rem(me + 1, n)
+        # Entry barrier: peers must be inside the kernel before any put.
+        dl.barrier_tile("tp", ctx=ctx)
+        copy = dl.remote_put(x_ref, out_ref, send_sem, recv_sem, right,
+                             axis="tp", ctx=ctx)
+        copy.wait()
+
+    def run(x):
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    f = spmd(tp8_mesh, run, P("tp", None), P("tp", None))
+    y = f(x)
+    expected = jnp.roll(x.reshape(8, 8, 128), 1, axis=0).reshape(64, 128)
+    assert_allclose(y, expected)
+
+
+def test_notify_wait_counter(tp8_mesh, tp8_ctx):
+    """All devices notify rank 0's semaphore; rank 0 waits for n counts —
+    the counting re-design of signal_wait_until (SURVEY.md §7)."""
+
+    def kernel(out_ref, zero_v, sem, *, ctx):
+        n = dl.num_ranks("tp")
+        me = dl.rank("tp")
+        # Align entry before signalling scratch semaphores cross-device.
+        dl.barrier_all("tp", ctx=ctx)
+        dl.notify(sem, 0, axis="tp", ctx=ctx)
+
+        @pl.when(me == 0)
+        def _():
+            dl.wait(sem, n)
+
+        zero_v[...] = jnp.full_like(zero_v, 7.0)
+        pltpu.sync_copy(zero_v, out_ref)
+
+    def run():
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32),
+                            pltpu.SemaphoreType.REGULAR],
+        )()
+
+    f = spmd(tp8_mesh, run, (), P("tp", None))
+    y = f()
+    assert_allclose(y, jnp.full((64, 128), 7.0))
+
+
+def test_barrier_all_in_kernel(tp8_mesh, tp8_ctx):
+    def kernel(out_ref, v, *, ctx):
+        dl.barrier_all("tp", ctx=ctx)
+        v[...] = jnp.ones_like(v)
+        pltpu.sync_copy(v, out_ref)
+
+    def run():
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+        )()
+
+    f = spmd(tp8_mesh, run, (), P("tp", None))
+    assert_allclose(f(), jnp.ones((64, 128)))
+
+
+def test_logical_device_id_2d(dp2tp4_mesh, dp2tp4_ctx):
+    """Ring put along tp inside a 2D (dp, tp) mesh must stay within each
+    dp group — validates logical-id linearization."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, *, ctx):
+        n = dl.num_ranks("tp")
+        me = dl.rank("tp")
+        right = jax.lax.rem(me + 1, n)
+        dl.barrier_tile("tp", ctx=ctx)
+        copy = dl.remote_put(x_ref, out_ref, send_sem, recv_sem, right,
+                             axis="tp", ctx=ctx)
+        copy.wait()
+
+    def run(x):
+        return core_call(
+            functools.partial(kernel, ctx=dp2tp4_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    f = spmd(dp2tp4_mesh, run, P(("dp", "tp"), None), P(("dp", "tp"), None))
+    y = f(x)
+    blocks = x.reshape(2, 4, 8, 128)
+    expected = jnp.roll(blocks, 1, axis=1).reshape(64, 128)
+    assert_allclose(y, expected)

@@ -1,0 +1,37 @@
+"""In-kernel communication primitives ("the language layer").
+
+TPU-native analogue of ``triton_dist.language`` (reference:
+``python/triton_dist/language/distributed_ops.py`` — wait/consume_token/
+rank/num_ranks/symm_at/notify — and ``language/extra/libshmem_device.py``,
+the portable SHMEM device API). Here the primitives are Pallas/Mosaic
+operations: one-sided puts are ICI/DCN remote DMAs, signal words are
+hardware semaphores, and waits are semaphore waits — no spin loops on HBM.
+"""
+
+from triton_dist_tpu.lang.shmem_device import (  # noqa: F401
+    rank,
+    num_ranks,
+    my_pe,
+    n_pes,
+    remote_put,
+    putmem_block,
+    putmem_signal_block,
+    getmem_block,
+    signal_op,
+    notify,
+    wait,
+    wait_arrivals,
+    signal_wait_until,
+    consume_token,
+    barrier_all,
+    barrier_tile,
+    local_copy,
+    local_copy_async,
+    SIGNAL_SET,
+    SIGNAL_ADD,
+)
+from triton_dist_tpu.lang.pallas_helpers import (  # noqa: F401
+    core_call,
+    comm_compiler_params,
+    next_collective_id,
+)

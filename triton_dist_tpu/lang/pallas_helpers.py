@@ -1,0 +1,57 @@
+"""pallas_call plumbing shared by every kernel in the package.
+
+The analogue of the reference's ``@triton_dist.jit`` overlay
+(``python/triton_dist/jit.py``): where that wrapper injects the SHMEM
+extern lib and registers modules with the SHMEM runtime, ours injects the
+interpret-mode switch (CPU mesh testing), communication compiler params
+(``has_side_effects`` + ``collective_id``), and default cost estimates.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.utils.distributed import interpret_arg
+
+# Mosaic requires distinct collective_ids for concurrently-running
+# collective kernels; a process-wide counter keeps them unique per traced
+# kernel (cached tracings reuse their id, which is the requirement). The
+# hardware barrier-semaphore table is small, so ids cycle mod 32 —
+# aliasing would need >32 distinct comm kernels genuinely in flight.
+_collective_ids = itertools.count(1)
+
+
+def next_collective_id() -> int:
+    return next(_collective_ids) % 32
+
+
+def comm_compiler_params(collective_id: Optional[int] = None,
+                         **kwargs) -> pltpu.CompilerParams:
+    """CompilerParams for kernels that perform remote DMA / barriers."""
+    if collective_id is None:
+        collective_id = next_collective_id()
+    return pltpu.CompilerParams(
+        has_side_effects=True, collective_id=collective_id, **kwargs)
+
+
+def core_call(kernel, *, comm: bool = False,
+              compiler_params: Optional[pltpu.CompilerParams] = None,
+              interpret: Any = None, **pallas_kwargs):
+    """``pl.pallas_call`` with package defaults applied.
+
+    - ``interpret`` defaults to the global interpret switch
+      (on for non-TPU platforms → the CPU-mesh test backend).
+    - ``comm=True`` marks a communicating kernel: side effects + a fresh
+      ``collective_id`` unless explicit ``compiler_params`` are given.
+    """
+    if interpret is None:
+        interpret = interpret_arg()
+    if compiler_params is None and comm:
+        compiler_params = comm_compiler_params()
+    if compiler_params is not None:
+        pallas_kwargs["compiler_params"] = compiler_params
+    return pl.pallas_call(kernel, interpret=interpret, **pallas_kwargs)

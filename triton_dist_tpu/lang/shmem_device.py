@@ -1,0 +1,274 @@
+"""Device-side one-sided communication primitives for Pallas TPU kernels.
+
+Semantics map (reference → here):
+
+- ``dl.rank()/num_ranks()`` (``language/distributed_ops.py:84,90``)
+  → :func:`rank` / :func:`num_ranks` over a named mesh axis.
+- ``libshmem_device.putmem_block(dst, src, nbytes, pe)``
+  (``language/extra/libshmem_device.py:~120``) → :func:`putmem_block` —
+  an async remote DMA; completion is a *semaphore*, not a flag word.
+- ``libshmem_device.putmem_signal_block(..., sig_ptr, sig_val, SIGNAL_SET, pe)``
+  → :func:`putmem_signal_block` — remote DMA plus a remote semaphore
+  signal the consumer waits on.
+- ``dl.notify(ptr, rank, signal=v, comm_scope=...)``
+  (``distributed_ops.py:103``) → :func:`notify` — remote semaphore signal.
+- ``dl.wait(barrierPtrs, N, scope, semantic)`` (``distributed_ops.py:57``)
+  → :func:`wait` — semaphore wait. TPU semaphores are counting, so the
+  reference's ``signal_wait_until(CMP_EQ, value)`` value-compare protocol
+  becomes a count protocol: producers ``inc`` by 1, consumers wait for a
+  target count (SURVEY.md §7 "hard parts" — phase/parity re-design).
+- ``dl.consume_token`` → :func:`consume_token` (no-op: Mosaic orders
+  memory through semaphore waits; kept for API parity).
+- ``libshmem_device.barrier_all()`` → :func:`barrier_all`.
+
+All functions must be called inside a Pallas kernel traced under
+``shard_map`` (they use ``jax.lax.axis_index``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.parallel.mesh import logical_device_id
+
+SIGNAL_SET = "set"   # reference: SignalOp::SET (DistributedAttrDefs.td:36)
+SIGNAL_ADD = "add"   # reference: SignalOp::ADD
+
+
+# ---------------------------------------------------------------------------
+# Rank queries
+# ---------------------------------------------------------------------------
+
+def rank(axis: str):
+    """This device's rank along ``axis`` (reference: dl.rank())."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str) -> int:
+    """Static size of ``axis`` (reference: dl.num_ranks())."""
+    return jax.lax.axis_size(axis)
+
+
+# SHMEM-flavoured aliases (reference: libshmem_device.my_pe/n_pes)
+my_pe = rank
+n_pes = num_ranks
+
+
+def _resolve_device_id(ctx, axis: str, peer):
+    """Logical device id of ``peer`` along ``axis`` given a MeshContext."""
+    if ctx is None:
+        # Single-axis mesh: the peer rank is the logical id.
+        return peer
+    return logical_device_id(ctx.axes, axis, peer, ctx.sizes)
+
+
+# ---------------------------------------------------------------------------
+# One-sided puts / gets
+# ---------------------------------------------------------------------------
+
+def remote_put(src_ref, dst_ref, send_sem, recv_sem, peer, *, axis: str,
+               ctx=None, start: bool = True):
+    """One-sided put: copy ``src_ref`` into ``dst_ref`` on device ``peer``
+    (rank along ``axis``). Returns the DMA handle; caller may ``.wait()``
+    the send side, the remote side waits its ``recv_sem``.
+
+    Reference: ``libshmem_device.putmem_nbi_block`` lowered to NVSHMEM
+    (``NVIDIA/DistributedOpToLLVM.cpp:94-154``); here it is a single
+    Mosaic ``make_async_remote_copy`` riding ICI (or DCN across slices).
+    """
+    copy = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=_resolve_device_id(ctx, axis, peer),
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    if start:
+        copy.start()
+    return copy
+
+
+def putmem_block(dst_ref, src_ref, peer, send_sem, recv_sem, *, axis: str,
+                 ctx=None):
+    """SHMEM-argument-order alias of :func:`remote_put` (dst first)."""
+    return remote_put(src_ref, dst_ref, send_sem, recv_sem, peer, axis=axis,
+                      ctx=ctx)
+
+
+def putmem_signal_block(dst_ref, src_ref, sig_sem, peer, send_sem, recv_sem,
+                        *, axis: str, ctx=None, sig_inc: int = 1):
+    """Put + remote user-semaphore signal.
+
+    ORDERING CAVEAT (differs from NVSHMEM putmem_signal): the remote
+    ``sig_sem`` signal is issued after the local send drains
+    (``wait_send``) and may overtake the bulk data in flight. Only the
+    DMA's own ``recv_sem`` certifies data arrival on the destination —
+    consumers must wait ``recv_sem`` before reading ``dst_ref`` and use
+    ``sig_sem`` purely for application-level sequencing (tile counters
+    etc.). The fused ops in this package follow that discipline.
+
+    Reference: ``libshmem_device.putmem_signal_block`` / ``_nbi``.
+    """
+    copy = remote_put(src_ref, dst_ref, send_sem, recv_sem, peer, axis=axis,
+                      ctx=ctx)
+    copy.wait_send()
+    notify(sig_sem, peer, axis=axis, ctx=ctx, inc=sig_inc)
+    return copy
+
+
+def getmem_block(dst_ref, src_ref, peer, send_sem, recv_sem, *, axis: str,
+                 ctx=None):
+    """One-sided get: fetch ``src_ref`` from ``peer`` into local ``dst_ref``.
+
+    TPU remote DMA is push-only, so a get is expressed as a remote-issued
+    put in the SPMD program: every device issues the symmetric put that
+    realises its peers' gets. For the common symmetric patterns
+    (all-gather pull schedules) this is what the collective kernels do;
+    a true single-sided get is emulated with a request/response semaphore
+    pair. Provided for API parity with ``libshmem_device.getmem_block``.
+    """
+    raise NotImplementedError(
+        "TPU RDMA is push-only; restructure as symmetric puts "
+        "(see ops/collectives) or use p2p request/response (ops/p2p).")
+
+
+# ---------------------------------------------------------------------------
+# Signal / wait
+# ---------------------------------------------------------------------------
+
+def notify(sem, peer=None, *, axis: Optional[str] = None, ctx=None,
+           inc: int = 1):
+    """Signal a semaphore, optionally on a remote device.
+
+    Reference: ``dl.notify`` (``distributed_ops.py:103``) — release-store /
+    ``signal_op`` by CommScope (``NVIDIA/DistributedOpToLLVM.cpp:243-353``).
+    Local signal: ``notify(sem)``. Remote: ``notify(sem, peer, axis="tp")``.
+    """
+    if peer is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(
+            sem, inc=inc,
+            device_id=_resolve_device_id(ctx, axis, peer),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+
+def signal_op(sig_sem, signal, sig_op: str, peer, *, axis: str, ctx=None):
+    """Reference ``libshmem_device.signal_op(ptr, val, SIGNAL_*, pe)``.
+
+    TPU semaphores are counting: ADD maps to an increment; SET-to-value
+    protocols must be re-expressed as counts (the collective kernels use
+    monotonically increasing per-tile counts instead of set-flags).
+    """
+    if sig_op != SIGNAL_ADD:
+        raise NotImplementedError(
+            "SIGNAL_SET has no TPU analogue; use counting (SIGNAL_ADD) "
+            "protocols — see ops/collectives for the patterns.")
+    notify(sig_sem, peer, axis=axis, ctx=ctx, inc=signal)
+
+
+def wait(sem, value: int = 1):
+    """Block until ``sem``'s count reaches ``value``; decrements by
+    ``value`` (TPU semaphore-wait semantics).
+
+    Reference: ``dl.wait(barrierPtrs, numBarriers, scope, semantic)``
+    (``distributed_ops.py:57``) — the PTX acquire spin loop
+    (``DistributedOpToLLVM.cpp:156-229``) becomes a hardware semaphore
+    wait: no SM/core spinning, the scalar unit sleeps until count.
+    """
+    pltpu.semaphore_wait(sem, value)
+
+
+def signal_wait_until(sem, cmp: str, value: int):
+    """Reference ``libshmem_device.signal_wait_until(ptr, CMP_EQ, val)``.
+
+    Only >=-then-consume (counting) semantics exist on TPU; CMP_EQ with
+    monotone counters is equivalent to waiting for the count."""
+    if cmp not in ("eq", "ge"):
+        raise NotImplementedError(f"cmp {cmp!r} not expressible on TPU")
+    pltpu.semaphore_wait(sem, value)
+
+
+def wait_arrivals(sem, ref, count: int = 1):
+    """Wait for ``count`` DMA deliveries of ``ref``'s size on a *DMA*
+    semaphore. TPU DMA semaphores count transfer units, so an aggregate
+    arrival wait is expressed as ``count`` descriptor waits of the common
+    chunk shape (``count`` must be static).
+
+    This is the consumer half of the reference's per-tile
+    ``signal_wait_until`` on flag words (``distributed_ops.py:57``).
+    """
+    for _ in range(count):
+        pltpu.make_async_copy(ref, ref, sem).wait()
+
+
+def consume_token(value, token=None):
+    """API-parity no-op (reference ``dl.consume_token``,
+    ``distributed_ops.py:74``): Mosaic already orders reads after the
+    semaphore waits that guard them."""
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Barriers
+# ---------------------------------------------------------------------------
+
+def barrier_all(axis: str, *, ctx=None):
+    """Barrier over all devices along ``axis``.
+
+    Full-mesh signal + wait on the global barrier semaphore — the
+    analogue of ``libshmem_device.barrier_all`` / the reference's
+    ``barrier_all_intra_node_*`` kernels (``kernels/nvidia/common_ops.py``).
+    Requires ``collective_id`` in the kernel's CompilerParams.
+    """
+    n = num_ranks(axis)
+    sem = pltpu.get_barrier_semaphore()
+    for peer in range(n):
+        pltpu.semaphore_signal(
+            sem, inc=1,
+            device_id=_resolve_device_id(ctx, axis, peer),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(sem, n)
+
+
+def barrier_tile(axis: str, *, ctx=None, sem=None):
+    """Neighbour-pair barrier (cheaper than :func:`barrier_all`): signal
+    both ring neighbours, wait for both.
+
+    Uses the *global* barrier semaphore (keyed by the kernel's
+    ``collective_id``) by default: unlike scratch semaphores it is safe
+    against skewed kernel entry — a fast peer's signal cannot alias into
+    whatever kernel this device is still running.
+    """
+    if sem is None:
+        sem = pltpu.get_barrier_semaphore()
+    n = num_ranks(axis)
+    me = rank(axis)
+    left = jax.lax.rem(me + n - 1, n)
+    right = jax.lax.rem(me + 1, n)
+    notify(sem, left, axis=axis, ctx=ctx)
+    notify(sem, right, axis=axis, ctx=ctx)
+    wait(sem, 2)
+
+
+# ---------------------------------------------------------------------------
+# Local copies (HBM<->VMEM staging helpers)
+# ---------------------------------------------------------------------------
+
+def local_copy(src_ref, dst_ref):
+    """Synchronous local DMA (for ANY/HBM-space refs)."""
+    pltpu.sync_copy(src_ref, dst_ref)
+
+
+def local_copy_async(src_ref, dst_ref, sem, *, start: bool = True):
+    copy = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    if start:
+        copy.start()
+    return copy

@@ -1,0 +1,20 @@
+"""Fused distributed operators (the analogue of
+``python/triton_dist/kernels/`` — SURVEY.md §2.5, the heart of the
+reference). Every op has:
+
+- a Pallas implementation (``impl="pallas"``): DMA/semaphore overlapped
+  kernels designed for ICI,
+- an XLA reference implementation (``impl="xla"``): ``jax.lax``
+  collectives + einsum — the correctness oracle (the role PyTorch
+  collectives play in the reference's tests, SURVEY.md §4) and the
+  portable fallback.
+"""
+
+from triton_dist_tpu.ops.allgather import all_gather, all_gather_ref  # noqa: F401
+from triton_dist_tpu.ops.reduce_scatter import (  # noqa: F401
+    reduce_scatter, reduce_scatter_ref,
+)
+from triton_dist_tpu.ops.allreduce import (  # noqa: F401
+    all_reduce, all_reduce_ref, AllReduceMethod,
+)
+from triton_dist_tpu.ops.p2p import p2p_put, ppermute_ref  # noqa: F401

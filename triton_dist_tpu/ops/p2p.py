@@ -1,0 +1,78 @@
+"""Point-to-point put kernels (pipeline-parallel transport).
+
+Reference: ``python/triton_dist/kernels/nvidia/p2p.py`` (150 LoC put/get)
+backing ``layers/nvidia/pp_block.py``. TPU form: a static permutation of
+one-sided puts — each (src → dst) edge is one remote DMA; receivers wait
+arrival counts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def ppermute_ref(x, perm: Sequence[Tuple[int, int]], *, axis: str = "pp",
+                 **_):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def _p2p_kernel(x_ref, out_ref, zero_v, send_sem, recv_sem, *,
+                axis: str, ctx: MeshContext,
+                perm: Tuple[Tuple[int, int], ...]):
+    me = dl.rank(axis)
+
+    n_recv_static = {}
+    for _, dst in perm:
+        n_recv_static[dst] = n_recv_static.get(dst, 0) + 1
+
+    # Non-receivers produce zeros (lax.ppermute semantics). Must happen
+    # before the barrier so no peer's put can race the zero-fill.
+    zero_v[...] = jnp.zeros_like(zero_v)
+    pltpu.sync_copy(zero_v, out_ref)
+    dl.barrier_all(axis, ctx=ctx)
+
+    for src, dst in perm:
+        @pl.when(me == src)
+        def _():
+            copy = dl.remote_put(x_ref, out_ref, send_sem, recv_sem, dst,
+                                 axis=axis, ctx=ctx)
+            copy.wait_send()
+
+    # Wait for my arrivals (semaphore_wait needs a static value; emit
+    # per-destination predicated waits).
+    for dst, cnt in n_recv_static.items():
+        @pl.when(me == dst)
+        def _():
+            dl.wait_arrivals(recv_sem, out_ref, cnt)
+
+
+def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
+            axis: str = "pp"):
+    """One-sided put along a static permutation (inside shard_map).
+
+    Devices that receive nothing get zeros (matching ``lax.ppermute``).
+    """
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    kernel = functools.partial(_p2p_kernel, axis=axis, ctx=ctx, perm=perm)
+    return core_call(
+        kernel,
+        comm=True,
+        out_shape=jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM(tuple(x.shape), x.dtype),  # zero_v
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )(x)

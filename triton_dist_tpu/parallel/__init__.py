@@ -1,0 +1,6 @@
+from triton_dist_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    MeshContext,
+    make_mesh,
+    logical_device_id,
+)

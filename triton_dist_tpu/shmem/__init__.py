@@ -1,0 +1,5 @@
+from triton_dist_tpu.shmem.workspace import (  # noqa: F401
+    symm_tensor,
+    symm_spec,
+    barrier_all,
+)

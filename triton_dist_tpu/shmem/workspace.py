@@ -1,0 +1,65 @@
+"""Host-side symmetric-buffer management ("icishmem").
+
+Reference analogue: ``nvshmem_create_tensor`` / ``nvshmem_create_tensors``
+(``python/triton_dist/utils.py:252,272``) allocate one buffer at the same
+symmetric-heap offset on every GPU, plus per-peer P2P views.
+
+On TPU the symmetric heap falls out of SPMD: a global array sharded over a
+mesh axis gives every device an identically-shaped local shard at an
+address the RDMA engine can target on any peer ("symmetric address" =
+same Ref in the same kernel on the peer core). So:
+
+- ``symm_tensor(mesh, local_shape, ...)`` returns a *global* zeros array
+  whose per-device shard (under ``shard_map`` with ``symm_spec``) is
+  ``local_shape`` — pass it into kernels as workspace, alias it to an
+  output (``input_output_aliases``) if it must persist across calls.
+- per-peer views need no API: a kernel addresses peer buffers directly in
+  ``make_async_remote_copy(device_id=...)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def symm_spec(axis: str = "tp", ndim: int = 2) -> P:
+    """PartitionSpec placing the symmetric (per-rank) dim first."""
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def symm_tensor(mesh: Mesh, local_shape: Tuple[int, ...], dtype=jnp.float32,
+                axis: str = "tp") -> jax.Array:
+    """Symmetric workspace: every device along ``axis`` owns a zeroed
+    ``local_shape`` shard of one global array.
+
+    Reference: ``nvshmem_create_tensor(shape, dtype)`` (utils.py:252).
+    """
+    n = mesh.shape[axis]
+    global_shape = (n * local_shape[0],) + tuple(local_shape[1:])
+    sharding = NamedSharding(mesh, symm_spec(axis, len(local_shape)))
+    return jax.device_put(jnp.zeros(global_shape, dtype), sharding)
+
+
+def barrier_all(mesh: Mesh, axis: str = "tp") -> None:
+    """Host-level device barrier along ``axis`` — the analogue of
+    ``nvshmem_barrier_all_on_stream`` (utils.py:325).
+
+    XLA programs are already bulk-synchronous per dispatch; this exists
+    for test scaffolding and for flushing outstanding async work: it runs
+    a trivial psum across the axis and blocks until ready.
+    """
+    @jax.jit
+    def _bar():
+        def inner(x):
+            return jax.lax.psum(x, axis)
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=P(), out_specs=P(), check_vma=False,
+        )(jnp.zeros((), jnp.int32))
+
+    _bar().block_until_ready()

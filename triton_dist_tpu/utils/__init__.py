@@ -1,0 +1,10 @@
+from triton_dist_tpu.utils.distributed import (  # noqa: F401
+    dist_print,
+    initialize_distributed,
+    finalize_distributed,
+    on_tpu,
+    platform,
+    use_interpret,
+    set_interpret,
+    interpret_mode,
+)

@@ -1,0 +1,131 @@
+"""Host-side distributed runtime bring-up.
+
+TPU-native analogue of the reference's host runtime
+(``python/triton_dist/utils.py:341`` ``initialize_distributed`` /
+``:229`` ``init_nvshmem_by_torch_process_group``): instead of a torchrun
+process group + NVSHMEM symmetric heap, a JAX program is a single SPMD
+computation over a :class:`jax.sharding.Mesh`; multi-host bring-up is
+``jax.distributed.initialize`` and the "symmetric heap" is simply sharded
+device arrays addressed by remote DMA (see ``triton_dist_tpu.shmem``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Optional
+
+import jax
+
+
+# ---------------------------------------------------------------------------
+# Platform predicates (reference: utils.py:51-112 is_cuda()/is_rocshmem()/...)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def platform() -> str:
+    """Backend platform string: "tpu", "cpu", "gpu", or vendor plugin name."""
+    p = jax.devices()[0].platform
+    # The axon PJRT plugin surfaces real TPU devices under platform "axon".
+    if p == "axon":
+        return "tpu"
+    return p
+
+
+def on_tpu() -> bool:
+    return platform() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode plumbing.
+#
+# The reference has no fake/mock comm backend (SURVEY.md §4); we make one
+# first-class: every pallas_call in this package routes its ``interpret``
+# argument through use_interpret(), so the full kernel battery runs on a
+# CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=N).
+# ---------------------------------------------------------------------------
+
+_INTERPRET_OVERRIDE: Optional[bool] = None
+
+
+def set_interpret(value: Optional[bool]) -> None:
+    """Force interpret mode on/off globally (None = auto: on unless on TPU)."""
+    global _INTERPRET_OVERRIDE
+    _INTERPRET_OVERRIDE = value
+
+
+def use_interpret() -> bool:
+    if _INTERPRET_OVERRIDE is not None:
+        return _INTERPRET_OVERRIDE
+    return not on_tpu()
+
+
+def interpret_arg():
+    """Value to pass as ``pl.pallas_call(interpret=...)``."""
+    if use_interpret():
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams(dma_execution_mode="eager")
+    return False
+
+
+@contextlib.contextmanager
+def interpret_mode(value: bool = True):
+    global _INTERPRET_OVERRIDE
+    prev = _INTERPRET_OVERRIDE
+    _INTERPRET_OVERRIDE = value
+    try:
+        yield
+    finally:
+        _INTERPRET_OVERRIDE = prev
+
+
+# ---------------------------------------------------------------------------
+# Bring-up / teardown
+# ---------------------------------------------------------------------------
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX if the standard env vars are present.
+
+    Single-host (including the CPU-mesh test configuration) needs no
+    initialization; multi-host pods read ``COORDINATOR_ADDRESS`` /
+    ``NUM_PROCESSES`` / ``PROCESS_ID`` (or the arguments), mirroring the
+    torchrun env-var contract in the reference (``utils.py:342-347``).
+    """
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes or _int_env("NUM_PROCESSES")
+    pid = process_id if process_id is not None else _int_env("PROCESS_ID")
+    if addr and nproc and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc,
+                                   process_id=pid or 0)
+
+
+def finalize_distributed() -> None:
+    """Reference: utils.py:302 finalize_distributed."""
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+# ---------------------------------------------------------------------------
+# Rank-aware printing (reference: utils.py:445 dist_print)
+# ---------------------------------------------------------------------------
+
+def dist_print(*args, allowed_ranks=(0,), prefix: bool = True, **kwargs):
+    """Print only on the allowed process indices (host-level ranks)."""
+    rank = jax.process_index()
+    if allowed_ranks == "all" or rank in tuple(allowed_ranks):
+        if prefix:
+            print(f"[rank {rank}]", *args, **kwargs)
+        else:
+            print(*args, **kwargs)

@@ -1,0 +1,37 @@
+"""Test scaffolding.
+
+The reference's testing contract (SURVEY.md §4): every fused op has a
+pure-framework reference implementation and an allclose gate, tests run
+on one host with N local devices, a conftest-style spawner abstracts
+world bring-up. Here "N local devices" is the forced-host-platform CPU
+mesh and the spawner is :func:`spmd` (no processes needed — shard_map is
+the SPMD region).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: F401
+
+
+def spmd(mesh: Mesh, fn, in_specs, out_specs, jit: bool = True):
+    """Wrap a per-shard fn into a jitted SPMD callable over ``mesh``.
+
+    The analogue of launching a reference test under torchrun
+    (``scripts/launch.sh``): inside ``fn`` the code sees per-device
+    shards and named axes.
+    """
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    return jax.jit(mapped) if jit else mapped
+
+
+def assert_allclose(actual: Any, desired: Any, rtol: float = 1e-5,
+                    atol: float = 1e-5, msg: str = ""):
+    actual = jax.device_get(actual)
+    desired = jax.device_get(desired)
+    np.testing.assert_allclose(actual, desired, rtol=rtol, atol=atol,
+                               err_msg=msg)
