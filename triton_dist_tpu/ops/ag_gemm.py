@@ -1,0 +1,220 @@
+"""Fused AllGather + GEMM (tensor-parallel column-linear forward).
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_gemm.py`` —
+``create_ag_gemm_context`` (:511), ``ag_gemm`` (:570), persistent consumer
+GEMM with per-tile ``dl.wait`` on rank barriers (:200) fed by copy-engine
+pushes (``allgather.py:202``).
+
+TPU redesign (one kernel, no producer stream): the GEMM grid's outermost
+dimension *is* the ring schedule. Iteration ``k`` computes the output
+rows of chunk ``c = (me - k) % n``:
+
+- ``k = 0``: my own A chunk — compute starts immediately, zero exposed
+  comm latency (the tile-swizzle trick of the reference consumer,
+  ``allgather_gemm.py:~200``, falls out of the grid order).
+- each ``k``: chunk ``c``'s arrival is certified by one DMA-semaphore
+  wait, then the chunk is forwarded right (ring push) while the MXU
+  works on it — compute hides the transfer of the *next* chunk.
+
+A chunks ride manual RDMA into an HBM workspace (Pallas pipelining must
+not prefetch not-yet-arrived data); A row-tiles are staged per K-block
+into VMEM manually, B tiles and C tiles use pipelined BlockSpecs. The
+inner ``kk`` grid dimension tiles the contraction so arbitrary K fits
+VMEM, accumulating in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGemmContext:
+    """Analogue of ``AllGatherGEMMTensorParallelContext``
+    (reference ``allgather_gemm.py:449``)."""
+    mesh: MeshContext
+    axis: str = "tp"
+    block_m: int = 256
+    block_n: int = 256
+    block_k: int = 512
+    out_dtype: Optional[jnp.dtype] = None
+
+
+def create_ag_gemm_context(mesh: MeshContext, axis: str = "tp",
+                           block_m: int = 256, block_n: int = 256,
+                           block_k: int = 512,
+                           out_dtype=None) -> AGGemmContext:
+    return AGGemmContext(mesh=mesh, axis=axis, block_m=block_m,
+                         block_n=block_n, block_k=block_k,
+                         out_dtype=out_dtype)
+
+
+def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
+    """Oracle: lax.all_gather + einsum (the reference's ``ag_gemm_torch``
+    pattern, ``test/nvidia/test_ag_gemm.py:62-69``)."""
+    a_full = jax.lax.all_gather(a, axis, axis=0, tiled=True)
+    return jnp.dot(a_full, b, preferred_element_type=jnp.float32
+                   ).astype(a.dtype)
+
+
+def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_tile, acc_v, send_sem,
+                    recv_sem, *, axis: str, ctx: MeshContext, m_loc: int,
+                    tm: int, tk: int, n_ranks: int):
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    c = jax.lax.rem(me - k + n, n)
+    right = jax.lax.rem(me + 1, n)
+
+    chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
+
+    first = jnp.logical_and(
+        k == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        # Peers must be in-kernel before any remote traffic.
+        dl.barrier_tile(axis, ctx=ctx)
+        # Local chunk into the workspace, then kick off the ring.
+        pltpu.sync_copy(a_ref, chunk_of(me))
+        if n > 1:
+            dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
+                          recv_sem.at[0], right, axis=axis, ctx=ctx)
+
+    chunk_start = jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0))
+
+    @pl.when(jnp.logical_and(k > 0, chunk_start))
+    def _():
+        # Chunk c arrives from the left neighbour's step-(k-1) put.
+        dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(c), 1)
+
+        # Forward it right (steps 1..n-2) while we compute on it.
+        @pl.when(k < n - 1)
+        def _():
+            dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
+                          recv_sem.at[k], right, axis=axis, ctx=ctx)
+
+    @pl.when(j == 0)
+    def _():
+        # Stage this chunk's (row-tile, K-tile) for the whole j sweep.
+        pltpu.sync_copy(
+            a_ws.at[pl.ds(c * m_loc + i * tm, tm), pl.ds(kk * tk, tk)],
+            a_tile)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_tile[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+    # Drain send semaphores before kernel exit.
+    last = jnp.logical_and(
+        k == n - 1,
+        jnp.logical_and(i == n_i - 1,
+                        jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for s in range(n - 1):
+            dl.wait_arrivals(send_sem.at[s], chunk_of(0), 1)
+
+
+def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
+    """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
+
+    ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;
+    ``b``: (K, N_loc) — column-parallel weight shard.
+    Returns C of shape (n·M_loc, N_loc); with ``return_ag=True`` also the
+    gathered A — the workspace the ring already filled, exposed as a
+    second kernel output at no extra traffic (reference reuses the AG
+    buffer for QKV projections, ``layers/nvidia/tp_attn.py``).
+    """
+    mesh = ctx.mesh
+    n = mesh.size(ctx.axis)
+    m_loc, kdim = a.shape
+    _, n_loc = b.shape
+    out_dtype = ctx.out_dtype or a.dtype
+    if n == 1:
+        c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+        return (c, a) if return_ag else c
+
+    tm = min(ctx.block_m, m_loc)
+    tn = min(ctx.block_n, n_loc)
+    tk = min(ctx.block_k, kdim)
+    if m_loc % tm or n_loc % tn or kdim % tk:
+        raise ValueError(
+            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
+            f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
+    n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
+    m_full = n * m_loc
+
+    def c_index(k, i, j, kk):
+        me = jax.lax.axis_index(ctx.axis)
+        c = jax.lax.rem(me - k + n, n)
+        return (c * n_i + i, j)
+
+    kernel = functools.partial(
+        _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
+        tk=tk, n_ranks=n)
+
+    out_shapes = [jax.ShapeDtypeStruct((m_full, n_loc), out_dtype)]
+    out_specs = [pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM)]
+    scratch = [
+        pltpu.VMEM((tm, tk), a.dtype),              # a_tile
+        pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
+        pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
+        pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
+    ]
+    if return_ag:
+        # Expose the workspace as a second output: the ring fills it, the
+        # caller gets gathered A for free.
+        out_shapes.append(jax.ShapeDtypeStruct((m_full, kdim), a.dtype))
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    else:
+        scratch.insert(0, pltpu.HBM((m_full, kdim), a.dtype))  # a_ws
+
+    # Either way the kernel sees (..., o_ref, a_ws, a_tile, ...): as
+    # output #2 or as scratch #0, a_ws sits right after the C output.
+    result = core_call(
+        kernel,
+        comm=True,
+        grid=(n, n_i, n_j, n_k),
+        out_shape=tuple(out_shapes) if return_ag else out_shapes[0],
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # a (manual RDMA)
+            pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(out_specs) if return_ag else out_specs[0],
+        scratch_shapes=scratch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_full * kdim * n_loc,
+            bytes_accessed=(m_full * kdim + kdim * n_loc * n * n_i
+                            + m_full * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a, b)
+    return result

@@ -1,0 +1,156 @@
+"""Fused GEMM + AllReduce (small-M / decode path).
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_allreduce.py`` (840
+LoC) — ``gemm_allreduce_op`` and the fused multimem low-latency variant;
+used by ``GemmARLayer`` (``layers/nvidia/gemm_allreduce_layer.py:34``)
+for small-batch decode where ReduceScatter+AllGather latency dominates.
+
+TPU redesign: one-shot scheme in one kernel — each device computes its
+K-shard partial product tile-by-tile, pushes each finished tile to every
+peer's gather workspace (the transfer of tile t overlaps the MXU on tile
+t+1), then reduces the n arrivals locally. Latency-optimal when M is a
+few hundred rows (decode); for large M use :func:`gemm_rs` + AllGather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmARContext:
+    mesh: MeshContext
+    axis: str = "tp"
+    block_n: int = 512
+    block_k: int = 512
+    out_dtype: Optional[jnp.dtype] = None
+
+
+def create_gemm_ar_context(mesh: MeshContext, axis: str = "tp",
+                           block_n: int = 512, block_k: int = 512,
+                           out_dtype=None) -> GemmARContext:
+    return GemmARContext(mesh=mesh, axis=axis, block_n=block_n,
+                         block_k=block_k, out_dtype=out_dtype)
+
+
+def gemm_ar_ref(a, b, *, axis: str = "tp", **_):
+    partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    return jax.lax.psum(partial, axis).astype(a.dtype)
+
+
+def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
+                    send_sem, recv_sem, *, axis: str, ctx: MeshContext,
+                    m: int, tn: int, n_ranks: int):
+    j = pl.program_id(0)
+    kk = pl.program_id(1)
+    n_j = pl.num_programs(0)
+    n_k = pl.num_programs(1)
+    me = dl.rank(axis)
+    n = n_ranks
+
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _():
+        dl.barrier_all(axis, ctx=ctx)
+
+    # Partial product for this N-tile, accumulated over K blocks.
+    @pl.when(kk == 0)
+    def _():
+        part_v[...] = jnp.zeros_like(part_v)
+
+    part_v[...] += jnp.dot(a_ref[...], b_ref[...],
+                           preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        my_slot = gather_hbm.at[me, :, pl.ds(j * tn, tn)]
+        pltpu.sync_copy(part_v, my_slot)
+
+        # Push the finished tile to every peer; transfers overlap the
+        # next tile's matmul.
+        for peer_off in range(1, n):
+            peer = jax.lax.rem(me + peer_off, n)
+            dl.remote_put(my_slot, my_slot,
+                          send_sem.at[(peer_off - 1)], recv_sem, peer,
+                          axis=axis, ctx=ctx)
+
+    @pl.when(jnp.logical_and(j == n_j - 1, kk == n_k - 1))
+    def _():
+        # All tiles pushed; await the (n-1) peers' full partials.
+        tile_ref = gather_hbm.at[0, :, pl.ds(0, tn)]
+        dl.wait_arrivals(recv_sem, tile_ref, (n - 1) * n_j)
+        for t in range(n - 1):
+            dl.wait_arrivals(send_sem.at[t], tile_ref, n_j)
+
+        # Reduce: sum the n gather slots into the output.
+        for jj in range(n_j):
+            acc = None
+            for r in range(n):
+                pltpu.sync_copy(
+                    gather_hbm.at[r, :, pl.ds(jj * tn, tn)], tmp_v)
+                acc = tmp_v[...] if acc is None else acc + tmp_v[...]
+            out_v[...] = acc.astype(out_v.dtype)
+            pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
+
+
+def gemm_ar(a, b, ctx: GemmARContext):
+    """Overlapped per-shard (A @ B) all-reduced along ``ctx.axis``.
+
+    ``a``: (M, K_loc); ``b``: (K_loc, N). Returns the fully-reduced
+    (M, N) on every device. Designed for small M (decode).
+    """
+    mesh = ctx.mesh
+    n = mesh.size(ctx.axis)
+    m, k_loc = a.shape
+    _, n_dim = b.shape
+    out_dtype = ctx.out_dtype or a.dtype
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32
+                       ).astype(out_dtype)
+    tn = min(ctx.block_n, n_dim)
+    tk = min(ctx.block_k, k_loc)
+    if n_dim % tn or k_loc % tk:
+        raise ValueError(
+            f"block sizes (block_n={tn}, block_k={tk}) must divide "
+            f"(N={n_dim}, K_loc={k_loc})")
+    n_j, n_k = n_dim // tn, k_loc // tk
+
+    kernel = functools.partial(_gemm_ar_kernel, axis=ctx.axis, ctx=mesh,
+                               m=m, tn=tn, n_ranks=n)
+    return core_call(
+        kernel,
+        comm=True,
+        grid=(n_j, n_k),
+        out_shape=jax.ShapeDtypeStruct((m, n_dim), out_dtype),
+        in_specs=[
+            pl.BlockSpec((m, tk), lambda j, kk: (0, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.HBM((n, m, n_dim), jnp.float32),       # gather_hbm
+            pltpu.VMEM((m, tn), jnp.float32),             # part_v
+            pltpu.VMEM((m, tn), jnp.float32),             # tmp_v
+            pltpu.VMEM((m, tn), out_dtype),               # out_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),    # send_sem
+            pltpu.SemaphoreType.DMA(()),                  # recv_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k_loc * n_dim,
+            bytes_accessed=(m * k_loc + k_loc * n_dim
+                            + (n + 1) * m * n_dim) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a, b)

@@ -1,0 +1,202 @@
+"""Fused GEMM + ReduceScatter (tensor-parallel row-linear forward).
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py``
+(producer GEMM signalling per-tile, :233/:384) + ``reduce_scatter.py``
+consumer; host API ``gemm_rs`` (:754).
+
+TPU redesign — a ring-reduce fused into the GEMM grid: step ``s``
+computes the partial product for the output chunk owned by device
+``c = (me - s - 1) % n``, adds the partial received from the left
+neighbour (which already accumulated s upstream devices), and forwards
+the running sum right. After ``n`` steps the fully-reduced chunk ``me``
+is written out. Compute of step ``s+1`` overlaps the transfer of step
+``s``'s running sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRSContext:
+    """Analogue of the reference's ``create_gemm_rs_context``
+    (``gemm_reduce_scatter.py:51``)."""
+    mesh: MeshContext
+    axis: str = "tp"
+    block_m: int = 256
+    block_n: int = 256
+    block_k: int = 512
+    out_dtype: Optional[jnp.dtype] = None
+
+
+def create_gemm_rs_context(mesh: MeshContext, axis: str = "tp",
+                           block_m: int = 256, block_n: int = 256,
+                           block_k: int = 512,
+                           out_dtype=None) -> GemmRSContext:
+    return GemmRSContext(mesh=mesh, axis=axis, block_m=block_m,
+                         block_n=block_n, block_k=block_k,
+                         out_dtype=out_dtype)
+
+
+def gemm_rs_ref(a, b, *, axis: str = "tp", **_):
+    """Oracle: einsum + psum_scatter."""
+    partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                                tiled=True).astype(a.dtype)
+
+
+def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
+                    out_v, send_sem, recv_sem, *, axis: str,
+                    ctx: MeshContext, m_loc: int, tm: int, tn: int,
+                    n_ranks: int):
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    right = jax.lax.rem(me + 1, n)
+
+    first = jnp.logical_and(
+        s == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_tile(axis, ctx=ctx)
+
+    chunk_start = jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0))
+
+    @pl.when(jnp.logical_and(s > 0, chunk_start))
+    def _():
+        # Running sum for this step's chunk arrives from the left.
+        dl.wait_arrivals(recv_sem.at[s - 1], recv_hbm.at[s - 1], 1)
+
+    # Partial product for this (tile, K-block), accumulated over kk.
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_ref[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        @pl.when(s > 0)
+        def _():
+            # Add the accumulated partial from upstream devices.
+            pltpu.sync_copy(
+                recv_hbm.at[s - 1, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
+                tmp_v)
+            acc_v[...] = acc_v[...] + tmp_v[...]
+
+        @pl.when(s < n - 1)
+        def _():
+            pltpu.sync_copy(acc_v, send_hbm.at[s, pl.ds(i * tm, tm),
+                                               pl.ds(j * tn, tn)])
+
+            # Chunk complete → forward the running sum right.
+            @pl.when(jnp.logical_and(i == n_i - 1, j == n_j - 1))
+            def _():
+                dl.remote_put(send_hbm.at[s], recv_hbm.at[s],
+                              send_sem.at[s], recv_sem.at[s], right,
+                              axis=axis, ctx=ctx)
+
+        @pl.when(s == n - 1)
+        def _():
+            # Fully reduced tile of my own chunk (manual store: the
+            # output is only defined at the last ring step, so it cannot
+            # be a pipelined BlockSpec).
+            out_v[...] = acc_v[...].astype(out_v.dtype)
+            pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
+                                            pl.ds(j * tn, tn)])
+
+    last = jnp.logical_and(
+        s == n - 1,
+        jnp.logical_and(i == n_i - 1,
+                        jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(last)
+    def _():
+        for t in range(n - 1):
+            dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
+
+
+def gemm_rs(a, b, ctx: GemmRSContext):
+    """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``.
+
+    ``a``: (M, K_loc) — activations, K sharded (row-parallel);
+    ``b``: (K_loc, N) — row-parallel weight shard.
+    Returns C shard of shape (M / n, N).
+    """
+    mesh = ctx.mesh
+    n = mesh.size(ctx.axis)
+    m_full, k_loc = a.shape
+    _, n_dim = b.shape
+    out_dtype = ctx.out_dtype or a.dtype
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32
+                       ).astype(out_dtype)
+    if m_full % n:
+        raise ValueError(f"M={m_full} not divisible by axis size {n}")
+    m_loc = m_full // n
+    tm = min(ctx.block_m, m_loc)
+    tn = min(ctx.block_n, n_dim)
+    tk = min(ctx.block_k, k_loc)
+    if m_loc % tm or n_dim % tn or k_loc % tk:
+        raise ValueError(
+            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
+            f"divide (M_loc={m_loc}, N={n_dim}, K_loc={k_loc})")
+    n_i, n_j, n_k = m_loc // tm, n_dim // tn, k_loc // tk
+
+    def a_index(s, i, j, kk):
+        me = jax.lax.axis_index(ctx.axis)
+        c = jax.lax.rem(me - s - 1 + n, n)
+        return (c * n_i + i, kk)
+
+    kernel = functools.partial(
+        _gemm_rs_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
+        tn=tn, n_ranks=n)
+
+    return core_call(
+        kernel,
+        comm=True,
+        grid=(n, n_i, n_j, n_k),
+        out_shape=jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+        in_specs=[
+            pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda s, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.HBM((n - 1, m_loc, n_dim), jnp.float32),  # recv_hbm
+            pltpu.HBM((n - 1, m_loc, n_dim), jnp.float32),  # send_hbm
+            pltpu.VMEM((tm, tn), jnp.float32),               # acc_v
+            pltpu.VMEM((tm, tn), jnp.float32),               # tmp_v
+            pltpu.VMEM((tm, tn), out_dtype),                 # out_v
+            pltpu.SemaphoreType.DMA((n - 1,)),               # send_sem
+            pltpu.SemaphoreType.DMA((n - 1,)),               # recv_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_full * k_loc * n_dim,
+            bytes_accessed=(m_full * k_loc + k_loc * n_dim * n * n_i
+                            + m_loc * n_dim) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a, b)
