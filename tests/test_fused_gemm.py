@@ -107,10 +107,11 @@ def test_gemm_ar_bf16(tp8_mesh, tp8_ctx):
 
 
 def test_ag_gemm_ktiled(tp8_mesh, tp8_ctx):
-    """Exercise the inner-K accumulation loop (n_k > 1)."""
+    """Exercise n_k > 1 together with n_j > 1 (regression: the A panel
+    must stay valid across the whole j sweep, not just j == 0)."""
     a = _rand((256, 64), 12)
     b = _rand((64, 64), 13)
-    ctx = create_ag_gemm_context(tp8_ctx, block_m=16, block_n=8, block_k=16)
+    ctx = create_ag_gemm_context(tp8_ctx, block_m=16, block_n=4, block_k=16)
     f = spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),

@@ -1,0 +1,16 @@
+"""nn-style distributed layers (analogue of ``python/triton_dist/layers/``,
+SURVEY.md §2.6).
+
+Layers are *functional*: each is a namespace of ``init(key, cfg) ->
+params`` / ``fwd(params, x, ...) -> y`` functions operating on per-shard
+values inside ``shard_map``, plus a ``param_specs`` pytree of
+PartitionSpecs for placing the weights on the mesh. Forward-mode
+selection mirrors the reference's ``set_fwd('torch'|'triton_dist'|
+'triton_dist_AR')`` (``models/dense.py:146``): ``"xla"`` (lax
+collectives — oracle/portable), ``"fused"`` (ag_gemm + gemm_rs
+overlapped kernels), ``"fused_ar"`` (gemm_ar decode path).
+"""
+
+from triton_dist_tpu.layers.norm import rms_norm  # noqa: F401
+from triton_dist_tpu.layers.rope import apply_rope, rope_freqs  # noqa: F401
+from triton_dist_tpu.layers import tp_mlp, tp_attn  # noqa: F401

@@ -1,0 +1,14 @@
+"""RMSNorm (Qwen3 uses pre-norm RMSNorm everywhere, plus per-head q/k
+norms; reference: the torch ops inside ``models/dense.py`` layers)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    x = x * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (x * weight.astype(jnp.float32)).astype(dtype)

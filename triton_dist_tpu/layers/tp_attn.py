@@ -1,0 +1,193 @@
+"""Tensor-parallel attention (GQA + rope + Qwen3 q/k-norm).
+
+Reference: ``layers/nvidia/tp_attn.py:80`` ``TP_Attn`` — QKV via ag_gemm
+(AG buffer reused across the three projections), flash attention, O via
+gemm_rs; gemm_ar mode for decode.
+
+Heads are sharded along ``tp``; the residual stream is token-sharded
+(sequence parallel) in "xla"/"fused" modes and replicated in "fused_ar"
+decode mode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.layers.rope import apply_rope, rope_freqs
+from triton_dist_tpu.ops import ag_gemm, gemm_rs, gemm_ar
+
+
+def init(key, cfg, dtype=jnp.float32) -> Dict:
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    d = cfg.hidden_size
+    hd = cfg.head_dim
+    scale = d ** -0.5
+    return {
+        "wq": jax.random.normal(kq, (d, cfg.num_attention_heads * hd),
+                                dtype) * scale,
+        "wk": jax.random.normal(kk, (d, cfg.num_key_value_heads * hd),
+                                dtype) * scale,
+        "wv": jax.random.normal(kv, (d, cfg.num_key_value_heads * hd),
+                                dtype) * scale,
+        "wo": jax.random.normal(
+            ko, (cfg.num_attention_heads * hd, d), dtype
+        ) * ((cfg.num_attention_heads * hd) ** -0.5),
+        "q_norm": jnp.ones((hd,), dtype),
+        "k_norm": jnp.ones((hd,), dtype),
+    }
+
+
+def param_specs(axis: str = "tp") -> Dict:
+    return {
+        "wq": P(None, axis),
+        "wk": P(None, axis),
+        "wv": P(None, axis),
+        "wo": P(axis, None),
+        "q_norm": P(None),
+        "k_norm": P(None),
+    }
+
+
+def _head_split(cfg, n: int):
+    """Per-device head counts; KV-head replication for n > KV-heads is
+    not implemented yet, so fail loudly rather than mis-reshape."""
+    if cfg.num_attention_heads % n:
+        raise ValueError(
+            f"num_attention_heads={cfg.num_attention_heads} not divisible "
+            f"by tp={n}")
+    if cfg.num_key_value_heads % n:
+        raise ValueError(
+            f"num_key_value_heads={cfg.num_key_value_heads} not divisible "
+            f"by tp={n} (KV-head replication unimplemented)")
+    return cfg.num_attention_heads // n, cfg.num_key_value_heads // n
+
+
+def _project_qkv(params, x, *, mode, axis, ag_ctx):
+    """Returns (q, k, v) as (tokens_full, *_loc) plus tokens_full count."""
+    if mode == "xla":
+        x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        q = jnp.dot(x_full, params["wq"])
+        k = jnp.dot(x_full, params["wk"])
+        v = jnp.dot(x_full, params["wv"])
+    elif mode == "fused":
+        q, x_full = ag_gemm(x, params["wq"], ag_ctx, return_ag=True)
+        k = jnp.dot(x_full, params["wk"])
+        v = jnp.dot(x_full, params["wv"])
+    elif mode == "fused_ar":
+        # Replicated tokens: plain local projections.
+        q = jnp.dot(x, params["wq"])
+        k = jnp.dot(x, params["wk"])
+        v = jnp.dot(x, params["wv"])
+    else:
+        raise ValueError(f"unknown TP_Attn mode {mode!r}")
+    return q, k, v
+
+
+def _norm_rope(q, k, params, cfg, positions):
+    """q: (B, S, H_loc, hd); k: (B, S, KV_loc, hd)."""
+    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
+    inv_freq = rope_freqs(cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k
+
+
+def sdpa(q, k, v, *, causal: bool, kv_len=None):
+    """q: (B, Sq, H, hd); k/v: (B, Skv, KV, hd). GQA by head repeat."""
+    b, sq, h, hd = q.shape
+    skv, kvh = k.shape[1], k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    if causal:
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(skv)[None, :]
+        offset = skv - sq  # cache prefix
+        mask = ki <= (qi + offset)
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if kv_len is not None:
+        ki = jnp.arange(skv)[None, None, None, :]
+        scores = jnp.where(ki < kv_len[:, None, None, None], scores,
+                           -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
+                axis: str = "tp", ag_ctx=None, rs_ctx=None, ar_ctx=None,
+                kv_out: bool = True):
+    """x: (tokens_loc, d) token-sharded (or replicated for fused_ar).
+    Returns (y in the same layout, (k_cache, v_cache) per-shard)."""
+    n = jax.lax.axis_size(axis)
+    hd = cfg.head_dim
+    h_loc, kv_loc = _head_split(cfg, n)
+
+    q, k, v = _project_qkv(params, x, mode=mode, axis=axis, ag_ctx=ag_ctx)
+    tokens = q.shape[0]
+    seq = tokens // batch
+    q = q.reshape(batch, seq, h_loc, hd)
+    k = k.reshape(batch, seq, kv_loc, hd)
+    v = v.reshape(batch, seq, kv_loc, hd)
+    positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    q, k = _norm_rope(q, k, params, cfg, positions)
+
+    o = sdpa(q, k, v, causal=True)
+    o = o.reshape(tokens, h_loc * hd)
+
+    if mode == "xla":
+        partial = jnp.dot(o, params["wo"], preferred_element_type=jnp.float32)
+        y = jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                                 tiled=True).astype(x.dtype)
+    elif mode == "fused":
+        y = gemm_rs(o, params["wo"], rs_ctx)
+    else:  # fused_ar
+        y = gemm_ar(o, params["wo"], ar_ctx)
+    return (y, (k, v)) if kv_out else y
+
+
+def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
+               mode: str = "xla", axis: str = "tp", ar_ctx=None):
+    """Single-token decode. x: (B, d) replicated; caches
+    (B, max_len, KV_loc, hd); cache_len: scalar current length.
+    Returns (y (B, d) replicated, updated caches).
+
+    Reference: decode path of ``TP_Attn`` + ``KV_Cache``
+    (``models/kv_cache.py``), gemm_ar mode (``e2e_dense.md:34``).
+    """
+    n = jax.lax.axis_size(axis)
+    hd = cfg.head_dim
+    h_loc, kv_loc = _head_split(cfg, n)
+    b = x.shape[0]
+
+    q = jnp.dot(x, params["wq"]).reshape(b, 1, h_loc, hd)
+    k = jnp.dot(x, params["wk"]).reshape(b, 1, kv_loc, hd)
+    v = jnp.dot(x, params["wv"]).reshape(b, 1, kv_loc, hd)
+    positions = jnp.full((b, 1), cache_len, dtype=jnp.int32)
+    q, k = _norm_rope(q, k, params, cfg, positions)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0))
+
+    kv_len = jnp.full((b,), cache_len + 1, dtype=jnp.int32)
+    o = sdpa(q, k_cache, v_cache, causal=False, kv_len=kv_len)
+    o = o.reshape(b, h_loc * hd)
+
+    if mode in ("xla",):
+        y = jax.lax.psum(
+            jnp.dot(o, params["wo"], preferred_element_type=jnp.float32),
+            axis).astype(x.dtype)
+    else:  # fused / fused_ar decode both use gemm_ar (small M)
+        y = gemm_ar(o, params["wo"], ar_ctx)
+    return y, (k_cache, v_cache)

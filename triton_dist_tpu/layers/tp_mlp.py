@@ -1,0 +1,80 @@
+"""Tensor-parallel SwiGLU MLP.
+
+Reference: ``layers/nvidia/tp_mlp.py:52`` ``TP_MLP`` — gate/up column-
+parallel (fed by ag_gemm), down row-parallel (into gemm_rs), or
+gemm_allreduce mode for small batches.
+
+Sequence-parallel residual layout: activations enter and leave sharded
+over tokens (dim 0) along the tp axis; ``fwd`` gathers tokens into the
+column-parallel GEMMs and reduce-scatters back (the reference's
+AG+GEMM → GEMM+RS sandwich, ``e2e_dense.md:21``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops import ag_gemm, gemm_rs, gemm_ar
+
+
+def init(key, cfg, dtype=jnp.float32) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    d, ff = cfg.hidden_size, cfg.intermediate_size
+    scale = d ** -0.5
+    return {
+        "w_gate": (jax.random.normal(k1, (d, ff), dtype) * scale),
+        "w_up": (jax.random.normal(k2, (d, ff), dtype) * scale),
+        "w_down": (jax.random.normal(k3, (ff, d), dtype) * (ff ** -0.5)),
+    }
+
+
+def param_specs(axis: str = "tp") -> Dict:
+    return {
+        "w_gate": P(None, axis),   # column-parallel
+        "w_up": P(None, axis),
+        "w_down": P(axis, None),   # row-parallel
+    }
+
+
+def fwd(params, x, *, mode: str = "xla", axis: str = "tp",
+        ag_ctx=None, rs_ctx=None, ar_ctx=None):
+    """x: (tokens_loc, d) sharded over tokens → same layout out.
+
+    mode="fused_ar" takes/returns *replicated* tokens (decode path,
+    reference ``GemmARLayer``).
+    """
+    if mode == "xla":
+        x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        h = _swiglu(x_full, params["w_gate"], params["w_up"])
+        partial = jnp.dot(h, params["w_down"],
+                          preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                                    tiled=True).astype(x.dtype)
+    if mode == "xla_ar":
+        # Replicated tokens (decode): local partial + psum.
+        h = _swiglu(x, params["w_gate"], params["w_up"])
+        partial = jnp.dot(h, params["w_down"],
+                          preferred_element_type=jnp.float32)
+        return jax.lax.psum(partial, axis).astype(x.dtype)
+    if mode == "fused":
+        # One AG feeds both column GEMMs: reuse the gathered copy.
+        g, x_full = ag_gemm(x, params["w_gate"], ag_ctx, return_ag=True)
+        u = jnp.dot(x_full, params["w_up"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
+             ).astype(x.dtype)
+        return gemm_rs(h, params["w_down"], rs_ctx)
+    if mode == "fused_ar":
+        h = _swiglu(x, params["w_gate"], params["w_up"])
+        return gemm_ar(h, params["w_down"], ar_ctx)
+    raise ValueError(f"unknown TP_MLP mode {mode!r}")
+
+
+def _swiglu(x, w_gate, w_up):
+    g = jnp.dot(x, w_gate, preferred_element_type=jnp.float32)
+    u = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
+    return (jax.nn.silu(g) * u).astype(x.dtype)

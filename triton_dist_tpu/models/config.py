@@ -1,0 +1,73 @@
+"""Model configuration (reference: ``models/config.py:53`` ModelConfig).
+
+Presets cover the reference's demo models (Qwen3 dense family,
+``docs/getting-started/e2e/e2e_dense.md``) plus a tiny config for the
+CPU-mesh test battery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 4096
+    intermediate_size: int = 12288
+    num_hidden_layers: int = 36
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1_000_000.0
+    max_position_embeddings: int = 40960
+    tie_word_embeddings: bool = False
+    model_name: str = "qwen3"
+
+    @classmethod
+    def qwen3_8b(cls) -> "ModelConfig":
+        return cls(hidden_size=4096, intermediate_size=12288,
+                   num_hidden_layers=36, num_attention_heads=32,
+                   num_key_value_heads=8, head_dim=128,
+                   model_name="qwen3-8b")
+
+    @classmethod
+    def qwen3_32b(cls) -> "ModelConfig":
+        return cls(hidden_size=5120, intermediate_size=25600,
+                   num_hidden_layers=64, num_attention_heads=64,
+                   num_key_value_heads=8, head_dim=128,
+                   model_name="qwen3-32b")
+
+    @classmethod
+    def tiny(cls, *, vocab_size: int = 256, hidden_size: int = 32,
+             intermediate_size: int = 64, num_hidden_layers: int = 2,
+             num_attention_heads: int = 8, num_key_value_heads: int = 8,
+             head_dim: int = 8) -> "ModelConfig":
+        """Small enough that every pallas buffer stays under the
+        interpret-mode 64 KB/device limit on the CPU test mesh."""
+        return cls(vocab_size=vocab_size, hidden_size=hidden_size,
+                   intermediate_size=intermediate_size,
+                   num_hidden_layers=num_hidden_layers,
+                   num_attention_heads=num_attention_heads,
+                   num_key_value_heads=num_key_value_heads,
+                   head_dim=head_dim, model_name="qwen3-tiny")
+
+    @classmethod
+    def from_hf_config(cls, hf_cfg) -> "ModelConfig":
+        """Build from a transformers AutoConfig (reference loads HF
+        checkpoints, ``models/dense.py:150``)."""
+        return cls(
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=hf_cfg.num_key_value_heads,
+            head_dim=getattr(hf_cfg, "head_dim",
+                             hf_cfg.hidden_size // hf_cfg.num_attention_heads),
+            rms_norm_eps=hf_cfg.rms_norm_eps,
+            rope_theta=getattr(hf_cfg, "rope_theta", 1_000_000.0),
+            tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+            model_name=getattr(hf_cfg, "model_type", "qwen3"),
+        )

@@ -1,0 +1,205 @@
+"""Dense Qwen3-style LLM (reference: ``models/dense.py:117`` ``DenseLLM``
+/ ``:53`` ``DenseLLMLayer``).
+
+Functional model: ``init_params`` builds the (per-device logical) weight
+pytree, ``param_specs`` gives the PartitionSpec pytree, and
+``prefill``/``decode_step`` are per-shard functions to run inside
+``shard_map`` over a mesh. Forward mode mirrors the reference's
+``set_fwd('torch'|'triton_dist'|'triton_dist_AR')`` (``dense.py:146``):
+``"xla"``, ``"fused"`` (AG+GEMM / GEMM+RS), ``"fused_ar"`` (GEMM+AR).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers import tp_attn, tp_mlp
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.ops import (
+    create_ag_gemm_context, create_gemm_rs_context, create_gemm_ar_context,
+)
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class FwdContexts:
+    """Per-layer fused-op contexts (reference ``dense.py:169-208``
+    init_triton_dist_ctx: per-layer create_ag_gemm_context +
+    create_gemm_rs_context)."""
+    ag: object = None
+    rs: object = None
+    ar: object = None
+
+
+def make_fwd_contexts(mesh: MeshContext, axis: str = "tp",
+                      block_m: int = 256, block_n: int = 256,
+                      block_k: int = 512) -> FwdContexts:
+    return FwdContexts(
+        ag=create_ag_gemm_context(mesh, axis, block_m, block_n, block_k),
+        rs=create_gemm_rs_context(mesh, axis, block_m, block_n, block_k),
+        ar=create_gemm_ar_context(mesh, axis, block_n, block_k),
+    )
+
+
+def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
+    keys = jax.random.split(key, cfg.num_hidden_layers + 2)
+    layers = []
+    for li in range(cfg.num_hidden_layers):
+        ka, km = jax.random.split(keys[li])
+        layers.append({
+            "attn": tp_attn.init(ka, cfg, dtype),
+            "mlp": tp_mlp.init(km, cfg, dtype),
+            "ln_attn": jnp.ones((cfg.hidden_size,), dtype),
+            "ln_mlp": jnp.ones((cfg.hidden_size,), dtype),
+        })
+    emb = jax.random.normal(keys[-2], (cfg.vocab_size, cfg.hidden_size),
+                            dtype) * 0.02
+    lm_head = (emb if cfg.tie_word_embeddings else
+               jax.random.normal(keys[-1],
+                                 (cfg.vocab_size, cfg.hidden_size),
+                                 dtype) * 0.02)
+    return {
+        "embed": emb,
+        "layers": layers,
+        "ln_f": jnp.ones((cfg.hidden_size,), dtype),
+        "lm_head": lm_head,
+    }
+
+
+def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
+    layer_spec = {
+        "attn": tp_attn.param_specs(axis),
+        "mlp": tp_mlp.param_specs(axis),
+        "ln_attn": P(None),
+        "ln_mlp": P(None),
+    }
+    return {
+        "embed": P(None, None),
+        "layers": [layer_spec] * cfg.num_hidden_layers,
+        "ln_f": P(None),
+        "lm_head": P(axis, None),  # vocab-sharded head
+    }
+
+
+def _layer_fwd_prefill(layer_params, x, cfg, *, batch, mode, axis, ctxs):
+    h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
+    attn_out, kv = tp_attn.fwd_prefill(
+        layer_params["attn"], h, cfg, batch=batch, mode=mode, axis=axis,
+        ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+    x = x + attn_out
+    h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
+    x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mode, axis=axis,
+                       ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+    return x, kv
+
+
+def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
+            axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
+            max_len: Optional[int] = None):
+    """Per-shard prefill. input_ids: (B, S) replicated. Returns
+    (logits (B, vocab) for the last position, KVCache per-shard).
+
+    Token-sharded residual stream ("sequence parallel"): requires B*S
+    divisible by the axis size in xla/fused modes.
+    """
+    n = jax.lax.axis_size(axis)
+    b, s = input_ids.shape
+    tokens = b * s
+    x = params["embed"][input_ids.reshape(tokens)]
+    if mode in ("xla", "fused"):
+        me = jax.lax.axis_index(axis)
+        loc = tokens // n
+        x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
+
+    kv_loc = max(cfg.num_key_value_heads // n, 1)
+    max_len = max_len or s
+    cache = KVCache.empty(cfg.num_hidden_layers, b, max_len, kv_loc,
+                          cfg.head_dim, dtype=x.dtype)
+    for li, layer_params in enumerate(params["layers"]):
+        x, (k, v) = _layer_fwd_prefill(
+            layer_params, x, cfg, batch=b, mode=mode, axis=axis, ctxs=ctxs)
+        cache = cache.write_prefill(li, k, v)
+    cache = dataclasses.replace(cache, length=jnp.asarray(s, jnp.int32))
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    if mode in ("xla", "fused"):
+        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    # Last position of each sequence → logits over the vocab shard, then
+    # gather the full vocab (head is vocab-sharded).
+    last = x.reshape(b, s, cfg.hidden_size)[:, -1]
+    logits_loc = jnp.dot(last, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits, cache
+
+
+def forward_tokens(params, input_ids, cfg: ModelConfig, *,
+                   mode: str = "xla", axis: str = "tp",
+                   ctxs: FwdContexts = FwdContexts()):
+    """Per-shard forward returning logits for every position —
+    the training-loss forward (B, S, vocab). Same token-sharded layout
+    rules as :func:`prefill`."""
+    n = jax.lax.axis_size(axis)
+    b, s = input_ids.shape
+    tokens = b * s
+    x = params["embed"][input_ids.reshape(tokens)]
+    if mode in ("xla", "fused"):
+        me = jax.lax.axis_index(axis)
+        loc = tokens // n
+        x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
+    for layer_params in params["layers"]:
+        x, _ = _layer_fwd_prefill(
+            layer_params, x, cfg, batch=b, mode=mode, axis=axis,
+            ctxs=ctxs)
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    if mode in ("xla", "fused"):
+        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits.reshape(b, s, cfg.vocab_size)
+
+
+def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
+                mode: str = "xla", axis: str = "tp",
+                ctxs: FwdContexts = FwdContexts()):
+    """One decode step. token_ids: (B,) replicated. Returns
+    (logits (B, vocab), updated cache). Decode always runs with a
+    replicated (B, d) residual (M is tiny) — the reference's
+    AR/gemm_ar decode regime (``e2e_dense.md:25,34``)."""
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids]
+    pos = cache.length
+    dec_mode = "xla" if mode == "xla" else "fused_ar"
+
+    new_k, new_v = cache.k, cache.v
+    for li, layer_params in enumerate(params["layers"]):
+        h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
+        attn_out, (lk, lv) = tp_attn.fwd_decode(
+            layer_params["attn"], h, cfg, new_k[li], new_v[li], pos,
+            mode=dec_mode, axis=axis, ar_ctx=ctxs.ar)
+        new_k = jax.lax.dynamic_update_slice(
+            new_k, lk[None], (li, 0, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            new_v, lv[None], (li, 0, 0, 0, 0))
+        x = x + attn_out
+        h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
+        mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+        x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
+                           axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                           ar_ctx=ctxs.ar)
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    cache = KVCache(k=new_k, v=new_v, length=cache.length + 1)
+    return logits, cache

@@ -1,0 +1,95 @@
+"""Inference engine (reference: ``models/engine.py:37`` ``Engine`` —
+CUDA-graph capture :75, ``serve()`` decode loop :113).
+
+TPU form: no CUDA-graph analogue is needed — ``jax.jit`` already compiles
+the whole decode step into one XLA program (the role cudagraph capture
+plays in the reference); donated KV-cache buffers keep decode in-place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import dense
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+class Engine:
+    """Greedy-decoding TP inference engine over a mesh."""
+
+    def __init__(self, cfg: ModelConfig, mesh: Mesh, *, axis: str = "tp",
+                 mode: str = "xla", dtype=jnp.float32, max_len: int = 512,
+                 params=None, seed: int = 0,
+                 block_m: int = 256, block_n: int = 256,
+                 block_k: int = 512):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.mode = mode
+        self.max_len = max_len
+        mctx = MeshContext.from_mesh(mesh)
+        self.ctxs = dense.make_fwd_contexts(mctx, axis, block_m, block_n,
+                                            block_k)
+
+        specs = dense.param_specs(cfg, axis)
+        if params is None:
+            params = dense.init_params(jax.random.PRNGKey(seed), cfg, dtype)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda x: isinstance(x, jax.Array)
+            or isinstance(x, np.ndarray))
+        self._specs = specs
+
+        def _prefill(params, ids):
+            return dense.prefill(params, ids, cfg, mode=mode, axis=axis,
+                                 ctxs=self.ctxs, max_len=max_len)
+
+        def _decode(params, tok, cache):
+            return dense.decode_step(params, tok, cache, cfg, mode=mode,
+                                     axis=axis, ctxs=self.ctxs)
+
+        kv_spec = KVCache(k=P(None, None, None, axis, None),
+                          v=P(None, None, None, axis, None),
+                          length=P())
+        self._prefill = jax.jit(jax.shard_map(
+            _prefill, mesh=mesh,
+            in_specs=(specs, P(None, None)),
+            out_specs=(P(None, None), kv_spec),
+            check_vma=False))
+        self._decode = jax.jit(jax.shard_map(
+            _decode, mesh=mesh,
+            in_specs=(specs, P(None), kv_spec),
+            out_specs=(P(None, None), kv_spec),
+            check_vma=False), donate_argnums=(2,))
+
+    def prefill(self, input_ids) -> Tuple[jax.Array, KVCache]:
+        return self._prefill(self.params, jnp.asarray(input_ids))
+
+    def decode(self, tokens, cache) -> Tuple[jax.Array, KVCache]:
+        return self._decode(self.params, tokens, cache)
+
+    def serve(self, input_ids, gen_len: int = 32):
+        """Greedy generation (reference ``Engine.serve`` decode loop,
+        ``engine.py:113``). input_ids: (B, S) → (B, gen_len) tokens."""
+        input_ids = jnp.asarray(input_ids)
+        b, s = input_ids.shape
+        if s + gen_len > self.max_len:
+            raise ValueError(
+                f"sequence {s}+{gen_len} exceeds max_len={self.max_len}")
+        logits, cache = self.prefill(input_ids)
+        out = []
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append(tok)
+        for _ in range(gen_len - 1):
+            logits, cache = self.decode(tok, cache)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(tok)
+        return jnp.stack(out, axis=1)

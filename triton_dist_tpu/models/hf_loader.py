@@ -1,0 +1,66 @@
+"""HuggingFace checkpoint loading (reference: ``models/dense.py:150``
+``init_parameters`` — weights come from HF checkpoints sharded per
+rank; ``models/utils.py``).
+
+Zero-egress environments can't download weights; this maps an
+already-local safetensors/torch state dict onto the param pytree of
+:mod:`triton_dist_tpu.models.dense`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.models.config import ModelConfig
+
+
+def _to_np(t):
+    try:
+        import torch
+        if isinstance(t, torch.Tensor):
+            return t.float().cpu().numpy()
+    except ImportError:
+        pass
+    return np.asarray(t)
+
+
+def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
+                              dtype=jnp.bfloat16) -> Dict:
+    """Map a Qwen3 HF state dict to the DenseLLM param pytree.
+
+    Linear weights are stored (out, in) in torch; we keep (in, out).
+    """
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+    gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "attn": {
+                "wq": gT(p + "self_attn.q_proj.weight"),
+                "wk": gT(p + "self_attn.k_proj.weight"),
+                "wv": gT(p + "self_attn.v_proj.weight"),
+                "wo": gT(p + "self_attn.o_proj.weight"),
+                "q_norm": g(p + "self_attn.q_norm.weight"),
+                "k_norm": g(p + "self_attn.k_norm.weight"),
+            },
+            "mlp": {
+                "w_gate": gT(p + "mlp.gate_proj.weight"),
+                "w_up": gT(p + "mlp.up_proj.weight"),
+                "w_down": gT(p + "mlp.down_proj.weight"),
+            },
+            "ln_attn": g(p + "input_layernorm.weight"),
+            "ln_mlp": g(p + "post_attention_layernorm.weight"),
+        })
+    embed = g("model.embed_tokens.weight")
+    lm_head = (embed if cfg.tie_word_embeddings
+               else g("lm_head.weight"))
+    return {
+        "embed": embed,
+        "layers": layers,
+        "ln_f": g("model.norm.weight"),
+        "lm_head": lm_head,
+    }

@@ -1,0 +1,46 @@
+"""KV cache (reference: ``models/kv_cache.py`` ``KV_Cache``).
+
+Per-shard layout: ``(num_layers, batch, max_len, kv_heads_loc, head_dim)``
+— KV heads sharded along ``tp`` (each device holds its heads' cache, the
+same placement the reference uses for split-KV flash decode)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # (L, B, T, KV_loc, hd)
+    v: jax.Array
+    length: jax.Array  # scalar int32 — tokens currently cached
+
+    @classmethod
+    def empty(cls, num_layers: int, batch: int, max_len: int,
+              kv_heads_loc: int, head_dim: int, dtype=jnp.float32):
+        shape = (num_layers, batch, max_len, kv_heads_loc, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+    def write_prefill(self, layer: int, k, v):
+        """k/v: (B, S, KV_loc, hd) from prefill."""
+        self.k = jax.lax.dynamic_update_slice(
+            self.k, k[None].astype(self.k.dtype), (layer, 0, 0, 0, 0))
+        self.v = jax.lax.dynamic_update_slice(
+            self.v, v[None].astype(self.v.dtype), (layer, 0, 0, 0, 0))
+        return self
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    KVCache, KVCache.tree_flatten, KVCache.tree_unflatten)

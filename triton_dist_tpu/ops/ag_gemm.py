@@ -68,7 +68,7 @@ def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
                    ).astype(a.dtype)
 
 
-def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_tile, acc_v, send_sem,
+def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                     recv_sem, *, axis: str, ctx: MeshContext, m_loc: int,
                     tm: int, tk: int, n_ranks: int):
     k = pl.program_id(0)
@@ -112,18 +112,18 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_tile, acc_v, send_sem,
             dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
                           recv_sem.at[k], right, axis=axis, ctx=ctx)
 
-    @pl.when(j == 0)
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
     def _():
-        # Stage this chunk's (row-tile, K-tile) for the whole j sweep.
-        pltpu.sync_copy(
-            a_ws.at[pl.ds(c * m_loc + i * tm, tm), pl.ds(kk * tk, tk)],
-            a_tile)
+        # Stage this chunk's full-K row panel once per (k, i); the kk
+        # loop then slices it in VMEM. (Staging per (j, kk) would either
+        # re-read A n_j times or go stale — the panel holds all K.)
+        pltpu.sync_copy(a_ws.at[pl.ds(c * m_loc + i * tm, tm)], a_panel)
 
     @pl.when(kk == 0)
     def _():
         acc_v[...] = jnp.zeros_like(acc_v)
 
-    acc_v[...] += jnp.dot(a_tile[...], b_ref[...],
+    acc_v[...] += jnp.dot(a_panel[:, pl.ds(kk * tk, tk)], b_ref[...],
                           preferred_element_type=jnp.float32)
 
     @pl.when(kk == n_k - 1)
@@ -142,7 +142,8 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_tile, acc_v, send_sem,
             dl.wait_arrivals(send_sem.at[s], chunk_of(0), 1)
 
 
-def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
+def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
+            force_kernel: bool = False):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
 
     ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;
@@ -157,7 +158,10 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
     m_loc, kdim = a.shape
     _, n_loc = b.shape
     out_dtype = ctx.out_dtype or a.dtype
-    if n == 1:
+    if n == 1 and not force_kernel:
+        # force_kernel=True keeps the pallas pipeline even rankless —
+        # used by bench.py to measure kernel compute efficiency on one
+        # chip (the bound on multi-chip overlap efficiency).
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_ag else c
 
@@ -180,35 +184,31 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
         tk=tk, n_ranks=n)
 
-    out_shapes = [jax.ShapeDtypeStruct((m_full, n_loc), out_dtype)]
-    out_specs = [pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM)]
+    # The gather workspace is always a second kernel output: Mosaic only
+    # allows VMEM/SMEM/semaphore scratch on real TPUs, and as an output
+    # the ring-filled buffer doubles as the return_ag result for free.
+    out_shapes = (jax.ShapeDtypeStruct((m_full, n_loc), out_dtype),
+                  jax.ShapeDtypeStruct((m_full, kdim), a.dtype))
+    out_specs = (pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pl.ANY))
     scratch = [
-        pltpu.VMEM((tm, tk), a.dtype),              # a_tile
+        pltpu.VMEM((tm, kdim), a.dtype),            # a_panel (full K)
         pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
     ]
-    if return_ag:
-        # Expose the workspace as a second output: the ring fills it, the
-        # caller gets gathered A for free.
-        out_shapes.append(jax.ShapeDtypeStruct((m_full, kdim), a.dtype))
-        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-    else:
-        scratch.insert(0, pltpu.HBM((m_full, kdim), a.dtype))  # a_ws
 
-    # Either way the kernel sees (..., o_ref, a_ws, a_tile, ...): as
-    # output #2 or as scratch #0, a_ws sits right after the C output.
-    result = core_call(
+    out, a_full = core_call(
         kernel,
         comm=True,
         grid=(n, n_i, n_j, n_k),
-        out_shape=tuple(out_shapes) if return_ag else out_shapes[0],
+        out_shape=out_shapes,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # a (manual RDMA)
             pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=tuple(out_specs) if return_ag else out_specs[0],
+        out_specs=out_specs,
         scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=2 * m_full * kdim * n_loc,
@@ -217,4 +217,4 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
             transcendentals=0,
         ),
     )(a, b)
-    return result
+    return (out, a_full) if return_ag else out

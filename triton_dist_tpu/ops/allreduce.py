@@ -86,17 +86,20 @@ def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
 
     shape = tuple(x.shape)
     kernel = functools.partial(_one_shot_kernel, axis=axis, ctx=ctx)
-    return core_call(
+    # Gather workspace is a second output (no HBM scratch on real TPUs).
+    out, _gather_ws = core_call(
         kernel,
         comm=True,
-        out_shape=jax.ShapeDtypeStruct(shape, x.dtype),
+        out_shape=(jax.ShapeDtypeStruct(shape, x.dtype),
+                   jax.ShapeDtypeStruct((n,) + shape, x.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
-            pltpu.HBM((n,) + shape, x.dtype),      # gather_hbm
             pltpu.VMEM(shape, x.dtype),             # acc_v
             pltpu.VMEM(shape, x.dtype),             # tmp_v
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA(()),
         ],
     )(x)
+    return out

@@ -127,20 +127,22 @@ def gemm_ar(a, b, ctx: GemmARContext):
 
     kernel = functools.partial(_gemm_ar_kernel, axis=ctx.axis, ctx=mesh,
                                m=m, tn=tn, n_ranks=n)
-    return core_call(
+    # Gather workspace is a second output (no HBM scratch on real TPUs).
+    out, _gather_ws = core_call(
         kernel,
         comm=True,
         grid=(n_j, n_k),
-        out_shape=jax.ShapeDtypeStruct((m, n_dim), out_dtype),
+        out_shape=(jax.ShapeDtypeStruct((m, n_dim), out_dtype),
+                   jax.ShapeDtypeStruct((n, m, n_dim), jnp.float32)),
         in_specs=[
             pl.BlockSpec((m, tk), lambda j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((tk, tn), lambda j, kk: (kk, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
-            pltpu.HBM((n, m, n_dim), jnp.float32),       # gather_hbm
             pltpu.VMEM((m, tn), jnp.float32),             # part_v
             pltpu.VMEM((m, tn), jnp.float32),             # tmp_v
             pltpu.VMEM((m, tn), out_dtype),               # out_v
@@ -154,3 +156,4 @@ def gemm_ar(a, b, ctx: GemmARContext):
             transcendentals=0,
         ),
     )(a, b)
+    return out

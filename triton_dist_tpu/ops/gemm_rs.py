@@ -173,20 +173,26 @@ def gemm_rs(a, b, ctx: GemmRSContext):
         _gemm_rs_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
         tn=tn, n_ranks=n)
 
-    return core_call(
+    # Ring workspaces are extra outputs (Mosaic forbids HBM scratch on
+    # real TPUs); callers discard them.
+    out, _recv_ws, _send_ws = core_call(
         kernel,
         comm=True,
         grid=(n, n_i, n_j, n_k),
-        out_shape=jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+            jax.ShapeDtypeStruct((n - 1, m_loc, n_dim), jnp.float32),
+            jax.ShapeDtypeStruct((n - 1, m_loc, n_dim), jnp.float32),
+        ),
         in_specs=[
             pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
             pl.BlockSpec((tk, tn), lambda s, i, j, kk: (kk, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
-            pltpu.HBM((n - 1, m_loc, n_dim), jnp.float32),  # recv_hbm
-            pltpu.HBM((n - 1, m_loc, n_dim), jnp.float32),  # send_hbm
             pltpu.VMEM((tm, tn), jnp.float32),               # acc_v
             pltpu.VMEM((tm, tn), jnp.float32),               # tmp_v
             pltpu.VMEM((tm, tn), out_dtype),                 # out_v
@@ -200,3 +206,4 @@ def gemm_rs(a, b, ctx: GemmRSContext):
             transcendentals=0,
         ),
     )(a, b)
+    return out

@@ -87,18 +87,22 @@ def reduce_scatter(x, *, ctx: MeshContext, axis: str = "tp",
     rest = tuple(x.shape[1:])
     out_shape = jax.ShapeDtypeStruct((csize,) + rest, x.dtype)
     kernel = functools.partial(_ring_kernel, axis=axis, ctx=ctx)
-    return core_call(
+    # Ring buffers are extra outputs (no HBM scratch on real TPUs).
+    out, _recv_ws, _send_ws = core_call(
         kernel,
         comm=True,
-        out_shape=out_shape,
+        out_shape=(out_shape,
+                   jax.ShapeDtypeStruct((n - 1, csize) + rest, x.dtype),
+                   jax.ShapeDtypeStruct((csize,) + rest, x.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
-            pltpu.HBM((n - 1, csize) + rest, x.dtype),  # recv_hbm
-            pltpu.HBM((csize,) + rest, x.dtype),        # send_hbm
             pltpu.VMEM((csize,) + rest, x.dtype),       # acc_v
             pltpu.VMEM((csize,) + rest, x.dtype),       # tmp_v
             pltpu.SemaphoreType.DMA((n - 1,)),           # send_sem
             pltpu.SemaphoreType.DMA((n - 1,)),           # recv_sem
         ],
     )(x)
+    return out
