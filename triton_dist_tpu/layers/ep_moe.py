@@ -1,0 +1,67 @@
+"""Expert-parallel MoE layer.
+
+Reference: ``layers/nvidia/ep_moe.py:65`` ``EP_MoE`` (+ ``EPAll2AllLayer``
+``ep_a2a_layer.py:220`` and the low-latency variant): router → dispatch
+all-to-all → grouped expert MLP → combine all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.ep_a2a import EPContext, ep_dispatch, ep_combine
+from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
+
+
+def init(key, cfg, dtype=jnp.float32) -> Dict:
+    """cfg needs: hidden_size, moe_intermediate_size, num_experts."""
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    d, f, e = cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts
+    scale = d ** -0.5
+    return {
+        "router": jax.random.normal(kr, (d, e), dtype) * scale,
+        "w_gate": jax.random.normal(kg, (e, d, f), dtype) * scale,
+        "w_up": jax.random.normal(ku, (e, d, f), dtype) * scale,
+        "w_down": jax.random.normal(kd, (e, f, d), dtype) * (f ** -0.5),
+    }
+
+
+def param_specs(axis: str = "ep") -> Dict:
+    return {
+        "router": P(None, None),
+        "w_gate": P(axis, None, None),  # experts sharded
+        "w_up": P(axis, None, None),
+        "w_down": P(axis, None, None),
+    }
+
+
+def route(router_w, x, topk: int, *, norm_topk_prob: bool = True):
+    """Qwen3-MoE router: softmax over experts then top-k, weights
+    renormalized (reference ``models/qwen_moe.py``)."""
+    logits = jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    topk_w, topk_ids = jax.lax.top_k(probs, topk)
+    if norm_topk_prob:
+        topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
+    return topk_ids.astype(jnp.int32), topk_w
+
+
+def fwd(params, x, ep_ctx: EPContext, *, topk: int,
+        norm_topk_prob: bool = True):
+    """x: (T_loc, d) — every ep rank holds *its own* tokens (the data
+    dimension rides the ep axis, as in DeepEP). Returns (T_loc, d)."""
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+
+    recv_tok, recv_exp, state = ep_dispatch(x, topk_ids, ep_ctx)
+    sorted_tok, group_sizes, inv = sort_by_expert(
+        recv_tok, recv_exp, ep_ctx.experts_per_rank)
+    expert_out = grouped_swiglu(sorted_tok, params["w_gate"],
+                                params["w_up"], params["w_down"],
+                                group_sizes)
+    expert_out = expert_out[inv]  # back to slot order
+    return ep_combine(expert_out, state, topk_w, ep_ctx)

@@ -1,0 +1,56 @@
+"""Pipeline-parallel communication layer.
+
+Reference: ``layers/nvidia/pp_block.py:36,102`` ``PPCommLayer`` /
+``PyTorchP2P`` over the p2p put/get kernels (``kernels/nvidia/p2p.py``),
+benchmarked by ``bench_pp.py``.
+
+TPU form: stage boundaries are one-sided puts to the next stage
+(``ops/p2p.py``) or ``lax.ppermute`` (``impl="xla"``); a simple
+GPipe-style microbatch schedule helper runs a list of stage functions
+under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.p2p import p2p_put
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def send_next(x, *, axis: str = "pp", ctx: MeshContext = None,
+              impl: str = "pallas"):
+    """Shift activations one pipeline stage forward (last stage's output
+    wraps to stage 0, which ignores it)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    if impl == "xla" or ctx is None:
+        return jax.lax.ppermute(x, axis, perm)
+    return p2p_put(x, perm, ctx=ctx, axis=axis)
+
+
+def pipeline_forward(stage_fn: Callable, x, *, num_stages: int,
+                     axis: str = "pp", ctx: MeshContext = None,
+                     impl: str = "xla"):
+    """Run ``stage_fn(stage_index, h)`` through all pipeline stages.
+
+    Every rank holds its stage's layers; activations flow stage to
+    stage; rank ``num_stages-1`` ends with the final output, which is
+    broadcast back. (A microbatched 1F1B schedule is the training-side
+    extension; inference forward only needs the relay.)
+    """
+    me = jax.lax.axis_index(axis)
+    h = x
+    for stage in range(num_stages):
+        active = me == stage
+        h_new = stage_fn(stage, h)
+        h = jnp.where(active, h_new, h)
+        if stage < num_stages - 1:
+            h = send_next(h, axis=axis, ctx=ctx, impl=impl)
+            # Only the next stage consumes it; others carry h unchanged.
+    # Broadcast final stage's result to all ranks (psum of a one-hot).
+    keep = (me == num_stages - 1).astype(h.dtype)
+    return jax.lax.psum(h * keep, axis)

@@ -1,0 +1,72 @@
+"""Sequence-parallel GQA flash-decode attention layer.
+
+Reference: ``layers/nvidia/sp_flash_decode_layer.py:44``
+``SpGQAFlashDecodeAttention`` — decode-time attention with the KV cache
+sequence-sharded across ranks (1→32 GPU scaling, ``README.md:205``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.layers.rope import apply_rope, rope_freqs
+from triton_dist_tpu.ops.flash_decode import sp_flash_decode
+
+init = None  # uses tp_attn-style params passed by the caller
+
+
+def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis: str = "sp"):
+    """One decode step with a sequence-sharded cache.
+
+    x: (B, d) replicated along ``axis``; caches (B, T_loc, KV, hd) —
+    this rank's contiguous slice of the global (B, n*T_loc, KV, hd)
+    cache; cache_len: scalar global length. The new token's KV is
+    appended on the owning rank only. Returns (y (B, d), caches).
+
+    CAPACITY CONTRACT: ``cache_len`` must be < n*T_loc. At full
+    capacity no rank owns the append slot (owner == n) and the newest
+    token's KV would be silently dropped — callers must size caches or
+    guard the step count (as ``Engine.decode`` does for the TP cache).
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    hd = cfg.head_dim
+    h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+    b = x.shape[0]
+    t_loc = k_cache.shape[1]
+
+    q = jnp.dot(x, params["wq"]).reshape(b, 1, h, hd)
+    k = jnp.dot(x, params["wk"]).reshape(b, 1, kvh, hd)
+    v = jnp.dot(x, params["wv"]).reshape(b, 1, kvh, hd)
+    positions = jnp.full((b, 1), cache_len, jnp.int32)
+    inv_freq = rope_freqs(hd, cfg.rope_theta)
+    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    # Append on the rank that owns slot ``cache_len``.
+    owner = cache_len // t_loc
+    local_slot = cache_len - owner * t_loc
+    is_owner = owner == me
+    upd_k = jnp.where(is_owner, k.astype(k_cache.dtype),
+                      jax.lax.dynamic_slice(
+                          k_cache, (0, local_slot, 0, 0),
+                          (b, 1, kvh, hd)))
+    upd_v = jnp.where(is_owner, v.astype(v_cache.dtype),
+                      jax.lax.dynamic_slice(
+                          v_cache, (0, local_slot, 0, 0),
+                          (b, 1, kvh, hd)))
+    k_cache = jax.lax.dynamic_update_slice(k_cache, upd_k,
+                                           (0, local_slot, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, upd_v,
+                                           (0, local_slot, 0, 0))
+
+    kv_len = jnp.full((b,), cache_len + 1, jnp.int32)
+    o = sp_flash_decode(q[:, 0], k_cache, v_cache, kv_len, axis=axis)
+    y = jnp.dot(o.reshape(b, h * hd), params["wo"]).astype(x.dtype)
+    return y, (k_cache, v_cache)

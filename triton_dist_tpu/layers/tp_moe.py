@@ -1,0 +1,51 @@
+"""Tensor-parallel MoE layer (experts replicated, ffn dim sharded).
+
+Reference: ``layers/nvidia/tp_moe.py:48`` ``TP_MoE`` — AG tokens →
+grouped GEMM over the local ffn slice of every expert → weighted
+combine → ReduceScatter (the AG-MoE / moe_reduce_rs pipeline,
+``kernels/nvidia/allgather_group_gemm.py`` + ``moe_reduce_rs.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers.ep_moe import init, route  # shared weights/router
+from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
+
+
+def param_specs(axis: str = "tp") -> Dict:
+    return {
+        "router": P(None, None),
+        "w_gate": P(None, None, axis),  # ffn dim sharded
+        "w_up": P(None, None, axis),
+        "w_down": P(None, axis, None),
+    }
+
+
+def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
+        norm_topk_prob: bool = True):
+    """x: (tokens_loc, d) token-sharded along ``axis`` → same layout out."""
+    x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    t, d = x_full.shape
+    topk_ids, topk_w = route(params["router"], x_full, topk,
+                             norm_topk_prob=norm_topk_prob)
+
+    # Replicate each token per selected expert, sort by expert, grouped
+    # GEMM over the local ffn slice, then weighted un-sort.
+    k = topk_ids.shape[1]
+    flat_exp = topk_ids.reshape(-1)
+    tok_rep = jnp.repeat(x_full, k, axis=0)
+    sorted_tok, group_sizes, inv = sort_by_expert(tok_rep, flat_exp,
+                                                  num_experts)
+    out = grouped_swiglu(sorted_tok, params["w_gate"], params["w_up"],
+                         params["w_down"], group_sizes)
+    out = out[inv].reshape(t, k, d)
+    partial = jnp.einsum("tkd,tk->td", out.astype(jnp.float32),
+                         topk_w.astype(jnp.float32))
+    return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                                tiled=True).astype(x.dtype)

@@ -1,0 +1,65 @@
+"""Ulysses sequence-parallel attention layer.
+
+Reference: ``layers/nvidia/ulysses_sp_a2a_layer.py:29``
+``UlyssesSPAllToAllLayer`` + pre/post attn A2A op layers
+(``pre_attn_a2a_layer.py:71,199``, ``post_attn_a2a_layer.py:66``) and
+the fused QKV/O GEMM+A2A kernels they wrap.
+
+Layer form: QKV projection on the local sequence shard, head-resharding
+A2A, attention over the full sequence, inverse A2A, O projection.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.layers.rope import apply_rope, rope_freqs
+from triton_dist_tpu.layers import tp_attn
+from triton_dist_tpu.ops.ulysses import pre_attn_a2a, post_attn_a2a
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+init = tp_attn.init  # same weight shapes; heads stay *unsharded*
+
+
+def param_specs() -> Dict:
+    """Ulysses shards the *sequence*, not the weights."""
+    return {"wq": P(None, None), "wk": P(None, None),
+            "wv": P(None, None), "wo": P(None, None),
+            "q_norm": P(None), "k_norm": P(None)}
+
+
+def fwd(params, x, cfg, *, axis: str = "sp", ctx: MeshContext = None,
+        impl: str = "pallas", causal: bool = True):
+    """x: (S_loc, d) sequence-sharded along ``axis`` → same layout out."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    hd = cfg.head_dim
+    h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+    s_loc = x.shape[0]
+
+    q = jnp.dot(x, params["wq"]).reshape(s_loc, h, hd)
+    k = jnp.dot(x, params["wk"]).reshape(s_loc, kvh, hd)
+    v = jnp.dot(x, params["wv"]).reshape(s_loc, kvh, hd)
+
+    # Rope with *global* positions (this rank's sequence slice).
+    positions = (me * s_loc + jnp.arange(s_loc))[None]
+    inv_freq = rope_freqs(hd, cfg.rope_theta)
+    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q[None], positions, inv_freq)[0]
+    k = apply_rope(k[None], positions, inv_freq)[0]
+
+    # Head-reshard, attend over the full sequence, reshard back.
+    qh = pre_attn_a2a(q, axis=axis, ctx=ctx, impl=impl)
+    kh = pre_attn_a2a(k, axis=axis, ctx=ctx, impl=impl)
+    vh = pre_attn_a2a(v, axis=axis, ctx=ctx, impl=impl)
+    o = tp_attn.sdpa(qh[None], kh[None], vh[None], causal=causal)[0]
+    o = post_attn_a2a(o, axis=axis, ctx=ctx, impl=impl)
+
+    return jnp.dot(o.reshape(s_loc, h * hd), params["wo"]).astype(x.dtype)

@@ -24,6 +24,15 @@ class ModelConfig:
     max_position_embeddings: int = 40960
     tie_word_embeddings: bool = False
     model_name: str = "qwen3"
+    # MoE fields (0 experts = dense; reference: models/qwen_moe.py)
+    num_experts: int = 0
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int = 768
+    norm_topk_prob: bool = True
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @classmethod
     def qwen3_8b(cls) -> "ModelConfig":
@@ -38,6 +47,26 @@ class ModelConfig:
                    num_hidden_layers=64, num_attention_heads=64,
                    num_key_value_heads=8, head_dim=128,
                    model_name="qwen3-32b")
+
+    @classmethod
+    def qwen3_moe_30b_a3b(cls) -> "ModelConfig":
+        """Qwen3-30B-A3B (reference MoE demo, models/qwen_moe.py)."""
+        return cls(hidden_size=2048, intermediate_size=6144,
+                   num_hidden_layers=48, num_attention_heads=32,
+                   num_key_value_heads=4, head_dim=128,
+                   num_experts=128, num_experts_per_tok=8,
+                   moe_intermediate_size=768,
+                   model_name="qwen3-moe-30b-a3b")
+
+    @classmethod
+    def tiny_moe(cls, **kw) -> "ModelConfig":
+        base = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=8,
+                    num_key_value_heads=8, head_dim=8, num_experts=16,
+                    num_experts_per_tok=2, moe_intermediate_size=32,
+                    model_name="qwen3-moe-tiny")
+        base.update(kw)
+        return cls(**base)
 
     @classmethod
     def tiny(cls, *, vocab_size: int = 256, hidden_size: int = 32,

@@ -101,6 +101,44 @@ def _layer_fwd_prefill(layer_params, x, cfg, *, batch, mode, axis, ctxs):
     return x, kv
 
 
+def _embed_tokens(params, input_ids, *, mode, axis):
+    """Embed with slice-before-gather: each tp rank embeds only its
+    token slice in the token-sharded modes."""
+    n = jax.lax.axis_size(axis)
+    b, s = input_ids.shape
+    flat = input_ids.reshape(b * s)
+    if mode in ("xla", "fused"):
+        me = jax.lax.axis_index(axis)
+        loc = (b * s) // n
+        flat = jax.lax.dynamic_slice_in_dim(flat, me * loc, loc, axis=0)
+    return params["embed"][flat]
+
+
+def _forward_trunk(params, input_ids, cfg: ModelConfig, *, mode, axis,
+                   ctxs, cache: Optional[KVCache]):
+    """Shared prefill/all-token forward: embed → layers (optionally
+    recording KV) → final norm → gather to full tokens. Returns
+    (x (B*S, d) full, cache)."""
+    b, s = input_ids.shape
+    x = _embed_tokens(params, input_ids, mode=mode, axis=axis)
+    for li, layer_params in enumerate(params["layers"]):
+        x, kv = _layer_fwd_prefill(
+            layer_params, x, cfg, batch=b, mode=mode, axis=axis, ctxs=ctxs)
+        if cache is not None:
+            cache = cache.write_prefill(li, *kv)
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    if mode in ("xla", "fused"):
+        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    return x, cache
+
+
+def _lm_head(params, x, axis):
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    return jax.lax.all_gather(logits_loc, axis, axis=x.ndim - 1,
+                              tiled=True)
+
+
 def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
             axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
             max_len: Optional[int] = None):
@@ -112,33 +150,16 @@ def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
     """
     n = jax.lax.axis_size(axis)
     b, s = input_ids.shape
-    tokens = b * s
-    x = params["embed"][input_ids.reshape(tokens)]
-    if mode in ("xla", "fused"):
-        me = jax.lax.axis_index(axis)
-        loc = tokens // n
-        x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
-
     kv_loc = max(cfg.num_key_value_heads // n, 1)
     max_len = max_len or s
     cache = KVCache.empty(cfg.num_hidden_layers, b, max_len, kv_loc,
-                          cfg.head_dim, dtype=x.dtype)
-    for li, layer_params in enumerate(params["layers"]):
-        x, (k, v) = _layer_fwd_prefill(
-            layer_params, x, cfg, batch=b, mode=mode, axis=axis, ctxs=ctxs)
-        cache = cache.write_prefill(li, k, v)
+                          cfg.head_dim,
+                          dtype=params["embed"].dtype)
+    x, cache = _forward_trunk(params, input_ids, cfg, mode=mode,
+                              axis=axis, ctxs=ctxs, cache=cache)
     cache = dataclasses.replace(cache, length=jnp.asarray(s, jnp.int32))
-
-    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
-    if mode in ("xla", "fused"):
-        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
-    # Last position of each sequence → logits over the vocab shard, then
-    # gather the full vocab (head is vocab-sharded).
     last = x.reshape(b, s, cfg.hidden_size)[:, -1]
-    logits_loc = jnp.dot(last, params["lm_head"].T,
-                         preferred_element_type=jnp.float32)
-    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
-    return logits, cache
+    return _lm_head(params, last, axis), cache
 
 
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
@@ -147,25 +168,10 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
     """Per-shard forward returning logits for every position —
     the training-loss forward (B, S, vocab). Same token-sharded layout
     rules as :func:`prefill`."""
-    n = jax.lax.axis_size(axis)
     b, s = input_ids.shape
-    tokens = b * s
-    x = params["embed"][input_ids.reshape(tokens)]
-    if mode in ("xla", "fused"):
-        me = jax.lax.axis_index(axis)
-        loc = tokens // n
-        x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
-    for layer_params in params["layers"]:
-        x, _ = _layer_fwd_prefill(
-            layer_params, x, cfg, batch=b, mode=mode, axis=axis,
-            ctxs=ctxs)
-    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
-    if mode in ("xla", "fused"):
-        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
-    logits_loc = jnp.dot(x, params["lm_head"].T,
-                         preferred_element_type=jnp.float32)
-    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
-    return logits.reshape(b, s, cfg.vocab_size)
+    x, _ = _forward_trunk(params, input_ids, cfg, mode=mode, axis=axis,
+                          ctxs=ctxs, cache=None)
+    return _lm_head(params, x, axis).reshape(b, s, cfg.vocab_size)
 
 
 def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,

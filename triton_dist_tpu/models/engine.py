@@ -74,6 +74,11 @@ class Engine:
         return self._prefill(self.params, jnp.asarray(input_ids))
 
     def decode(self, tokens, cache) -> Tuple[jax.Array, KVCache]:
+        # dynamic_update_slice clamps out-of-range starts, which would
+        # silently overwrite the last cache slot — fail loudly instead.
+        if int(np.asarray(cache.length)) >= self.max_len:
+            raise ValueError(
+                f"KV cache full ({self.max_len}); cannot decode further")
         return self._decode(self.params, tokens, cache)
 
     def serve(self, input_ids, gen_len: int = 32):

@@ -1,0 +1,112 @@
+"""Qwen3-MoE model (reference: ``models/qwen_moe.py`` — Qwen3-MoE with
+EP; demo model for the EP dispatch/combine stack).
+
+Same transformer skeleton as :mod:`triton_dist_tpu.models.dense` with
+the MLP replaced by a MoE block. Two parallelization regimes (mirroring
+the reference's TP_MoE vs EP_MoE layers):
+
+- ``moe_impl="tp"``: experts replicated, ffn dim sharded over tp —
+  tokens stay sequence-parallel.
+- ``moe_impl="ep"``: experts sharded over the axis; each rank routes its
+  own token shard through the dispatch/combine all-to-all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers import tp_attn, ep_moe, tp_moe
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import FwdContexts
+from triton_dist_tpu.ops.ep_a2a import EPContext, create_ep_context
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
+    keys = jax.random.split(key, cfg.num_hidden_layers + 2)
+    layers = []
+    for li in range(cfg.num_hidden_layers):
+        ka, km = jax.random.split(keys[li])
+        layers.append({
+            "attn": tp_attn.init(ka, cfg, dtype),
+            "moe": ep_moe.init(km, cfg, dtype),
+            "ln_attn": jnp.ones((cfg.hidden_size,), dtype),
+            "ln_mlp": jnp.ones((cfg.hidden_size,), dtype),
+        })
+    emb = jax.random.normal(keys[-2], (cfg.vocab_size, cfg.hidden_size),
+                            dtype) * 0.02
+    lm_head = (emb if cfg.tie_word_embeddings else
+               jax.random.normal(keys[-1],
+                                 (cfg.vocab_size, cfg.hidden_size),
+                                 dtype) * 0.02)
+    return {"embed": emb, "layers": layers,
+            "ln_f": jnp.ones((cfg.hidden_size,), dtype),
+            "lm_head": lm_head}
+
+
+def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
+                axis: str = "tp", ep_axis: str = "ep") -> Dict:
+    moe_specs = (tp_moe.param_specs(axis) if moe_impl == "tp"
+                 else ep_moe.param_specs(ep_axis))
+    layer_spec = {
+        "attn": tp_attn.param_specs(axis),
+        "moe": moe_specs,
+        "ln_attn": P(None),
+        "ln_mlp": P(None),
+    }
+    return {"embed": P(None, None),
+            "layers": [layer_spec] * cfg.num_hidden_layers,
+            "ln_f": P(None),
+            "lm_head": P(axis, None)}
+
+
+def forward_tokens(params, input_ids, cfg: ModelConfig, *,
+                   moe_impl: str = "tp", mode: str = "xla",
+                   axis: str = "tp", ep_ctx: Optional[EPContext] = None,
+                   ctxs: FwdContexts = FwdContexts()):
+    """Per-shard all-token forward → (B, S, vocab) logits.
+
+    For ``moe_impl="ep"`` the residual stream is token-sharded along the
+    *ep* axis (each rank owns its tokens); attention still runs TP over
+    ``axis`` (= the same axis for a 1D mesh: tp and ep traffic share it,
+    matching the reference's single-group EP demos).
+    """
+    n = jax.lax.axis_size(axis)
+    b, s = input_ids.shape
+    tokens = b * s
+    x = params["embed"][input_ids.reshape(tokens)]
+    me = jax.lax.axis_index(axis)
+    loc = tokens // n
+    x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
+
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        attn_out, _ = tp_attn.fwd_prefill(
+            lp["attn"], h, cfg, batch=b, mode=mode, axis=axis,
+            ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+        x = x + attn_out
+        h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        if moe_impl == "tp":
+            moe_out = tp_moe.fwd(lp["moe"], h, topk=cfg.num_experts_per_tok,
+                                 num_experts=cfg.num_experts, axis=axis,
+                                 norm_topk_prob=cfg.norm_topk_prob)
+        else:
+            moe_out = ep_moe.fwd(lp["moe"], h, ep_ctx,
+                                 topk=cfg.num_experts_per_tok,
+                                 norm_topk_prob=cfg.norm_topk_prob)
+        x = x + moe_out
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits.reshape(b, s, cfg.vocab_size)
+
+

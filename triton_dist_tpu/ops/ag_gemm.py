@@ -168,6 +168,13 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     tm = min(ctx.block_m, m_loc)
     tn = min(ctx.block_n, n_loc)
     tk = min(ctx.block_k, kdim)
+    # The A panel is (tm, K) in VMEM; clamp tm so it stays within a
+    # ~6 MB budget for any K (block_k bounds only the B tiles).
+    panel_budget = 6 * 1024 * 1024
+    while tm > 8 and tm * kdim * a.dtype.itemsize > panel_budget:
+        tm //= 2
+    while tm > 1 and m_loc % tm:
+        tm //= 2
     if m_loc % tm or n_loc % tn or kdim % tk:
         raise ValueError(
             f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "

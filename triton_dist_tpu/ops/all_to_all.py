@@ -1,0 +1,73 @@
+"""All-to-all over ICI (the EP/SP transport primitive).
+
+Reference: ``python/triton_dist/kernels/nvidia/fast_all_to_all``/
+``all_to_all_single_2d.py`` and the low-latency dispatch/combine pair
+(``low_latency_all_to_all_v2.py:156,360``): every rank one-sided-puts its
+per-destination chunk straight into the destination's receive slot
+indexed by source rank — no ring, latency-optimal.
+
+TPU form: one kernel, n-1 direct remote DMAs (slot ``me`` on the peer),
+local chunk copied locally. Used by EP dispatch/combine and Ulysses SP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def all_to_all_ref(x, *, axis: str = "ep", **_):
+    """x: (n, C, ...) per-shard; out[src] = what src sent to me."""
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+
+
+def _a2a_kernel(x_ref, out_ref, send_sem, recv_sem, *, axis: str,
+                ctx: MeshContext):
+    n = dl.num_ranks(axis)
+    me = dl.rank(axis)
+
+    dl.local_copy(x_ref.at[me], out_ref.at[me])
+    dl.barrier_all(axis, ctx=ctx)
+
+    copies = []
+    for off in range(1, n):
+        peer = jax.lax.rem(me + off, n)
+        copy = dl.remote_put(x_ref.at[peer], out_ref.at[me],
+                             send_sem.at[off - 1], recv_sem, peer,
+                             axis=axis, ctx=ctx)
+        copies.append(copy)
+    for copy in copies:
+        copy.wait_send()
+    dl.wait_arrivals(recv_sem, x_ref.at[0], n - 1)
+
+
+def all_to_all(x, *, ctx: MeshContext, axis: str = "ep"):
+    """Per-shard all-to-all (inside shard_map): x (n, C, ...) where
+    x[r] is the chunk destined for rank r; returns out (n, C, ...) where
+    out[r] is the chunk received from rank r."""
+    n = ctx.size(axis)
+    if x.shape[0] != n:
+        raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
+    if n == 1:
+        return x
+    kernel = functools.partial(_a2a_kernel, axis=axis, ctx=ctx)
+    return core_call(
+        kernel,
+        comm=True,
+        out_shape=jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )(x)

@@ -1,0 +1,161 @@
+"""Expert-parallel dispatch/combine (DeepEP-style all-to-all).
+
+Reference: ``python/triton_dist/kernels/nvidia/ep_a2a.py`` (dispatch/
+combine with splits-cumsum + putmem + signal, token sorting) and the
+low-latency double-buffered variant ``low_latency_all_to_all_v2.py``
+(``dispatch_kernel_v2`` :156, ``combine_kernel_v2`` :360,
+``create_ep_ll_a2a_ctx`` :628).
+
+XLA/TPU redesign around static shapes (the reference already pads to
+MAX_M, ``README.md:133-145``): per-(src,dst) capacity ``C`` slots —
+
+1. routing plan in plain XLA ops (cumsum/sort, no host sync),
+2. one low-latency all-to-all (``ops/all_to_all.py``) moving
+   ``(n, C, d)``; overflow tokens beyond C are dropped (zero weight),
+3. receiver sorts arrivals by local expert for the grouped GEMM,
+4. combine reverses the route with a second all-to-all and applies the
+   top-k weights at the source (weights never travel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.all_to_all import all_to_all, all_to_all_ref
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class EPContext:
+    """Analogue of ``create_ep_ll_a2a_ctx`` (low_latency_all_to_all_v2
+    .py:628): static EP geometry + capacity."""
+    mesh: MeshContext
+    axis: str = "ep"
+    num_experts: int = 8
+    topk: int = 2
+    capacity: int = 128  # max tokens per (src rank, dst rank) pair
+    impl: str = "pallas"  # "pallas" | "xla" transport
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.num_experts // self.mesh.size(self.axis)
+
+
+def create_ep_context(mesh: MeshContext, *, num_experts: int, topk: int,
+                      capacity: int, axis: str = "ep",
+                      impl: str = "pallas") -> EPContext:
+    if num_experts % mesh.size(axis):
+        raise ValueError(
+            f"num_experts={num_experts} not divisible by ep={mesh.size(axis)}")
+    return EPContext(mesh=mesh, axis=axis, num_experts=num_experts,
+                     topk=topk, capacity=capacity, impl=impl)
+
+
+@dataclasses.dataclass
+class DispatchState:
+    """Routing metadata kept at the *source* rank for combine."""
+    slot_rank: jax.Array   # (T, K) destination rank per token/k
+    slot_index: jax.Array  # (T, K) slot within that rank's capacity
+    valid: jax.Array       # (T, K) bool — False if dropped (overflow)
+
+    def tree_flatten(self):
+        return (self.slot_rank, self.slot_index, self.valid), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    DispatchState, DispatchState.tree_flatten, DispatchState.tree_unflatten)
+
+
+def _transport(ctx: EPContext, x):
+    if ctx.impl == "xla":
+        return all_to_all_ref(x, axis=ctx.axis)
+    return all_to_all(x, ctx=ctx.mesh, axis=ctx.axis)
+
+
+def ep_dispatch(tokens, topk_ids, ctx: EPContext):
+    """Route tokens to the ranks owning their top-k experts.
+
+    tokens: (T, d); topk_ids: (T, K) global expert ids.
+    Returns (recv_tokens (n*C, d), recv_expert (n*C,) local expert id or
+    -1 for empty slots, state: DispatchState).
+    """
+    n = ctx.mesh.size(ctx.axis)
+    t, d = tokens.shape
+    k = topk_ids.shape[1]
+    cap = ctx.capacity
+    e_loc = ctx.experts_per_rank
+
+    dst_rank = topk_ids // e_loc                      # (T, K)
+    flat_rank = dst_rank.reshape(-1)                  # (T*K,)
+    # Slot within each destination: running count of earlier (token, k)
+    # pairs headed to the same rank.
+    one_hot = jax.nn.one_hot(flat_rank, n, dtype=jnp.int32)  # (TK, n)
+    pos_in_rank = jnp.cumsum(one_hot, axis=0) - 1             # (TK, n)
+    slot = jnp.take_along_axis(pos_in_rank, flat_rank[:, None],
+                               axis=1)[:, 0]                  # (TK,)
+    valid = slot < cap
+
+    # Scatter tokens and expert ids into the (n, C) send layout;
+    # overflow (and any dropped) entries scatter out-of-bounds and are
+    # discarded by mode="drop".
+    send_tok = jnp.zeros((n, cap, d), tokens.dtype)
+    send_exp = jnp.full((n, cap), -1, jnp.int32)
+    tok_rep = jnp.repeat(tokens, k, axis=0)           # (TK, d)
+    local_exp = (topk_ids % e_loc).reshape(-1)
+    s_idx = jnp.where(valid, slot, cap)               # cap = OOB sentinel
+    send_tok = send_tok.at[flat_rank, s_idx].set(tok_rep, mode="drop")
+    send_exp = send_exp.at[flat_rank, s_idx].set(local_exp, mode="drop")
+
+    recv_tok = _transport(ctx, send_tok)              # (n, C, d)
+    recv_exp = _transport(ctx, send_exp[..., None])[..., 0]  # (n, C)
+
+    state = DispatchState(
+        slot_rank=dst_rank,
+        slot_index=slot.reshape(t, k),
+        valid=valid.reshape(t, k),
+    )
+    return recv_tok.reshape(n * cap, d), recv_exp.reshape(n * cap), state
+
+
+def ep_combine(expert_out, state: DispatchState, topk_weights,
+               ctx: EPContext):
+    """Return expert outputs to their source ranks and reduce with the
+    top-k weights. expert_out: (n*C, d) in the same slot order as
+    ep_dispatch's recv_tokens. Returns (T, d)."""
+    n = ctx.mesh.size(ctx.axis)
+    cap = ctx.capacity
+    d = expert_out.shape[-1]
+    t, k = state.valid.shape
+
+    back = _transport(ctx, expert_out.reshape(n, cap, d))  # (n, C, d)
+    # back[r, s] = my token's expert output that was processed on rank r
+    # at slot s (slot indices were assigned locally, so they're ours).
+    gathered = back[jnp.where(state.valid, state.slot_rank, 0),
+                    jnp.where(state.valid, state.slot_index, 0)]  # (T,K,d)
+    w = jnp.where(state.valid, topk_weights, 0.0)
+    return jnp.einsum("tkd,tk->td", gathered.astype(jnp.float32),
+                      w.astype(jnp.float32)).astype(expert_out.dtype)
+
+
+def ep_moe_ref(tokens, topk_ids, topk_weights, expert_fn, num_experts):
+    """Dense oracle: run every token through its top-k experts directly
+    (the reference's torch oracle, ``test/nvidia/ep_a2a_utils.py``)."""
+    t, d = tokens.shape
+    outs = []
+    for e in range(num_experts):
+        outs.append(expert_fn(tokens, e))            # (T, d) each
+    all_out = jnp.stack(outs, axis=0)                 # (E, T, d)
+    sel = all_out[topk_ids.reshape(-1), jnp.tile(
+        jnp.arange(t)[:, None], (1, topk_ids.shape[1])).reshape(-1)]
+    sel = sel.reshape(t, topk_ids.shape[1], d)
+    return jnp.einsum("tkd,tk->td", sel.astype(jnp.float32),
+                      topk_weights.astype(jnp.float32)).astype(tokens.dtype)

@@ -1,0 +1,76 @@
+"""Distributed flash decode: split-KV attention across ranks.
+
+Reference: ``kernels/nvidia/flash_decode.py`` (1132 LoC) — split-KV GQA
+decode :130, per-rank combine :393/:482, host APIs
+``gqa_fwd_batch_decode*`` :763-1095; scales bs=1 decode 1→32 GPUs
+(``README.md:205-207``), exposed as ``SpGQAFlashDecodeAttention``.
+
+TPU redesign: the KV cache is *sequence*-sharded along ``axis``; each
+rank computes a flash partial (m, l, acc) over its shard, then a single
+log-sum-exp combine runs as three tiny collectives (pmax + two psums) —
+the analogue of the reference's intra/inter-rank combine kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_decode_ref(q, k_full, v_full, kv_len):
+    """Oracle: dense attention over the full cache (single rank).
+    q: (B, H, hd); k/v_full: (B, T, KV, hd); kv_len: (B,)."""
+    from triton_dist_tpu.layers.tp_attn import sdpa
+
+    return sdpa(q[:, None], k_full, v_full, causal=False,
+                kv_len=kv_len)[:, 0]
+
+
+def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis: str = "sp",
+                    shard_offset=None):
+    """Split-KV decode step.
+
+    q: (B, H, hd) replicated along ``axis``;
+    k_shard/v_shard: (B, T_loc, KV, hd) — this rank's contiguous slice
+    of the cache; kv_len: (B,) total valid length (global);
+    shard_offset: global position of this shard's first slot (defaults
+    to rank * T_loc). Returns (B, H, hd).
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    b, h, hd = q.shape
+    t_loc, kvh = k_shard.shape[1], k_shard.shape[2]
+    if shard_offset is None:
+        shard_offset = me * t_loc
+    if kvh != h:
+        rep = h // kvh
+        k_shard = jnp.repeat(k_shard, rep, axis=2)
+        v_shard = jnp.repeat(v_shard, rep, axis=2)
+
+    # Local flash partial over this shard.
+    scores = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                        k_shard.astype(jnp.float32))
+    scores /= jnp.sqrt(jnp.float32(hd))
+    pos = shard_offset + jnp.arange(t_loc)[None, :]         # (1, T_loc)
+    valid = pos < kv_len[:, None]                            # (B, T_loc)
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+
+    m = jnp.max(scores, axis=-1)                             # (B, H)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    l = jnp.sum(p, axis=-1)                                  # (B, H)
+    acc = jnp.einsum("bhk,bkhd->bhd", p,
+                     v_shard.astype(jnp.float32))            # (B, H, hd)
+
+    if n > 1:
+        # Cross-rank log-sum-exp combine (reference combine kernels).
+        m_glob = jax.lax.pmax(m, axis)
+        m_glob_safe = jnp.where(jnp.isfinite(m_glob), m_glob, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m_safe - m_glob_safe),
+                         0.0)
+        l = jax.lax.psum(l * corr, axis)
+        acc = jax.lax.psum(acc * corr[..., None], axis)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)

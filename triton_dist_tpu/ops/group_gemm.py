@@ -1,0 +1,49 @@
+"""Grouped GEMM for MoE expert compute.
+
+Reference: ``python/triton_dist/kernels/nvidia/group_gemm.py`` (1102 LoC
+persistent grouped GEMM with token-block swizzle) + ``moe_utils.py``.
+
+TPU form: tokens sorted by expert + ``jax.lax.ragged_dot`` (XLA's native
+grouped matmul, which tiles onto the MXU with group offsets) — the
+idiomatic equivalent of the reference's swizzled persistent kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_by_expert(tokens, expert_ids, num_experts: int):
+    """Sort (slots, d) tokens by local expert id (-1 = empty slots go
+    last). Returns (sorted_tokens, group_sizes (num_experts,), inverse
+    permutation to restore slot order)."""
+    key = jnp.where(expert_ids < 0, num_experts, expert_ids)
+    order = jnp.argsort(key, stable=True)
+    inv = jnp.argsort(order)
+    sorted_tok = tokens[order]
+    group_sizes = jnp.bincount(key[order], length=num_experts + 1)[:-1]
+    return sorted_tok, group_sizes.astype(jnp.int32), inv
+
+
+def grouped_gemm(x, w, group_sizes):
+    """x: (M, d) sorted by group; w: (E, d, f); group_sizes: (E,).
+    Returns (M, f) with rows of group e multiplied by w[e]."""
+    return jax.lax.ragged_dot(x, w, group_sizes,
+                              preferred_element_type=jnp.float32
+                              ).astype(x.dtype)
+
+
+def grouped_swiglu(x, w_gate, w_up, w_down, group_sizes):
+    """Per-expert SwiGLU MLP over expert-sorted tokens.
+
+    w_*: (E, d, f) / (E, d, f) / (E, f, d).
+    """
+    g = jax.lax.ragged_dot(x, w_gate, group_sizes,
+                           preferred_element_type=jnp.float32)
+    u = jax.lax.ragged_dot(x, w_up, group_sizes,
+                           preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    return jax.lax.ragged_dot(h, w_down, group_sizes,
+                              preferred_element_type=jnp.float32
+                              ).astype(x.dtype)

@@ -1,0 +1,109 @@
+"""KV-allgather (ring) attention for long-context prefill.
+
+Reference: ``kernels/nvidia/sp_ag_attention_intra_node.py`` (KV allgather
+push 2D :116, consumer FA forward waiting per-KV-tile :329) /
+``_inter_node.py`` — the repo's ring-attention analogue: KV tiles stream
+in ring order and each rank's attention consumes a tile as soon as it
+lands (SURVEY.md §2.5).
+
+TPU redesign: queries stay sequence-sharded; KV chunks rotate around the
+ring via ``lax.ppermute`` while flash-style online-softmax state
+(m, l, acc) accumulates per step — XLA's latency-hiding scheduler
+overlaps each ppermute with the previous chunk's attention compute (the
+same producer/consumer overlap the reference builds by hand).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True):
+    """Oracle: gather full KV then dense causal attention."""
+    from triton_dist_tpu.layers.tp_attn import sdpa
+
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    s_loc = q.shape[0]
+    k_full = jax.lax.all_gather(k, axis, axis=0, tiled=True)
+    v_full = jax.lax.all_gather(v, axis, axis=0, tiled=True)
+    if not causal:
+        return sdpa(q[None], k_full[None], v_full[None], causal=False)[0]
+    # Causal with the query offset of this rank's sequence slice.
+    scores_mask_offset = me * s_loc
+    return _masked_attn(q, k_full, v_full, scores_mask_offset)
+
+
+def _masked_attn(q, k, v, q_offset):
+    """Dense attention where query global position = q_offset + row."""
+    sq, h, hd = q.shape
+    skv, kvh = k.shape[0], k.shape[1]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores /= jnp.sqrt(jnp.float32(hd))
+    qi = q_offset + jnp.arange(sq)[:, None]
+    ki = jnp.arange(skv)[None, :]
+    scores = jnp.where((ki <= qi)[None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("hqk,khd->qhd", probs, v)
+
+
+def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
+    """Ring KV attention. q/k/v per-shard: (S_loc, H|KV, hd), sequence
+    sharded along ``axis``. Returns (S_loc, H, hd)."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    if n == 1:
+        return _masked_attn(q, k, v, 0)
+    s_loc, h, hd = q.shape
+    kvh = k.shape[1]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    q32 = q.astype(jnp.float32)
+    qi = me * s_loc + jnp.arange(s_loc)[:, None]  # global query positions
+
+    def step(carry, src_shift, rotate):
+        kc, vc, m, l, acc = carry
+        # KV chunk currently held originated at rank (me - src_shift).
+        src = jax.lax.rem(me - src_shift + n, n)
+        ki = src * s_loc + jnp.arange(s_loc)[None, :]
+        s_blk = jnp.einsum("qhd,khd->hqk", q32, kc.astype(jnp.float32)
+                           ) * scale
+        if causal:
+            s_blk = jnp.where((ki <= qi)[None], s_blk, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))      # (h, q)
+        # Guard fully-masked rows (m_new = -inf) against NaN.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s_blk - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s_blk), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = (acc * corr[..., None]
+               + jnp.einsum("hqk,khd->hqd", p, vc.astype(jnp.float32)))
+        m = m_new
+        if rotate:
+            # Rotate KV one hop right; XLA overlaps this transfer with
+            # the next step's compute.
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+        return (kc, vc, m, l, acc)
+
+    m0 = jnp.full((h, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((h, s_loc, hd), jnp.float32)
+    carry = (k, v, m0, l0, acc0)
+    for shift in range(n):  # static ring schedule
+        carry = step(carry, shift, rotate=shift < n - 1)
+    _, _, m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(1, 0, 2).astype(q.dtype)

@@ -1,0 +1,75 @@
+"""Ulysses sequence parallelism: head↔sequence resharding all-to-alls.
+
+Reference: ``kernels/nvidia/ulysses_sp_dispatch.py`` (707,
+``UlyssesSPPreAttnCommContext`` :470), ``pre_attn_a2a.py`` /
+``post_attn_a2a.py``, and the fused GEMM+A2A pair
+``sp_ulysess_qkv_gemm_all2all.py`` / ``sp_ulysess_o_all2all_gemm.py``.
+
+Layout contract (per shard, inside shard_map):
+- before attention: activations are *sequence-sharded* ``(S_loc, H, hd)``
+  with all heads present;
+- ``pre_attn_a2a`` → ``(S, H_loc, hd)``: full sequence, heads sharded —
+  what attention wants;
+- ``post_attn_a2a`` reverses.
+
+The transport is the low-latency all-to-all (``ops/all_to_all.py``);
+``impl="xla"`` uses ``lax.all_to_all``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.all_to_all import all_to_all, all_to_all_ref
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def _transport(x, ctx, axis, impl):
+    if impl == "xla" or ctx is None:
+        return all_to_all_ref(x, axis=axis)
+    return all_to_all(x, ctx=ctx, axis=axis)
+
+
+def pre_attn_a2a(x, *, axis: str = "sp", ctx: MeshContext = None,
+                 impl: str = "pallas"):
+    """(S_loc, H, hd) → (n·S_loc, H/n, hd)."""
+    n = jax.lax.axis_size(axis)
+    s_loc, h, hd = x.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sp={n}")
+    h_loc = h // n
+    # chunk r = the heads rank r owns.
+    x = x.reshape(s_loc, n, h_loc, hd).transpose(1, 0, 2, 3)
+    out = _transport(x, ctx, axis, impl)  # (n, S_loc, h_loc, hd) by src
+    return out.reshape(n * s_loc, h_loc, hd)
+
+
+def post_attn_a2a(x, *, axis: str = "sp", ctx: MeshContext = None,
+                  impl: str = "pallas"):
+    """(S, H_loc, hd) → (S/n, n·H_loc, hd) — inverse of pre_attn_a2a."""
+    n = jax.lax.axis_size(axis)
+    s, h_loc, hd = x.shape
+    if s % n:
+        raise ValueError(f"sequence {s} not divisible by sp={n}")
+    s_loc = s // n
+    x = x.reshape(n, s_loc, h_loc, hd)  # chunk r = rank r's seq slice
+    out = _transport(x, ctx, axis, impl)  # (n, s_loc, h_loc, hd) by src head owner
+    return out.transpose(1, 0, 2, 3).reshape(s_loc, n * h_loc, hd)
+
+
+def ulysses_attn(q, k, v, *, axis: str = "sp", ctx: MeshContext = None,
+                 impl: str = "pallas", causal: bool = True):
+    """Full Ulysses attention block on seq-sharded QKV.
+
+    q: (S_loc, H, hd); k/v: (S_loc, KV, hd) → returns (S_loc, H, hd).
+    The reference fuses these A2As into the QKV/O projections; here the
+    resharding is explicit and the projections stay in the caller.
+    """
+    from triton_dist_tpu.layers.tp_attn import sdpa
+
+    qh = pre_attn_a2a(q, axis=axis, ctx=ctx, impl=impl)
+    kh = pre_attn_a2a(k, axis=axis, ctx=ctx, impl=impl)
+    vh = pre_attn_a2a(v, axis=axis, ctx=ctx, impl=impl)
+    o = sdpa(qh[None], kh[None], vh[None], causal=causal)[0]
+    return post_attn_a2a(o, axis=axis, ctx=ctx, impl=impl)
