@@ -1,0 +1,141 @@
+// Megakernel task scheduler — native core of the megakernel runtime.
+//
+// Reference analogue: python/triton_dist/mega_triton_kernel/core/
+// scheduler.py:31 (SchedulingStrategy: round_robin / zig_zag packing of
+// tasks into per-SM work queues + flat dependency/scoreboard encoding)
+// and core/graph.py:101 (dependency Graph with dep optimization). The
+// reference keeps these in Python over torch tensors; on the TPU build
+// the scheduler is the natural native component (pure graph algorithms,
+// no device APIs), exposed to Python via ctypes.
+//
+// Responsibilities:
+//  - validate the dependency graph (cycle detection via Kahn's
+//    algorithm),
+//  - produce a dependency-respecting execution order,
+//  - pack tasks onto `num_cores` queues (round-robin or zig-zag over
+//    ready tasks, matching the reference's strategies),
+//  - emit the scoreboard encoding: for every task, the number of
+//    cross-core predecessors and the flat list of (pred_task) ids —
+//    what a multi-core TPU megakernel polls semaphores on. With one
+//    core per chip the queue order alone carries all dependencies and
+//    the scoreboard degenerates to zero entries.
+
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, -1 on cycle, -2 on bad input.
+// out_order:  execution order (task ids), length n_tasks.
+// out_core:   core id per task (indexed by task id), length n_tasks.
+// out_pos:    position within its core's queue, length n_tasks.
+// out_nxdeps: number of cross-core predecessors per task.
+// out_xdeps:  flat cross-core predecessor ids (capacity n_deps).
+int tdt_schedule(int32_t n_tasks, const int32_t* dep_src,
+                 const int32_t* dep_dst, int32_t n_deps,
+                 int32_t num_cores, int32_t strategy,
+                 int32_t* out_order, int32_t* out_core,
+                 int32_t* out_pos, int32_t* out_nxdeps,
+                 int32_t* out_xdeps) {
+  if (n_tasks < 0 || n_deps < 0 || num_cores < 1) return -2;
+  std::vector<std::vector<int32_t>> succ(n_tasks);
+  std::vector<std::vector<int32_t>> pred(n_tasks);
+  std::vector<int32_t> indeg(n_tasks, 0);
+  for (int32_t e = 0; e < n_deps; ++e) {
+    int32_t s = dep_src[e], d = dep_dst[e];
+    if (s < 0 || s >= n_tasks || d < 0 || d >= n_tasks) return -2;
+    succ[s].push_back(d);
+    pred[d].push_back(s);
+    ++indeg[d];
+  }
+
+  // Kahn's algorithm; FIFO keeps build order among ready tasks, which
+  // preserves the builder's layer-by-layer locality.
+  std::queue<int32_t> ready;
+  for (int32_t t = 0; t < n_tasks; ++t)
+    if (indeg[t] == 0) ready.push(t);
+
+  std::vector<int32_t> core_fill(num_cores, 0);
+  int32_t emitted = 0;
+  int32_t rr = 0;   // round-robin cursor
+  int32_t dir = 1;  // zig-zag direction
+  while (!ready.empty()) {
+    int32_t t = ready.front();
+    ready.pop();
+    out_order[emitted] = t;
+
+    // Core assignment (reference round_robin / zig_zag).
+    int32_t core;
+    if (strategy == 1 && num_cores > 1) {  // zig-zag
+      core = rr;
+      rr += dir;
+      if (rr == num_cores) { rr = num_cores - 1; dir = -1; }
+      else if (rr < 0) { rr = 0; dir = 1; }
+    } else {  // round-robin
+      core = rr;
+      rr = (rr + 1) % num_cores;
+    }
+    out_core[t] = core;
+    out_pos[t] = core_fill[core]++;
+    ++emitted;
+
+    for (int32_t s : succ[t])
+      if (--indeg[s] == 0) ready.push(s);
+  }
+  if (emitted != n_tasks) return -1;  // cycle
+
+  // Scoreboard: predecessors on a different core must be waited on.
+  int32_t xcursor = 0;
+  for (int32_t t = 0; t < n_tasks; ++t) {
+    int32_t count = 0;
+    for (int32_t p : pred[t]) {
+      if (out_core[p] != out_core[t]) {
+        out_xdeps[xcursor + count] = p;
+        ++count;
+      }
+    }
+    out_nxdeps[t] = count;
+    xcursor += count;
+  }
+  return 0;
+}
+
+// Transitive-reduction style dependency pruning (reference
+// enable_dep_opt, core/graph.py): drop edge (a, c) when a path
+// a -> b -> c of retained edges exists. O(V*E) BFS bound — fine for
+// decode graphs (thousands of tasks). Returns the new edge count.
+int32_t tdt_prune_deps(int32_t n_tasks, int32_t* dep_src,
+                       int32_t* dep_dst, int32_t n_deps) {
+  std::vector<std::vector<int32_t>> succ(n_tasks);
+  for (int32_t e = 0; e < n_deps; ++e) succ[dep_src[e]].push_back(dep_dst[e]);
+
+  auto reachable_without = [&](int32_t from, int32_t to) {
+    // BFS from `from` skipping the direct edge from->to.
+    std::vector<uint8_t> seen(n_tasks, 0);
+    std::queue<int32_t> q;
+    for (int32_t s : succ[from]) {
+      if (s == to) continue;  // skip direct edge (all copies)
+      if (!seen[s]) { seen[s] = 1; q.push(s); }
+    }
+    while (!q.empty()) {
+      int32_t u = q.front(); q.pop();
+      if (u == to) return true;
+      for (int32_t s : succ[u])
+        if (!seen[s]) { seen[s] = 1; q.push(s); }
+    }
+    return false;
+  };
+
+  int32_t kept = 0;
+  for (int32_t e = 0; e < n_deps; ++e) {
+    if (!reachable_without(dep_src[e], dep_dst[e])) {
+      dep_src[kept] = dep_src[e];
+      dep_dst[kept] = dep_dst[e];
+      ++kept;
+    }
+  }
+  return kept;
+}
+
+}  // extern "C"

@@ -1,0 +1,137 @@
+"""Megakernel: one persistent kernel per device must reproduce the
+layer-by-layer decode step (reference acceptance: megakernel output vs
+triton_dist layer path, ``mega_triton_kernel/test/models/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers import tp_attn, tp_mlp
+from triton_dist_tpu.layers.norm import rms_norm
+from triton_dist_tpu.megakernel import ModelBuilder, schedule
+from triton_dist_tpu.megakernel.graph import Graph
+from triton_dist_tpu.megakernel.task import TaskType
+from triton_dist_tpu.models import dense
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.utils.testing import spmd, assert_allclose
+
+CFG = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                       intermediate_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=8)
+B, MAXLEN, NTP = 2, 32, 2
+
+
+def test_scheduler_native():
+    """C++ scheduler: topological order + cycle detection."""
+    s = schedule(4, [0, 1, 2], [1, 2, 3], num_cores=1)
+    assert list(s["order"]) == [0, 1, 2, 3]
+    with pytest.raises(ValueError, match="cycle"):
+        schedule(2, [0, 1], [1, 0], num_cores=1)
+    # Multi-core packing keeps deps cross-core.
+    s = schedule(4, [0, 1], [2, 3], num_cores=2)
+    assert sorted(s["order"]) == [0, 1, 2, 3]
+
+
+def test_graph_dataflow_deps():
+    g = Graph()
+    t0 = g.add(TaskType.RMSNORM, (0, 0, 10, 1), reads=[(0, 2)],
+               writes=[(10, 2)])
+    t1 = g.add(TaskType.LINEAR, (10, 0, 20, 1, 1, 0), reads=[(10, 2)],
+               writes=[(20, 2)])
+    t2 = g.add(TaskType.ADD, (0, 20, 10, 1), reads=[(0, 2), (20, 2)],
+               writes=[(10, 2)])  # WAR on t1's read of 10
+    assert t1.deps == [t0.task_id]
+    assert t0.task_id in t2.deps or t1.task_id in t2.deps
+
+
+@pytest.fixture(scope="module")
+def tp2_mesh():
+    return Mesh(np.array(jax.devices()[:NTP]), ("tp",))
+
+
+def test_megakernel_decode_vs_layers(tp2_mesh):
+    mesh = tp2_mesh
+    mb = ModelBuilder(CFG, mesh, batch=B, max_len=MAXLEN, tile_w=16,
+                      t_tile=16)
+    params = dense.init_params(jax.random.PRNGKey(0), CFG)
+    specs = dense.param_specs(CFG)
+
+    kv_loc = CFG.num_key_value_heads // NTP
+    cache_shape = (CFG.num_hidden_layers, B, MAXLEN,
+                   CFG.num_key_value_heads, CFG.head_dim)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), cache_shape) * 0.3
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), cache_shape) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(3), (B, CFG.hidden_size))
+    pos = jnp.asarray(5, jnp.int32)
+    kvspec = P(None, None, None, "tp", None)
+
+    # --- megakernel path ---
+    pack = spmd(mesh, mb.pack_arena, (specs,), P("tp", None))
+    arena = pack(params)
+    step = spmd(mesh, mb.step_fn(),
+                (P("tp", None), kvspec, kvspec, P(None, None), P()),
+                (P(None, None), P("tp", None), kvspec, kvspec))
+    hidden, arena2, kc2, vc2 = step(arena, k_cache, v_cache, x, pos)
+
+    # --- layer-by-layer oracle (xla mode, proven against dense) ---
+    def oracle(p, xx, kc, vc):
+        h = xx
+        new_k, new_v = kc, vc
+        for li, lp in enumerate(p["layers"]):
+            t = rms_norm(h, lp["ln_attn"], CFG.rms_norm_eps)
+            ao, (lk, lv) = tp_attn.fwd_decode(
+                lp["attn"], t, CFG, new_k[li], new_v[li], pos, mode="xla")
+            new_k = new_k.at[li].set(lk)
+            new_v = new_v.at[li].set(lv)
+            h = h + ao
+            t = rms_norm(h, lp["ln_mlp"], CFG.rms_norm_eps)
+            h = h + tp_mlp.fwd(lp["mlp"], t, mode="xla_ar")
+        h = rms_norm(h, p["ln_f"], CFG.rms_norm_eps)
+        return h, new_k, new_v
+
+    of = spmd(mesh, oracle, (specs, P(None, None), kvspec, kvspec),
+              (P(None, None), kvspec, kvspec))
+    want_h, want_k, want_v = of(params, x, k_cache, v_cache)
+
+    assert_allclose(hidden, want_h, rtol=2e-3, atol=2e-3)
+    # Cache slot 5 must hold the new roped+normed K and the raw V.
+    assert_allclose(np.asarray(kc2)[:, :, 5], np.asarray(want_k)[:, :, 5],
+                    rtol=2e-3, atol=2e-3)
+    assert_allclose(np.asarray(vc2)[:, :, 5], np.asarray(want_v)[:, :, 5],
+                    rtol=2e-3, atol=2e-3)
+    # Untouched slots unchanged.
+    assert_allclose(np.asarray(kc2)[:, :, :5], np.asarray(k_cache)[:, :, :5])
+
+
+def test_megakernel_engine_generate(tp2_mesh):
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+    eng = MegaKernelEngine(CFG, tp2_mesh, batch=B, max_len=MAXLEN,
+                           tile_w=16, t_tile=16, seed=4)
+    toks = np.asarray(eng.generate(jnp.zeros((B,), jnp.int32), steps=4))
+    assert toks.shape == (B, 4)
+    assert np.isfinite(toks).all()
+
+    # Oracle: same params through the layer-path Engine decode chain.
+    from triton_dist_tpu.models import Engine
+    import jax.numpy as jnp2
+    params = jax.tree.map(np.asarray, eng.params)
+    e2 = Engine(CFG, tp2_mesh, mode="xla", max_len=MAXLEN, params=params)
+    # Drive the same chain manually: prefill over the single seed token
+    # is equivalent to a decode at position 0 on an empty cache.
+    from triton_dist_tpu.models.kv_cache import KVCache
+    kv_loc = CFG.num_key_value_heads  # spec shards it; global here
+    cache = KVCache.empty(CFG.num_hidden_layers, B, MAXLEN,
+                          CFG.num_key_value_heads, CFG.head_dim)
+    tok = jnp2.zeros((B,), jnp2.int32)
+    ref = []
+    for _ in range(4):
+        logits, cache = e2._decode(e2.params, tok, cache)
+        tok = jnp2.argmax(logits, -1).astype(jnp2.int32)
+        ref.append(np.asarray(tok))
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_array_equal(toks, ref)

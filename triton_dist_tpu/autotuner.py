@@ -1,0 +1,74 @@
+"""Config autotuner for fused kernels.
+
+Reference: ``python/triton_dist/autotuner.py`` (250 LoC) —
+``ContextualAutoTuner`` steps all ranks through configs *in lockstep*
+with error-sync so a crashed config can't deadlock the job
+(``autotuner.py:43``, ``contextual_autotune(is_dist=True)`` :97).
+
+JAX redesign: an SPMD program is already lockstep — every host traces
+the same config sequence deterministically, and a config that fails to
+compile fails identically everywhere, so the reference's error-sync
+machinery reduces to a deterministic try/except. Timing uses the
+chained-slope harness (``profiler_utils.perf_func``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from triton_dist_tpu import tune
+from triton_dist_tpu.profiler_utils import perf_func
+
+
+@dataclasses.dataclass
+class Config:
+    """One tuning point (kwargs merged into the op call)."""
+    kwargs: Dict[str, Any]
+
+    def __repr__(self):
+        return f"Config({self.kwargs})"
+
+
+def autotune(op_name: str, configs: Sequence[Dict[str, Any]],
+             key_fn: Callable[..., Dict[str, Any]],
+             prune_fn: Optional[Callable] = None):
+    """Decorator: ``fn(*args, **config_kwargs)`` is swept over
+    ``configs`` on first use per cache key; the winner persists in the
+    tune cache (reference ``triton_dist.tune.autotune``).
+
+    ``key_fn(*args, **kwargs) -> dict`` of static attributes (shapes,
+    dtypes, mesh) forming the cache key. ``prune_fn(config, *args)``
+    may veto configs before timing.
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            attrs = key_fn(*args, **kwargs)
+            key = tune.make_key(op_name, **attrs)
+            cached = tune.load_autotune_data(key)
+            if cached is not None:
+                return fn(*args, **kwargs, **cached)
+
+            candidates = [c for c in configs
+                          if prune_fn is None or prune_fn(c, *args)]
+            if not candidates:
+                return fn(*args, **kwargs)
+            best_cfg, best_t = None, float("inf")
+            for cfg in candidates:
+                try:
+                    t = perf_func(
+                        lambda *a: fn(*a, **kwargs, **cfg), args)
+                except Exception:
+                    # Deterministic across hosts: every rank sees the
+                    # same failure and skips the same config.
+                    continue
+                if t < best_t:
+                    best_cfg, best_t = cfg, t
+            if best_cfg is None:
+                return fn(*args, **kwargs)
+            tune.store_autotune_data(key, best_cfg, best_t)
+            return fn(*args, **kwargs, **best_cfg)
+        return wrapper
+    return deco

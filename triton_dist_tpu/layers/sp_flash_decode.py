@@ -43,11 +43,8 @@ def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis: str = "sp"):
     k = jnp.dot(x, params["wk"]).reshape(b, 1, kvh, hd)
     v = jnp.dot(x, params["wv"]).reshape(b, 1, kvh, hd)
     positions = jnp.full((b, 1), cache_len, jnp.int32)
-    inv_freq = rope_freqs(hd, cfg.rope_theta)
-    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    from triton_dist_tpu.layers import tp_attn
+    q, k = tp_attn._norm_rope(q, k, params, cfg, positions)
 
     # Append on the rank that owns slot ``cache_len``.
     owner = cache_len // t_loc

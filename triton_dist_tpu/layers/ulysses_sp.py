@@ -47,13 +47,10 @@ def fwd(params, x, cfg, *, axis: str = "sp", ctx: MeshContext = None,
     k = jnp.dot(x, params["wk"]).reshape(s_loc, kvh, hd)
     v = jnp.dot(x, params["wv"]).reshape(s_loc, kvh, hd)
 
-    # Rope with *global* positions (this rank's sequence slice).
+    # q/k norm + rope with *global* positions (this rank's seq slice).
     positions = (me * s_loc + jnp.arange(s_loc))[None]
-    inv_freq = rope_freqs(hd, cfg.rope_theta)
-    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
-    q = apply_rope(q[None], positions, inv_freq)[0]
-    k = apply_rope(k[None], positions, inv_freq)[0]
+    q, k = tp_attn._norm_rope(q[None], k[None], params, cfg, positions)
+    q, k = q[0], k[0]
 
     # Head-reshard, attend over the full sequence, reshard back.
     qh = pre_attn_a2a(q, axis=axis, ctx=ctx, impl=impl)

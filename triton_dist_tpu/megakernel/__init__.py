@@ -1,0 +1,23 @@
+"""Megakernel runtime: a whole decode step as one persistent per-core
+Pallas kernel (reference: ``python/triton_dist/mega_triton_kernel/``,
+SURVEY.md §2.8).
+
+Execution model mapping:
+
+- reference: every SM loops over a private work queue, spin-waiting on a
+  ``scoreboard[layer, task, tile]`` tensor (``core/scheduler.py:71-100``)
+  and dispatching generated if/elif task bodies
+  (``core/code_generator.py:193-243``).
+- here: a TPU core runs its whole queue as the grid of one Pallas call —
+  grid iteration = queue slot; task descriptors arrive via scalar
+  prefetch; dispatch is a ``lax.switch`` over task types reading/writing
+  one HBM arena at dynamic offsets. Per-core ordering subsumes the
+  scoreboard; cross-chip tasks (allreduce) synchronize with DMA
+  semaphores. The native C++ scheduler (``csrc/megakernel_scheduler.cc``)
+  orders tasks, packs multi-core queues, and prunes dependencies.
+"""
+
+from triton_dist_tpu.megakernel.task import TaskType, Task  # noqa: F401
+from triton_dist_tpu.megakernel.graph import Graph  # noqa: F401
+from triton_dist_tpu.megakernel.scheduler import schedule, prune_deps  # noqa: F401
+from triton_dist_tpu.megakernel.builder import ModelBuilder  # noqa: F401

@@ -1,0 +1,361 @@
+"""ModelBuilder: record a decode step as tasks, schedule natively, run
+as ONE persistent Pallas kernel.
+
+Reference: ``mega_triton_kernel/models/model_builder.py:86``
+``ModelBuilder`` — records ops via task builders (:192), ``compile()``
+:514 (dep opt → enqueue → codegen → import), ``run()`` :557 launching
+``MEGA_TRITON_KERNEL[grid=(NUM_SMS,)]``.
+
+TPU differences: instead of generating Triton source text, the kernel
+is a *task interpreter* — grid = the core's work queue, task descriptors
+arrive via scalar prefetch, dispatch is ``lax.switch``
+(``megakernel/kernels.py``); the C++ scheduler orders/packs the queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import core_call, comm_compiler_params
+from triton_dist_tpu.megakernel import kernels as K
+from triton_dist_tpu.megakernel.graph import Graph
+from triton_dist_tpu.megakernel.scheduler import schedule
+from triton_dist_tpu.megakernel.task import ARGS_MAX, TaskType
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+class ModelBuilder:
+    """Builds the Qwen3 dense decode step as a megakernel."""
+
+    def __init__(self, cfg: ModelConfig, mesh, *, batch: int,
+                 max_len: int, axis: str = "tp",
+                 tile_w: Optional[int] = None, t_tile: Optional[int] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.mctx = MeshContext.from_mesh(mesh)
+        self.axis = axis
+        self.n = self.mctx.size(axis)
+        self.batch = batch
+        self.max_len = max_len
+        hd = cfg.head_dim
+        self.w = tile_w or max(128, hd)
+        if self.w % hd:
+            raise ValueError(f"tile width {self.w} must be a multiple of "
+                             f"head_dim {hd}")
+        self.t_tile = t_tile or min(128, max_len)
+        if max_len % self.t_tile:
+            raise ValueError("max_len must divide t_tile")
+
+        n = self.n
+        self.h_loc = cfg.num_attention_heads // n
+        self.kv_loc = cfg.num_key_value_heads // n
+        self.d_tiles = _cdiv(cfg.hidden_size, self.w)
+        self.hq_tiles = _cdiv(self.h_loc * hd, self.w)
+        self.kv_tiles = _cdiv(self.kv_loc * hd, self.w)
+        self.ff_tiles = _cdiv(cfg.intermediate_size // n, self.w)
+
+        self._cursor = 0
+        self._offsets: Dict[str, int] = {}
+        self.graph = Graph()
+        self._weight_entries: List[Tuple[str, int]] = []
+        self._build()
+
+    # ---------------- arena layout -------------------------------------
+    def _alloc(self, name: str, rows: int) -> int:
+        off = self._cursor
+        self._offsets[name] = off
+        self._cursor += rows
+        return off
+
+    def _alloc_act(self, name: str, tiles: int) -> int:
+        return self._alloc(name, tiles * self.batch)
+
+    # ---------------- recording helpers --------------------------------
+    def _linear(self, in_off, w_off, out_off, k_tiles, n_tiles, *,
+                layer, in_rows, w_rows):
+        b = self.batch
+        for j in range(n_tiles):
+            self.graph.add(
+                TaskType.LINEAR,
+                (in_off, w_off, out_off, k_tiles, n_tiles, j),
+                reads=[(in_off, in_rows), (w_off, w_rows)],
+                writes=[(out_off + j * b, b)], layer=layer)
+
+    def _build(self):
+        cfg, b, w = self.cfg, self.batch, self.w
+        d_t, hq_t, kv_t, ff_t = (self.d_tiles, self.hq_tiles,
+                                 self.kv_tiles, self.ff_tiles)
+
+        # Weights region (per layer) — order defines pack_arena.
+        def walloc(name, k_tiles, n_tiles):
+            rows = k_tiles * n_tiles * w
+            off = self._alloc(name, rows)
+            self._weight_entries.append((name, rows))
+            return off
+
+        def vecalloc(name, tiles):
+            off = self._alloc(name, tiles)
+            self._weight_entries.append((name, tiles))
+            return off
+
+        L = cfg.num_hidden_layers
+        wo_offs = []
+        for li in range(L):
+            walloc(f"l{li}.wq", d_t, hq_t)
+            walloc(f"l{li}.wk", d_t, kv_t)
+            walloc(f"l{li}.wv", d_t, kv_t)
+            walloc(f"l{li}.wo", hq_t, d_t)
+            walloc(f"l{li}.w_gate", d_t, ff_t)
+            walloc(f"l{li}.w_up", d_t, ff_t)
+            walloc(f"l{li}.w_down", ff_t, d_t)
+            vecalloc(f"l{li}.ln_attn", d_t)
+            vecalloc(f"l{li}.ln_mlp", d_t)
+            vecalloc(f"l{li}.q_norm", 1)
+            vecalloc(f"l{li}.k_norm", 1)
+        vecalloc("ln_f", d_t)
+
+        # Allreduce workspace + I/O regions.
+        ar_max_tiles = max(d_t, 1)
+        self.ar_ws_off = self._alloc("ar_ws", self.n * ar_max_tiles * b)
+        self.ar_max_tiles = ar_max_tiles
+        x_off = self._alloc_act("x", d_t)
+        self.x_off = x_off
+
+        # Per-layer tasks.
+        g = self.graph
+        o = self._offsets
+        for li in range(L):
+            t0 = self._alloc_act(f"l{li}.t0", d_t)
+            q = self._alloc_act(f"l{li}.q", hq_t)
+            kx = self._alloc_act(f"l{li}.k", kv_t)
+            vx = self._alloc_act(f"l{li}.v", kv_t)
+            attn = self._alloc_act(f"l{li}.attn", hq_t)
+            opart = self._alloc_act(f"l{li}.opart", d_t)
+            x1 = self._alloc_act(f"l{li}.x1", d_t)
+            t1 = self._alloc_act(f"l{li}.t1", d_t)
+            gx = self._alloc_act(f"l{li}.g", ff_t)
+            ux = self._alloc_act(f"l{li}.u", ff_t)
+            hx = self._alloc_act(f"l{li}.h", ff_t)
+            mpart = self._alloc_act(f"l{li}.mpart", d_t)
+            x2 = self._alloc_act(f"l{li}.x2", d_t)
+
+            g.add(TaskType.RMSNORM,
+                  (x_off, o[f"l{li}.ln_attn"], t0, d_t),
+                  reads=[(x_off, d_t * b), (o[f"l{li}.ln_attn"], d_t)],
+                  writes=[(t0, d_t * b)], layer=li)
+            self._linear(t0, o[f"l{li}.wq"], q, d_t, hq_t, layer=li,
+                         in_rows=d_t * b, w_rows=d_t * hq_t * w)
+            self._linear(t0, o[f"l{li}.wk"], kx, d_t, kv_t, layer=li,
+                         in_rows=d_t * b, w_rows=d_t * kv_t * w)
+            self._linear(t0, o[f"l{li}.wv"], vx, d_t, kv_t, layer=li,
+                         in_rows=d_t * b, w_rows=d_t * kv_t * w)
+            g.add(TaskType.WRITE_KV,
+                  (kx, vx, li, o[f"l{li}.k_norm"]),
+                  reads=[(kx, kv_t * b), (vx, kv_t * b),
+                         (o[f"l{li}.k_norm"], 1)],
+                  writes=[], layer=li)
+            # ATTN reads the cache written by WRITE_KV — encode the
+            # ordering as an artificial region keyed off the task above.
+            attn_task = g.add(TaskType.ATTN_DECODE,
+                              (q, attn, li, o[f"l{li}.q_norm"]),
+                              reads=[(q, hq_t * b),
+                                     (o[f"l{li}.q_norm"], 1)],
+                              writes=[(attn, hq_t * b)], layer=li)
+            attn_task.deps.append(g.tasks[-2].task_id)  # after WRITE_KV
+            self._linear(attn, o[f"l{li}.wo"], opart, hq_t, d_t,
+                         layer=li, in_rows=hq_t * b,
+                         w_rows=hq_t * d_t * w)
+            g.add(TaskType.ALLREDUCE, (opart, d_t),
+                  reads=[(opart, d_t * b)],
+                  writes=[(opart, d_t * b),
+                          (self.ar_ws_off, self.n * ar_max_tiles * b)],
+                  layer=li)
+            g.add(TaskType.ADD, (x_off, opart, x1, d_t),
+                  reads=[(x_off, d_t * b), (opart, d_t * b)],
+                  writes=[(x1, d_t * b)], layer=li)
+            g.add(TaskType.RMSNORM,
+                  (x1, o[f"l{li}.ln_mlp"], t1, d_t),
+                  reads=[(x1, d_t * b), (o[f"l{li}.ln_mlp"], d_t)],
+                  writes=[(t1, d_t * b)], layer=li)
+            self._linear(t1, o[f"l{li}.w_gate"], gx, d_t, ff_t, layer=li,
+                         in_rows=d_t * b, w_rows=d_t * ff_t * w)
+            self._linear(t1, o[f"l{li}.w_up"], ux, d_t, ff_t, layer=li,
+                         in_rows=d_t * b, w_rows=d_t * ff_t * w)
+            g.add(TaskType.SILU_MUL, (gx, ux, hx, ff_t),
+                  reads=[(gx, ff_t * b), (ux, ff_t * b)],
+                  writes=[(hx, ff_t * b)], layer=li)
+            self._linear(hx, o[f"l{li}.w_down"], mpart, ff_t, d_t,
+                         layer=li, in_rows=ff_t * b,
+                         w_rows=ff_t * d_t * w)
+            g.add(TaskType.ALLREDUCE, (mpart, d_t),
+                  reads=[(mpart, d_t * b)],
+                  writes=[(mpart, d_t * b),
+                          (self.ar_ws_off, self.n * ar_max_tiles * b)],
+                  layer=li)
+            g.add(TaskType.ADD, (x1, mpart, x2, d_t),
+                  reads=[(x1, d_t * b), (mpart, d_t * b)],
+                  writes=[(x2, d_t * b)], layer=li)
+            x_off = x2
+
+        out_off = self._alloc_act("x_final", d_t)
+        g.add(TaskType.RMSNORM, (x_off, o["ln_f"], out_off, d_t),
+              reads=[(x_off, d_t * b), (o["ln_f"], d_t)],
+              writes=[(out_off, d_t * b)])
+        self.out_off = out_off
+        self.arena_rows = self._cursor
+
+        # -------- native schedule --------
+        src, dst = g.edges()
+        sched = schedule(len(g.tasks), src, dst, num_cores=1)
+        self.order = sched["order"]
+        self.task_types = np.array(
+            [g.tasks[t].task_type for t in self.order], np.int32)
+        self.task_args = np.array(
+            [g.tasks[t].encoded_args() for t in self.order], np.int32)
+
+    # ---------------- arena packing ------------------------------------
+    def _tile_weight(self, wmat, k_tiles, n_tiles):
+        w = self.w
+        kpad, npad = k_tiles * w, n_tiles * w
+        wm = jnp.zeros((kpad, npad), jnp.float32).at[
+            :wmat.shape[0], :wmat.shape[1]].set(wmat.astype(jnp.float32))
+        return wm.reshape(k_tiles, w, n_tiles, w).transpose(
+            0, 2, 1, 3).reshape(k_tiles * n_tiles * w, w)
+
+    def _pad_vec(self, vec, tiles):
+        w = self.w
+        out = jnp.zeros((tiles * w,), jnp.float32).at[
+            :vec.shape[0]].set(vec.astype(jnp.float32))
+        return out.reshape(tiles, w)
+
+    def pack_arena(self, params) -> jax.Array:
+        """Per-shard: assemble the weight region + zeroed activation
+        region into the (arena_rows, w) arena (traced; run inside
+        shard_map so ``params`` are the local shards)."""
+        cfg = self.cfg
+        d_t, hq_t, kv_t, ff_t = (self.d_tiles, self.hq_tiles,
+                                 self.kv_tiles, self.ff_tiles)
+        parts = []
+        for li in range(cfg.num_hidden_layers):
+            lp = params["layers"][li]
+            parts.append(self._tile_weight(lp["attn"]["wq"], d_t, hq_t))
+            parts.append(self._tile_weight(lp["attn"]["wk"], d_t, kv_t))
+            parts.append(self._tile_weight(lp["attn"]["wv"], d_t, kv_t))
+            parts.append(self._tile_weight(lp["attn"]["wo"], hq_t, d_t))
+            parts.append(self._tile_weight(lp["mlp"]["w_gate"], d_t, ff_t))
+            parts.append(self._tile_weight(lp["mlp"]["w_up"], d_t, ff_t))
+            parts.append(self._tile_weight(lp["mlp"]["w_down"], ff_t, d_t))
+            parts.append(self._pad_vec(lp["ln_attn"], d_t))
+            parts.append(self._pad_vec(lp["ln_mlp"], d_t))
+            parts.append(self._pad_vec(lp["attn"]["q_norm"], 1))
+            parts.append(self._pad_vec(lp["attn"]["k_norm"], 1))
+        parts.append(self._pad_vec(params["ln_f"], d_t))
+        weights = jnp.concatenate(parts, axis=0)
+        pad = jnp.zeros((self.arena_rows - weights.shape[0], self.w),
+                        jnp.float32)
+        return jnp.concatenate([weights, pad], axis=0)
+
+    # ---------------- the megakernel -----------------------------------
+    def kernel_config(self) -> K.KernelConfig:
+        return K.KernelConfig(
+            w=self.w, batch=self.batch, h_loc=self.h_loc,
+            kv_loc=self.kv_loc, hd=self.cfg.head_dim,
+            rope_theta=self.cfg.rope_theta, rms_eps=self.cfg.rms_norm_eps,
+            n_ranks=self.n, axis=self.axis, mesh=self.mctx,
+            ar_ws_off=self.ar_ws_off, ar_max_tiles=self.ar_max_tiles)
+
+    def _kernel(self, types_s, args_s, len_s, arena_in, kc_in, vc_in,
+                arena, k_cache, v_cache, va, vb, vc, vw, acc, vhd, vkt,
+                send_sem, recv_sem):
+        cfg = self.kernel_config()
+        i = pl.program_id(0)
+        ttype = types_s[i]
+        args = tuple(args_s[i, j] for j in range(ARGS_MAX))
+        refs = {"arena": arena, "k_cache": k_cache, "v_cache": v_cache,
+                "va": va, "vb": vb, "vc": vc, "vw": vw, "acc": acc,
+                "vhd": vhd, "vkt": vkt, "send_sem": send_sem,
+                "recv_sem": recv_sem}
+
+        branches = [
+            lambda: K.rmsnorm_body(cfg, args, refs),
+            lambda: K.linear_body(cfg, args, refs),
+            lambda: K.add_body(cfg, args, refs),
+            lambda: K.silu_mul_body(cfg, args, refs),
+            lambda: K.attn_decode_body(cfg, args, refs, len_s),
+            lambda: K.write_kv_body(cfg, args, refs, len_s),
+            lambda: K.allreduce_body(cfg, args, refs),
+        ]
+        jax.lax.switch(ttype, branches)
+
+    def step_fn(self):
+        """Per-shard decode step: (arena, k_cache, v_cache, x, cache_len)
+        → (hidden (B, d), arena, k_cache, v_cache). Call inside
+        shard_map; donate arena + caches at jit level."""
+        b, w, d_t = self.batch, self.w, self.d_tiles
+        cfg = self.cfg
+        T = len(self.task_types)
+        types = jnp.asarray(self.task_types)
+        args = jnp.asarray(self.task_args)
+
+        def step(arena, k_cache, v_cache, x, cache_len):
+            # Write x (B, d) into its arena region as (d_t*b, w) tiles.
+            xcols = jnp.zeros((b, d_t * w), jnp.float32).at[
+                :, :cfg.hidden_size].set(x.astype(jnp.float32))
+            xt = xcols.reshape(b, d_t, w).transpose(1, 0, 2).reshape(
+                d_t * b, w)
+            arena = jax.lax.dynamic_update_slice(
+                arena, xt, (self.x_off, 0))
+            len_arr = jnp.asarray([cache_len], jnp.int32)
+
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(T,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+                scratch_shapes=[
+                    pltpu.VMEM((b, w), jnp.float32),       # va
+                    pltpu.VMEM((b, w), jnp.float32),       # vb
+                    pltpu.VMEM((b, w), jnp.float32),       # vc
+                    pltpu.VMEM((w, w), jnp.float32),       # vw
+                    pltpu.VMEM((b, w), jnp.float32),       # acc
+                    pltpu.VMEM((b, self.cfg.head_dim), jnp.float32),
+                    pltpu.VMEM((self.t_tile, self.cfg.head_dim),
+                               jnp.float32),                # vkt
+                    pltpu.SemaphoreType.DMA((max(self.n - 1, 1),)),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+            )
+            arena, k_cache, v_cache = core_call(
+                self._kernel,
+                grid_spec=grid_spec,
+                out_shape=(
+                    jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+                    jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                    jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+                ),
+                input_output_aliases={3: 0, 4: 1, 5: 2},
+                compiler_params=comm_compiler_params(),
+            )(types, args, len_arr, arena, k_cache, v_cache)
+
+            out_rows = jax.lax.dynamic_slice(
+                arena, (self.out_off, 0), (d_t * b, w))
+            hidden = out_rows.reshape(d_t, b, w).transpose(1, 0, 2
+                                                           ).reshape(b, d_t * w)
+            return hidden[:, :cfg.hidden_size], arena, k_cache, v_cache
+
+        return step

@@ -1,0 +1,79 @@
+"""Megakernel inference engine: greedy decode where every step is ONE
+persistent Pallas kernel (reference: ``mega_triton_kernel/test/models/``
+chat demo / ``model_server.py`` / ``bench_qwen3.py``).
+
+Embedding lookup and the LM head run outside the megakernel (cheap
+gather / single matmul); everything between — norms, projections, rope,
+flash decode over the cache, SwiGLU, and the TP allreduces — executes
+inside it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.megakernel.builder import ModelBuilder
+from triton_dist_tpu.models import dense
+from triton_dist_tpu.models.config import ModelConfig
+
+
+class MegaKernelEngine:
+    def __init__(self, cfg: ModelConfig, mesh: Mesh, *, batch: int,
+                 max_len: int = 512, axis: str = "tp", params=None,
+                 seed: int = 0, tile_w=None, t_tile=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.max_len = max_len
+        self.builder = ModelBuilder(cfg, mesh, batch=batch,
+                                    max_len=max_len, axis=axis,
+                                    tile_w=tile_w, t_tile=t_tile)
+        specs = dense.param_specs(cfg, axis)
+        if params is None:
+            params = dense.init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs)
+
+        kvspec = P(None, None, None, axis, None)
+        self._arena = jax.jit(jax.shard_map(
+            self.builder.pack_arena, mesh=mesh, in_specs=(specs,),
+            out_specs=P(axis, None), check_vma=False))(self.params)
+
+        step = self.builder.step_fn()
+        self._step = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(axis, None), kvspec, kvspec, P(None, None), P()),
+            out_specs=(P(None, None), P(axis, None), kvspec, kvspec),
+            check_vma=False), donate_argnums=(0, 1, 2))
+
+        n = mesh.shape[axis]
+        kv = cfg.num_key_value_heads
+        shape = (cfg.num_hidden_layers, batch, max_len, kv, cfg.head_dim)
+        self.k_cache = jax.device_put(
+            jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
+        self.v_cache = jax.device_put(
+            jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
+
+    def decode_step(self, token_ids, cache_len) -> jax.Array:
+        """token_ids: (B,) → logits (B, vocab). Advances the caches."""
+        x = jnp.asarray(self.params["embed"])[token_ids]
+        hidden, self._arena, self.k_cache, self.v_cache = self._step(
+            self._arena, self.k_cache, self.v_cache, x,
+            jnp.asarray(cache_len, jnp.int32))
+        return jnp.dot(hidden, jnp.asarray(self.params["lm_head"]).T)
+
+    def generate(self, first_tokens, steps: int):
+        """Greedy chain from (B,) seed tokens; returns (B, steps)."""
+        tok = jnp.asarray(first_tokens, jnp.int32)
+        out = []
+        for i in range(steps):
+            logits = self.decode_step(tok, i)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(tok)
+        return jnp.stack(out, axis=1)

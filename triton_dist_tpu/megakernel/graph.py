@@ -1,0 +1,59 @@
+"""Dependency graph (reference: ``mega_triton_kernel/core/graph.py:101``
+``Graph`` with dependency optimization under ``enable_dep_opt``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from triton_dist_tpu.megakernel.task import Task, TaskType
+
+
+class Graph:
+    """Records tasks and infers dependencies from arena data flow:
+    a task depends on the most recent writers of the regions it reads
+    and the most recent accessor of regions it writes (WAR/WAW)."""
+
+    def __init__(self):
+        self.tasks: List[Task] = []
+        self._last_writer: Dict[Tuple[int, int], int] = {}
+        self._readers: Dict[Tuple[int, int], List[int]] = {}
+
+    def add(self, task_type: TaskType, args, *, reads, writes,
+            layer: int = -1) -> Task:
+        """reads/writes: list of (offset, size) arena regions."""
+        t = Task(task_id=len(self.tasks), task_type=task_type,
+                 args=tuple(int(a) for a in args), layer=layer)
+        deps = set()
+        for region in reads:
+            for key, writer in self._overlapping(self._last_writer, region):
+                deps.add(writer)
+            self._readers.setdefault(self._key(region), []).append(t.task_id)
+        for region in writes:
+            for key, writer in self._overlapping(self._last_writer, region):
+                deps.add(writer)  # WAW
+            for key, readers in self._overlapping(self._readers, region):
+                deps.update(readers)  # WAR
+            self._last_writer[self._key(region)] = t.task_id
+            self._readers[self._key(region)] = []
+        t.deps = sorted(d for d in deps if d != t.task_id)
+        self.tasks.append(t)
+        return t
+
+    @staticmethod
+    def _key(region):
+        return (int(region[0]), int(region[1]))
+
+    @staticmethod
+    def _overlap(a, b):
+        return a[0] < b[0] + b[1] and b[0] < a[0] + a[1]
+
+    def _overlapping(self, table, region):
+        return [(k, v) for k, v in table.items() if self._overlap(k, region)]
+
+    def edges(self):
+        src, dst = [], []
+        for t in self.tasks:
+            for d in t.deps:
+                src.append(d)
+                dst.append(t.task_id)
+        return src, dst

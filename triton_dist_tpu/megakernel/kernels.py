@@ -1,0 +1,342 @@
+"""Per-task device code for the megakernel interpreter.
+
+Reference: ``mega_triton_kernel/kernels/`` (linear, flash_decode paged,
+norm, activation, allreduce via symm buffers, barrier) — one Triton
+function per task type, dispatched by generated if/elif
+(``core/code_generator.py:193-243``).
+
+TPU redesign: task bodies are closures over a static ``KernelConfig``;
+dispatch is ``lax.switch`` on the prefetched task type. All tensors live
+in one HBM arena of shape ``(rows, W)`` — activations as consecutive
+``(B, W)`` tiles, weights pre-tiled into ``(W, W)`` blocks (tile-major),
+so every dynamic access is a contiguous ``pl.ds`` row slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.megakernel.task import ARGS_MAX, TaskType
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    w: int                  # arena lane width (tile size)
+    batch: int              # decode batch B
+    h_loc: int              # local attention heads
+    kv_loc: int             # local KV heads
+    hd: int                 # head dim (<= w)
+    rope_theta: float
+    rms_eps: float
+    n_ranks: int            # TP size
+    axis: str               # mesh axis name ("tp")
+    mesh: MeshContext
+    ar_ws_off: int          # arena row offset of the allreduce workspace
+    ar_max_tiles: int       # max (B, W) tiles a single allreduce moves
+
+
+def _act(arena, off, tiles_b):
+    """Contiguous activation slab: ``tiles_b`` rows of the arena."""
+    return arena.at[pl.ds(off, tiles_b)]
+
+
+# ---------------------------------------------------------------------------
+# Task bodies. Common closure args: cfg + refs
+# (args_s, len_s, arena, k_cache, v_cache, vmem scratches, sems).
+# ---------------------------------------------------------------------------
+
+def rmsnorm_body(cfg, args, refs):
+    arena, va, vb, vc, acc = (refs["arena"], refs["va"], refs["vb"],
+                              refs["vc"], refs["acc"])
+    in_off, w_off, out_off, d_tiles = args[0], args[1], args[2], args[3]
+    b = cfg.batch
+
+    def ssq_step(j, ssq):
+        pltpu.sync_copy(arena.at[pl.ds(in_off + j * b, b)], va)
+        x = va[...].astype(jnp.float32)
+        return ssq + jnp.sum(x * x, axis=1, keepdims=True)
+
+    ssq = jax.lax.fori_loop(0, d_tiles, ssq_step,
+                            jnp.zeros((b, 1), jnp.float32))
+    inv = jax.lax.rsqrt(ssq / (d_tiles * cfg.w).astype(jnp.float32)
+                        + cfg.rms_eps)
+
+    def norm_step(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(in_off + j * b, b)], va)
+        pltpu.sync_copy(arena.at[pl.ds(w_off + j, 1)],
+                        vc.at[pl.ds(0, 1)])
+        vb[...] = (va[...].astype(jnp.float32) * inv
+                   * vc[0:1, :].astype(jnp.float32))
+        pltpu.sync_copy(vb, arena.at[pl.ds(out_off + j * b, b)])
+        return 0
+
+    jax.lax.fori_loop(0, d_tiles, norm_step, 0)
+
+
+def linear_body(cfg, args, refs):
+    arena, va, vw, acc = (refs["arena"], refs["va"], refs["vw"],
+                          refs["acc"])
+    in_off, w_off, out_off = args[0], args[1], args[2]
+    k_tiles, n_tiles, j = args[3], args[4], args[5]
+    b, w = cfg.batch, cfg.w
+
+    def kt_step(kt, a):
+        pltpu.sync_copy(arena.at[pl.ds(in_off + kt * b, b)], va)
+        pltpu.sync_copy(
+            arena.at[pl.ds(w_off + (kt * n_tiles + j) * w, w)], vw)
+        return a + jnp.dot(va[...], vw[...],
+                           preferred_element_type=jnp.float32)
+
+    out = jax.lax.fori_loop(0, k_tiles, kt_step,
+                            jnp.zeros((b, w), jnp.float32))
+    acc[...] = out
+    pltpu.sync_copy(acc, arena.at[pl.ds(out_off + j * b, b)])
+
+
+def add_body(cfg, args, refs):
+    arena, va, vb, vc = refs["arena"], refs["va"], refs["vb"], refs["vc"]
+    a_off, b_off, out_off, tiles = args[0], args[1], args[2], args[3]
+    b = cfg.batch
+
+    def step(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(a_off + j * b, b)], va)
+        pltpu.sync_copy(arena.at[pl.ds(b_off + j * b, b)], vb)
+        vc[...] = va[...] + vb[...]
+        pltpu.sync_copy(vc, arena.at[pl.ds(out_off + j * b, b)])
+        return 0
+
+    jax.lax.fori_loop(0, tiles, step, 0)
+
+
+def silu_mul_body(cfg, args, refs):
+    arena, va, vb, vc = refs["arena"], refs["va"], refs["vb"], refs["vc"]
+    g_off, u_off, out_off, tiles = args[0], args[1], args[2], args[3]
+    b = cfg.batch
+
+    def step(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(g_off + j * b, b)], va)
+        pltpu.sync_copy(arena.at[pl.ds(u_off + j * b, b)], vb)
+        g = va[...].astype(jnp.float32)
+        vc[...] = jax.nn.silu(g) * vb[...].astype(jnp.float32)
+        pltpu.sync_copy(vc, arena.at[pl.ds(out_off + j * b, b)])
+        return 0
+
+    jax.lax.fori_loop(0, tiles, step, 0)
+
+
+def _rope_vec(x, pos, hd, theta):
+    """x: (rows, hd) fp32; rotate-half rope at scalar position pos."""
+    half = hd // 2
+    # broadcasted_iota instead of arange: pallas kernels cannot capture
+    # host constants.
+    idx = jax.lax.broadcasted_iota(jnp.float32, (1, half), 1)[0] * 2.0
+    inv = 1.0 / (theta ** (idx / hd))
+    ang = pos.astype(jnp.float32) * inv          # (half,)
+    cos, sin = jnp.cos(ang)[None], jnp.sin(ang)[None]
+    x1, x2 = x[:, :half], x[:, half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=1)
+
+
+def _rms_rows(x, w_row, eps):
+    """Row-wise RMSNorm of (rows, hd) fp32 with (hd,) weight."""
+    var = jnp.mean(x * x, axis=1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * w_row[None]
+
+
+def write_kv_body(cfg, args, refs, len_s):
+    """Append the new token's K/V (with k-norm + rope on K) to the cache
+    at position cache_len. Builder guarantees hd | w."""
+    arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
+                               refs["v_cache"])
+    va, vb, vhd = refs["va"], refs["vb"], refs["vhd"]
+    k_off, v_off, layer, knorm_off = args[0], args[1], args[2], args[3]
+    b, hd, kv_loc, w = cfg.batch, cfg.hd, cfg.kv_loc, cfg.w
+    pos = len_s[0]
+    heads_per_tile = w // hd
+    kv_tiles = pl.cdiv(kv_loc * hd, w)
+
+    pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)],
+                    vb.at[pl.ds(0, 1)])  # (1, w) k_norm
+    wrow = vb[0, :hd].astype(jnp.float32)
+
+    def per_tile(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(k_off + j * b, b)], va)
+        kt = va[...].astype(jnp.float32)        # (b, w)
+
+        def per_head(hh, _):
+            kv_head = j * heads_per_tile + hh
+
+            @pl.when(kv_head < cfg.kv_loc)  # skip padding heads
+            def _():
+                head = jax.lax.dynamic_slice(kt, (0, hh * hd), (b, hd))
+                head = _rms_rows(head, wrow, cfg.rms_eps)
+                head = _rope_vec(head, pos, hd, cfg.rope_theta)
+                vhd[...] = head.astype(vhd.dtype)
+                pltpu.sync_copy(
+                    vhd, k_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+            return 0
+
+        jax.lax.fori_loop(0, heads_per_tile, per_head, 0)
+
+        pltpu.sync_copy(arena.at[pl.ds(v_off + j * b, b)], va)
+        vt = va[...]
+
+        def per_head_v(hh, _):
+            kv_head = j * heads_per_tile + hh
+
+            @pl.when(kv_head < cfg.kv_loc)
+            def _():
+                vhd[...] = jax.lax.dynamic_slice(
+                    vt, (0, hh * hd), (b, hd)).astype(vhd.dtype)
+                pltpu.sync_copy(
+                    vhd, v_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+            return 0
+
+        jax.lax.fori_loop(0, heads_per_tile, per_head_v, 0)
+        return 0
+
+    jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
+
+
+def attn_decode_body(cfg, args, refs, len_s):
+    """Single-token GQA flash decode over the (already appended) cache.
+
+    q: (B, h_loc*hd) activation; out same shape. Loops heads × batch;
+    each (head, batch) pair streams the cache in (T_TILE, hd) tiles with
+    online-softmax accumulation.
+    """
+    arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
+                                        refs["v_cache"], refs["va"],
+                                        refs["vkt"])
+    q_off, out_off, layer, qnorm_off = args[0], args[1], args[2], args[3]
+    b, hd, w = cfg.batch, cfg.hd, cfg.w
+    h_loc, kv_loc = cfg.h_loc, cfg.kv_loc
+    t_tile = vkt.shape[0]
+    pos = len_s[0]
+    kv_len = pos + 1
+    n_tiles_t = pl.cdiv(kv_len, t_tile)
+    group = h_loc // kv_loc
+    heads_per_tile = w // hd
+
+    pltpu.sync_copy(arena.at[pl.ds(qnorm_off, 1)],
+                    refs["vb"].at[pl.ds(0, 1)])
+    qn_row = refs["vb"][0, :hd].astype(jnp.float32)
+
+    def per_qtile(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(q_off + j * b, b)], va)
+        qtile = va[...].astype(jnp.float32)     # (b, w)
+        out_tile = jnp.zeros((b, w), jnp.float32)
+
+        def per_head(hh, out_tile):
+            h_idx = j * heads_per_tile + hh
+            # Padding heads beyond h_loc compute garbage that is
+            # discarded below; clamp the cache index to stay in bounds.
+            kv_head = jnp.minimum(h_idx // group, cfg.kv_loc - 1)
+            q = jax.lax.dynamic_slice(qtile, (0, hh * hd), (b, hd))
+            q = _rms_rows(q, qn_row, cfg.rms_eps)
+            q = _rope_vec(q, pos, hd, cfg.rope_theta)
+            q = q / jnp.sqrt(jnp.float32(hd))
+
+            def per_batch(bb, out_tile):
+                def tstep(tt, carry):
+                    m, l, acc = carry
+                    pltpu.sync_copy(
+                        k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
+                                   kv_head, :], vkt)
+                    kt = vkt[...].astype(jnp.float32)   # (t_tile, hd)
+                    qb = jax.lax.dynamic_slice(q, (bb, 0), (1, hd))
+                    s = jnp.dot(kt, qb[0],
+                                preferred_element_type=jnp.float32)
+                    tpos = tt * t_tile + jax.lax.broadcasted_iota(
+                        jnp.int32, (t_tile, 1), 0)[:, 0]
+                    s = jnp.where(tpos < kv_len, s, -jnp.inf)
+                    m_new = jnp.maximum(m, jnp.max(s))
+                    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                    p = jnp.where(jnp.isfinite(s),
+                                  jnp.exp(s - m_safe), 0.0)
+                    corr = jnp.where(jnp.isfinite(m),
+                                     jnp.exp(m - m_safe), 0.0)
+                    pltpu.sync_copy(
+                        v_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
+                                   kv_head, :], vkt)
+                    vt = vkt[...].astype(jnp.float32)
+                    acc = acc * corr + jnp.dot(
+                        p[None, :], vt,
+                        preferred_element_type=jnp.float32)[0]
+                    l = l * corr + jnp.sum(p)
+                    return (m_new, l, acc)
+
+                m0 = jnp.float32(-jnp.inf)
+                l0 = jnp.float32(0.0)
+                acc0 = jnp.zeros((hd,), jnp.float32)
+                m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
+                                              (m0, l0, acc0))
+                o = acc / jnp.maximum(l, 1e-30)
+                upd = jax.lax.dynamic_update_slice(
+                    out_tile, o[None], (bb, hh * hd))
+                return jnp.where(h_idx < cfg.h_loc, upd, out_tile)
+
+            return jax.lax.fori_loop(0, b, per_batch, out_tile)
+
+        out_tile = jax.lax.fori_loop(0, heads_per_tile, per_head,
+                                     out_tile)
+        refs["acc"][...] = out_tile
+        pltpu.sync_copy(refs["acc"], arena.at[pl.ds(out_off + j * b, b)])
+        return 0
+
+    q_tiles = pl.cdiv(h_loc * hd, w)
+    jax.lax.fori_loop(0, q_tiles, per_qtile, 0)
+
+
+def allreduce_body(cfg, args, refs):
+    """One-shot in-kernel allreduce of an arena slab across the TP axis
+    (reference: megakernel allreduce + barrier tasks,
+    ``mega_triton_kernel/kernels/allreduce.py``)."""
+    arena, va, vb, send_sem, recv_sem = (
+        refs["arena"], refs["va"], refs["vb"], refs["send_sem"],
+        refs["recv_sem"])
+    buf_off, tiles = args[0], args[1]
+    b, n = cfg.batch, cfg.n_ranks
+    if n == 1:
+        return
+    me = dl.rank(cfg.axis)
+    rows = tiles * b
+    slab = arena.at[pl.ds(buf_off, rows)]
+    my_slot = arena.at[pl.ds(cfg.ar_ws_off + me * cfg.ar_max_tiles * b,
+                             rows)]
+
+    dl.barrier_all(cfg.axis, ctx=cfg.mesh)
+    copies = []
+    for off in range(1, n):
+        peer = jax.lax.rem(me + off, n)
+        copies.append(dl.remote_put(slab, my_slot, send_sem.at[off - 1],
+                                    recv_sem, peer, axis=cfg.axis,
+                                    ctx=cfg.mesh))
+    for c in copies:
+        c.wait_send()
+    dl.wait_arrivals(recv_sem, slab, n - 1)
+
+    def step(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(buf_off + j * b, b)], va)
+        acc = va[...].astype(jnp.float32)
+        for r_off in range(1, n):
+            peer = jax.lax.rem(me + r_off, n)
+            pltpu.sync_copy(
+                arena.at[pl.ds(cfg.ar_ws_off
+                               + peer * cfg.ar_max_tiles * b + j * b, b)],
+                vb)
+            acc = acc + vb[...].astype(jnp.float32)
+        va[...] = acc
+        pltpu.sync_copy(va, arena.at[pl.ds(buf_off + j * b, b)])
+        return 0
+
+    jax.lax.fori_loop(0, tiles, step, 0)

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native scheduler
+(``csrc/megakernel_scheduler.cc``) with lazy compilation via g++.
+
+Reference analogue: ``mega_triton_kernel/core/scheduler.py`` — here the
+graph algorithms live in C++ (the natural native component of the
+runtime) and Python only marshals arrays.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_LIB = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_lib():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    csrc = os.path.join(_repo_root(), "csrc")
+    so = os.path.join(csrc, "libtdt_scheduler.so")
+    src = os.path.join(csrc, "megakernel_scheduler.cc")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", so, src],
+            check=True)
+    lib = ctypes.CDLL(so)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.tdt_schedule.restype = ctypes.c_int32
+    lib.tdt_schedule.argtypes = [ctypes.c_int32, i32p, i32p,
+                                 ctypes.c_int32, ctypes.c_int32,
+                                 ctypes.c_int32, i32p, i32p, i32p, i32p,
+                                 i32p]
+    lib.tdt_prune_deps.restype = ctypes.c_int32
+    lib.tdt_prune_deps.argtypes = [ctypes.c_int32, i32p, i32p,
+                                   ctypes.c_int32]
+    _LIB = lib
+    return lib
+
+
+def _as_i32(a):
+    return np.ascontiguousarray(np.asarray(a, np.int32))
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def prune_deps(n_tasks: int, src: Sequence[int], dst: Sequence[int]
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Transitive-reduction pruning (reference enable_dep_opt)."""
+    lib = _load_lib()
+    s, d = _as_i32(src), _as_i32(dst)
+    kept = lib.tdt_prune_deps(n_tasks, _ptr(s), _ptr(d), len(s))
+    return s[:kept], d[:kept]
+
+
+def schedule(n_tasks: int, src: Sequence[int], dst: Sequence[int], *,
+             num_cores: int = 1, strategy: str = "round_robin",
+             dep_opt: bool = True):
+    """Returns dict with order, core, pos, cross-core deps arrays."""
+    lib = _load_lib()
+    s, d = _as_i32(src), _as_i32(dst)
+    if dep_opt and len(s):
+        s, d = prune_deps(n_tasks, s, d)
+    order = np.zeros(n_tasks, np.int32)
+    core = np.zeros(n_tasks, np.int32)
+    pos = np.zeros(n_tasks, np.int32)
+    nxdeps = np.zeros(n_tasks, np.int32)
+    xdeps = np.zeros(max(len(s), 1), np.int32)
+    rc = lib.tdt_schedule(n_tasks, _ptr(s), _ptr(d), len(s), num_cores,
+                          1 if strategy == "zig_zag" else 0, _ptr(order),
+                          _ptr(core), _ptr(pos), _ptr(nxdeps),
+                          _ptr(xdeps))
+    if rc == -1:
+        raise ValueError("dependency cycle in task graph")
+    if rc != 0:
+        raise ValueError(f"scheduler error {rc}")
+    n_x = int(nxdeps.sum())
+    return {"order": order, "core": core, "pos": pos,
+            "n_cross_deps": nxdeps, "cross_deps": xdeps[:n_x]}

@@ -1,0 +1,36 @@
+"""Task descriptors (reference: ``mega_triton_kernel/core/task_base.py``
+``TaskBase`` :162 + ``TaskDependency`` :113 + tile descriptors
+:137-161)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Tuple
+
+ARGS_MAX = 8
+
+
+class TaskType(enum.IntEnum):
+    """Per-op device code selector (reference: the op→task registry,
+    ``core/registry.py:30``; kernels in ``mega_triton_kernel/kernels/``)."""
+    RMSNORM = 0        # args: in_off, w_off, out_off, rows, dim
+    LINEAR = 1         # args: in_off, w_off, out_off, rows, k, n, accum
+    ADD = 2            # args: a_off, b_off, out_off, rows, dim
+    SILU_MUL = 3       # args: gate_off, up_off, out_off, rows, dim
+    ATTN_DECODE = 4    # args: q_off, out_off, layer, h_loc, kv_loc, hd
+    WRITE_KV = 5       # args: k_off, v_off, layer, kv_loc, hd
+    ALLREDUCE = 6      # args: buf_off, rows, dim
+
+
+@dataclasses.dataclass
+class Task:
+    task_id: int
+    task_type: TaskType
+    args: Tuple[int, ...]
+    deps: List[int] = dataclasses.field(default_factory=list)
+    layer: int = -1
+
+    def encoded_args(self) -> List[int]:
+        a = list(self.args)[:ARGS_MAX]
+        return a + [0] * (ARGS_MAX - len(a))

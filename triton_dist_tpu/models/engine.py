@@ -71,15 +71,26 @@ class Engine:
             check_vma=False), donate_argnums=(2,))
 
     def prefill(self, input_ids) -> Tuple[jax.Array, KVCache]:
-        return self._prefill(self.params, jnp.asarray(input_ids))
+        input_ids = jnp.asarray(input_ids)
+        # Host-side mirror of cache.length: lets decode() guard overruns
+        # without forcing a device sync per generated token.
+        self._host_len = int(input_ids.shape[1])
+        return self._prefill(self.params, input_ids)
 
     def decode(self, tokens, cache) -> Tuple[jax.Array, KVCache]:
         # dynamic_update_slice clamps out-of-range starts, which would
         # silently overwrite the last cache slot — fail loudly instead.
-        if int(np.asarray(cache.length)) >= self.max_len:
+        # The host counter tracks engine-driven prefill/decode; fall back
+        # to a (synchronizing) device read for externally-built caches.
+        length = getattr(self, "_host_len", None)
+        if length is None:
+            length = int(np.asarray(cache.length))
+        if length >= self.max_len:
             raise ValueError(
                 f"KV cache full ({self.max_len}); cannot decode further")
-        return self._decode(self.params, tokens, cache)
+        out = self._decode(self.params, tokens, cache)
+        self._host_len = length + 1
+        return out
 
     def serve(self, input_ids, gen_len: int = 32):
         """Greedy generation (reference ``Engine.serve`` decode loop,

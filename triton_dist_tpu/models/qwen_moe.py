@@ -77,13 +77,10 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
     ``axis`` (= the same axis for a 1D mesh: tp and ep traffic share it,
     matching the reference's single-group EP demos).
     """
-    n = jax.lax.axis_size(axis)
+    from triton_dist_tpu.models.dense import _embed_tokens, _lm_head
+
     b, s = input_ids.shape
-    tokens = b * s
-    x = params["embed"][input_ids.reshape(tokens)]
-    me = jax.lax.axis_index(axis)
-    loc = tokens // n
-    x = jax.lax.dynamic_slice_in_dim(x, me * loc, loc, axis=0)
+    x = _embed_tokens(params, input_ids, mode=mode, axis=axis)
 
     for lp in params["layers"]:
         h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
@@ -102,11 +99,11 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
                                  norm_topk_prob=cfg.norm_topk_prob)
         x = x + moe_out
 
+    from triton_dist_tpu.models.dense import _lm_head
+
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
-    x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
-    logits_loc = jnp.dot(x, params["lm_head"].T,
-                         preferred_element_type=jnp.float32)
-    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
-    return logits.reshape(b, s, cfg.vocab_size)
+    if mode in ("xla", "fused"):
+        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    return _lm_head(params, x, axis).reshape(b, s, cfg.vocab_size)
 
 

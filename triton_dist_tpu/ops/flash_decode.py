@@ -42,26 +42,29 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis: str = "sp",
     t_loc, kvh = k_shard.shape[1], k_shard.shape[2]
     if shard_offset is None:
         shard_offset = me * t_loc
-    if kvh != h:
-        rep = h // kvh
-        k_shard = jnp.repeat(k_shard, rep, axis=2)
-        v_shard = jnp.repeat(v_shard, rep, axis=2)
+    # GQA via grouped einsum (q reshaped per KV group) — no repeated KV
+    # copy on the decode hot path.
+    rep = h // kvh
+    qg = q.astype(jnp.float32).reshape(b, kvh, rep, hd)
 
-    # Local flash partial over this shard.
-    scores = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+    scores = jnp.einsum("bgrd,btgd->bgrt", qg,
                         k_shard.astype(jnp.float32))
     scores /= jnp.sqrt(jnp.float32(hd))
     pos = shard_offset + jnp.arange(t_loc)[None, :]         # (1, T_loc)
     valid = pos < kv_len[:, None]                            # (B, T_loc)
-    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
 
-    m = jnp.max(scores, axis=-1)                             # (B, H)
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    m_g = jnp.max(scores, axis=-1)                           # (B, g, r)
+    m_safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
     p = jnp.exp(scores - m_safe[..., None])
     p = jnp.where(jnp.isfinite(scores), p, 0.0)
-    l = jnp.sum(p, axis=-1)                                  # (B, H)
-    acc = jnp.einsum("bhk,bkhd->bhd", p,
-                     v_shard.astype(jnp.float32))            # (B, H, hd)
+    l_g = jnp.sum(p, axis=-1)                                # (B, g, r)
+    acc_g = jnp.einsum("bgrt,btgd->bgrd", p,
+                       v_shard.astype(jnp.float32))
+    m = m_g.reshape(b, h)
+    m_safe = m_safe.reshape(b, h)
+    l = l_g.reshape(b, h)
+    acc = acc_g.reshape(b, h, hd)
 
     if n > 1:
         # Cross-rank log-sum-exp combine (reference combine kernels).

@@ -62,13 +62,12 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
         return _masked_attn(q, k, v, 0)
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
-    if kvh != h:
-        rep = h // kvh
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    rep = h // kvh
 
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-    q32 = q.astype(jnp.float32)
+    # GQA grouped form: KV rotates the ring at its true (kvh) size —
+    # repeating to H first would multiply ICI traffic by h/kvh.
+    q32 = q.astype(jnp.float32).reshape(s_loc, kvh, rep, hd)
     qi = me * s_loc + jnp.arange(s_loc)[:, None]  # global query positions
 
     def step(carry, src_shift, rotate):
@@ -76,8 +75,9 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
         # KV chunk currently held originated at rank (me - src_shift).
         src = jax.lax.rem(me - src_shift + n, n)
         ki = src * s_loc + jnp.arange(s_loc)[None, :]
-        s_blk = jnp.einsum("qhd,khd->hqk", q32, kc.astype(jnp.float32)
-                           ) * scale
+        s_blk = jnp.einsum("qgrd,kgd->grqk", q32,
+                           kc.astype(jnp.float32)
+                           ).reshape(h, s_loc, s_loc) * scale
         if causal:
             s_blk = jnp.where((ki <= qi)[None], s_blk, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))      # (h, q)
@@ -87,8 +87,11 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
         p = jnp.where(jnp.isfinite(s_blk), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l = l * corr + jnp.sum(p, axis=-1)
-        acc = (acc * corr[..., None]
-               + jnp.einsum("hqk,khd->hqd", p, vc.astype(jnp.float32)))
+        pg = p.reshape(kvh, rep, s_loc, s_loc)
+        acc_new = jnp.einsum("grqk,kgd->grqd", pg,
+                             vc.astype(jnp.float32)
+                             ).reshape(h, s_loc, hd)
+        acc = acc * corr[..., None] + acc_new
         m = m_new
         if rotate:
             # Rotate KV one hop right; XLA overlaps this transfer with

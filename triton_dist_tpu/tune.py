@@ -1,0 +1,96 @@
+"""Persistent autotune cache.
+
+Reference: ``python/triton_dist/tune.py`` (503 LoC) — JSON records keyed
+by tensor shapes/dtypes + dependency versions (``store_autotune_data``
+:187, ``load_autotune_data`` :175, dependency check :228-246), consumed
+by the ``triton_dist.tune.autotune(config_space, key_fn, prune_fn)``
+decorator on ag_gemm etc.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+_CACHE: Optional[Dict] = None
+_CACHE_PATH: Optional[str] = None
+
+
+def cache_path() -> str:
+    global _CACHE_PATH
+    if _CACHE_PATH is None:
+        base = os.environ.get(
+            "TRITON_DIST_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "triton_dist_tpu"))
+        os.makedirs(base, exist_ok=True)
+        _CACHE_PATH = os.path.join(base, "tune_cache.json")
+    return _CACHE_PATH
+
+
+def _dep_versions() -> Dict[str, str]:
+    """Dependency stamp: cached entries are invalidated when the stack
+    changes (reference ``tune.py:228-246``)."""
+    import jax
+    import triton_dist_tpu
+
+    return {
+        "jax": jax.__version__,
+        "triton_dist_tpu": triton_dist_tpu.__version__,
+        "backend": jax.default_backend(),
+    }
+
+
+def make_key(op: str, **attrs) -> str:
+    """Stable key from op name + shapes/dtypes/mesh attributes
+    (reference ``triton_dist_key``, ``utils.py:862``)."""
+    blob = json.dumps({"op": op, **{k: str(v) for k, v in attrs.items()}},
+                      sort_keys=True)
+    return f"{op}:{hashlib.sha256(blob.encode()).hexdigest()[:16]}"
+
+
+def _load() -> Dict:
+    global _CACHE
+    if _CACHE is None:
+        try:
+            with open(cache_path()) as f:
+                _CACHE = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            _CACHE = {}
+    return _CACHE
+
+
+def load_autotune_data(key: str) -> Optional[Dict[str, Any]]:
+    with _LOCK:
+        rec = _load().get(key)
+    if rec is None:
+        return None
+    if rec.get("versions") != _dep_versions():
+        return None
+    return rec["config"]
+
+
+def store_autotune_data(key: str, config: Dict[str, Any],
+                        seconds: Optional[float] = None) -> None:
+    with _LOCK:
+        cache = _load()
+        cache[key] = {"config": config, "seconds": seconds,
+                      "versions": _dep_versions()}
+        tmp = cache_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+        os.replace(tmp, cache_path())
+
+
+def clear_cache() -> None:
+    global _CACHE
+    with _LOCK:
+        _CACHE = {}
+        try:
+            os.remove(cache_path())
+        except FileNotFoundError:
+            pass
