@@ -1,0 +1,22 @@
+"""Gated DeltaNet vs per-step oracle."""
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.gdn import gdn_fwd, gdn_ref
+from triton_dist_tpu.utils.testing import assert_allclose
+
+
+def test_gdn_scan_matches_loop():
+    s, h, dk, dv = 16, 2, 8, 8
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (s, h, dk))
+    k = jax.random.normal(ks[1], (s, h, dk))
+    v = jax.random.normal(ks[2], (s, h, dv))
+    g = -jax.nn.softplus(jax.random.normal(ks[3], (s, h)))
+    beta = jax.nn.sigmoid(jax.random.normal(ks[4], (s, h)))
+    o, S = gdn_fwd(q, k, v, g, beta)
+    o_ref = gdn_ref(q, k, v, g, beta)
+    assert_allclose(o, o_ref, rtol=1e-5, atol=1e-5)
+    assert S.shape == (h, dk, dv)

@@ -1,0 +1,34 @@
+"""AOT compile/load and perf-model tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.tools import (
+    compile_aot, load_aot, gemm_time_s, collective_time_s,
+    ChipSpec,
+)
+from triton_dist_tpu.tools.perf_model import overlap_efficiency_bound
+
+
+def test_aot_roundtrip(tmp_path):
+    def fn(x, y):
+        return jnp.dot(x, y, preferred_element_type=jnp.float32)
+
+    x = jnp.ones((16, 32))
+    y = jnp.ones((32, 8))
+    path = compile_aot(fn, (x, y), str(tmp_path / "fn.bin"))
+    exe = load_aot(path)
+    out = exe(x, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(fn(x, y)))
+
+
+def test_perf_model_sanity():
+    # Bigger GEMMs take longer; memory-bound for skinny shapes.
+    assert gemm_time_s(4096, 4096, 4096) > gemm_time_s(1024, 1024, 1024)
+    assert collective_time_s(1 << 26, 8) > collective_time_s(1 << 20, 8)
+    assert collective_time_s(1 << 20, 8, kind="all_reduce") > \
+        collective_time_s(1 << 20, 8, kind="all_gather")
+    # Overlap bound in (0, 1]; big compute → full hiding.
+    b = overlap_efficiency_bound(8192, 8192, 8192, 8)
+    assert 0.0 < b <= 1.0

@@ -49,15 +49,24 @@ class AGGemmContext:
     block_n: int = 256
     block_k: int = 512
     out_dtype: Optional[jnp.dtype] = None
+    # Fault-injection: delay one rank at kernel entry to test overlap
+    # robustness (reference straggler_option, allgather_gemm.py:662).
+    # The delay is a compute spin of `straggler_delay_iters` dependent
+    # FLOP iterations — pl.delay is a no-op under interpret mode, so a
+    # busy loop is the only skew source that works on both backends.
+    straggler_rank: int = -1
+    straggler_delay_iters: int = 0
 
 
 def create_ag_gemm_context(mesh: MeshContext, axis: str = "tp",
                            block_m: int = 256, block_n: int = 256,
-                           block_k: int = 512,
-                           out_dtype=None) -> AGGemmContext:
+                           block_k: int = 512, out_dtype=None,
+                           straggler_rank: int = -1,
+                           straggler_delay_iters: int = 0) -> AGGemmContext:
     return AGGemmContext(mesh=mesh, axis=axis, block_m=block_m,
                          block_n=block_n, block_k=block_k,
-                         out_dtype=out_dtype)
+                         out_dtype=out_dtype, straggler_rank=straggler_rank,
+                         straggler_delay_iters=straggler_delay_iters)
 
 
 def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
@@ -70,7 +79,9 @@ def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
 
 def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                     recv_sem, *, axis: str, ctx: MeshContext, m_loc: int,
-                    tm: int, tk: int, n_ranks: int):
+                    tm: int, tk: int, n_ranks: int,
+                    straggler_rank: int = -1,
+                    straggler_delay_iters: int = 0):
     k = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -90,6 +101,15 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
 
     @pl.when(first)
     def _():
+        if straggler_delay_iters > 0:
+            @pl.when(me == straggler_rank)
+            def _():
+                # Dependent-FLOP spin: real wall-time skew on both the
+                # compiled and interpreted backends.
+                spin = jax.lax.fori_loop(
+                    0, straggler_delay_iters,
+                    lambda _, x: x * 1.0000001 + 1e-7, jnp.float32(1.0))
+                acc_v[0, 0] = spin * 0.0
         # Peers must be in-kernel before any remote traffic.
         dl.barrier_tile(axis, ctx=ctx)
         # Local chunk into the workspace, then kick off the ring.
@@ -189,7 +209,8 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
 
     kernel = functools.partial(
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
-        tk=tk, n_ranks=n)
+        tk=tk, n_ranks=n, straggler_rank=ctx.straggler_rank,
+        straggler_delay_iters=ctx.straggler_delay_iters)
 
     # The gather workspace is always a second kernel output: Mosaic only
     # allows VMEM/SMEM/semaphore scratch on real TPUs, and as an output

@@ -1,0 +1,88 @@
+"""Gated DeltaNet (GDN) — Qwen3-Next linear attention.
+
+Reference: ``kernels/nvidia/gdn.py`` (1075 LoC) — chunked gated
+delta-rule forward.
+
+Recurrence (per head, state S ∈ R^{dk×dv}):
+
+    Ŝ_t = exp(g_t) · S_{t-1}                  (gated decay)
+    S_t = Ŝ_t + β_t · k_t (v_t − Ŝ_tᵀ k_t)ᵀ   (delta rule)
+    o_t = S_tᵀ q_t
+
+Implementation: ``lax.scan`` over time with the state resident in
+registers/VMEM — the natural TPU form (each step is two rank-1 updates
+plus two matvecs; XLA fuses the scan body onto the VPU/MXU). The
+reference's chunked WY-representation kernel is a planned optimization
+for long-sequence prefill; decode and moderate prefill are
+scan-efficient on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gdn_fwd(q, k, v, g, beta, *, initial_state=None, normalize_qk=True):
+    """q/k: (S, H, dk); v: (S, H, dv); g: (S, H) log-decay (≤ 0);
+    beta: (S, H) write strength (0, 1]. Returns (o (S, H, dv), S_final
+    (H, dk, dv))."""
+    s, h, dk = q.shape
+    dv = v.shape[-1]
+    if normalize_qk:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
+                            1e-6)
+        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
+                            1e-6)
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    b32 = beta.astype(jnp.float32)
+
+    if initial_state is None:
+        initial_state = jnp.zeros((h, dk, dv), jnp.float32)
+
+    def step(S, inp):
+        qt, kt, vt, gt, bt = inp           # (H,dk),(H,dk),(H,dv),(H,),(H,)
+        S = S * jnp.exp(gt)[:, None, None]
+        pred = jnp.einsum("hkv,hk->hv", S, kt)          # Ŝᵀ k
+        delta = (vt - pred) * bt[:, None]               # β (v − Ŝᵀk)
+        S = S + jnp.einsum("hk,hv->hkv", kt, delta)
+        o = jnp.einsum("hkv,hk->hv", S, qt)
+        return S, o
+
+    S_final, o = jax.lax.scan(
+        step, initial_state,
+        (q32.swapaxes(0, 0), k32, v32, g32, b32))
+    return o.astype(v.dtype), S_final
+
+
+def gdn_decode_step(S, q, k, v, g, beta, *, normalize_qk=True):
+    """Single-token step for inference. S: (H, dk, dv); q/k: (H, dk);
+    v: (H, dv); g/beta: (H,). Returns (o (H, dv), S_new)."""
+    if normalize_qk:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
+                            1e-6)
+        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
+                            1e-6)
+    S = S * jnp.exp(g.astype(jnp.float32))[:, None, None]
+    pred = jnp.einsum("hkv,hk->hv", S, k.astype(jnp.float32))
+    delta = (v.astype(jnp.float32) - pred) * beta[:, None]
+    S = S + jnp.einsum("hk,hv->hkv", k.astype(jnp.float32), delta)
+    o = jnp.einsum("hkv,hk->hv", S, q.astype(jnp.float32))
+    return o.astype(v.dtype), S
+
+
+def gdn_ref(q, k, v, g, beta, **kw):
+    """Plain-python oracle (same math, per-step loop)."""
+    s = q.shape[0]
+    S = None
+    outs = []
+    for t in range(s):
+        o, S = gdn_decode_step(
+            S if S is not None else jnp.zeros(
+                (q.shape[1], q.shape[2], v.shape[2]), jnp.float32),
+            q[t], k[t], v[t], g[t], beta[t], **kw)
+        outs.append(o)
+    return jnp.stack(outs)

@@ -1,0 +1,9 @@
+"""Cross-cutting tools (reference: ``python/triton_dist/tools/``,
+SURVEY.md §2.11): AOT compilation, tune helpers, perf models."""
+
+from triton_dist_tpu.tools.aot import (  # noqa: F401
+    compile_aot, load_aot, AOTExecutable,
+)
+from triton_dist_tpu.tools.perf_model import (  # noqa: F401
+    gemm_time_s, collective_time_s, ChipSpec,
+)

@@ -1,0 +1,54 @@
+"""Ahead-of-time compilation.
+
+Reference: ``tools/compile_aot.py`` + ``tools/compile/compile.py:78-283``
+compile listed kernels to C sources + cubins with a CUDA-driver C
+runtime (``tools/runtime/triton_aot_runtime.{h,cc}``), gated by
+``USE_TRITON_DISTRIBUTED_AOT``.
+
+TPU redesign: ``jax.export`` serializes a lowered+compiled XLA program
+(StableHLO) to a portable blob; ``load_aot`` rehydrates it without
+retracing Python. This is the platform-native equivalent of the cubin +
+driver-cache runtime — XLA's compilation cache plays the role of the
+module/function cache in ``triton_aot_runtime.h:33``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Sequence
+
+import jax
+
+
+@dataclasses.dataclass
+class AOTExecutable:
+    rehydrated: object
+
+    def __call__(self, *args):
+        return self.rehydrated.call(*args)
+
+
+def compile_aot(fn: Callable, example_args: Sequence, path: str,
+                *, platforms: Sequence[str] = None) -> str:
+    """Serialize ``jit(fn)`` for ``example_args`` to ``path``."""
+    from jax import export as jexport
+
+    exported = jexport.export(
+        jax.jit(fn),
+        platforms=list(platforms) if platforms else None,
+    )(*[jax.ShapeDtypeStruct(a.shape, a.dtype) if hasattr(a, "shape")
+        else a for a in example_args])
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+def load_aot(path: str) -> AOTExecutable:
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    return AOTExecutable(jexport.deserialize(blob))

@@ -1,0 +1,66 @@
+"""Analytical performance models for autotuner pruning.
+
+Reference: ``kernels/nvidia/gemm_perf_model.py`` (249 — tensorcore
+TFLOPS estimator), ``comm_perf_model.py`` (116 — NVLink/IB transfer
+times); used to prune autotune config spaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Peak numbers per chip. Defaults: TPU v5p-ish."""
+    bf16_tflops: float = 459.0
+    hbm_gbps: float = 2765.0
+    ici_gbps_per_link: float = 100.0   # one direction, per link
+    ici_links: int = 6                 # 3D torus
+    dcn_gbps: float = 25.0
+    mxu_util: float = 0.7              # achievable fraction of peak
+
+
+V5P = ChipSpec()
+V5E = ChipSpec(bf16_tflops=197.0, hbm_gbps=819.0,
+               ici_gbps_per_link=100.0, ici_links=4)
+
+
+def gemm_time_s(m: int, k: int, n: int, *, dtype_bytes: int = 2,
+                chip: ChipSpec = V5P) -> float:
+    """Roofline: max(compute, HBM) time for an (m,k)x(k,n) GEMM."""
+    flops = 2.0 * m * k * n
+    t_compute = flops / (chip.bf16_tflops * 1e12 * chip.mxu_util)
+    traffic = (m * k + k * n + m * n) * dtype_bytes
+    t_mem = traffic / (chip.hbm_gbps * 1e9)
+    return max(t_compute, t_mem)
+
+
+def collective_time_s(bytes_per_device: int, n_devices: int, *,
+                      kind: str = "all_gather", inter_slice: bool = False,
+                      chip: ChipSpec = V5P) -> float:
+    """Ring-collective transfer-time estimate over ICI (or DCN).
+
+    all_gather / reduce_scatter move (n-1)/n of the data per link step;
+    all_reduce twice that; all_to_all one full shuffle.
+    """
+    bw = (chip.dcn_gbps if inter_slice
+          else chip.ici_gbps_per_link * 2) * 1e9  # bidir ring
+    factor = {"all_gather": (n_devices - 1) / n_devices,
+              "reduce_scatter": (n_devices - 1) / n_devices,
+              "all_reduce": 2.0 * (n_devices - 1) / n_devices,
+              "all_to_all": (n_devices - 1) / n_devices,
+              "p2p": 1.0}[kind]
+    return bytes_per_device * factor / bw
+
+
+def overlap_efficiency_bound(m: int, k: int, n: int, world: int, *,
+                             dtype_bytes: int = 2,
+                             chip: ChipSpec = V5P) -> float:
+    """Upper bound on AG+GEMM overlap efficiency: comm fully hidden iff
+    per-chunk transfer <= per-chunk compute."""
+    t_gemm = gemm_time_s(m, k, n // world, dtype_bytes=dtype_bytes,
+                         chip=chip)
+    t_comm = collective_time_s(m * k * dtype_bytes // world, world,
+                               kind="all_gather", chip=chip)
+    return min(1.0, t_gemm / (t_gemm + max(t_comm - t_gemm, 0.0)))

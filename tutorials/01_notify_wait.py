@@ -1,0 +1,58 @@
+"""Tutorial 01: one-sided notify/wait primitives.
+
+Reference: ``tutorials/01-distributed-notify-wait.py`` (:29-156) — rank 0
+signals every peer's flag; peers spin-wait. On TPU the flag word is a
+hardware semaphore: `dl.notify` is a remote semaphore signal, `dl.wait`
+a semaphore wait (no spinning).
+Run: python tutorials/01_notify_wait.py
+"""
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu as tdt
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.utils.testing import spmd
+
+mesh = tdt.make_mesh(tp=8)
+ctx = tdt.MeshContext.from_mesh(mesh)
+
+
+def kernel(out_ref, ones_v, sem, *, ctx):
+    me = dl.rank("tp")
+    n = dl.num_ranks("tp")
+    dl.barrier_all("tp", ctx=ctx)  # peers in-kernel
+
+    @pl.when(me == 0)
+    def _():
+        for peer in range(1, n):
+            dl.notify(sem, peer, axis="tp", ctx=ctx)
+
+    @pl.when(me != 0)
+    def _():
+        dl.wait(sem, 1)  # block until rank 0 says go
+
+    ones_v[...] = jnp.ones_like(ones_v)
+    pltpu.sync_copy(ones_v, out_ref)
+
+
+def run():
+    return core_call(
+        functools.partial(kernel, ctx=ctx), comm=True,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32),
+                        pltpu.SemaphoreType.REGULAR])()
+
+
+out = spmd(mesh, run, (), P("tp", None))()
+print("notify/wait ok:", np.asarray(out).sum() == 64 * 128)

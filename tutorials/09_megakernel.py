@@ -1,0 +1,27 @@
+"""Tutorial 09: the megakernel — a whole decode step as one kernel.
+
+Reference: ``docs/getting-started/megakernel/megakernel.md``. Builds the
+task graph, schedules it natively, and greedy-decodes.
+Run: python tutorials/09_megakernel.py
+"""
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                       intermediate_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=8)
+mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+eng = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
+                       t_tile=16)
+print("tasks per step:", len(eng.builder.task_types))
+print("generated:",
+      np.asarray(eng.generate(jnp.zeros((2,), jnp.int32), steps=6)))

@@ -1,0 +1,20 @@
+"""Shared tutorial bring-up: 8 virtual CPU devices unless real multi-chip
+TPU hardware is attached (tutorials run anywhere; see docs/testing.md)."""
+
+import os
+import sys
+
+
+def bootstrap(num_devices: int = 8):
+    # Repo root on sys.path so tutorials run from anywhere.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={num_devices}")
+    import jax
+    # Default to the virtual CPU mesh; set TDT_REAL_TPU=1 on a real
+    # multi-chip slice. (Calling jax.devices() first would pin the
+    # backend, so the decision is env-driven.)
+    if os.environ.get("TDT_REAL_TPU") != "1":
+        jax.config.update("jax_platforms", "cpu")
+    return jax
