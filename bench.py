@@ -113,6 +113,26 @@ def main():
 
     t_fused = max(_timed_chain(fused_step, a, b), 1e-9)
     t_compute = max(_timed_chain(compute_step, a_full, b), 1e-9)
+
+    # Secondary: GEMM+RS efficiency on the transposed problem.
+    from triton_dist_tpu.ops import gemm_rs, create_gemm_rs_context
+    rs_ctx = create_gemm_rs_context(mctx, block_m=512, block_n=512,
+                                    block_k=2048)
+    a_rs = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (m_full, k_dim), dtype),
+        NamedSharding(mesh, P(None, "tp")))
+    b_rs = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(3), (k_dim, n_dim), dtype),
+        NamedSharding(mesh, P("tp", None)))
+
+    def rs_fused(x, w):
+        return jax.shard_map(
+            lambda xs, ws: gemm_rs(xs, ws, rs_ctx,
+                                   force_kernel=(n == 1)),
+            mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None), check_vma=False)(x, w)
+
+    t_rs = max(_timed_chain(rs_fused, a_rs, b_rs), 1e-9)
     eff = t_compute / t_fused
     flops = 2 * m_full * k_dim * n_dim / max(n, 1)
     print(json.dumps({
@@ -126,6 +146,8 @@ def main():
             "t_fused_ms": round(t_fused * 1e3, 3),
             "t_compute_only_ms": round(t_compute * 1e3, 3),
             "fused_tflops_per_chip": round(flops / t_fused / 1e12, 2),
+            "gemm_rs_ms": round(t_rs * 1e3, 3),
+            "gemm_rs_efficiency": round(float(t_compute / t_rs), 4),
             "shape_m_k_n": [m_full, k_dim, n_dim],
         },
     }))

@@ -136,3 +136,27 @@ def test_ep_capacity_overflow_drops(tp8_mesh, tp8_ctx):
     # copy overflows, so it contributes with weight 0.5 only.
     np.testing.assert_allclose(out[0], 0.5 * tok_np[0], rtol=1e-5)
     np.testing.assert_allclose(out[1], 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("wire", ["int8", "float8_e4m3fn"])
+def test_ep_dispatch_combine_quantized_wire(tp8_mesh, tp8_ctx, wire):
+    """On-wire quantization (reference ll-a2a-v2 fp8 mode): roundtrip
+    within quantization tolerance."""
+    T, d, E, K = 16, 32, 16, 2
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K,
+                            capacity=2 * T, axis="tp",
+                            wire_dtype=jnp.dtype(wire))
+    tokens = _rand((8 * T, d), 20)
+    ids = jax.random.randint(jax.random.PRNGKey(21), (8 * T, K), 0, E)
+    w = jax.nn.softmax(_rand((8 * T, K), 22), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        return ep_combine(recv, state, w_, ctx)
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)), P("tp", None))
+    out = np.asarray(f(tokens, ids, w))
+    expected = np.asarray(tokens * jnp.sum(w, axis=-1, keepdims=True))
+    # Two quantization passes (dispatch + combine): ~1-2% error budget.
+    np.testing.assert_allclose(out, expected, rtol=0.08, atol=0.08)

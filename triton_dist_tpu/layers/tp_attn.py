@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from math import sqrt as np_sqrt
+
 from triton_dist_tpu.layers.norm import rms_norm
 from triton_dist_tpu.layers.rope import apply_rope, rope_freqs
 from triton_dist_tpu.ops import ag_gemm, gemm_rs, gemm_ar
@@ -98,14 +100,31 @@ def _norm_rope(q, k, params, cfg, positions):
     return q, k
 
 
-def sdpa(q, k, v, *, causal: bool, kv_len=None):
-    """q: (B, Sq, H, hd); k/v: (B, Skv, KV, hd). GQA by head repeat."""
+def sdpa(q, k, v, *, causal: bool, kv_len=None, use_flash=None):
+    """q: (B, Sq, H, hd); k/v: (B, Skv, KV, hd). GQA by head repeat.
+
+    On real TPUs with long sequences the bundled Pallas flash-attention
+    kernel handles the softmax online (O(S) memory); the jnp path is the
+    portable oracle (and handles ragged kv_len masking).
+    """
     b, sq, h, hd = q.shape
     skv, kvh = k.shape[1], k.shape[2]
     if kvh != h:
         rep = h // kvh
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    if use_flash is None:
+        from triton_dist_tpu.utils.distributed import on_tpu, use_interpret
+        use_flash = (on_tpu() and not use_interpret() and kv_len is None
+                     and sq >= 128 and skv >= 128 and hd >= 64)
+    if use_flash:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal,
+            sm_scale=1.0 / float(np_sqrt(hd)))
+        return o.transpose(0, 2, 1, 3)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(hd))

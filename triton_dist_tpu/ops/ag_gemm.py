@@ -246,3 +246,31 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         ),
     )(a, b)
     return (out, a_full) if return_ag else out
+
+
+def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
+                  configs=None, **kw):
+    """Autotuned ag_gemm: sweeps block configs on first use per
+    (shape, dtype, mesh) key and persists the winner (reference:
+    ``@triton_dist.tune.autotune`` on ``ag_gemm``,
+    ``allgather_gemm.py:565-569``)."""
+    from triton_dist_tpu.autotuner import autotune
+
+    if configs is None:
+        configs = [
+            {"block_m": 256, "block_n": 512, "block_k": 1024},
+            {"block_m": 512, "block_n": 512, "block_k": 2048},
+            {"block_m": 512, "block_n": 1024, "block_k": 1024},
+            {"block_m": 256, "block_n": 256, "block_k": 512},
+        ]
+
+    @autotune("ag_gemm", configs,
+              key_fn=lambda a_, b_, **kk: {
+                  "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
+                  "dtype": str(a_.dtype), "world": mesh.size(axis)})
+    def _run(a_, b_, block_m=256, block_n=256, block_k=512):
+        ctx = create_ag_gemm_context(mesh, axis, block_m, block_n,
+                                     block_k)
+        return ag_gemm(a_, b_, ctx, **kw)
+
+    return _run(a, b)

@@ -40,6 +40,9 @@ class EPContext:
     topk: int = 2
     capacity: int = 128  # max tokens per (src rank, dst rank) pair
     impl: str = "pallas"  # "pallas" | "xla" transport
+    # On-wire quantization (reference low-latency a2a v2's optional fp8
+    # online quant): tokens travel as wire_dtype with per-token scales.
+    wire_dtype: Optional[object] = None  # e.g. jnp.float8_e4m3fn, jnp.int8
 
     @property
     def experts_per_rank(self) -> int:
@@ -48,12 +51,14 @@ class EPContext:
 
 def create_ep_context(mesh: MeshContext, *, num_experts: int, topk: int,
                       capacity: int, axis: str = "ep",
-                      impl: str = "pallas") -> EPContext:
+                      impl: str = "pallas",
+                      wire_dtype=None) -> EPContext:
     if num_experts % mesh.size(axis):
         raise ValueError(
             f"num_experts={num_experts} not divisible by ep={mesh.size(axis)}")
     return EPContext(mesh=mesh, axis=axis, num_experts=num_experts,
-                     topk=topk, capacity=capacity, impl=impl)
+                     topk=topk, capacity=capacity, impl=impl,
+                     wire_dtype=wire_dtype)
 
 
 @dataclasses.dataclass
@@ -79,6 +84,32 @@ def _transport(ctx: EPContext, x):
     if ctx.impl == "xla":
         return all_to_all_ref(x, axis=ctx.axis)
     return all_to_all(x, ctx=ctx.mesh, axis=ctx.axis)
+
+
+def _wire_max(dtype) -> float:
+    d = jnp.dtype(dtype)
+    if d == jnp.int8:
+        return 127.0
+    return float(jnp.finfo(d).max)
+
+
+def _quant_transport(ctx: EPContext, x):
+    """Token transport with optional on-wire quantization: per-token
+    (row) scales travel alongside the narrow payload (reference
+    ``low_latency_all_to_all_v2`` fp8 online quant)."""
+    if ctx.wire_dtype is None:
+        return _transport(ctx, x)
+    dmax = _wire_max(ctx.wire_dtype)
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / dmax
+    scale = jnp.maximum(scale, 1e-12)
+    q = (x.astype(jnp.float32) / scale)
+    if jnp.dtype(ctx.wire_dtype) == jnp.int8:
+        q = jnp.round(q)
+    q = q.astype(ctx.wire_dtype)
+    qr = _transport(ctx, q)
+    sr = _transport(ctx, scale)
+    return (qr.astype(jnp.float32) * sr).astype(x.dtype)
 
 
 def ep_dispatch(tokens, topk_ids, ctx: EPContext):
@@ -115,7 +146,7 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
     send_tok = send_tok.at[flat_rank, s_idx].set(tok_rep, mode="drop")
     send_exp = send_exp.at[flat_rank, s_idx].set(local_exp, mode="drop")
 
-    recv_tok = _transport(ctx, send_tok)              # (n, C, d)
+    recv_tok = _quant_transport(ctx, send_tok)        # (n, C, d)
     recv_exp = _transport(ctx, send_exp[..., None])[..., 0]  # (n, C)
 
     state = DispatchState(
@@ -136,7 +167,7 @@ def ep_combine(expert_out, state: DispatchState, topk_weights,
     d = expert_out.shape[-1]
     t, k = state.valid.shape
 
-    back = _transport(ctx, expert_out.reshape(n, cap, d))  # (n, C, d)
+    back = _quant_transport(ctx, expert_out.reshape(n, cap, d))  # (n, C, d)
     # back[r, s] = my token's expert output that was processed on rank r
     # at slot s (slot indices were assigned locally, so they're ours).
     gathered = back[jnp.where(state.valid, state.slot_rank, 0),

@@ -121,7 +121,9 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
         def _():
             # Fully reduced tile of my own chunk (manual store: the
             # output is only defined at the last ring step, so it cannot
-            # be a pipelined BlockSpec).
+            # be a pipelined BlockSpec). Note at s == n-1 the recv add
+            # above (s > 0) has already folded in the upstream partials;
+            # with n == 1 (forced rankless) acc is the whole result.
             out_v[...] = acc_v[...].astype(out_v.dtype)
             pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
                                             pl.ds(j * tn, tn)])
@@ -137,7 +139,7 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
             dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
 
 
-def gemm_rs(a, b, ctx: GemmRSContext):
+def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
     """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``.
 
     ``a``: (M, K_loc) — activations, K sharded (row-parallel);
@@ -149,7 +151,9 @@ def gemm_rs(a, b, ctx: GemmRSContext):
     m_full, k_loc = a.shape
     _, n_dim = b.shape
     out_dtype = ctx.out_dtype or a.dtype
-    if n == 1:
+    if n == 1 and not force_kernel:
+        # force_kernel=True keeps the pallas pipeline even rankless
+        # (single-chip kernel-efficiency benchmarking, like ag_gemm).
         return jnp.dot(a, b, preferred_element_type=jnp.float32
                        ).astype(out_dtype)
     if m_full % n:
@@ -181,8 +185,10 @@ def gemm_rs(a, b, ctx: GemmRSContext):
         grid=(n, n_i, n_j, n_k),
         out_shape=(
             jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
-            jax.ShapeDtypeStruct((n - 1, m_loc, n_dim), jnp.float32),
-            jax.ShapeDtypeStruct((n - 1, m_loc, n_dim), jnp.float32),
+            jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
+                                 jnp.float32),
         ),
         in_specs=[
             pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
@@ -196,8 +202,8 @@ def gemm_rs(a, b, ctx: GemmRSContext):
             pltpu.VMEM((tm, tn), jnp.float32),               # acc_v
             pltpu.VMEM((tm, tn), jnp.float32),               # tmp_v
             pltpu.VMEM((tm, tn), out_dtype),                 # out_v
-            pltpu.SemaphoreType.DMA((n - 1,)),               # send_sem
-            pltpu.SemaphoreType.DMA((n - 1,)),               # recv_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # recv_sem
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * m_full * k_loc * n_dim,
