@@ -129,3 +129,16 @@ def test_ag_gemm_bf16(tp8_mesh, tp8_ctx):
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(jnp.asarray(f(a, b), jnp.float32),
                     jnp.asarray(g(a, b), jnp.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_ag_gemm_pipelined_variant(tp8_mesh, tp8_ctx):
+    """The opt-in pipelined variant must agree with the oracle."""
+    a = _rand((256, 64), 30)
+    b = _rand((64, 64), 31)
+    ctx = create_ag_gemm_context(tp8_ctx, block_m=16, block_n=4,
+                                 block_k=16, variant="pipelined")
+    f = spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)

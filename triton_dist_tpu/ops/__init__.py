@@ -27,3 +27,24 @@ from triton_dist_tpu.ops.gemm_rs import (  # noqa: F401
 from triton_dist_tpu.ops.gemm_ar import (  # noqa: F401
     GemmARContext, create_gemm_ar_context, gemm_ar, gemm_ar_ref,
 )
+from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
+    all_to_all, all_to_all_ref,
+)
+from triton_dist_tpu.ops.ep_a2a import (  # noqa: F401
+    EPContext, create_ep_context, ep_dispatch, ep_combine, ep_moe_ref,
+)
+from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
+    grouped_gemm, grouped_swiglu, sort_by_expert,
+)
+from triton_dist_tpu.ops.ulysses import (  # noqa: F401
+    pre_attn_a2a, post_attn_a2a, ulysses_attn,
+)
+from triton_dist_tpu.ops.sp_ag_attention import (  # noqa: F401
+    sp_ag_attention, sp_ag_attention_ref,
+)
+from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
+    sp_flash_decode, flash_decode_ref,
+)
+from triton_dist_tpu.ops.gdn import (  # noqa: F401
+    gdn_fwd, gdn_decode_step, gdn_ref,
+)

@@ -56,17 +56,30 @@ class AGGemmContext:
     # busy loop is the only skew source that works on both backends.
     straggler_rank: int = -1
     straggler_delay_iters: int = 0
+    # Kernel variant: "panel" (default — full-K A panel staged per row
+    # tile; fastest measured single-chip) or "pipelined" (A rides the
+    # BlockSpec pipeline from the RDMA-fed aliased workspace; finer
+    # chunk-arrival granularity, currently slower on hardware because
+    # aliasing constrains Mosaic's multiple buffering). NOTE: "pipelined"
+    # needs >= 2 grid bodies per ring chunk (its arrival wait runs one
+    # body early) and falls back to "panel" below that.
+    variant: str = "panel"
 
 
 def create_ag_gemm_context(mesh: MeshContext, axis: str = "tp",
                            block_m: int = 256, block_n: int = 256,
                            block_k: int = 512, out_dtype=None,
                            straggler_rank: int = -1,
-                           straggler_delay_iters: int = 0) -> AGGemmContext:
+                           straggler_delay_iters: int = 0,
+                           variant: str = "panel") -> AGGemmContext:
+    if variant not in ("panel", "pipelined"):
+        raise ValueError(f"unknown ag_gemm variant {variant!r} "
+                         "(expected 'panel' or 'pipelined')")
     return AGGemmContext(mesh=mesh, axis=axis, block_m=block_m,
                          block_n=block_n, block_k=block_k,
                          out_dtype=out_dtype, straggler_rank=straggler_rank,
-                         straggler_delay_iters=straggler_delay_iters)
+                         straggler_delay_iters=straggler_delay_iters,
+                         variant=variant)
 
 
 def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
@@ -75,6 +88,23 @@ def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
     a_full = jax.lax.all_gather(a, axis, axis=0, tiled=True)
     return jnp.dot(a_full, b, preferred_element_type=jnp.float32
                    ).astype(a.dtype)
+
+
+def _straggler_spin(acc_v, me, straggler_rank: int, delay_iters: int):
+    """Fault-injection compute spin (shared by both kernel variants)."""
+    if delay_iters > 0:
+        @pl.when(me == straggler_rank)
+        def _():
+            spin = jax.lax.fori_loop(
+                0, delay_iters,
+                lambda _, x: x * 1.0000001 + 1e-7, jnp.float32(1.0))
+            acc_v[0, 0] = spin * 0.0
+
+
+def _drain_sends(send_sem, chunk_ref, n: int):
+    """Consume all ring send-semaphore counts before kernel exit."""
+    for s in range(n - 1):
+        dl.wait_arrivals(send_sem.at[s], chunk_ref, 1)
 
 
 def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
@@ -101,15 +131,7 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
 
     @pl.when(first)
     def _():
-        if straggler_delay_iters > 0:
-            @pl.when(me == straggler_rank)
-            def _():
-                # Dependent-FLOP spin: real wall-time skew on both the
-                # compiled and interpreted backends.
-                spin = jax.lax.fori_loop(
-                    0, straggler_delay_iters,
-                    lambda _, x: x * 1.0000001 + 1e-7, jnp.float32(1.0))
-                acc_v[0, 0] = spin * 0.0
+        _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         # Peers must be in-kernel before any remote traffic.
         dl.barrier_tile(axis, ctx=ctx)
         # Local chunk into the workspace, then kick off the ring.
@@ -158,8 +180,136 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
 
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
-        for s in range(n - 1):
-            dl.wait_arrivals(send_sem.at[s], chunk_of(0), 1)
+        _drain_sends(send_sem, chunk_of(0), n)
+
+
+def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
+                       recv_sem, *, axis: str, ctx: MeshContext,
+                       m_loc: int, n_ranks: int,
+                       straggler_rank: int = -1,
+                       straggler_delay_iters: int = 0):
+    """Fully-pipelined variant: A blocks arrive through the regular
+    Pallas double-buffered pipeline reading the RDMA-fed workspace
+    (``a_ws`` is the *aliased output* of the pipelined input ``a_pipe``).
+
+    The arrival hazard — the pipeline prefetches the next grid step's A
+    block before that step's body runs — is closed by waiting for chunk
+    ``k+1``'s arrival one body *early* (at the second-to-last body of
+    chunk ``k``), so the data is in HBM before its first prefetch is
+    issued. Requires >= 2 bodies per chunk (host falls back to the
+    panel variant otherwise).
+    """
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    right = jax.lax.rem(me + 1, n)
+
+    chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
+    lin = (i * n_j + j) * n_k + kk          # body index within chunk k
+    chunk_len = n_i * n_j * n_k
+
+    first = jnp.logical_and(k == 0, lin == 0)
+
+    @pl.when(first)
+    def _():
+        _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
+        dl.barrier_tile(axis, ctx=ctx)
+        if n > 1:
+            # Ring kick-off: send my chunk (pre-placed by the host).
+            dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
+                          recv_sem.at[0], right, axis=axis, ctx=ctx)
+
+    # Early wait: during chunk k's second-to-last body, certify chunk
+    # k+1's arrival (slot k) and forward it — before the pipeline
+    # prefetches chunk k+1's first A block.
+    @pl.when(jnp.logical_and(k < n - 1, lin == chunk_len - 2))
+    def _():
+        nxt = jax.lax.rem(me - (k + 1) + n, n)
+        dl.wait_arrivals(recv_sem.at[k], chunk_of(nxt), 1)
+
+        @pl.when(k + 1 < n - 1)
+        def _():
+            dl.remote_put(chunk_of(nxt), chunk_of(nxt),
+                          send_sem.at[k + 1], recv_sem.at[k + 1], right,
+                          axis=axis, ctx=ctx)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_pipe[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+    last = jnp.logical_and(k == n - 1, lin == chunk_len - 1)
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        _drain_sends(send_sem, chunk_of(0), n)
+
+
+def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
+                out_dtype, tm, tn, tk, n_i, n_j, n_k):
+    mesh = ctx.mesh
+    m_full = n * m_loc
+    me = jax.lax.axis_index(ctx.axis)
+    # Pre-place the local chunk so chunk k=0's pipeline reads are valid
+    # from the first body.
+    a_ws_init = jax.lax.dynamic_update_slice(
+        jnp.zeros((m_full, kdim), a.dtype), a, (me * m_loc, 0))
+
+    def a_index(k, i, j, kk):
+        me_ = jax.lax.axis_index(ctx.axis)
+        c = jax.lax.rem(me_ - k + n, n)
+        return (c * n_i + i, kk)
+
+    kernel = functools.partial(
+        _ag_gemm_kernel_v2, axis=ctx.axis, ctx=mesh, m_loc=m_loc,
+        n_ranks=n, straggler_rank=ctx.straggler_rank,
+        straggler_delay_iters=ctx.straggler_delay_iters)
+
+    out, a_full = core_call(
+        kernel,
+        comm=True,
+        grid=(n, n_i, n_j, n_k),
+        out_shape=(jax.ShapeDtypeStruct((m_full, n_loc), out_dtype),
+                   jax.ShapeDtypeStruct((m_full, kdim), a.dtype)),
+        in_specs=[
+            pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tm, tn),
+                         lambda k, i, j, kk: (
+                             (jax.lax.rem(jax.lax.axis_index(ctx.axis)
+                                          - k + n, n)) * n_i + i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
+        ],
+        input_output_aliases={0: 1},  # a_ws_init → a_ws output
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_full * kdim * n_loc,
+            bytes_accessed=(m_full * kdim + kdim * n_loc * n * n_i
+                            + m_full * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a_ws_init, b)
+    return out, a_full
 
 
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
@@ -201,6 +351,11 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
             f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
     n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
     m_full = n * m_loc
+
+    if ctx.variant == "pipelined" and n_i * n_j * n_k >= 2:
+        out, a_full = _ag_gemm_v2(a, b, ctx, n, m_loc, kdim, n_loc,
+                                  out_dtype, tm, tn, tk, n_i, n_j, n_k)
+        return (out, a_full) if return_ag else out
 
     def c_index(k, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)

@@ -1,0 +1,31 @@
+"""Tutorial 05: Ulysses sequence parallelism.
+
+Reference: the Ulysses fused QKV/O A2A kernels
+(``sp_ulysess_qkv_gemm_all2all.py``). Head<->sequence resharding
+all-to-alls around full-sequence attention.
+Run: python tutorials/05_ulysses_sp.py
+"""
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu as tdt
+from triton_dist_tpu.layers.tp_attn import sdpa
+from triton_dist_tpu.ops import ulysses_attn
+from triton_dist_tpu.utils.testing import spmd
+
+mesh = tdt.make_mesh(tp=8)
+ctx = tdt.MeshContext.from_mesh(mesh)
+s, h, hd = 64, 8, 16
+q = jax.random.normal(jax.random.PRNGKey(0), (s, h, hd))
+k = jax.random.normal(jax.random.PRNGKey(1), (s, h, hd))
+v = jax.random.normal(jax.random.PRNGKey(2), (s, h, hd))
+f = spmd(mesh, lambda a, b, c: ulysses_attn(a, b, c, axis="tp", ctx=ctx),
+         (P("tp", None, None),) * 3, P("tp", None, None))
+out = np.asarray(f(q, k, v))
+want = np.asarray(sdpa(q[None], k[None], v[None], causal=True)[0])
+print("ulysses attention max err:", np.abs(out - want).max())
