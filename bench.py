@@ -26,7 +26,8 @@ import time
 
 import numpy as np
 
-ITERS_LO, ITERS_HI = 8, 40
+ITERS_LO, ITERS_HI = 8, 72
+REPEATS = 5
 
 
 def _timed_chain(step, a, b):
@@ -54,7 +55,7 @@ def _timed_chain(step, a, b):
         v = np.asarray(chain(a, b))  # warmup/compile
         assert np.isfinite(v), "benchmark chain produced non-finite value"
         best = float("inf")
-        for _ in range(3):
+        for _ in range(REPEATS):
             t0 = time.perf_counter()
             np.asarray(chain(a, b))
             best = min(best, time.perf_counter() - t0)
@@ -77,8 +78,8 @@ def main():
 
     mesh = Mesh(np.array(devices), ("tp",))
     mctx = MeshContext.from_mesh(mesh)
-    ctx = create_ag_gemm_context(mctx, block_m=512, block_n=512,
-                                 block_k=2048)
+    ctx = create_ag_gemm_context(mctx, block_m=1024, block_n=128,
+                                 block_k=4096)
 
     a = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(0), (m_full, k_dim), dtype),
@@ -116,8 +117,8 @@ def main():
 
     # Secondary: GEMM+RS efficiency on the transposed problem.
     from triton_dist_tpu.ops import gemm_rs, create_gemm_rs_context
-    rs_ctx = create_gemm_rs_context(mctx, block_m=512, block_n=512,
-                                    block_k=2048)
+    rs_ctx = create_gemm_rs_context(mctx, block_m=1024, block_n=128,
+                                    block_k=4096)
     a_rs = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(2), (m_full, k_dim), dtype),
         NamedSharding(mesh, P(None, "tp")))

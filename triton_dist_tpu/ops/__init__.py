@@ -10,7 +10,9 @@ reference). Every op has:
   portable fallback.
 """
 
-from triton_dist_tpu.ops.allgather import all_gather, all_gather_ref  # noqa: F401
+from triton_dist_tpu.ops.allgather import (  # noqa: F401
+    all_gather, all_gather_2d, all_gather_ref,
+)
 from triton_dist_tpu.ops.reduce_scatter import (  # noqa: F401
     reduce_scatter, reduce_scatter_ref,
 )

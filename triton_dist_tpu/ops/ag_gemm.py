@@ -339,8 +339,10 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     tn = min(ctx.block_n, n_loc)
     tk = min(ctx.block_k, kdim)
     # The A panel is (tm, K) in VMEM; clamp tm so it stays within a
-    # ~6 MB budget for any K (block_k bounds only the B tiles).
-    panel_budget = 6 * 1024 * 1024
+    # ~9 MB budget for any K (block_k bounds only the B tiles; the rest
+    # of the ~16 MB VMEM holds double-buffered B, the accumulator, and
+    # the output tile).
+    panel_budget = 9 * 1024 * 1024
     while tm > 8 and tm * kdim * a.dtype.itemsize > panel_budget:
         tm //= 2
     while tm > 1 and m_loc % tm:

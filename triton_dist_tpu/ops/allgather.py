@@ -96,6 +96,21 @@ def _full_mesh_kernel(x_ref, out_ref, send_sem, recv_sem, *,
     dl.wait_arrivals(recv_sem, out_ref.at[pl.ds(me * csize, csize)], n - 1)
 
 
+def all_gather_2d(x, *, ctx: MeshContext, inner_axis: str = "tp",
+                  outer_axis: str = "dp", mode: str = "ring"):
+    """Hierarchical AllGather over two mesh axes: ring within the fast
+    (ICI) ``inner_axis`` first, then across the slow (DCN) ``outer_axis``
+    — the reference's NUMA-aware 2D schedule
+    (``allgather.py:202`` 2D ring; SURVEY.md §7 "the INTRA/INTER scope
+    split and the 2D ring are the right template").
+
+    Output chunk order is global rank order (outer-major), matching a
+    flat all_gather over (outer, inner).
+    """
+    inner = all_gather(x, ctx=ctx, axis=inner_axis, mode=mode)
+    return all_gather(inner, ctx=ctx, axis=outer_axis, mode=mode)
+
+
 def all_gather(x, *, ctx: MeshContext, axis: str = "tp",
                mode: str = "ring"):
     """Per-shard AllGather along ``axis`` (call inside shard_map).

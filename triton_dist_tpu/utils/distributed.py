@@ -62,11 +62,20 @@ def use_interpret() -> bool:
 
 
 def interpret_arg():
-    """Value to pass as ``pl.pallas_call(interpret=...)``."""
+    """Value to pass as ``pl.pallas_call(interpret=...)``.
+
+    Set ``TRITON_DIST_TPU_DETECT_RACES=1`` to run the whole battery
+    under the vector-clock race detector — the deliberate signal-
+    protocol checker SURVEY.md §5 calls for (the reference only has a
+    compute-sanitizer hook).
+    """
     if use_interpret():
         from jax.experimental.pallas import tpu as pltpu
 
-        return pltpu.InterpretParams(dma_execution_mode="eager")
+        return pltpu.InterpretParams(
+            dma_execution_mode="eager",
+            detect_races=os.environ.get(
+                "TRITON_DIST_TPU_DETECT_RACES") == "1")
     return False
 
 
