@@ -1,0 +1,78 @@
+"""Batch-serving demo for the dense Qwen3 engine.
+
+Reference analogue: ``test_e2e_inference.py`` / the megakernel
+``model_server.py`` chat demo. Runs greedy generation over a token
+batch and reports per-token latency; add ``--megakernel`` to run every
+decode step as one persistent Pallas kernel per device.
+
+Run (CPU mesh): python examples/serve_dense.py
+Run (real TPUs): TDT_REAL_TPU=1 python examples/serve_dense.py --tp 8
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tp", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--gen-len", type=int, default=16)
+    ap.add_argument("--mode", default="fused",
+                    choices=["xla", "fused", "fused_ar"])
+    ap.add_argument("--megakernel", action="store_true")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={args.tp}")
+    import jax
+    if os.environ.get("TDT_REAL_TPU") != "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import triton_dist_tpu as tdt
+    from triton_dist_tpu.models import ModelConfig, Engine
+
+    # vocab kept small so the megakernel arena stays under the CPU
+    # interpret-mode per-buffer limit (docs/testing.md).
+    cfg = ModelConfig.tiny(vocab_size=64)
+    mesh = tdt.make_mesh(tp=args.tp)
+    ids = jax.random.randint(jax.random.PRNGKey(0),
+                             (args.batch, args.prompt_len), 0,
+                             cfg.vocab_size)
+
+    if args.megakernel:
+        from jax.sharding import Mesh
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
+        max_len = -(-(args.prompt_len + args.gen_len) // 16) * 16
+        eng = MegaKernelEngine(cfg, mesh1d, batch=args.batch,
+                               max_len=max_len, tile_w=16, t_tile=16)
+        t0 = time.perf_counter()
+        toks = np.asarray(eng.generate(ids[:, 0], steps=args.gen_len))
+        dt = time.perf_counter() - t0
+    else:
+        eng = Engine(cfg, mesh, mode=args.mode,
+                     max_len=args.prompt_len + args.gen_len,
+                     block_m=8, block_n=8, block_k=32)
+        t0 = time.perf_counter()
+        toks = np.asarray(eng.serve(ids, gen_len=args.gen_len))
+        dt = time.perf_counter() - t0
+
+    print("generated tokens:\n", toks)
+    print(f"{toks.size} tokens in {dt:.2f}s "
+          f"({dt / max(toks.shape[1], 1) * 1e3:.1f} ms/step incl. "
+          "interpret overhead)" if os.environ.get("TDT_REAL_TPU") != "1"
+          else f"{dt / toks.shape[1] * 1e3:.2f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

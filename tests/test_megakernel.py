@@ -65,21 +65,21 @@ def test_megakernel_decode_vs_layers(tp2_mesh):
                    CFG.num_key_value_heads, CFG.head_dim)
     k_cache = jax.random.normal(jax.random.PRNGKey(1), cache_shape) * 0.3
     v_cache = jax.random.normal(jax.random.PRNGKey(2), cache_shape) * 0.3
-    x = jax.random.normal(jax.random.PRNGKey(3), (B, CFG.hidden_size))
+    tokens = jnp.asarray([3, 17], jnp.int32)
     pos = jnp.asarray(5, jnp.int32)
     kvspec = P(None, None, None, "tp", None)
 
-    # --- megakernel path ---
+    # --- megakernel path (embedding + stack + LM head in-kernel) ---
     pack = spmd(mesh, mb.pack_arena, (specs,), P("tp", None))
     arena = pack(params)
     step = spmd(mesh, mb.step_fn(),
-                (P("tp", None), kvspec, kvspec, P(None, None), P()),
-                (P(None, None), P("tp", None), kvspec, kvspec))
-    hidden, arena2, kc2, vc2 = step(arena, k_cache, v_cache, x, pos)
+                (P("tp", None), kvspec, kvspec, P(None), P()),
+                (P(None, "tp"), P("tp", None), kvspec, kvspec))
+    logits, arena2, kc2, vc2 = step(arena, k_cache, v_cache, tokens, pos)
 
     # --- layer-by-layer oracle (xla mode, proven against dense) ---
-    def oracle(p, xx, kc, vc):
-        h = xx
+    def oracle(p, tok, kc, vc):
+        h = p["embed"][tok]
         new_k, new_v = kc, vc
         for li, lp in enumerate(p["layers"]):
             t = rms_norm(h, lp["ln_attn"], CFG.rms_norm_eps)
@@ -91,13 +91,15 @@ def test_megakernel_decode_vs_layers(tp2_mesh):
             t = rms_norm(h, lp["ln_mlp"], CFG.rms_norm_eps)
             h = h + tp_mlp.fwd(lp["mlp"], t, mode="xla_ar")
         h = rms_norm(h, p["ln_f"], CFG.rms_norm_eps)
-        return h, new_k, new_v
+        logits_loc = h @ p["lm_head"].T
+        return (jax.lax.all_gather(logits_loc, "tp", axis=1, tiled=True),
+                new_k, new_v)
 
-    of = spmd(mesh, oracle, (specs, P(None, None), kvspec, kvspec),
+    of = spmd(mesh, oracle, (specs, P(None), kvspec, kvspec),
               (P(None, None), kvspec, kvspec))
-    want_h, want_k, want_v = of(params, x, k_cache, v_cache)
+    want_logits, want_k, want_v = of(params, tokens, k_cache, v_cache)
 
-    assert_allclose(hidden, want_h, rtol=2e-3, atol=2e-3)
+    assert_allclose(logits, want_logits, rtol=2e-3, atol=2e-3)
     # Cache slot 5 must hold the new roped+normed K and the raw V.
     assert_allclose(np.asarray(kc2)[:, :, 5], np.asarray(want_k)[:, :, 5],
                     rtol=2e-3, atol=2e-3)
@@ -111,7 +113,8 @@ def test_megakernel_engine_generate(tp2_mesh):
     from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
     eng = MegaKernelEngine(CFG, tp2_mesh, batch=B, max_len=MAXLEN,
-                           tile_w=16, t_tile=16, seed=4)
+                           tile_w=16, t_tile=16, seed=4,
+                           keep_params=True)
     toks = np.asarray(eng.generate(jnp.zeros((B,), jnp.int32), steps=4))
     assert toks.shape == (B, 4)
     assert np.isfinite(toks).all()

@@ -59,7 +59,7 @@ class ModelBuilder:
                              f"head_dim {hd}")
         self.t_tile = t_tile or min(128, max_len)
         if max_len % self.t_tile:
-            raise ValueError("max_len must divide t_tile")
+            raise ValueError(f"t_tile={self.t_tile} must divide max_len={max_len}")
 
         n = self.n
         self.h_loc = cfg.num_attention_heads // n
@@ -113,8 +113,14 @@ class ModelBuilder:
             self._weight_entries.append((name, tiles))
             return off
 
+        # lm_head is vocab-sharded along tp (models.dense.param_specs);
+        # each shard holds vocab/n rows.
+        if cfg.vocab_size % self.n:
+            raise ValueError(f"vocab_size={cfg.vocab_size} not divisible "
+                             f"by tp={self.n}")
+        self.vocab_loc = cfg.vocab_size // self.n
+        self.vloc_tiles = _cdiv(self.vocab_loc, w)
         L = cfg.num_hidden_layers
-        wo_offs = []
         for li in range(L):
             walloc(f"l{li}.wq", d_t, hq_t)
             walloc(f"l{li}.wk", d_t, kv_t)
@@ -128,6 +134,13 @@ class ModelBuilder:
             vecalloc(f"l{li}.q_norm", 1)
             vecalloc(f"l{li}.k_norm", 1)
         vecalloc("ln_f", d_t)
+        # Embedding table vocab-sharded like lm_head: vocab/n entries
+        # per rank; the gather task zero-fills off-shard tokens and an
+        # allreduce sums the single real contribution.
+        self._alloc("embed", (cfg.vocab_size // self.n) * d_t)
+        self._weight_entries.append(
+            ("embed", (cfg.vocab_size // self.n) * d_t))
+        walloc("lm_head_T", d_t, self.vloc_tiles)
 
         # Allreduce workspace + I/O regions.
         ar_max_tiles = max(d_t, 1)
@@ -135,6 +148,20 @@ class ModelBuilder:
         self.ar_max_tiles = ar_max_tiles
         x_off = self._alloc_act("x", d_t)
         self.x_off = x_off
+
+        # Embedding lookup inside the kernel (token ids via prefetch),
+        # then an allreduce to sum the vocab-shard contributions.
+        self.graph.add(TaskType.GATHER,
+                       (self._offsets["embed"], x_off, d_t,
+                        self.vocab_loc),
+                       reads=[(self._offsets["embed"],
+                               (cfg.vocab_size // self.n) * d_t)],
+                       writes=[(x_off, d_t * b)])
+        self.graph.add(TaskType.ALLREDUCE, (x_off, d_t),
+                       reads=[(x_off, d_t * b)],
+                       writes=[(x_off, d_t * b),
+                               (self.ar_ws_off,
+                                self.n * ar_max_tiles * b)])
 
         # Per-layer tasks.
         g = self.graph
@@ -217,6 +244,12 @@ class ModelBuilder:
               reads=[(x_off, d_t * b), (o["ln_f"], d_t)],
               writes=[(out_off, d_t * b)])
         self.out_off = out_off
+        # LM head inside the kernel: logits over this rank's vocab shard.
+        logits_off = self._alloc_act("logits", self.vloc_tiles)
+        self._linear(out_off, o["lm_head_T"], logits_off, d_t,
+                     self.vloc_tiles, layer=-1, in_rows=d_t * b,
+                     w_rows=d_t * self.vloc_tiles * w)
+        self.logits_off = logits_off
         self.arena_rows = self._cursor
 
         # -------- native schedule --------
@@ -265,6 +298,19 @@ class ModelBuilder:
             parts.append(self._pad_vec(lp["attn"]["q_norm"], 1))
             parts.append(self._pad_vec(lp["attn"]["k_norm"], 1))
         parts.append(self._pad_vec(params["ln_f"], d_t))
+        # Embedding table shard: this rank's vocab/n rows, laid out as
+        # (vocab_loc * d_tiles, w). Params keep embed replicated
+        # (dense.param_specs), so slice the local shard here.
+        me = jax.lax.axis_index(self.axis)
+        emb = jax.lax.dynamic_slice_in_dim(
+            params["embed"].astype(jnp.float32), me * self.vocab_loc,
+            self.vocab_loc, axis=0)
+        vpad = jnp.zeros((self.vocab_loc, d_t * self.w), jnp.float32
+                         ).at[:, :cfg.hidden_size].set(emb)
+        parts.append(vpad.reshape(self.vocab_loc * d_t, self.w))
+        # LM head transposed: x @ lm_head.T with lm_head (vocab_loc, d).
+        parts.append(self._tile_weight(params["lm_head"].T, d_t,
+                                       self.vloc_tiles))
         weights = jnp.concatenate(parts, axis=0)
         pad = jnp.zeros((self.arena_rows - weights.shape[0], self.w),
                         jnp.float32)
@@ -279,9 +325,9 @@ class ModelBuilder:
             n_ranks=self.n, axis=self.axis, mesh=self.mctx,
             ar_ws_off=self.ar_ws_off, ar_max_tiles=self.ar_max_tiles)
 
-    def _kernel(self, types_s, args_s, len_s, arena_in, kc_in, vc_in,
-                arena, k_cache, v_cache, va, vb, vc, vw, acc, vhd, vkt,
-                send_sem, recv_sem):
+    def _kernel(self, types_s, args_s, len_s, tok_s, arena_in, kc_in,
+                vc_in, arena, k_cache, v_cache, va, vb, vc, vw, acc, vhd,
+                vkt, send_sem, recv_sem):
         cfg = self.kernel_config()
         i = pl.program_id(0)
         ttype = types_s[i]
@@ -299,31 +345,29 @@ class ModelBuilder:
             lambda: K.attn_decode_body(cfg, args, refs, len_s),
             lambda: K.write_kv_body(cfg, args, refs, len_s),
             lambda: K.allreduce_body(cfg, args, refs),
+            lambda: K.gather_body(cfg, args, refs, tok_s),
         ]
         jax.lax.switch(ttype, branches)
 
     def step_fn(self):
-        """Per-shard decode step: (arena, k_cache, v_cache, x, cache_len)
-        → (hidden (B, d), arena, k_cache, v_cache). Call inside
-        shard_map; donate arena + caches at jit level."""
+        """Per-shard decode step:
+        (arena, k_cache, v_cache, token_ids (B,), cache_len)
+        → (logits (B, vocab_loc), arena, k_cache, v_cache).
+        Embedding, the transformer stack, and the vocab-sharded LM head
+        all run inside the kernel. Call inside shard_map; donate arena +
+        caches at jit level."""
         b, w, d_t = self.batch, self.w, self.d_tiles
         cfg = self.cfg
         T = len(self.task_types)
         types = jnp.asarray(self.task_types)
         args = jnp.asarray(self.task_args)
 
-        def step(arena, k_cache, v_cache, x, cache_len):
-            # Write x (B, d) into its arena region as (d_t*b, w) tiles.
-            xcols = jnp.zeros((b, d_t * w), jnp.float32).at[
-                :, :cfg.hidden_size].set(x.astype(jnp.float32))
-            xt = xcols.reshape(b, d_t, w).transpose(1, 0, 2).reshape(
-                d_t * b, w)
-            arena = jax.lax.dynamic_update_slice(
-                arena, xt, (self.x_off, 0))
+        def step(arena, k_cache, v_cache, token_ids, cache_len):
             len_arr = jnp.asarray([cache_len], jnp.int32)
+            tok_arr = jnp.asarray(token_ids, jnp.int32)
 
             grid_spec = pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=3,
+                num_scalar_prefetch=4,
                 grid=(T,),
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
                 out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
@@ -348,14 +392,15 @@ class ModelBuilder:
                     jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                     jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
                 ),
-                input_output_aliases={3: 0, 4: 1, 5: 2},
+                input_output_aliases={4: 0, 5: 1, 6: 2},
                 compiler_params=comm_compiler_params(),
-            )(types, args, len_arr, arena, k_cache, v_cache)
+            )(types, args, len_arr, tok_arr, arena, k_cache, v_cache)
 
+            lt = self.vloc_tiles
             out_rows = jax.lax.dynamic_slice(
-                arena, (self.out_off, 0), (d_t * b, w))
-            hidden = out_rows.reshape(d_t, b, w).transpose(1, 0, 2
-                                                           ).reshape(b, d_t * w)
-            return hidden[:, :cfg.hidden_size], arena, k_cache, v_cache
+                arena, (self.logits_off, 0), (lt * b, w))
+            logits = out_rows.reshape(lt, b, w).transpose(1, 0, 2
+                                                          ).reshape(b, lt * w)
+            return (logits[:, :self.vocab_loc], arena, k_cache, v_cache)
 
         return step

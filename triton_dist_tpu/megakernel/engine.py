@@ -2,10 +2,10 @@
 persistent Pallas kernel (reference: ``mega_triton_kernel/test/models/``
 chat demo / ``model_server.py`` / ``bench_qwen3.py``).
 
-Embedding lookup and the LM head run outside the megakernel (cheap
-gather / single matmul); everything between — norms, projections, rope,
-flash decode over the cache, SwiGLU, and the TP allreduces — executes
-inside it.
+The entire decode step runs inside the kernel: embedding gather (over
+the vocab-sharded table), norms, projections, rope, flash decode over
+the cache, SwiGLU, the TP allreduces, and the vocab-sharded LM head —
+token ids in, logits out.
 """
 
 from __future__ import annotations
@@ -25,7 +25,8 @@ from triton_dist_tpu.models.config import ModelConfig
 class MegaKernelEngine:
     def __init__(self, cfg: ModelConfig, mesh: Mesh, *, batch: int,
                  max_len: int = 512, axis: str = "tp", params=None,
-                 seed: int = 0, tile_w=None, t_tile=None):
+                 seed: int = 0, tile_w=None, t_tile=None,
+                 keep_params: bool = False):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -36,20 +37,23 @@ class MegaKernelEngine:
         specs = dense.param_specs(cfg, axis)
         if params is None:
             params = dense.init_params(jax.random.PRNGKey(seed), cfg)
-        self.params = jax.tree.map(
+        placed = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, specs)
 
         kvspec = P(None, None, None, axis, None)
         self._arena = jax.jit(jax.shard_map(
             self.builder.pack_arena, mesh=mesh, in_specs=(specs,),
-            out_specs=P(axis, None), check_vma=False))(self.params)
+            out_specs=P(axis, None), check_vma=False))(placed)
+        # After packing, decode no longer reads the params; keeping them
+        # doubles weight HBM (useful only for tests/oracles).
+        self.params = placed if keep_params else None
 
         step = self.builder.step_fn()
         self._step = jax.jit(jax.shard_map(
             step, mesh=mesh,
-            in_specs=(P(axis, None), kvspec, kvspec, P(None, None), P()),
-            out_specs=(P(None, None), P(axis, None), kvspec, kvspec),
+            in_specs=(P(axis, None), kvspec, kvspec, P(None), P()),
+            out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
             check_vma=False), donate_argnums=(0, 1, 2))
 
         n = mesh.shape[axis]
@@ -61,12 +65,15 @@ class MegaKernelEngine:
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
 
     def decode_step(self, token_ids, cache_len) -> jax.Array:
-        """token_ids: (B,) → logits (B, vocab). Advances the caches."""
-        x = jnp.asarray(self.params["embed"])[token_ids]
-        hidden, self._arena, self.k_cache, self.v_cache = self._step(
-            self._arena, self.k_cache, self.v_cache, x,
+        """token_ids: (B,) → logits (B, vocab). Embedding, the whole
+        transformer stack, and the LM head all run inside the
+        megakernel; the vocab-sharded logits are stitched by the
+        out_specs."""
+        logits, self._arena, self.k_cache, self.v_cache = self._step(
+            self._arena, self.k_cache, self.v_cache,
+            jnp.asarray(token_ids, jnp.int32),
             jnp.asarray(cache_len, jnp.int32))
-        return jnp.dot(hidden, jnp.asarray(self.params["lm_head"]).T)
+        return logits
 
     def generate(self, first_tokens, steps: int):
         """Greedy chain from (B,) seed tokens; returns (B, steps)."""

@@ -297,6 +297,43 @@ def attn_decode_body(cfg, args, refs, len_s):
     jax.lax.fori_loop(0, q_tiles, per_qtile, 0)
 
 
+def gather_body(cfg, args, refs, tok_s):
+    """Embedding lookup over the *vocab-sharded* table: each rank holds
+    ``vocab_loc`` entries; non-owners write zeros and the following
+    ALLREDUCE task sums the one real contribution. Token ids arrive via
+    scalar prefetch; out-of-shard (including out-of-vocab) ids simply
+    produce a zero contribution, so no arena row outside the table is
+    ever addressed."""
+    arena, vb = refs["arena"], refs["vb"]
+    table_off, out_off, d_tiles, vocab_loc = (args[0], args[1], args[2],
+                                              args[3])
+    b = cfg.batch
+    me = dl.rank(cfg.axis)
+
+    for bb in range(b):  # static batch
+        tok_local = tok_s[bb] - me * vocab_loc
+        owner = jnp.logical_and(tok_local >= 0, tok_local < vocab_loc)
+        tok_safe = jnp.clip(tok_local, 0, vocab_loc - 1)
+
+        def per_tile(j, _):
+            @pl.when(owner)
+            def _():
+                pltpu.sync_copy(
+                    arena.at[pl.ds(table_off + tok_safe * d_tiles + j, 1)],
+                    vb.at[pl.ds(0, 1)])
+
+            @pl.when(jnp.logical_not(owner))
+            def _():
+                vb[pl.ds(0, 1), :] = jnp.zeros((1, cfg.w), vb.dtype)
+
+            pltpu.sync_copy(
+                vb.at[pl.ds(0, 1)],
+                arena.at[pl.ds(out_off + j * b + bb, 1)])
+            return 0
+
+        jax.lax.fori_loop(0, d_tiles, per_tile, 0)
+
+
 def allreduce_body(cfg, args, refs):
     """One-shot in-kernel allreduce of an arena slab across the TP axis
     (reference: megakernel allreduce + barrier tasks,

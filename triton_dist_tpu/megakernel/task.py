@@ -21,6 +21,7 @@ class TaskType(enum.IntEnum):
     ATTN_DECODE = 4    # args: q_off, out_off, layer, h_loc, kv_loc, hd
     WRITE_KV = 5       # args: k_off, v_off, layer, kv_loc, hd
     ALLREDUCE = 6      # args: buf_off, rows, dim
+    GATHER = 7         # args: table_off, out_off, d_tiles (ids via prefetch)
 
 
 @dataclasses.dataclass
