@@ -137,9 +137,7 @@ class ModelBuilder:
         # Embedding table vocab-sharded like lm_head: vocab/n entries
         # per rank; the gather task zero-fills off-shard tokens and an
         # allreduce sums the single real contribution.
-        self._alloc("embed", (cfg.vocab_size // self.n) * d_t)
-        self._weight_entries.append(
-            ("embed", (cfg.vocab_size // self.n) * d_t))
+        vecalloc("embed", self.vocab_loc * d_t)
         walloc("lm_head_T", d_t, self.vloc_tiles)
 
         # Allreduce workspace + I/O regions.
@@ -155,7 +153,7 @@ class ModelBuilder:
                        (self._offsets["embed"], x_off, d_t,
                         self.vocab_loc),
                        reads=[(self._offsets["embed"],
-                               (cfg.vocab_size // self.n) * d_t)],
+                               self.vocab_loc * d_t)],
                        writes=[(x_off, d_t * b)])
         self.graph.add(TaskType.ALLREDUCE, (x_off, d_t),
                        reads=[(x_off, d_t * b)],
