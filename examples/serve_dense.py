@@ -57,18 +57,9 @@ def main():
         eng = MegaKernelEngine(cfg, mesh1d, batch=args.batch,
                                max_len=max_len, tile_w=16, t_tile=16)
         t0 = time.perf_counter()
-        # Prefill by decode chain: feed every prompt token (the
-        # megakernel has no separate prefill path yet), then generate.
-        import jax.numpy as jnp
-        for pos in range(args.prompt_len - 1):
-            eng.decode_step(ids[:, pos], pos)
-        tok = ids[:, args.prompt_len - 1]
-        outs = []
-        for i in range(args.gen_len):
-            logits = eng.decode_step(tok, args.prompt_len - 1 + i)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            outs.append(np.asarray(tok))
-        toks = np.stack(outs, axis=1)
+        seed = eng.prefill_chain(ids)
+        toks = np.asarray(eng.generate(seed, steps=args.gen_len,
+                                       start_pos=args.prompt_len - 1))
         dt = time.perf_counter() - t0
     else:
         eng = Engine(cfg, mesh, mode=args.mode,

@@ -128,3 +128,28 @@ def test_race_detector_clean(tp8_mesh, tp8_ctx):
             "race detector flagged the ring allgather"
     finally:
         pallas_helpers.interpret_arg = orig
+
+
+def test_all_reduce_recursive(tp8_mesh, tp8_ctx):
+    """Rabenseifner recursive halving-doubling (tree-class, 2·log n
+    steps) vs psum."""
+    x = _rand((64, 64), seed=50)
+    f = spmd(tp8_mesh,
+             lambda v: all_reduce(v, ctx=tp8_ctx,
+                                  method=AllReduceMethod.RECURSIVE),
+             P("tp", None), P("tp", None))
+    g = spmd(tp8_mesh, lambda v: all_reduce_ref(v),
+             P("tp", None), P("tp", None))
+    assert_allclose(f(x), g(x), rtol=1e-4, atol=1e-4)
+
+
+def test_all_reduce_recursive_validation(tp8_mesh, tp8_ctx):
+    import pytest as _pytest
+    # (32, 64) shards evenly over 8 ranks (per-shard rows=4) but 4 is
+    # not divisible by n=8 — must hit the RECURSIVE precondition, not
+    # shard_map's own divisibility error.
+    with _pytest.raises(ValueError, match="RECURSIVE"):
+        spmd(tp8_mesh,
+             lambda v: all_reduce(v, ctx=tp8_ctx,
+                                  method=AllReduceMethod.RECURSIVE),
+             P("tp", None), P("tp", None))(_rand((32, 64), seed=51))

@@ -75,12 +75,22 @@ class MegaKernelEngine:
             jnp.asarray(cache_len, jnp.int32))
         return logits
 
-    def generate(self, first_tokens, steps: int):
-        """Greedy chain from (B,) seed tokens; returns (B, steps)."""
+    def prefill_chain(self, prompt_ids):
+        """Feed a (B, S) prompt token-by-token (the megakernel has no
+        batched prefill path yet). Returns the last token to seed
+        :meth:`generate` with ``start_pos=S-1``."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        for pos in range(prompt_ids.shape[1] - 1):
+            self.decode_step(prompt_ids[:, pos], pos)
+        return prompt_ids[:, -1]
+
+    def generate(self, first_tokens, steps: int, *, start_pos: int = 0):
+        """Greedy chain from (B,) seed tokens at cache position
+        ``start_pos``; returns (B, steps)."""
         tok = jnp.asarray(first_tokens, jnp.int32)
         out = []
         for i in range(steps):
-            logits = self.decode_step(tok, i)
+            logits = self.decode_step(tok, start_pos + i)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out.append(tok)
         return jnp.stack(out, axis=1)

@@ -20,6 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -31,9 +32,15 @@ from triton_dist_tpu.parallel.mesh import MeshContext
 
 
 class AllReduceMethod(enum.Enum):
-    """Reference: ``kernels/allreduce.py:31`` AllReduceMethod enum."""
+    """Reference: ``kernels/allreduce.py:31`` AllReduceMethod enum
+    (OneShot / TwoShot / DoubleTree / multimem variants)."""
     ONE_SHOT = "one_shot"
     TWO_SHOT = "two_shot"
+    # Rabenseifner recursive halving-doubling: 2·log2(n) steps — the
+    # latency-optimal tree-class algorithm (the DoubleTree analogue;
+    # NVLS multimem has no ICI equivalent). Requires power-of-two n and
+    # dim0 divisible by n.
+    RECURSIVE = "recursive"
 
 
 def all_reduce_ref(x, *, axis: str = "tp", **_):
@@ -69,6 +76,72 @@ def _one_shot_kernel(x_ref, out_ref, gather_hbm, acc_v, tmp_v,
     pltpu.sync_copy(acc_v, out_ref)
 
 
+def _rhd_kernel(x_ref, out_ref, recv_hbm, acc_v, tmp_v, send_sem,
+                recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
+                rows: int, tile_rows: int):
+    """Recursive halving (reduce-scatter) + recursive doubling
+    (allgather). ``recv_hbm[s]`` holds step s's incoming half; all
+    region *lengths* are static (``rows >> (s+1)``), only the region
+    *starts* are traced (they depend on this device's rank bits)."""
+    me = dl.rank(axis)
+    n = n_ranks
+    logn = n.bit_length() - 1
+
+    pltpu.sync_copy(x_ref, out_ref)
+    dl.barrier_all(axis, ctx=ctx)
+
+    def add_region(dst_start, src_hbm, src_start, length):
+        # out[dst_start:+length] += src_hbm[src_start:+length], tiled.
+        steps = length // tile_rows
+        def body(t, _):
+            o = t * tile_rows
+            pltpu.sync_copy(
+                out_ref.at[pl.ds(dst_start + o, tile_rows)], acc_v)
+            pltpu.sync_copy(
+                src_hbm.at[pl.ds(src_start + o, tile_rows)], tmp_v)
+            acc_v[...] = acc_v[...] + tmp_v[...]
+            pltpu.sync_copy(
+                acc_v, out_ref.at[pl.ds(dst_start + o, tile_rows)])
+            return 0
+        jax.lax.fori_loop(0, steps, body, 0)
+
+    # ---- reduce-scatter by recursive halving ----
+    start = jnp.int32(0)
+    for s in range(logn):
+        half = rows >> (s + 1)              # static length
+        bit = jax.lax.rem(jax.lax.shift_right_logical(
+            me, logn - s - 1), 2)
+        partner = jax.lax.bitwise_xor(me, 1 << (logn - s - 1))
+        keep_start = start + bit * half
+        send_start = start + (1 - bit) * half
+        # Packed workspace: step s's region starts after all earlier
+        # halves (sum_{t<s} rows>>(t+1) = rows - (rows>>s)).
+        ws_off = rows - (rows >> s)
+        copy = dl.remote_put(
+            out_ref.at[pl.ds(send_start, half)],
+            recv_hbm.at[pl.ds(ws_off, half)],
+            send_sem.at[s], recv_sem.at[s], partner, axis=axis, ctx=ctx)
+        copy.wait()
+        add_region(keep_start, recv_hbm, ws_off, half)
+        start = keep_start
+
+    # ---- allgather by recursive doubling (reverse order) ----
+    for s in reversed(range(logn)):
+        half = rows >> (s + 1)
+        bit = jax.lax.rem(jax.lax.shift_right_logical(
+            me, logn - s - 1), 2)
+        partner = jax.lax.bitwise_xor(me, 1 << (logn - s - 1))
+        # I own [start, +half); partner owns the sibling half. Put mine
+        # into the partner's out at the same coordinates (symmetric).
+        copy = dl.remote_put(
+            out_ref.at[pl.ds(start, half)],
+            out_ref.at[pl.ds(start, half)],
+            send_sem.at[logn + s], recv_sem.at[logn + s], partner,
+            axis=axis, ctx=ctx)
+        copy.wait()
+        start = start - bit * half  # merged region start
+
+
 def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
                method: AllReduceMethod = None):
     """Per-shard AllReduce along ``axis`` (inside shard_map)."""
@@ -83,6 +156,40 @@ def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
     if method == AllReduceMethod.TWO_SHOT:
         scattered = reduce_scatter(x, ctx=ctx, axis=axis)
         return all_gather(scattered, ctx=ctx, axis=axis)
+    if method == AllReduceMethod.RECURSIVE:
+        rows = x.shape[0]
+        if n & (n - 1) or rows % n:
+            raise ValueError(
+                f"RECURSIVE allreduce needs power-of-two ranks (n={n}) "
+                f"and dim0 divisible by n (rows={rows})")
+        chunk = rows // n
+        tile_rows = chunk
+        rest = tuple(x.shape[1:])
+        row_bytes = x.dtype.itemsize * (int(np.prod(rest)) if rest else 1)
+        while tile_rows > 1 and tile_rows % 2 == 0 and \
+                tile_rows * row_bytes > (2 << 20):
+            tile_rows //= 2
+        logn = n.bit_length() - 1
+        kernel = functools.partial(
+            _rhd_kernel, axis=axis, ctx=ctx, n_ranks=n, rows=rows,
+            tile_rows=tile_rows)
+        out, _recv_ws = core_call(
+            kernel,
+            comm=True,
+            out_shape=(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+                       jax.ShapeDtypeStruct(
+                           (rows - rows // n,) + rest, x.dtype)),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY)),
+            scratch_shapes=[
+                pltpu.VMEM((tile_rows,) + rest, x.dtype),  # acc_v
+                pltpu.VMEM((tile_rows,) + rest, x.dtype),  # tmp_v
+                pltpu.SemaphoreType.DMA((2 * logn,)),
+                pltpu.SemaphoreType.DMA((2 * logn,)),
+            ],
+        )(x)
+        return out
 
     shape = tuple(x.shape)
     kernel = functools.partial(_one_shot_kernel, axis=axis, ctx=ctx)
