@@ -153,3 +153,31 @@ def test_all_reduce_recursive_validation(tp8_mesh, tp8_ctx):
              lambda v: all_reduce(v, ctx=tp8_ctx,
                                   method=AllReduceMethod.RECURSIVE),
              P("tp", None), P("tp", None))(_rand((32, 64), seed=51))
+
+
+def test_broadcast(tp8_mesh, tp8_ctx):
+    from triton_dist_tpu.ops import broadcast, broadcast_ref
+
+    x = _rand((64, 32), seed=60)
+    for root in (0, 5):
+        f = spmd(tp8_mesh,
+                 lambda v: broadcast(v, root, ctx=tp8_ctx, axis="tp"),
+                 P("tp", None), P("tp", None))
+        g = spmd(tp8_mesh,
+                 lambda v: broadcast_ref(v, root, axis="tp"),
+                 P("tp", None), P("tp", None))
+        assert_allclose(f(x), g(x))
+
+
+def test_a2a_gemm(tp8_mesh, tp8_ctx):
+    from triton_dist_tpu.ops import a2a_gemm, a2a_gemm_ref
+
+    x = _rand((64, 2, 32), seed=61)   # per-shard (8, 2, 32)
+    w = _rand((32, 16), seed=62)
+    f = spmd(tp8_mesh,
+             lambda v, ww: a2a_gemm(v, ww, ctx=tp8_ctx, axis="tp"),
+             (P("tp", None, None), P(None, None)), P("tp", None))
+    g = spmd(tp8_mesh,
+             lambda v, ww: a2a_gemm_ref(v, ww, axis="tp"),
+             (P("tp", None, None), P(None, None)), P("tp", None))
+    assert_allclose(f(x, w), g(x, w), rtol=1e-4, atol=1e-4)

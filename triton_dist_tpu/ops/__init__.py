@@ -50,3 +50,5 @@ from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
 from triton_dist_tpu.ops.gdn import (  # noqa: F401
     gdn_fwd, gdn_decode_step, gdn_ref,
 )
+from triton_dist_tpu.ops.broadcast import broadcast, broadcast_ref  # noqa: F401
+from triton_dist_tpu.ops.a2a_gemm import a2a_gemm, a2a_gemm_ref  # noqa: F401

@@ -1,0 +1,41 @@
+"""All-to-all + GEMM (sequence/expert resharding into a matmul).
+
+Reference: ``kernels/nvidia/all_to_all_single_gemm.py`` (474) /
+``all_to_all_single_2d.py`` — an A2A whose received chunks feed straight
+into a GEMM.
+
+Composition form: the low-latency direct-put A2A (``ops/all_to_all``)
+followed by the local GEMM; XLA fuses the unpack/reshape into the matmul
+prologue. (A tile-granular fusion where each arrived chunk starts its
+GEMM tile early — the reference's overlapped variant — is the planned
+kernel-level upgrade; at A2A message sizes the latency win is small on
+ICI.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.all_to_all import all_to_all, all_to_all_ref
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def a2a_gemm_ref(x, w, *, axis: str = "tp", **_):
+    recv = all_to_all_ref(x, axis=axis)
+    n, c, d = recv.shape
+    return jnp.dot(recv.reshape(n * c, d), w,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def a2a_gemm(x, w, *, ctx: MeshContext, axis: str = "tp",
+             impl: str = "pallas"):
+    """x: (n, C, d) per-shard (chunk r → rank r); w: (d, N) local weight.
+    Returns (n·C, N): received tokens through the GEMM."""
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown impl {impl!r} (expected 'pallas'/'xla')")
+    recv = (all_to_all(x, ctx=ctx, axis=axis) if impl == "pallas"
+            else all_to_all_ref(x, axis=axis))
+    n, c, d = recv.shape
+    return jnp.dot(recv.reshape(n * c, d), w,
+                   preferred_element_type=jnp.float32).astype(x.dtype)

@@ -1,0 +1,75 @@
+"""Broadcast over ICI (libshmem ``broadcast*`` parity; ``fcollect`` is
+:func:`triton_dist_tpu.ops.all_gather`).
+
+One-shot root push: the root puts its buffer into every peer's output —
+latency-optimal for the small control tensors broadcasts carry (the
+reference uses it for uids/metadata, ``libshmem_device.py:broadcast``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def broadcast_ref(x, root: int = 0, *, axis: str = "tp", **_):
+    """Oracle: select the root's shard on every rank."""
+    full = jax.lax.all_gather(x, axis, axis=0)
+    return full[root]
+
+
+def _bcast_kernel(x_ref, out_ref, send_sem, recv_sem, *, axis: str,
+                  ctx: MeshContext, root: int):
+    n = dl.num_ranks(axis)
+    me = dl.rank(axis)
+
+    @pl.when(me == root)
+    def _():
+        dl.local_copy(x_ref, out_ref)  # peers receive theirs via put
+    dl.barrier_all(axis, ctx=ctx)
+
+    @pl.when(me == root)
+    def _():
+        copies = []
+        for off in range(1, n):
+            peer = (root + off) % n  # all-static: keep the id static
+            copies.append(dl.remote_put(
+                x_ref, out_ref, send_sem.at[off - 1], recv_sem, peer,
+                axis=axis, ctx=ctx))
+        for c in copies:
+            c.wait_send()
+
+    @pl.when(me != root)
+    def _():
+        dl.wait_arrivals(recv_sem, out_ref, 1)
+
+
+def broadcast(x, root: int = 0, *, ctx: MeshContext, axis: str = "tp"):
+    """Per-shard broadcast from ``root`` along ``axis`` (inside
+    shard_map): every rank returns the root's ``x``."""
+    n = ctx.size(axis)
+    if not 0 <= int(root) < n:
+        raise ValueError(f"root={root} out of range for axis size {n}")
+    if n == 1:
+        return x
+    kernel = functools.partial(_bcast_kernel, axis=axis, ctx=ctx,
+                               root=int(root))
+    return core_call(
+        kernel,
+        comm=True,
+        out_shape=jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )(x)
