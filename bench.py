@@ -28,9 +28,20 @@ import numpy as np
 
 ITERS_LO, ITERS_HI = 8, 72
 REPEATS = 5
+SWEEP_REPEATS = 3
+
+# Config space swept at bench time (ADVICE r1: a single hardcoded config
+# left the metric at the mercy of one noise sample). The round-1 winner
+# leads; the others bracket it in block_n / block_k.
+AG_GEMM_CONFIGS = (
+    {"block_m": 1024, "block_n": 128, "block_k": 4096},
+    {"block_m": 1024, "block_n": 256, "block_k": 4096},
+    {"block_m": 512, "block_n": 128, "block_k": 4096},
+    {"block_m": 1024, "block_n": 128, "block_k": 2048},
+)
 
 
-def _timed_chain(step, a, b):
+def _timed_chain(step, a, b, repeats=REPEATS):
     """step: (a, b) -> out; returns seconds/iter via two-point slope."""
     import jax
     import jax.numpy as jnp
@@ -55,7 +66,7 @@ def _timed_chain(step, a, b):
         v = np.asarray(chain(a, b))  # warmup/compile
         assert np.isfinite(v), "benchmark chain produced non-finite value"
         best = float("inf")
-        for _ in range(REPEATS):
+        for _ in range(repeats):
             t0 = time.perf_counter()
             np.asarray(chain(a, b))
             best = min(best, time.perf_counter() - t0)
@@ -78,8 +89,6 @@ def main():
 
     mesh = Mesh(np.array(devices), ("tp",))
     mctx = MeshContext.from_mesh(mesh)
-    ctx = create_ag_gemm_context(mctx, block_m=1024, block_n=128,
-                                 block_k=4096)
 
     a = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(0), (m_full, k_dim), dtype),
@@ -88,11 +97,15 @@ def main():
         jax.random.normal(jax.random.PRNGKey(1), (k_dim, n_dim), dtype),
         NamedSharding(mesh, P(None, "tp")))
 
-    def fused_step(x, w):
-        return jax.shard_map(
-            lambda xs, ws: ag_gemm(xs, ws, ctx, force_kernel=(n == 1)),
-            mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
-            out_specs=P(None, "tp"), check_vma=False)(x, w)
+    def make_fused_step(cfg):
+        ctx = create_ag_gemm_context(mctx, **cfg)
+
+        def fused_step(x, w):
+            return jax.shard_map(
+                lambda xs, ws: ag_gemm(xs, ws, ctx, force_kernel=(n == 1)),
+                mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
+                out_specs=P(None, "tp"), check_vma=False)(x, w)
+        return fused_step
 
     # Compute-only oracle: GEMM on already-gathered A (what overlap is
     # measured against in the reference charts, README.md:193).
@@ -107,13 +120,45 @@ def main():
             mesh=mesh, in_specs=(P(None, None), P(None, "tp")),
             out_specs=P(None, "tp"), check_vma=False)(x, w)
 
-    # Correctness gate before timing: a fast wrong kernel is worthless.
+    # Sweep block configs (tune-cache winner first), then re-time the
+    # winner at full repeats. A single hardcoded config made round 1's
+    # number a coin flip against tunnel noise.
+    from triton_dist_tpu import tune
+
+    tune_key = tune.make_key("ag_gemm_bench", m=m_full, k=k_dim, n=n_dim,
+                             dtype=str(dtype.dtype), world=n)
+    cached = tune.load_autotune_data(tune_key)
+    configs = list(AG_GEMM_CONFIGS)
+    if cached is not None and cached not in configs:
+        configs.append(cached)  # extra candidate from a previous run
+
+    sweep, errors = [], []
+    for cfg in configs:
+        step = make_fused_step(cfg)
+        try:
+            t = max(_timed_chain(step, a, b, repeats=SWEEP_REPEATS), 1e-9)
+        except Exception as e:
+            # Config doesn't lower at these shapes (e.g. VMEM overflow)
+            # — legal to skip, same policy as the autotuner.
+            errors.append(f"{cfg}: {type(e).__name__}: {str(e)[:200]}")
+            continue
+        sweep.append((t, cfg, step))
+    assert sweep, "no ag_gemm config compiled:\n" + "\n".join(errors)
+    sweep.sort(key=lambda e: e[0])
+    _, best_cfg, fused_step = sweep[0]
+
+    # Correctness gate before persisting or timing: a fast wrong kernel
+    # is worthless (and must not poison the tune cache).
     got = np.asarray(fused_step(a, b), np.float32)
     want = np.asarray(compute_step(a_full, b), np.float32)
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-1)
+    tune.store_autotune_data(tune_key, best_cfg, seconds=sweep[0][0])
 
-    t_fused = max(_timed_chain(fused_step, a, b), 1e-9)
+    # Final numbers: one full-repeat slope measurement each — same
+    # protocol for numerator and denominator so noise doesn't bias the
+    # ratio (the sweep samples only pick the config).
     t_compute = max(_timed_chain(compute_step, a_full, b), 1e-9)
+    t_fused = max(_timed_chain(fused_step, a, b), 1e-9)
 
     # Secondary: GEMM+RS efficiency on the transposed problem.
     from triton_dist_tpu.ops import gemm_rs, create_gemm_rs_context
@@ -150,6 +195,9 @@ def main():
             "gemm_rs_ms": round(t_rs * 1e3, 3),
             "gemm_rs_efficiency": round(float(t_compute / t_rs), 4),
             "shape_m_k_n": [m_full, k_dim, n_dim],
+            "best_config": best_cfg,
+            "swept_ms": {f"{c['block_m']}x{c['block_n']}x{c['block_k']}":
+                         round(t * 1e3, 3) for t, c, _ in sweep},
         },
     }))
 

@@ -108,8 +108,9 @@ def _drain_sends(send_sem, chunk_ref, n: int):
 
 
 def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
-                    recv_sem, *, axis: str, ctx: MeshContext, m_loc: int,
-                    tm: int, tk: int, n_ranks: int,
+                    recv_sem, panel_sem, local_sem, *, axis: str,
+                    ctx: MeshContext, m_loc: int, tm: int, tk: int,
+                    n_ranks: int, n_buf: int, write_ag: bool,
                     straggler_rank: int = -1,
                     straggler_delay_iters: int = 0):
     k = pl.program_id(0)
@@ -134,10 +135,14 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
         _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         # Peers must be in-kernel before any remote traffic.
         dl.barrier_tile(axis, ctx=ctx)
-        # Local chunk into the workspace, then kick off the ring.
-        pltpu.sync_copy(a_ref, chunk_of(me))
+        # The ring and the local panels both read the *input* ref
+        # directly, so neither waits on a workspace copy; the local
+        # chunk lands in a_ws asynchronously (and only if the caller
+        # wants the gathered A back) — drained at kernel exit.
+        if write_ag:
+            pltpu.make_async_copy(a_ref, chunk_of(me), local_sem).start()
         if n > 1:
-            dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
+            dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
                           recv_sem.at[0], right, axis=axis, ctx=ctx)
 
     chunk_start = jnp.logical_and(
@@ -154,25 +159,60 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
             dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
                           recv_sem.at[k], right, axis=axis, ctx=ctx)
 
+    def start_panel_copy(ii, buf):
+        """Start panel ii of chunk c into a_panel[buf]. My own chunk
+        (k == 0) reads straight from the input; received chunks read
+        from the workspace (arrival already certified above)."""
+        @pl.when(k == 0)
+        def _():
+            pltpu.make_async_copy(a_ref.at[pl.ds(ii * tm, tm)],
+                                  a_panel.at[buf], panel_sem).start()
+
+        @pl.when(k > 0)
+        def _():
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(c * m_loc + ii * tm, tm)],
+                a_panel.at[buf], panel_sem).start()
+
+    def wait_panel(buf):
+        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
+                              panel_sem).wait()
+
+    buf = jax.lax.rem(i, n_buf) if n_buf > 1 else 0
+
     @pl.when(jnp.logical_and(j == 0, kk == 0))
     def _():
         # Stage this chunk's full-K row panel once per (k, i); the kk
         # loop then slices it in VMEM. (Staging per (j, kk) would either
         # re-read A n_j times or go stale — the panel holds all K.)
-        pltpu.sync_copy(a_ws.at[pl.ds(c * m_loc + i * tm, tm)], a_panel)
+        if n_buf == 1:
+            start_panel_copy(i, 0)
+            wait_panel(0)
+        else:
+            # Double-buffered: panel i was prefetched during panel i-1;
+            # only the first panel of each chunk is a cold, blocking
+            # load. One copy is in flight at a time (single sem).
+            @pl.when(i == 0)
+            def _():
+                start_panel_copy(i, buf)
+            wait_panel(buf)
+
+            @pl.when(i + 1 < n_i)
+            def _():
+                start_panel_copy(i + 1, jax.lax.rem(i + 1, n_buf))
 
     @pl.when(kk == 0)
     def _():
         acc_v[...] = jnp.zeros_like(acc_v)
 
-    acc_v[...] += jnp.dot(a_panel[:, pl.ds(kk * tk, tk)], b_ref[...],
+    acc_v[...] += jnp.dot(a_panel[buf, :, pl.ds(kk * tk, tk)], b_ref[...],
                           preferred_element_type=jnp.float32)
 
     @pl.when(kk == n_k - 1)
     def _():
         o_ref[...] = acc_v[...].astype(o_ref.dtype)
 
-    # Drain send semaphores before kernel exit.
+    # Drain send + local-copy semaphores before kernel exit.
     last = jnp.logical_and(
         k == n - 1,
         jnp.logical_and(i == n_i - 1,
@@ -181,6 +221,11 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
         _drain_sends(send_sem, chunk_of(0), n)
+
+    if write_ag:
+        @pl.when(last)
+        def _():
+            dl.wait_arrivals(local_sem, a_ref, 1)
 
 
 def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
@@ -364,9 +409,16 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         c = jax.lax.rem(me - k + n, n)
         return (c * n_i + i, j)
 
+    # Double-buffer the A panel when two fit the budget: panel i+1
+    # prefetches while panel i computes, hiding the HBM→VMEM staging
+    # everywhere except the first panel of each ring chunk.
+    panel_bytes = tm * kdim * a.dtype.itemsize
+    n_buf = 2 if (n_i > 1 and 2 * panel_bytes <= panel_budget) else 1
+
     kernel = functools.partial(
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
-        tk=tk, n_ranks=n, straggler_rank=ctx.straggler_rank,
+        tk=tk, n_ranks=n, n_buf=n_buf, write_ag=return_ag,
+        straggler_rank=ctx.straggler_rank,
         straggler_delay_iters=ctx.straggler_delay_iters)
 
     # The gather workspace is always a second kernel output: Mosaic only
@@ -377,10 +429,12 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     out_specs = (pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
                  pl.BlockSpec(memory_space=pl.ANY))
     scratch = [
-        pltpu.VMEM((tm, kdim), a.dtype),            # a_panel (full K)
+        pltpu.VMEM((n_buf, tm, kdim), a.dtype),     # a_panel (full K)
         pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
+        pltpu.SemaphoreType.DMA(()),                # panel_sem
+        pltpu.SemaphoreType.DMA(()),                # local_sem
     ]
 
     out, a_full = core_call(
