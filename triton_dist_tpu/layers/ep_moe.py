@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.ep_a2a import EPContext, ep_dispatch, ep_combine
+from triton_dist_tpu.ops.ep_fused import EPFusedContext, ep_moe_fused
 from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
 
 
@@ -65,3 +66,15 @@ def fwd(params, x, ep_ctx: EPContext, *, topk: int,
                                 group_sizes)
     expert_out = expert_out[inv]  # back to slot order
     return ep_combine(expert_out, state, topk_w, ep_ctx)
+
+
+def fwd_fused(params, x, ep_ctx: EPFusedContext, *, topk: int,
+              norm_topk_prob: bool = True):
+    """Mega-EP forward: dispatch fused into the up-projection grouped
+    GEMM, down-projection fused into the combine (``ops/ep_fused.py``).
+    Returns ((T_loc, d), num_dropped)."""
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+    return ep_moe_fused(x, topk_ids, topk_w, params["w_gate"],
+                        params["w_up"], params["w_down"], ep_ctx,
+                        w_gu=params.get("w_gu"))

@@ -35,6 +35,10 @@ from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
 from triton_dist_tpu.ops.ep_a2a import (  # noqa: F401
     EPContext, create_ep_context, ep_dispatch, ep_combine, ep_moe_ref,
 )
+from triton_dist_tpu.ops.ep_fused import (  # noqa: F401
+    EPFusedContext, create_ep_fused_context, ep_route, ep_dispatch_gemm,
+    ep_gemm_combine, ep_moe_fused,
+)
 from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
     grouped_gemm, grouped_swiglu, sort_by_expert,
 )

@@ -67,9 +67,13 @@ class DispatchState:
     slot_rank: jax.Array   # (T, K) destination rank per token/k
     slot_index: jax.Array  # (T, K) slot within that rank's capacity
     valid: jax.Array       # (T, K) bool — False if dropped (overflow)
+    # Observability for the capacity-drop policy (round-1 advisor
+    # finding): how many (token, k) assignments overflowed.
+    num_dropped: jax.Array = None
 
     def tree_flatten(self):
-        return (self.slot_rank, self.slot_index, self.valid), None
+        return (self.slot_rank, self.slot_index, self.valid,
+                self.num_dropped), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -153,6 +157,7 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
         slot_rank=dst_rank,
         slot_index=slot.reshape(t, k),
         valid=valid.reshape(t, k),
+        num_dropped=jnp.sum(~valid).astype(jnp.int32),
     )
     return recv_tok.reshape(n * cap, d), recv_exp.reshape(n * cap), state
 

@@ -1,0 +1,395 @@
+"""Tile-fused expert-parallel MoE (Mega-EP).
+
+Reference: ``python/triton_dist/kernels/nvidia/ep_all2all_fused.py`` —
+``mega_kernel_dispatch_token_moe_grouped_gemm`` (:839) fuses the dispatch
+all-to-all INTO the grouped GEMM (expert tiles start as their tokens
+arrive), ``mega_kernel_moe_grouped_gemm_combine_token`` (:1020) fuses the
+down-projection grouped GEMM INTO the combine all-to-all (tiles are sent
+home as they are produced). FlashComm's CuteDSL kernels mirror the same
+pairing.
+
+TPU redesign (static shapes, per-(rank, expert) capacity):
+
+- The routing plan packs tokens as ``(dst_rank, local_expert, slot)``
+  with capacity ``C_e`` per (src, dst, expert) triple — one step finer
+  than ``ep_a2a``'s per-(src, dst) layout, so a receiving tile knows its
+  expert from its position and needs no sorting pass.
+- **dispatch+GEMM kernel**: at entry each rank fires (n-1)·E_loc direct
+  one-sided puts (per-peer, per-expert — the per-expert arrival
+  granularity of the reference's token-block scoreboard). The grid walks
+  sources in ring order starting at ``me`` (own tokens first — zero
+  exposed latency), waits one DMA-semaphore arrival per (src, expert)
+  sub-chunk, and runs that expert's MXU tile immediately.
+- **GEMM+combine kernel**: walks (src, expert) tiles, accumulates the
+  full down-projection in VMEM, and puts each finished ``(C_e, d)``
+  block straight back to its source rank — compute of tile i overlaps
+  the return transport of tile i-1.
+- Overflow beyond ``C_e`` is *counted* (``RouteState.num_dropped``) and
+  dropped with zero weight — the deliberate inference-mode capacity
+  policy, now observable (round-1 advisor finding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class EPFusedContext:
+    """Geometry for the fused EP kernels (analogue of the reference's
+    ``ep_all2all_fused`` context: rank/world + capacities + tiles)."""
+    mesh: MeshContext
+    axis: str = "ep"
+    num_experts: int = 8
+    topk: int = 2
+    capacity_per_expert: int = 64  # tokens per (src, dst, local expert)
+    block_f: int = 256             # output tile of the up-projection
+    block_d: int = 256             # output tile of the down-projection
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.num_experts // self.mesh.size(self.axis)
+
+
+def create_ep_fused_context(mesh: MeshContext, *, num_experts: int,
+                            topk: int, capacity_per_expert: int,
+                            axis: str = "ep", block_f: int = 256,
+                            block_d: int = 256) -> EPFusedContext:
+    if num_experts % mesh.size(axis):
+        raise ValueError(f"num_experts={num_experts} not divisible by "
+                         f"ep={mesh.size(axis)}")
+    return EPFusedContext(mesh=mesh, axis=axis, num_experts=num_experts,
+                          topk=topk,
+                          capacity_per_expert=capacity_per_expert,
+                          block_f=block_f, block_d=block_d)
+
+
+@dataclasses.dataclass
+class RouteState:
+    """Source-side routing metadata (kept local; weights never travel)."""
+    slot_rank: jax.Array    # (T, K) destination rank
+    slot_expert: jax.Array  # (T, K) local expert on that rank
+    slot_index: jax.Array   # (T, K) slot within (rank, expert) capacity
+    valid: jax.Array        # (T, K) False → dropped on overflow
+    num_dropped: jax.Array  # () int32 — dropped (token, k) assignments
+
+    def tree_flatten(self):
+        return ((self.slot_rank, self.slot_expert, self.slot_index,
+                 self.valid, self.num_dropped), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    RouteState, RouteState.tree_flatten, RouteState.tree_unflatten)
+
+
+def ep_route(tokens, topk_ids, ctx: EPFusedContext
+             ) -> Tuple[jax.Array, RouteState]:
+    """Pack tokens into the (n, E_loc, C_e, d) send layout.
+
+    Slot assignment is a per-(rank, expert) running count (the splits
+    cumsum of the reference dispatch, ``ep_a2a.py``), computed in XLA —
+    no host sync. Returns (send_tok, state)."""
+    n = ctx.mesh.size(ctx.axis)
+    t, d = tokens.shape
+    k = topk_ids.shape[1]
+    e_loc = ctx.experts_per_rank
+    cap = ctx.capacity_per_expert
+
+    dst_rank = topk_ids // e_loc                    # (T, K)
+    local_exp = topk_ids % e_loc                    # (T, K)
+    group = (dst_rank * e_loc + local_exp).reshape(-1)   # (TK,)
+    one_hot = jax.nn.one_hot(group, n * e_loc, dtype=jnp.int32)
+    slot = jnp.take_along_axis(jnp.cumsum(one_hot, axis=0) - 1,
+                               group[:, None], axis=1)[:, 0]  # (TK,)
+    valid = slot < cap
+
+    send_tok = jnp.zeros((n, e_loc, cap, d), tokens.dtype)
+    s_idx = jnp.where(valid, slot, cap)             # cap = OOB sentinel
+    send_tok = send_tok.at[
+        dst_rank.reshape(-1), local_exp.reshape(-1), s_idx
+    ].set(jnp.repeat(tokens, k, axis=0), mode="drop")
+
+    state = RouteState(
+        slot_rank=dst_rank,
+        slot_expert=local_exp,
+        slot_index=slot.reshape(t, k),
+        valid=valid.reshape(t, k),
+        num_dropped=jnp.sum(~valid).astype(jnp.int32),
+    )
+    return send_tok, state
+
+
+def _dispatch_gemm_kernel(x_ref, w_ref, o_ref, recv_ws, x_v, send_sem,
+                          recv_sem, *, axis: str, ctx: MeshContext,
+                          n_ranks: int, e_loc: int):
+    """Grid (n, E_loc, n_j): src chunk → wait its arrival → MXU tile."""
+    k = pl.program_id(0)
+    e = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    me = dl.rank(axis)
+    n = n_ranks
+    src = jax.lax.rem(me + k, n)
+
+    first = jnp.logical_and(
+        k == 0, jnp.logical_and(e == 0, j == 0))
+
+    @pl.when(first)
+    def _():
+        # All-peer puts need the all-peer barrier (ops/all_to_all.py
+        # precedent): barrier_tile only certifies ring neighbours.
+        dl.barrier_all(axis, ctx=ctx)
+        # Fire every (peer, expert) sub-chunk now; arrivals are
+        # certified per (src, expert) as the grid reaches them.
+        for off in range(1, n):
+            peer = jax.lax.rem(me + off, n)
+            for ee in range(e_loc):
+                dl.remote_put(x_ref.at[peer, ee], recv_ws.at[me, ee],
+                              send_sem.at[off - 1, ee],
+                              recv_sem.at[me, ee], peer,
+                              axis=axis, ctx=ctx)
+
+    @pl.when(j == 0)
+    def _():
+        # Own tokens (k == 0) read straight from the send buffer; remote
+        # chunks wait for exactly their (src, expert) delivery.
+        @pl.when(k == 0)
+        def _():
+            pltpu.sync_copy(x_ref.at[me, e], x_v)
+
+        @pl.when(k > 0)
+        def _():
+            dl.wait_arrivals(recv_sem.at[src, e], x_v, 1)
+            pltpu.sync_copy(recv_ws.at[src, e], x_v)
+
+    o_ref[0, 0] = jnp.dot(
+        x_v[...], w_ref[0], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+    last = jnp.logical_and(
+        k == n - 1, jnp.logical_and(e == e_loc - 1, j == n_j - 1))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for off in range(1, n):
+            for ee in range(e_loc):
+                dl.wait_arrivals(send_sem.at[off - 1, ee],
+                                 x_ref.at[0, 0], 1)
+
+
+def ep_dispatch_gemm(tokens, topk_ids, w, ctx: EPFusedContext):
+    """Fused dispatch all-to-all + up-projection grouped GEMM.
+
+    tokens: (T, d); topk_ids: (T, K); w: (E_loc, d, F) — this rank's
+    expert up-projection (pass gate|up concatenated for SwiGLU).
+    Returns (h (n, E_loc, C_e, F), state).
+    """
+    n = ctx.mesh.size(ctx.axis)
+    e_loc = ctx.experts_per_rank
+    cap = ctx.capacity_per_expert
+    d = tokens.shape[-1]
+    f = w.shape[-1]
+    send_tok, state = ep_route(tokens, topk_ids, ctx)
+
+    tf = min(ctx.block_f, f)
+    if f % tf:
+        raise ValueError(f"block_f={tf} must divide F={f}")
+    n_j = f // tf
+
+    kernel = functools.partial(
+        _dispatch_gemm_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n,
+        e_loc=e_loc)
+
+    def o_index(k, e, j):
+        me = jax.lax.axis_index(ctx.axis)
+        return (jax.lax.rem(me + k, n), e, 0, j)
+
+    h, _ = core_call(
+        kernel,
+        comm=True,
+        grid=(n, e_loc, n_j),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, e_loc, cap, f), tokens.dtype),
+            jax.ShapeDtypeStruct((n, e_loc, cap, d), tokens.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),   # send layout (manual)
+            pl.BlockSpec((1, d, tf), lambda k, e, j: (e, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, cap, tf), o_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),   # recv workspace
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((cap, d), tokens.dtype),           # x_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), e_loc)),
+            pltpu.SemaphoreType.DMA((n, e_loc)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * e_loc * cap * d * f,
+            bytes_accessed=(n * e_loc * cap * (d + f) + e_loc * d * f)
+            * tokens.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(send_tok, w)
+    return h, state
+
+
+def _gemm_combine_kernel(y_ref, w_ref, comb_ws, z_stage, y_v, acc_v,
+                         z_send_sem, recv_sem, *, axis: str,
+                         ctx: MeshContext, n_ranks: int, e_loc: int):
+    """Grid (n, E_loc, n_j): accumulate down-proj tiles in VMEM; when a
+    (src, expert) block completes, put it straight home."""
+    k = pl.program_id(0)
+    e = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    me = dl.rank(axis)
+    n = n_ranks
+    src = jax.lax.rem(me + k, n)
+    td = acc_v.shape[-1]
+
+    first = jnp.logical_and(
+        k == 0, jnp.logical_and(e == 0, j == 0))
+
+    @pl.when(first)
+    def _():
+        # Puts go to every rank, not just neighbours → all-peer barrier.
+        dl.barrier_all(axis, ctx=ctx)
+
+    @pl.when(j == 0)
+    def _():
+        pltpu.sync_copy(y_ref.at[src, e], y_v)
+
+    acc_v[...] = jnp.dot(y_v[...], w_ref[0],
+                         preferred_element_type=jnp.float32)
+
+    # Land the finished tile in the HBM staging slot, then ship the
+    # whole (C_e, d) block home once its last tile is down.
+    @pl.when(k > 0)
+    def _():
+        pltpu.sync_copy(acc_v, z_stage.at[src, e, :, pl.ds(j * td, td)])
+
+        @pl.when(j == n_j - 1)
+        def _():
+            dl.remote_put(z_stage.at[src, e], comb_ws.at[me, e],
+                          z_send_sem.at[e], recv_sem, src,
+                          axis=axis, ctx=ctx)
+
+    @pl.when(k == 0)
+    def _():
+        # Own tokens: straight into my combine buffer, no transport.
+        pltpu.sync_copy(acc_v, comb_ws.at[me, e, :, pl.ds(j * td, td)])
+
+    last = jnp.logical_and(
+        k == n - 1, jnp.logical_and(e == e_loc - 1, j == n_j - 1))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for ee in range(e_loc):
+            # n-1 outbound blocks rode z_send_sem[ee].
+            dl.wait_arrivals(z_send_sem.at[ee], z_stage.at[0, 0], n - 1)
+        # All (worker, expert) blocks of MY tokens must be home before
+        # the kernel's combine output is read.
+        dl.wait_arrivals(recv_sem, z_stage.at[0, 0], (n - 1) * e_loc)
+
+
+def ep_gemm_combine(y, w, state: RouteState, topk_weights,
+                    ctx: EPFusedContext):
+    """Fused down-projection grouped GEMM + combine all-to-all.
+
+    y: (n, E_loc, C_e, F) activated expert hidden states (dispatch
+    order); w: (E_loc, F, d). Returns (T, d) with top-k weights applied
+    at the source (weights never travel)."""
+    n = ctx.mesh.size(ctx.axis)
+    e_loc = ctx.experts_per_rank
+    cap = ctx.capacity_per_expert
+    f = y.shape[-1]
+    d = w.shape[-1]
+
+    td = min(ctx.block_d, d)
+    if d % td:
+        raise ValueError(f"block_d={td} must divide d={d}")
+    n_j = d // td
+
+    kernel = functools.partial(
+        _gemm_combine_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n,
+        e_loc=e_loc)
+
+    comb, _ = core_call(
+        kernel,
+        comm=True,
+        grid=(n, e_loc, n_j),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, e_loc, cap, d), jnp.float32),
+            jax.ShapeDtypeStruct((n, e_loc, cap, d), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),   # y (manual staging)
+            pl.BlockSpec((1, f, td), lambda k, e, j: (e, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),   # combine buffer
+            pl.BlockSpec(memory_space=pl.ANY),   # send staging
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((cap, f), y.dtype),        # y_v
+            pltpu.VMEM((cap, td), jnp.float32),   # acc_v
+            pltpu.SemaphoreType.DMA((e_loc,)),    # z_send_sem
+            pltpu.SemaphoreType.DMA(()),          # recv_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * e_loc * cap * f * d,
+            bytes_accessed=(n * e_loc * cap * (f + 2 * d)
+                            + e_loc * f * d) * 4,
+            transcendentals=0,
+        ),
+    )(y, w)
+
+    # comb[w, e, s] = down-projected output computed on worker w for the
+    # token I placed at (w, e, s). Gather + weight at the source.
+    gathered = comb[
+        jnp.where(state.valid, state.slot_rank, 0),
+        jnp.where(state.valid, state.slot_expert, 0),
+        jnp.where(state.valid, state.slot_index, 0)]          # (T, K, d)
+    wts = jnp.where(state.valid, topk_weights, 0.0)
+    return jnp.einsum("tkd,tk->td", gathered,
+                      wts.astype(jnp.float32)).astype(y.dtype)
+
+
+def ep_moe_fused(x, topk_ids, topk_weights, w_gate, w_up, w_down,
+                 ctx: EPFusedContext, *, w_gu=None):
+    """Full fused EP MoE forward: dispatch+upGEMM → SwiGLU → downGEMM+
+    combine (the Mega-EP pairing, ``ep_all2all_fused.py:839,1020``).
+
+    x: (T, d); w_gate/w_up: (E_loc, d, F); w_down: (E_loc, F, d).
+    Pass a pre-concatenated ``w_gu`` (E_loc, d, 2F) to skip the
+    per-step gate|up concat (it re-materializes under jit otherwise).
+    Returns ((T, d), num_dropped)."""
+    if w_gu is None:
+        w_gu = jnp.concatenate([w_gate, w_up], axis=-1)  # (E_loc, d, 2F)
+    f = w_gu.shape[-1] // 2
+    h, state = ep_dispatch_gemm(x, topk_ids, w_gu, ctx)
+    g, u = h[..., :f], h[..., f:]
+    act = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
+           ).astype(x.dtype)
+    out = ep_gemm_combine(act, w_down, state, topk_weights, ctx)
+    return out, state.num_dropped
