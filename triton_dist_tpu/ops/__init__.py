@@ -45,6 +45,10 @@ from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
 from triton_dist_tpu.ops.ulysses import (  # noqa: F401
     pre_attn_a2a, post_attn_a2a, ulysses_attn,
 )
+from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
+    UlyssesFusedContext, create_ulysses_fused_context, qkv_gemm_a2a,
+    o_a2a_gemm, group_qkv_columns, group_o_rows, ulysses_attn_fused,
+)
 from triton_dist_tpu.ops.sp_ag_attention import (  # noqa: F401
     sp_ag_attention, sp_ag_attention_ref,
 )

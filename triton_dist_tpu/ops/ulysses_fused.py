@@ -1,0 +1,422 @@
+"""Ulysses SP: projections fused with the head/sequence all-to-alls.
+
+Reference: ``python/triton_dist/kernels/nvidia/sp_ulysess_qkv_gemm_all2all
+.py`` (963 LoC — QKV projection tiles scattered to their head-owner rank
+as the GEMM produces them, :63-195) and ``sp_ulysess_o_all2all_gemm.py``
+(848 LoC — the O projection consumes A2A chunks as they arrive). These
+are the defining Ulysses kernels; round 1 only had the serial
+projection → A2A composition (``ops/ulysses.py``).
+
+TPU redesign:
+
+- **qkv_gemm_a2a** (producer side): grid walks (row panel, peer, column
+  tile); every finished (row, peer) projection block is one-sided-put
+  into its head-owner's receive buffer straight from VMEM — transport
+  of block b overlaps compute of block b+1, and the local-head blocks
+  skip transport entirely.
+- **o_a2a_gemm** (consumer side): the head-contraction is sharded, so
+  each source's chunk is a *partial product*. All sends fire at kernel
+  entry (the input already exists); the grid accumulates
+  ``acc += chunk_src @ W_o[rows(src)]`` the moment each chunk arrives —
+  the A2A rides entirely under the MXU.
+
+Both kernels are head-layout agnostic: callers pass weights grouped by
+owner rank, owner dim leading (``w: (n, d, cols_loc)`` /
+``(n, rows_loc, d)``), which covers GQA (unequal q/kv head splits) with
+a one-time column permute and keeps weight tiles contiguous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class UlyssesFusedContext:
+    """Analogue of ``UlyssesSPPreAttnCommContext``
+    (``ulysses_sp_dispatch.py:470``): geometry + tile sizes."""
+    mesh: MeshContext
+    axis: str = "sp"
+    block_m: int = 256   # row-panel tile (sequence dim)
+    block_n: int = 256   # output-column tile
+
+
+def create_ulysses_fused_context(mesh: MeshContext, axis: str = "sp",
+                                 block_m: int = 256, block_n: int = 256
+                                 ) -> UlyssesFusedContext:
+    return UlyssesFusedContext(mesh=mesh, axis=axis, block_m=block_m,
+                               block_n=block_n)
+
+
+def _qkv_kernel(x_ref, w_ref, out_ref, x_pan, z_row, bsem, psem,
+                recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
+                tm: int, n_i: int, n_j: int):
+    i = pl.program_id(0)
+    po = pl.program_id(1)
+    j = pl.program_id(2)
+    me = dl.rank(axis)
+    n = n_ranks
+    # Static peer order (peer == po): keeps the weight BlockSpec's
+    # index map static so Mosaic double-buffers the weight tiles (a
+    # dynamic map measured ~20% slower); my own block simply skips the
+    # transport when the walk reaches po == me.
+    peer = po
+    tn = w_ref.shape[-1]
+    rows = pl.ds(i * tm, tm)
+    s_lin = i * n + po          # linear (row, peer) block index
+    p2 = jax.lax.rem(s_lin, 2)  # z_row parity
+
+    first = jnp.logical_and(i == 0, jnp.logical_and(po == 0, j == 0))
+
+    @pl.when(first)
+    def _():
+        # All-peer puts → all-peer entry barrier.
+        dl.barrier_all(axis, ctx=ctx)
+
+    @pl.when(jnp.logical_and(po == 0, j == 0))
+    def _():
+        # Row panels double-buffer: panel i+1 prefetches while i
+        # computes (same discipline as ag_gemm's A panels).
+        @pl.when(i == 0)
+        def _():
+            pltpu.make_async_copy(x_ref.at[rows], x_pan.at[0],
+                                  psem).start()
+        pltpu.make_async_copy(x_pan.at[0], x_pan.at[0], psem).wait()
+
+        @pl.when(i + 1 < n_i)
+        def _():
+            pltpu.make_async_copy(
+                x_ref.at[pl.ds((i + 1) * tm, tm)],
+                x_pan.at[jax.lax.rem(i + 1, 2)], psem).start()
+
+    @pl.when(j == 0)
+    def _():
+        # Reclaim this parity's buffer: its block-(s-2) DMA (send or
+        # local flush — both z_row sized) must have left the building.
+        @pl.when(s_lin >= 2)
+        def _():
+            pltpu.make_async_copy(z_row.at[0], z_row.at[0],
+                                  bsem.at[p2]).wait()
+
+    # Column tiles accumulate into a full (tm, cols_loc) VMEM row; the
+    # flush and the put are ONE async DMA per (row panel, peer),
+    # directly from VMEM — per-tile sync stores measured 14x slower.
+    z_row[p2, :, pl.ds(j * tn, tn)] = jnp.dot(
+        x_pan[jax.lax.rem(i, 2)], w_ref[0],
+        preferred_element_type=jnp.float32).astype(z_row.dtype)
+
+    @pl.when(j == n_j - 1)
+    def _():
+        @pl.when(peer == me)
+        def _():
+            # My own heads: async flush into my receive slot.
+            pltpu.make_async_copy(z_row.at[p2], out_ref.at[me, rows],
+                                  bsem.at[p2]).start()
+
+        @pl.when(peer != me)
+        def _():
+            dl.remote_put(z_row.at[p2], out_ref.at[me, rows],
+                          bsem.at[p2], recv_sem, peer,
+                          axis=axis, ctx=ctx)
+
+    last = jnp.logical_and(
+        i == n_i - 1, jnp.logical_and(po == n - 1, j == n_j - 1))
+
+    @pl.when(last)
+    def _():
+        # Drain the final (up to two) in-flight z_row DMAs...
+        n_blocks = n_i * n
+        for par in range(min(n_blocks, 2)):
+            pltpu.make_async_copy(z_row.at[0], z_row.at[0],
+                                  bsem.at[(n_blocks - 1 - par) % 2]
+                                  ).wait()
+        # ...and all inbound head blocks from the other ranks.
+        if n > 1:
+            dl.wait_arrivals(recv_sem, z_row.at[0], (n - 1) * n_i)
+
+
+def qkv_gemm_a2a(x, w, ctx: UlyssesFusedContext):
+    """Fused QKV projection + head-scatter all-to-all.
+
+    x: (S_loc, d) sequence-sharded activations; w: (n, d, cols_loc)
+    projection weight with columns grouped by owner rank, owner dim
+    leading so weight tiles are contiguous slices (cols_loc =
+    (H/n + 2·KV/n)·hd for GQA). Returns (n, S_loc, cols_loc):
+    out[src] = src's sequence slice projected onto MY head block — the
+    result ``pre_attn_a2a(x @ w)`` would produce, with the A2A hidden
+    under the GEMM.
+    """
+    n = ctx.mesh.size(ctx.axis)
+    s_loc, d = x.shape
+    n_w, _, cols = w.shape
+    if n_w != n:
+        raise ValueError(f"w dim 0 ({n_w}) != axis size {n}")
+    tm = min(ctx.block_m, s_loc)
+    tn = min(ctx.block_n, cols)
+    if s_loc % tm or cols % tn:
+        raise ValueError(f"(block_m={tm}, block_n={tn}) must divide "
+                         f"(S_loc={s_loc}, cols_loc={cols})")
+    n_i, n_j = s_loc // tm, cols // tn
+
+    kernel = functools.partial(
+        _qkv_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n, tm=tm,
+        n_i=n_i, n_j=n_j)
+
+    def w_index(i, po, j):
+        return (po, 0, j)
+
+    out = core_call(
+        kernel,
+        comm=True,
+        grid=(n_i, n, n_j),
+        out_shape=jax.ShapeDtypeStruct((n, s_loc, cols), x.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # x (manual)
+            pl.BlockSpec((1, d, tn), w_index, memory_space=pltpu.VMEM),
+        ],
+        # Explicit HBM: with no pipelined output the compiler may
+        # otherwise try to place the full-size buffer in VMEM.
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),  # recv buffer
+        scratch_shapes=[
+            pltpu.VMEM((2, tm, d), x.dtype),            # x panels
+            pltpu.VMEM((2, tm, cols), x.dtype),         # z_row parity
+            pltpu.SemaphoreType.DMA((2,)),              # z_row busy
+            pltpu.SemaphoreType.DMA(()),                # panel prefetch
+            pltpu.SemaphoreType.DMA(()),                # recv aggregate
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * s_loc * d * n * cols,
+            bytes_accessed=(s_loc * d + d * n * cols + 2 * n * s_loc
+                            * cols) * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(x, w)
+    return out
+
+
+def _o_kernel(o_ref, w_ref, out_ref, recv_ws, panel, acc_v, send_sem,
+              recv_sem, psem, *, axis: str, ctx: MeshContext,
+              n_ranks: int, s_loc: int, tm: int, n_j: int):
+    i = pl.program_id(0)
+    k = pl.program_id(1)   # k IS the source rank (static weight map)
+    j = pl.program_id(2)
+    n_i = pl.num_programs(0)
+    me = dl.rank(axis)
+    n = n_ranks
+    tn = w_ref.shape[-1]   # column tile (out_ref holds the full row)
+    rows = pl.ds(i * tm, tm)
+    lin = i * n + k        # linear (row, source) block index
+    par = jax.lax.rem(lin, 2)
+
+    first = jnp.logical_and(i == 0, jnp.logical_and(k == 0, j == 0))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_all(axis, ctx=ctx)
+        # The input exists in full before any compute: fire every
+        # sequence-owner's chunk now, then eat arrivals under the MXU.
+        # Each sender signals its own recv_sem slot so the consumer can
+        # certify *which* source landed (a scalar semaphore could be
+        # bumped by a different, not-yet-needed source).
+        for off in range(1, n):
+            p = jax.lax.rem(me + off, n)
+            dl.remote_put(o_ref.at[pl.ds(p * s_loc, s_loc)],
+                          recv_ws.at[me], send_sem.at[off - 1],
+                          recv_sem.at[me], p, axis=axis, ctx=ctx)
+
+    @pl.when(jnp.logical_and(
+        jnp.logical_and(i == 0, j == 0), k != me))
+    def _():
+        dl.wait_arrivals(recv_sem.at[k], recv_ws.at[0], 1)
+
+    def start_panel(i2, k2, buf):
+        """Start the (row i2, source k2) panel copy into panel[buf].
+        My own sequence slice reads the input directly."""
+        @pl.when(k2 == me)
+        def _():
+            pltpu.make_async_copy(
+                o_ref.at[pl.ds(me * s_loc + i2 * tm, tm)],
+                panel.at[buf], psem).start()
+
+        @pl.when(k2 != me)
+        def _():
+            pltpu.make_async_copy(
+                recv_ws.at[k2, pl.ds(i2 * tm, tm)], panel.at[buf],
+                psem).start()
+
+    # A block's panel may be prefetched during the previous block only
+    # if its source is already certified: any i > 0 row (all sources
+    # were waited during i == 0), or the own-input source k == me.
+    @pl.when(j == 0)
+    def _():
+        prefetched = jnp.logical_or(i > 0, k == me)
+
+        @pl.when(jnp.logical_and(lin > 0, jnp.logical_not(prefetched)))
+        def _():
+            start_panel(i, k, par)  # cold load (fresh arrival)
+
+        @pl.when(lin == 0)
+        def _():
+            start_panel(i, k, par)
+        pltpu.make_async_copy(panel.at[0], panel.at[0], psem).wait()
+
+        nxt = lin + 1
+        i2, k2 = nxt // n, jax.lax.rem(nxt, n)
+        ok = jnp.logical_or(i2 > 0, k2 == me)
+
+        @pl.when(jnp.logical_and(nxt < n_i * n, ok))
+        def _():
+            start_panel(i2, k2, jax.lax.rem(nxt, 2))
+
+    @pl.when(jnp.logical_and(k == 0, j == 0))
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    # Each source's chunk is a partial product over its head rows.
+    acc_v[:, pl.ds(j * tn, tn)] += jnp.dot(
+        panel[par], w_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_and(k == n - 1, j == n_j - 1))
+    def _():
+        # Whole row-block write: the out block is indexed by i alone
+        # (revisits must be grid-consecutive), so it flushes once per
+        # row panel after the last source's last column tile.
+        out_ref[...] = acc_v[...].astype(out_ref.dtype)
+
+    last = jnp.logical_and(
+        i == n_i - 1, jnp.logical_and(k == n - 1, j == n_j - 1))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for off in range(n - 1):
+            dl.wait_arrivals(send_sem.at[off], recv_ws.at[0], 1)
+
+
+def o_a2a_gemm(o, w, ctx: UlyssesFusedContext):
+    """Fused gather all-to-all + O projection.
+
+    o: (S, rows_loc) attention output for MY heads over the FULL
+    sequence (heads flattened); w: (n, rows_loc, d) O-projection rows
+    grouped by head owner. Returns (S_loc, d) — sequence re-sharded,
+    heads re-contracted: equal to ``post_attn_a2a(o) @ W_o`` with the
+    A2A hidden under the GEMM (each source chunk is a partial product).
+    """
+    n = ctx.mesh.size(ctx.axis)
+    s, rows_loc = o.shape
+    n_w, rows_w, d = w.shape
+    if n_w != n or rows_w != rows_loc:
+        raise ValueError(f"w shape {w.shape} mismatches (n={n}, "
+                         f"rows_loc={rows_loc})")
+    if s % n:
+        raise ValueError(f"sequence {s} not divisible by sp={n}")
+    s_loc = s // n
+    tm = min(ctx.block_m, s_loc)
+    tn = min(ctx.block_n, d)
+    if s_loc % tm or d % tn:
+        raise ValueError(f"(block_m={tm}, block_n={tn}) must divide "
+                         f"(S_loc={s_loc}, d={d})")
+    n_i, n_j = s_loc // tm, d // tn
+
+    kernel = functools.partial(
+        _o_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n, s_loc=s_loc,
+        tm=tm, n_j=n_j)
+
+    def w_index(i, k, j):
+        return (k, 0, j)
+
+    out, _ = core_call(
+        kernel,
+        comm=True,
+        grid=(n_i, n, n_j),
+        out_shape=(
+            jax.ShapeDtypeStruct((s_loc, d), o.dtype),
+            jax.ShapeDtypeStruct((n, s_loc, rows_loc), o.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # o (manual)
+            pl.BlockSpec((1, rows_loc, tn), w_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tm, d), lambda i, k, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.HBM),       # recv buffer
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, tm, rows_loc), o.dtype),     # panel parity
+            pltpu.VMEM((tm, d), jnp.float32),           # acc (all cols)
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send per peer
+            pltpu.SemaphoreType.DMA((n,)),              # recv per src
+            pltpu.SemaphoreType.DMA(()),                # panel prefetch
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * s_loc * n * rows_loc * d,
+            bytes_accessed=(2 * s * rows_loc + n * rows_loc * d
+                            + s_loc * d) * o.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(o, w)
+    return out
+
+
+def group_qkv_columns(w_qkv, *, n: int, num_heads: int, num_kv_heads: int,
+                      head_dim: int):
+    """Rearrange a (d, (H+2·KV)·hd) QKV weight into the owner-grouped
+    (n, d, cols_loc) layout qkv_gemm_a2a expects: rank r's block is
+    [its q heads | its k heads | its v heads] (GQA-aware)."""
+    d = w_qkv.shape[0]
+    h_loc, kv_loc = num_heads // n, num_kv_heads // n
+    q, k_, v = jnp.split(
+        w_qkv, [num_heads * head_dim,
+                (num_heads + num_kv_heads) * head_dim], axis=1)
+
+    def owner_blocks(x, per_rank):
+        return x.reshape(d, n, per_rank * head_dim).transpose(1, 0, 2)
+
+    parts = [owner_blocks(q, h_loc), owner_blocks(k_, kv_loc),
+             owner_blocks(v, kv_loc)]
+    return jnp.concatenate(parts, axis=2)  # (n, d, (h+2kv)_loc · hd)
+
+
+def group_o_rows(w_o, *, n: int, num_heads: int, head_dim: int):
+    """(H·hd, d) O-projection → (n, rows_loc, d) grouped by head
+    owner."""
+    d = w_o.shape[1]
+    return w_o.reshape(n, (num_heads // n) * head_dim, d)
+
+
+def ulysses_attn_fused(x, w_qkv_grouped, w_o_grouped, ctx:
+                       UlyssesFusedContext, *, num_heads: int,
+                       num_kv_heads: int, head_dim: int,
+                       causal: bool = True):
+    """Full fused Ulysses attention block: qkv_gemm_a2a → attention on
+    my heads over the full sequence → o_a2a_gemm.
+
+    x: (S_loc, d). Returns (S_loc, d). The reference composes the same
+    pair around its FA kernel (``sp_ulysess_qkv_gemm_all2all.py`` +
+    ``sp_ulysess_o_all2all_gemm.py``)."""
+    from triton_dist_tpu.layers.tp_attn import sdpa
+
+    n = ctx.mesh.size(ctx.axis)
+    s_loc = x.shape[0]
+    h_loc, kv_loc = num_heads // n, num_kv_heads // n
+
+    qkv = qkv_gemm_a2a(x, w_qkv_grouped, ctx)      # (n, S_loc, cols)
+    s = n * s_loc
+    qkv = qkv.reshape(s, -1)
+    q = qkv[:, :h_loc * head_dim].reshape(s, h_loc, head_dim)
+    k = qkv[:, h_loc * head_dim:(h_loc + kv_loc) * head_dim
+            ].reshape(s, kv_loc, head_dim)
+    v = qkv[:, (h_loc + kv_loc) * head_dim:].reshape(s, kv_loc, head_dim)
+    o = sdpa(q[None], k[None], v[None], causal=causal)[0]  # (S, h_loc, hd)
+    return o_a2a_gemm(o.reshape(s, h_loc * head_dim), w_o_grouped, ctx)
