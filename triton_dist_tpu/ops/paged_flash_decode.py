@@ -1,0 +1,263 @@
+"""Paged split-KV flash decode as a Pallas kernel (distributed).
+
+Reference: ``python/triton_dist/kernels/nvidia/flash_decode.py`` —
+split-KV GQA decode kernel :130, block_table/workspace host APIs
+``gqa_fwd_batch_decode*`` :763-1095 (paged KV, per-rank partials,
+cross-rank combine :393-482). Round 1 only had the dense-cache XLA
+composition (``ops/flash_decode.py``); this adds the kernel-level form:
+
+- **Paged KV**: the cache is a page pool ``(num_pages, KV, page, hd)``
+  plus a per-sequence ``block_table (B, P_max)`` of page ids (SMEM) —
+  pages stream through VMEM one at a time via dynamic-index DMA, so
+  arbitrary context lengths serve from a fixed pool (no dense (B, T)
+  cache materialization).
+- **Online softmax in-kernel**: per (batch, page) grid step the running
+  (m, l, acc) update happens in VMEM scratch — the flash recurrence.
+- **RDMA combine**: each rank packs (acc, m, l) partials and one-sided
+  puts them to every peer (one-shot exchange over ICI); every rank then
+  reduces the log-sum-exp combine locally — the reference's
+  intra/inter-rank combine kernels without a host-launched second pass,
+  and no ``psum`` round-trip through XLA.
+
+The per-page update is factored as :func:`page_attend` so the
+megakernel's attention task can reuse the same body.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int):
+    """One online-softmax step over a KV page.
+
+    q2: (H, hd) fp32 queries (head-major); kpage/vpage: (KV, page, hd)
+    head-major pages; m/l: (H, 1) running max / normalizer; acc:
+    (H, hd); mask: (1, page) validity; rep = H // KV (GQA ratio).
+    Everything stays 2-D/batched-3-D — Mosaic has no legal layout cast
+    for the grouped (KV, rep, ...) forms. Pure function on values —
+    shared with the megakernel attention task."""
+    scale = q2.shape[-1] ** -0.5
+    krep = jnp.repeat(kpage.astype(jnp.float32), rep, axis=0)  # (H,p,hd)
+    vrep = jnp.repeat(vpage.astype(jnp.float32), rep, axis=0)
+    # Batched MAT-mat (unit M dim): a batched vec-mat has no lhs
+    # non-contracting dim and Mosaic's dot attr cannot express it.
+    s = jnp.einsum("hqd,hpd->hqp", q2[:, None, :], krep)[:, 0, :] * scale
+    s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "hqp,hpd->hqd", p[:, None, :], vrep)[:, 0, :]
+    return m_new, l_new, acc_new
+
+
+def _decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+                   part_gather, kpage, vpage, m_l, acc_s, part_stage,
+                   gather_v, psem, send_sem, recv_sem, *, axis: str,
+                   ctx: MeshContext, n_ranks: int, page: int, p_max: int,
+                   kvh: int, rep: int, hd: int, shard_len: int):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    n = n_ranks
+    me = dl.rank(axis) if n > 1 else 0
+    h = kvh * rep
+    off = me * shard_len          # my shard's global position offset
+
+    # Page p of batch b lives at pool slot table[b, p]. Pages past this
+    # batch's (local) length are skipped entirely.
+    local_end = jnp.clip(len_ref[b] - off, 0, shard_len)
+    active = p * page < local_end
+    lin = b * p_max + p
+    par = jax.lax.rem(lin, 2)
+
+    def load(b2, p2, buf):
+        pid = table_ref[b2, p2]
+        pltpu.make_async_copy(kp_ref.at[pid], kpage.at[buf],
+                              psem.at[buf]).start()
+        pltpu.make_async_copy(vp_ref.at[pid], vpage.at[buf],
+                              psem.at[buf]).start()
+
+    @pl.when(jnp.logical_and(active, lin == 0))
+    def _():
+        load(b, p, 0)        # cold start; later pages are prefetched
+
+    @pl.when(active)
+    def _():
+        # K and V of this page (issued here at lin==0, else one step
+        # ahead). Per-parity semaphores keep this wait from consuming
+        # the prefetch we are about to fire for the NEXT page.
+        pltpu.make_async_copy(kpage.at[par], kpage.at[par],
+                              psem.at[par]).wait()
+        pltpu.make_async_copy(vpage.at[par], vpage.at[par],
+                              psem.at[par]).wait()
+
+    # Prefetch the next block's page while this one computes.
+    nxt = lin + 1
+    b2 = jnp.minimum(nxt // p_max, n_b - 1)
+    p2 = jax.lax.rem(nxt, p_max)
+    end2 = jnp.clip(len_ref[b2] - off, 0, shard_len)
+    active2 = jnp.logical_and(nxt < n_b * p_max, p2 * page < end2)
+
+    @pl.when(active2)
+    def _():
+        load(b2, p2, jax.lax.rem(nxt, 2))
+
+    @pl.when(p == 0)
+    def _():
+        m_l[:, 0:1] = jnp.full((h, 1), -jnp.inf, jnp.float32)
+        m_l[:, 1:2] = jnp.zeros((h, 1), jnp.float32)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(active)
+    def _():
+        q2 = q_ref[0, b].astype(jnp.float32)
+        pos = p * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        mask = pos < local_end
+        m, l, acc = page_attend(q2, kpage[par], vpage[par],
+                                m_l[:, 0:1], m_l[:, 1:2], acc_s[...],
+                                mask, rep)
+        m_l[:, 0:1] = m
+        m_l[:, 1:2] = l
+        acc_s[...] = acc
+
+    # Pack this batch's partial after its last page: (h, hd+2) =
+    # [acc | m | l].
+    @pl.when(p == p_max - 1)
+    def _():
+        part_stage[b, :, :hd] = acc_s[...]
+        part_stage[b, :, hd:hd + 2] = m_l[...]
+
+        @pl.when(b == n_b - 1)
+        def _():
+            if n > 1:
+                dl.barrier_all(axis, ctx=ctx)
+                for offp in range(1, n):
+                    peer = jax.lax.rem(me + offp, n)
+                    dl.remote_put(part_stage, part_gather.at[me],
+                                  send_sem.at[offp - 1],
+                                  recv_sem, peer, axis=axis, ctx=ctx)
+                # My own partial straight into the reduce staging; the
+                # peers' land in HBM and are staged after the waits.
+                dl.wait_arrivals(recv_sem, part_stage, n - 1)
+                for offp in range(n - 1):
+                    dl.wait_arrivals(send_sem.at[offp], part_stage, 1)
+                pltpu.make_async_copy(part_gather, gather_v,
+                                      psem.at[0]).start()
+                pltpu.make_async_copy(gather_v, gather_v,
+                                      psem.at[0]).wait()
+            gather_v[me] = part_stage[...]
+
+            # Log-sum-exp combine across ranks (reference combine
+            # kernels, flash_decode.py:393-482), then the final divide.
+            m_r = gather_v[:, :, :, hd:hd + 1]         # (n, B, H, 1)
+            l_r = gather_v[:, :, :, hd + 1:hd + 2]
+            acc_r = gather_v[:, :, :, :hd]             # (n, B, H, hd)
+            m_g = jnp.max(m_r, axis=0, keepdims=True)  # (1, B, H, 1)
+            m_g_safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
+            corr = jnp.where(jnp.isfinite(m_r),
+                             jnp.exp(m_r - m_g_safe), 0.0)
+            l_tot = jnp.sum(l_r * corr, axis=0)        # (B, H, 1)
+            acc_tot = jnp.sum(acc_r * corr, axis=0)    # (B, H, hd)
+            out = acc_tot / jnp.maximum(l_tot, 1e-30)
+            o_ref[...] = out.astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
+                       ctx: MeshContext = None, axis: str = "sp"):
+    """Distributed paged-KV GQA decode step (call inside shard_map).
+
+    q: (B, H, hd) replicated along ``axis``;
+    k_pages/v_pages: (num_pages, KV, page, hd) — this rank's page pool
+    (head-major pages);
+    block_table: (B, P_max) int32 page ids into the local pool (rank r's
+    pages hold the global positions [r·P_max·page, (r+1)·P_max·page));
+    kv_len: (B,) int32 *global* valid lengths (ragged per batch).
+    Lengths beyond the total pool capacity (n·P_max·page) are an error
+    — positions past capacity would be silently dropped otherwise, so
+    concrete inputs are validated here.
+    Returns (B, H, hd).
+    """
+    b, h, hd = q.shape
+    _, kvh, page, _ = k_pages.shape
+    p_max = block_table.shape[1]
+    rep = h // kvh
+    if ctx is not None:
+        n = ctx.size(axis)
+    else:
+        # Inside shard_map the axis binds even without a MeshContext
+        # (single-axis meshes need no logical-id translation); falling
+        # back to n=1 under a bound multi-rank axis would silently
+        # return shard-local attention.
+        try:
+            n = jax.lax.axis_size(axis)
+        except (NameError, KeyError):
+            n = 1
+    shard_len = p_max * page
+    if not isinstance(kv_len, jax.core.Tracer):
+        import numpy as _np
+
+        if int(_np.max(_np.asarray(kv_len))) > n * shard_len:
+            raise ValueError(
+                f"kv_len max {int(_np.max(_np.asarray(kv_len)))} exceeds "
+                f"pool capacity {n * shard_len} ({n} ranks x {p_max} "
+                f"pages x {page})")
+
+    kernel = functools.partial(
+        _decode_kernel, axis=axis, ctx=ctx, n_ranks=n, page=page,
+        p_max=p_max, kvh=kvh, rep=rep, hd=hd, shard_len=shard_len)
+
+    out, _ = core_call(
+        kernel,
+        comm=n > 1,
+        grid=(b, p_max),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+            jax.ShapeDtypeStruct((max(n, 1), b, h, 2 + hd), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),     # block_table
+            pl.BlockSpec(memory_space=pltpu.SMEM),     # kv_len
+            pl.BlockSpec((1, b, h, hd), lambda bb, pp: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),     # q (whole)
+            pl.BlockSpec(memory_space=pl.ANY),         # k page pool
+            pl.BlockSpec(memory_space=pl.ANY),         # v page pool
+        ],
+        out_specs=(
+            pl.BlockSpec((b, h, hd), lambda bb, pp: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.HBM),      # partial gather
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, kvh, page, hd), k_pages.dtype),  # kpage x2
+            pltpu.VMEM((2, kvh, page, hd), v_pages.dtype),  # vpage x2
+            pltpu.VMEM((h, 2), jnp.float32),              # m | l
+            pltpu.VMEM((h, hd), jnp.float32),             # acc
+            pltpu.VMEM((b, h, 2 + hd), jnp.float32),      # part_stage
+            pltpu.VMEM((max(n, 1), b, h, 2 + hd), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),                # page loads
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),    # sends
+            pltpu.SemaphoreType.DMA(()),                  # recv
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * hd * shard_len,
+            bytes_accessed=2 * b * shard_len * kvh * hd
+            * k_pages.dtype.itemsize,
+            transcendentals=b * h * shard_len,
+        ),
+    )(block_table.astype(jnp.int32), kv_len.astype(jnp.int32), q[None],
+      k_pages, v_pages)
+    return out
