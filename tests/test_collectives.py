@@ -75,20 +75,25 @@ def test_p2p_put_partial(tp8_mesh, tp8_ctx):
     assert_allclose(f(x), g(x))
 
 
-def test_all_gather_2d(dp2tp4_mesh, dp2tp4_ctx):
-    """Hierarchical ICI-then-DCN allgather == flat gather over both
-    axes (reference 2D NUMA-aware ring)."""
+@pytest.mark.parametrize("mode", ["interleaved", "phased"])
+@pytest.mark.parametrize("inner,outer", [("tp", "dp"), ("dp", "tp")])
+def test_all_gather_2d(dp2tp4_mesh, dp2tp4_ctx, mode, inner, outer):
+    """Hierarchical ICI/DCN allgather == flat gather over both axes.
+    ``interleaved`` is the reference's 2D ring where outer hops hide
+    under inner rings (``allgather.py:232``); both axis assignments
+    exercise O=2/I=4 and O=4/I=2."""
     from triton_dist_tpu.ops import all_gather_2d
 
     x = _rand((64, 32), seed=40)
     f = spmd(dp2tp4_mesh,
-             lambda v: all_gather_2d(v, ctx=dp2tp4_ctx, inner_axis="tp",
-                                     outer_axis="dp"),
+             lambda v: all_gather_2d(v, ctx=dp2tp4_ctx,
+                                     inner_axis=inner, outer_axis=outer,
+                                     mode=mode),
              P(("dp", "tp"), None), P(None, None))
     g = spmd(dp2tp4_mesh,
              lambda v: jax.lax.all_gather(
-                 jax.lax.all_gather(v, "tp", axis=0, tiled=True),
-                 "dp", axis=0, tiled=True),
+                 jax.lax.all_gather(v, inner, axis=0, tiled=True),
+                 outer, axis=0, tiled=True),
              P(("dp", "tp"), None), P(None, None))
     assert_allclose(f(x), g(x))
 

@@ -49,6 +49,9 @@ from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
     UlyssesFusedContext, create_ulysses_fused_context, qkv_gemm_a2a,
     o_a2a_gemm, group_qkv_columns, group_o_rows, ulysses_attn_fused,
 )
+from triton_dist_tpu.ops.low_latency import (  # noqa: F401
+    fast_allgather, ll_a2a,
+)
 from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
     paged_flash_decode, page_attend,
 )

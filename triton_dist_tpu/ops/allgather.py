@@ -96,19 +96,96 @@ def _full_mesh_kernel(x_ref, out_ref, send_sem, recv_sem, *,
     dl.wait_arrivals(recv_sem, out_ref.at[pl.ds(me * csize, csize)], n - 1)
 
 
+def _ring_2d_kernel(x_ref, out_ref, isend, irecv, osend, orecv, *,
+                    inner_axis: str, outer_axis: str, ctx: MeshContext,
+                    n_inner: int, n_outer: int):
+    """Interleaved 2D ring: at outer step s the inner ring distributes
+    column (o-s)'s chunks while that device's copy of the SAME column
+    crosses the (slow) outer link toward step s+1 — the outer hop hides
+    behind I-1 inner ring steps (reference
+    ``allgather.py:232`` ``..._ring_push_2d_inter_node``; SURVEY.md §7
+    "inner-ring steps hide outer-hop latency")."""
+    o = dl.rank(outer_axis)
+    i = dl.rank(inner_axis)
+    csize = x_ref.shape[0]
+    i_right = jax.lax.rem(i + 1, n_inner)
+    o_right = jax.lax.rem(o + 1, n_outer)
+
+    def slot(oo, ii):
+        return out_ref.at[pl.ds((oo * n_inner + ii) * csize, csize)]
+
+    dl.local_copy(x_ref, slot(o, i))
+    # Both neighbour pairs must be in-kernel before any traffic.
+    dl.barrier_tile(inner_axis, ctx=ctx)
+    if n_outer > 1:
+        dl.barrier_tile(outer_axis, ctx=ctx)
+
+    for s in range(n_outer):
+        col = jax.lax.rem(o - s + n_outer, n_outer)
+
+        # Launch this column's outer hop first; it rides under the
+        # whole inner ring below.
+        if s < n_outer - 1:
+            ocopy = dl.remote_put(slot(col, i), slot(col, i),
+                                  osend.at[s], orecv.at[s], o_right,
+                                  axis=outer_axis, ctx=ctx)
+
+        if n_inner > 1:
+            for t in range(n_inner - 1):
+                src = jax.lax.rem(i - t + n_inner, n_inner)
+                chunk = slot(col, src)
+                copy = dl.remote_put(chunk, chunk,
+                                     isend.at[s * (n_inner - 1) + t],
+                                     irecv.at[s * (n_inner - 1) + t],
+                                     i_right, axis=inner_axis, ctx=ctx)
+                copy.wait()
+
+        if s < n_outer - 1:
+            # Next step's column arrives from the outer-left while we
+            # were ring-distributing this one.
+            ocopy.wait()
+
+
 def all_gather_2d(x, *, ctx: MeshContext, inner_axis: str = "tp",
-                  outer_axis: str = "dp", mode: str = "ring"):
-    """Hierarchical AllGather over two mesh axes: ring within the fast
-    (ICI) ``inner_axis`` first, then across the slow (DCN) ``outer_axis``
-    — the reference's NUMA-aware 2D schedule
-    (``allgather.py:202`` 2D ring; SURVEY.md §7 "the INTRA/INTER scope
-    split and the 2D ring are the right template").
+                  outer_axis: str = "dp", mode: str = "interleaved"):
+    """Hierarchical AllGather over two mesh axes (inner = fast/ICI,
+    outer = slow/DCN — the reference's NUMA/inter-node split).
+
+    - ``mode="interleaved"`` (default): one kernel running the 2D ring
+      schedule above — outer hops overlap inner rings.
+    - ``mode="phased"``: two flat gathers (inner then outer), the
+      round-1 composition — kept as the simple/debug path.
 
     Output chunk order is global rank order (outer-major), matching a
     flat all_gather over (outer, inner).
     """
-    inner = all_gather(x, ctx=ctx, axis=inner_axis, mode=mode)
-    return all_gather(inner, ctx=ctx, axis=outer_axis, mode=mode)
+    n_i = ctx.size(inner_axis)
+    n_o = ctx.size(outer_axis)
+    if mode == "phased":
+        inner = all_gather(x, ctx=ctx, axis=inner_axis, mode="ring")
+        return all_gather(inner, ctx=ctx, axis=outer_axis, mode="ring")
+    if mode != "interleaved":
+        raise ValueError(f"unknown all_gather_2d mode {mode!r}")
+    if n_i * n_o == 1:
+        return x
+    kernel = functools.partial(
+        _ring_2d_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
+        ctx=ctx, n_inner=n_i, n_outer=n_o)
+    out_shape = jax.ShapeDtypeStruct(
+        (n_i * n_o * x.shape[0],) + tuple(x.shape[1:]), x.dtype)
+    return core_call(
+        kernel,
+        comm=True,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n_o * (n_i - 1), 1),)),  # isend
+            pltpu.SemaphoreType.DMA((max(n_o * (n_i - 1), 1),)),  # irecv
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),          # osend
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),          # orecv
+        ],
+    )(x)
 
 
 def all_gather(x, *, ctx: MeshContext, axis: str = "tp",

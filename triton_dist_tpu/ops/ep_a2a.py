@@ -90,27 +90,26 @@ def _transport(ctx: EPContext, x):
     return all_to_all(x, ctx=ctx.mesh, axis=ctx.axis)
 
 
-def _wire_max(dtype) -> float:
-    d = jnp.dtype(dtype)
-    if d == jnp.int8:
-        return 127.0
-    return float(jnp.finfo(d).max)
-
-
-def _quant_transport(ctx: EPContext, x):
+def _quant_transport(ctx: EPContext, x, step=0):
     """Token transport with optional on-wire quantization: per-token
     (row) scales travel alongside the narrow payload (reference
-    ``low_latency_all_to_all_v2`` fp8 online quant)."""
+    ``low_latency_all_to_all_v2`` fp8 online quant).
+
+    ``impl="pallas"`` routes through :func:`ll_a2a` — quantization
+    happens *inside* the kernel on the way into the send buffer, with
+    slot-parity signal double-buffering (round-1 gap: quant ran in XLA
+    around the transport). ``impl="xla"`` keeps the around-the-wire
+    form as the debug path."""
     if ctx.wire_dtype is None:
         return _transport(ctx, x)
-    dmax = _wire_max(ctx.wire_dtype)
-    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                    keepdims=True) / dmax
-    scale = jnp.maximum(scale, 1e-12)
-    q = (x.astype(jnp.float32) / scale)
-    if jnp.dtype(ctx.wire_dtype) == jnp.int8:
-        q = jnp.round(q)
-    q = q.astype(ctx.wire_dtype)
+    if ctx.impl == "pallas":
+        from triton_dist_tpu.ops.low_latency import ll_a2a
+
+        return ll_a2a(x, ctx=ctx.mesh, axis=ctx.axis, step=step,
+                      wire_dtype=ctx.wire_dtype)
+    from triton_dist_tpu.ops.low_latency import quantize_rows
+
+    q, scale = quantize_rows(x, ctx.wire_dtype)
     qr = _transport(ctx, q)
     sr = _transport(ctx, scale)
     return (qr.astype(jnp.float32) * sr).astype(x.dtype)
@@ -150,7 +149,7 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
     send_tok = send_tok.at[flat_rank, s_idx].set(tok_rep, mode="drop")
     send_exp = send_exp.at[flat_rank, s_idx].set(local_exp, mode="drop")
 
-    recv_tok = _quant_transport(ctx, send_tok)        # (n, C, d)
+    recv_tok = _quant_transport(ctx, send_tok, step=0)  # (n, C, d)
     recv_exp = _transport(ctx, send_exp[..., None])[..., 0]  # (n, C)
 
     state = DispatchState(
@@ -172,7 +171,8 @@ def ep_combine(expert_out, state: DispatchState, topk_weights,
     d = expert_out.shape[-1]
     t, k = state.valid.shape
 
-    back = _quant_transport(ctx, expert_out.reshape(n, cap, d))  # (n, C, d)
+    back = _quant_transport(ctx, expert_out.reshape(n, cap, d),
+                            step=1)  # (n, C, d) — opposite slot parity
     # back[r, s] = my token's expert output that was processed on rank r
     # at slot s (slot indices were assigned locally, so they're ours).
     gathered = back[jnp.where(state.valid, state.slot_rank, 0),
