@@ -160,3 +160,41 @@ def test_ep_dispatch_combine_quantized_wire(tp8_mesh, tp8_ctx, wire):
     expected = np.asarray(tokens * jnp.sum(w, axis=-1, keepdims=True))
     # Two quantization passes (dispatch + combine): ~1-2% error budget.
     np.testing.assert_allclose(out, expected, rtol=0.08, atol=0.08)
+
+
+def test_moe_reduce_rs_vs_oracle(tp8_mesh, tp8_ctx):
+    """Fused weighted-combine + ring reduce-scatter == XLA combine +
+    psum_scatter (reference moe_reduce_rs pairing)."""
+    from triton_dist_tpu.ops.moe_reduce import moe_reduce_rs, moe_reduce_rs_ref
+
+    y = _rand((64, 2, 32), 50)   # (T, K, d), T = 8 ranks x 8
+    w = jax.nn.softmax(_rand((64, 2), 51), axis=-1)
+
+    f = spmd(tp8_mesh,
+             lambda yy, ww: moe_reduce_rs(yy, ww, ctx=tp8_ctx, axis="tp",
+                                          block_m=4, block_n=16),
+             (P(None, None, None), P(None, None)), P("tp", None))
+    g = spmd(tp8_mesh,
+             lambda yy, ww: moe_reduce_rs_ref(yy, ww, axis="tp"),
+             (P(None, None, None), P(None, None)), P("tp", None))
+    assert_allclose(f(y, w), g(y, w), rtol=1e-5, atol=1e-5)
+
+
+def test_tp_moe_layer_fused_epilogue(tp8_mesh, tp8_ctx):
+    """TP-MoE with the fused moe_reduce_rs epilogue == the psum_scatter
+    layer path."""
+    cfg = ModelConfig.tiny_moe()
+    params = ep_moe.init(jax.random.PRNGKey(60), cfg)
+    tokens = _rand((64, cfg.hidden_size), 61)
+
+    def run(fused):
+        return spmd(
+            tp8_mesh,
+            lambda p, t: tp_moe.fwd(
+                p, t, topk=cfg.num_experts_per_tok,
+                num_experts=cfg.num_experts, axis="tp",
+                mesh_ctx=tp8_ctx if fused else None),
+            (tp_moe.param_specs("tp"), P("tp", None)),
+            P("tp", None))(params, tokens)
+
+    assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-4)

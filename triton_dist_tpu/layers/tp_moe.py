@@ -28,8 +28,13 @@ def param_specs(axis: str = "tp") -> Dict:
 
 
 def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
-        norm_topk_prob: bool = True):
-    """x: (tokens_loc, d) token-sharded along ``axis`` → same layout out."""
+        norm_topk_prob: bool = True, mesh_ctx=None):
+    """x: (tokens_loc, d) token-sharded along ``axis`` → same layout out.
+
+    With ``mesh_ctx`` the epilogue runs the fused
+    :func:`~triton_dist_tpu.ops.moe_reduce.moe_reduce_rs` kernel (the
+    reference ``moe_reduce_rs.py`` pairing) instead of the XLA
+    combine + ``psum_scatter`` round-trip."""
     x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
     t, d = x_full.shape
     topk_ids, topk_w = route(params["router"], x_full, topk,
@@ -45,6 +50,12 @@ def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
     out = grouped_swiglu(sorted_tok, params["w_gate"], params["w_up"],
                          params["w_down"], group_sizes)
     out = out[inv].reshape(t, k, d)
+    if mesh_ctx is not None:
+        from triton_dist_tpu.ops.moe_reduce import moe_reduce_rs
+
+        # topk_w stays float32 — the kernel combines in f32 either way,
+        # and downcasting first would diverge from the unfused path.
+        return moe_reduce_rs(out, topk_w, ctx=mesh_ctx, axis=axis)
     partial = jnp.einsum("tkd,tk->td", out.astype(jnp.float32),
                          topk_w.astype(jnp.float32))
     return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,

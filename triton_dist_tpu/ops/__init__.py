@@ -52,6 +52,9 @@ from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
 from triton_dist_tpu.ops.low_latency import (  # noqa: F401
     fast_allgather, ll_a2a,
 )
+from triton_dist_tpu.ops.moe_reduce import (  # noqa: F401
+    moe_reduce_rs, moe_reduce_rs_ref,
+)
 from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
     paged_flash_decode, page_attend,
 )

@@ -1,0 +1,179 @@
+"""Fused MoE epilogue: weighted top-k combine + ring ReduceScatter.
+
+Reference: ``python/triton_dist/kernels/nvidia/moe_reduce_rs.py``
+(961 LoC — the grouped-GEMM consumer reduce-scatters expert partials as
+tiles complete) and ``moe_reduce_ar.py`` (:692, small-batch allreduce
+epilogue). Round 1's ``layers/tp_moe.py`` materialized the full
+``(T, d)`` weighted combine in XLA and round-tripped through
+``psum_scatter``; here the combine happens per ring tile inside the
+kernel, so the first chunk's transport starts after 1/n of the combine
+work instead of after all of it.
+
+Structure mirrors ``ops/gemm_rs.py``'s ring: step ``s`` combines the
+chunk owned by device ``(me - s - 1) % n``, folds in the running sum
+from the left neighbour, and forwards right; after ``n`` steps the
+fully-reduced chunk ``me`` is written out. The "producer GEMM" of the
+reference is here the per-(token, k) weighted reduction — the expert
+down-projection itself stays in ``lax.ragged_dot`` (XLA's grouped MXU
+loop), which is the idiomatic TPU split.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+def moe_reduce_rs_ref(y, w, *, axis: str = "tp"):
+    """Oracle: XLA combine + psum_scatter (round-1 tp_moe epilogue)."""
+    partial = jnp.einsum("tkd,tk->td", y.astype(jnp.float32),
+                         w.astype(jnp.float32))
+    return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
+                                tiled=True).astype(y.dtype)
+
+
+def _moe_rs_kernel(y_ref, w_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
+                   out_v, send_sem, recv_sem, *, axis: str,
+                   ctx: MeshContext, tm: int, tn: int, n_ranks: int):
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    me = dl.rank(axis)
+    n = n_ranks
+    right = jax.lax.rem(me + 1, n)
+
+    first = jnp.logical_and(
+        s == 0, jnp.logical_and(i == 0, j == 0))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_tile(axis, ctx=ctx)
+
+    @pl.when(jnp.logical_and(
+        s > 0, jnp.logical_and(i == 0, j == 0)))
+    def _():
+        # Running sum for this step's chunk arrives from the left.
+        dl.wait_arrivals(recv_sem.at[s - 1], recv_hbm.at[s - 1], 1)
+
+    # Weighted top-k combine of this tile (unit-M batched matmul:
+    # out[t] = w[t]ᵀ · y[t]).
+    acc_v[...] = jnp.einsum(
+        "tqk,tkd->tqd", w_ref[...].astype(jnp.float32)[:, None, :],
+        y_ref[...].astype(jnp.float32))[:, 0]
+
+    @pl.when(s > 0)
+    def _():
+        pltpu.sync_copy(
+            recv_hbm.at[s - 1, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
+            tmp_v)
+        acc_v[...] = acc_v[...] + tmp_v[...]
+
+    @pl.when(s < n - 1)
+    def _():
+        pltpu.sync_copy(acc_v, send_hbm.at[s, pl.ds(i * tm, tm),
+                                           pl.ds(j * tn, tn)])
+
+        @pl.when(jnp.logical_and(i == n_i - 1, j == n_j - 1))
+        def _():
+            dl.remote_put(send_hbm.at[s], recv_hbm.at[s],
+                          send_sem.at[s], recv_sem.at[s], right,
+                          axis=axis, ctx=ctx)
+
+    @pl.when(s == n - 1)
+    def _():
+        out_v[...] = acc_v[...].astype(out_v.dtype)
+        pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
+                                        pl.ds(j * tn, tn)])
+
+    last = jnp.logical_and(
+        s == n - 1, jnp.logical_and(i == n_i - 1, j == n_j - 1))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for t in range(n - 1):
+            dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
+
+
+def moe_reduce_rs(y, w, *, ctx: MeshContext, axis: str = "tp",
+                  block_m: int = 128, block_n: int = 512,
+                  force_kernel: bool = False):
+    """Fused weighted combine + ReduceScatter (call inside shard_map).
+
+    y: (T, K, d) per-(token, top-k) expert outputs (this rank's ffn
+    partial, slot order); w: (T, K) top-k weights.
+    Returns the (T/n, d) reduce-scattered combined output.
+    """
+    n = ctx.size(axis)
+    t, k, d = y.shape
+    if w.shape != (t, k):
+        raise ValueError(f"weights {w.shape} != {(t, k)}")
+    if n == 1 and not force_kernel:
+        return jnp.einsum("tkd,tk->td", y.astype(jnp.float32),
+                          w.astype(jnp.float32)).astype(y.dtype)
+    if t % n:
+        raise ValueError(f"T={t} not divisible by axis size {n}")
+    t_loc = t // n
+    tm = min(block_m, t_loc)
+    tn = min(block_n, d)
+    # Snap blocks down to divisors so any (T_loc, d) works (the layer
+    # path must never crash where the unfused epilogue would not).
+    while tm > 1 and t_loc % tm:
+        tm //= 2
+    while tn > 1 and d % tn:
+        tn //= 2
+    n_i, n_j = t_loc // tm, d // tn
+
+    def y_index(s, i, j):
+        me = jax.lax.axis_index(axis)
+        c = jax.lax.rem(me - s - 1 + n, n)
+        return (c * n_i + i, 0, j)
+
+    def w_index(s, i, j):
+        me = jax.lax.axis_index(axis)
+        c = jax.lax.rem(me - s - 1 + n, n)
+        return (c * n_i + i, 0)
+
+    kernel = functools.partial(
+        _moe_rs_kernel, axis=axis, ctx=ctx, tm=tm, tn=tn, n_ranks=n)
+
+    out, _recv_ws, _send_ws = core_call(
+        kernel,
+        comm=True,
+        grid=(n, n_i, n_j),
+        out_shape=(
+            jax.ShapeDtypeStruct((t_loc, d), y.dtype),
+            jax.ShapeDtypeStruct((max(n - 1, 1), t_loc, d), jnp.float32),
+            jax.ShapeDtypeStruct((max(n - 1, 1), t_loc, d), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((tm, k, tn), y_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, k), w_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((tm, tn), jnp.float32),               # acc_v
+            pltpu.VMEM((tm, tn), jnp.float32),               # tmp_v
+            pltpu.VMEM((tm, tn), y.dtype),                   # out_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # recv_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t * k * d,
+            bytes_accessed=(t * k * d + t * k + t_loc * d)
+            * y.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(y, w)
+    return out
