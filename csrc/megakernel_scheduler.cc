@@ -139,3 +139,158 @@ int32_t tdt_prune_deps(int32_t n_tasks, int32_t* dep_src,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Multi-core schedule with a sequential-safety guarantee.
+//
+// Produces per-core queues padded with NOOP slots (-1) such that every
+// task's merged index (pos * num_cores + core) exceeds the merged index
+// of ALL its predecessors. Consequences:
+//  - on a true multi-core part (TPU megacore, CORE_PARALLEL grid dim)
+//    cores run concurrently and cross-core edges are enforced by the
+//    edge semaphores emitted below;
+//  - on a single-core part (or interpret mode), executing slots in
+//    merged (q-major) order can never wait on a signal that hasn't
+//    been issued yet — no deadlock, by construction.
+//
+// Reference analogue: core/scheduler.py per-SM work queues + scoreboard
+// tensors; the padding plays the role of the reference's safe static
+// packing, the edge semaphores the scoreboard waits.
+//
+// strategy: 0 = round_robin, 1 = zig_zag, 2 = cost_lpt (greedy
+// longest-processing-time onto the least-loaded core using task_cost —
+// the static analogue of the reference's runtime scheduler's load
+// balancing; enable_runtime_scheduler has no TPU form because cores
+// share no atomic queue head).
+//
+// pin_core[t] >= 0 forces task t onto that core (collectives must stay
+// on core 0 so the SPMD comm order matches across chips).
+//
+// Outputs:
+//  out_queue:    (qlen_cap * num_cores) task id or -1, slot-major
+//                (q * num_cores + core); returns needed qlen via
+//                out_meta[0]. Returns -3 if qlen_cap too small.
+//  out_wait_start/out_wait_count (per task id): range into
+//  out_wait_edges (edge ids this task must wait on).
+//  out_sig_start/out_sig_count: range into out_sig_edges /
+//  out_sig_cores (edge id + consumer core to signal on completion).
+//  out_meta: [qlen, n_cross_edges].
+int tdt_schedule_mc(int32_t n_tasks, const int32_t* dep_src,
+                    const int32_t* dep_dst, int32_t n_deps,
+                    int32_t num_cores, int32_t strategy,
+                    const int32_t* task_cost, const int32_t* pin_core,
+                    int32_t qlen_cap, int32_t* out_queue,
+                    int32_t* out_wait_start, int32_t* out_wait_count,
+                    int32_t* out_wait_edges, int32_t* out_sig_start,
+                    int32_t* out_sig_count, int32_t* out_sig_edges,
+                    int32_t* out_sig_cores, int32_t* out_meta) {
+  if (n_tasks < 0 || n_deps < 0 || num_cores < 1) return -2;
+  std::vector<std::vector<int32_t>> succ(n_tasks), pred(n_tasks);
+  std::vector<int32_t> indeg(n_tasks, 0);
+  for (int32_t e = 0; e < n_deps; ++e) {
+    int32_t s = dep_src[e], d = dep_dst[e];
+    if (s < 0 || s >= n_tasks || d < 0 || d >= n_tasks) return -2;
+    succ[s].push_back(d);
+    pred[d].push_back(s);
+    ++indeg[d];
+  }
+
+  std::queue<int32_t> ready;
+  for (int32_t t = 0; t < n_tasks; ++t)
+    if (indeg[t] == 0) ready.push(t);
+
+  std::vector<int32_t> fill(num_cores, 0);   // next free pos per core
+  std::vector<int64_t> load(num_cores, 0);   // cost_lpt accumulated cost
+  std::vector<int32_t> core_of(n_tasks, 0), pos_of(n_tasks, 0);
+  int32_t emitted = 0, rr = 0, dir = 1;
+  while (!ready.empty()) {
+    int32_t t = ready.front();
+    ready.pop();
+
+    int32_t core;
+    if (pin_core && pin_core[t] >= 0) {
+      core = pin_core[t] % num_cores;
+    } else if (strategy == 2) {  // cost_lpt: least-loaded core
+      core = 0;
+      for (int32_t c = 1; c < num_cores; ++c)
+        if (load[c] < load[core]) core = c;
+    } else if (strategy == 1 && num_cores > 1) {  // zig-zag
+      core = rr;
+      rr += dir;
+      if (rr == num_cores) { rr = num_cores - 1; dir = -1; }
+      else if (rr < 0) { rr = 0; dir = 1; }
+    } else {  // round-robin
+      core = rr;
+      rr = (rr + 1) % num_cores;
+    }
+
+    // Earliest position satisfying the merged-order constraint.
+    int64_t need = -1;
+    for (int32_t p : pred[t]) {
+      int64_t mi = (int64_t)pos_of[p] * num_cores + core_of[p];
+      if (mi > need) need = mi;
+    }
+    int32_t pos = fill[core];
+    while ((int64_t)pos * num_cores + core <= need) ++pos;
+    core_of[t] = core;
+    pos_of[t] = pos;
+    fill[core] = pos + 1;
+    load[core] += task_cost ? task_cost[t] : 1;
+    ++emitted;
+    for (int32_t s : succ[t])
+      if (--indeg[s] == 0) ready.push(s);
+  }
+  if (emitted != n_tasks) return -1;  // cycle
+
+  int32_t qlen = 0;
+  for (int32_t c = 0; c < num_cores; ++c)
+    if (fill[c] > qlen) qlen = fill[c];
+  out_meta[0] = qlen;
+  if (qlen > qlen_cap) return -3;
+  for (int32_t i = 0; i < qlen * num_cores; ++i) out_queue[i] = -1;
+  for (int32_t t = 0; t < n_tasks; ++t)
+    out_queue[pos_of[t] * num_cores + core_of[t]] = t;
+
+  // Edge semaphores for cross-core edges only (same-core order is the
+  // queue itself). Edge ids are assigned in (dst task, pred) order.
+  int32_t edge_id = 0, wcur = 0;
+  for (int32_t t = 0; t < n_tasks; ++t) {
+    out_wait_start[t] = wcur;
+    int32_t cnt = 0;
+    for (int32_t p : pred[t]) {
+      if (core_of[p] != core_of[t]) {
+        out_wait_edges[wcur + cnt] = edge_id++;
+        ++cnt;
+      }
+    }
+    out_wait_count[t] = cnt;
+    wcur += cnt;
+  }
+  // Signals: re-walk edges in the same id order, bucketed by producer.
+  std::vector<std::vector<int32_t>> sig_e(n_tasks), sig_c(n_tasks);
+  edge_id = 0;
+  for (int32_t t = 0; t < n_tasks; ++t) {
+    for (int32_t p : pred[t]) {
+      if (core_of[p] != core_of[t]) {
+        sig_e[p].push_back(edge_id);
+        sig_c[p].push_back(core_of[t]);
+        ++edge_id;
+      }
+    }
+  }
+  int32_t scur = 0;
+  for (int32_t t = 0; t < n_tasks; ++t) {
+    out_sig_start[t] = scur;
+    out_sig_count[t] = (int32_t)sig_e[t].size();
+    for (std::size_t k = 0; k < sig_e[t].size(); ++k) {
+      out_sig_edges[scur] = sig_e[t][k];
+      out_sig_cores[scur] = sig_c[t][k];
+      ++scur;
+    }
+  }
+  out_meta[1] = edge_id;
+  return 0;
+}
+
+}  // extern "C"

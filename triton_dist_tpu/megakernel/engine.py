@@ -26,14 +26,18 @@ class MegaKernelEngine:
     def __init__(self, cfg: ModelConfig, mesh: Mesh, *, batch: int,
                  max_len: int = 512, axis: str = "tp", params=None,
                  seed: int = 0, tile_w=None, t_tile=None,
-                 keep_params: bool = False):
+                 keep_params: bool = False, prefill_seq: int = 0,
+                 num_cores: int = 1, strategy: str = "round_robin"):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
         self.max_len = max_len
+        self.batch = batch
         self.builder = ModelBuilder(cfg, mesh, batch=batch,
                                     max_len=max_len, axis=axis,
-                                    tile_w=tile_w, t_tile=t_tile)
+                                    tile_w=tile_w, t_tile=t_tile,
+                                    num_cores=num_cores,
+                                    strategy=strategy)
         specs = dense.param_specs(cfg, axis)
         if params is None:
             params = dense.init_params(jax.random.PRNGKey(seed), cfg)
@@ -42,8 +46,28 @@ class MegaKernelEngine:
             params, specs)
 
         kvspec = P(None, None, None, axis, None)
+        # Batched prefill shares the decode arena: both builders
+        # allocate the (identical) weight region first, so offsets
+        # coincide; the activation tail is per-run scratch and the
+        # bigger (prefill) footprint sizes the buffer.
+        self.prefill_builder = None
+        pack_builder = self.builder
+        if prefill_seq > 1:
+            self.prefill_builder = ModelBuilder(
+                cfg, mesh, batch=batch * prefill_seq, max_len=max_len,
+                axis=axis, tile_w=tile_w, t_tile=t_tile,
+                seq=prefill_seq, num_cores=num_cores, strategy=strategy)
+            self.prefill_seq = prefill_seq
+            pack_builder = self.prefill_builder
+            pstep = self.prefill_builder.step_fn()
+            self._prefill_step = jax.jit(jax.shard_map(
+                pstep, mesh=mesh,
+                in_specs=(P(axis, None), kvspec, kvspec, P(None), P()),
+                out_specs=(P(None, axis), P(axis, None), kvspec,
+                           kvspec),
+                check_vma=False), donate_argnums=(0, 1, 2))
         self._arena = jax.jit(jax.shard_map(
-            self.builder.pack_arena, mesh=mesh, in_specs=(specs,),
+            pack_builder.pack_arena, mesh=mesh, in_specs=(specs,),
             out_specs=P(axis, None), check_vma=False))(placed)
         # After packing, decode no longer reads the params; keeping them
         # doubles weight HBM (useful only for tests/oracles).
@@ -76,13 +100,32 @@ class MegaKernelEngine:
         return logits
 
     def prefill_chain(self, prompt_ids):
-        """Feed a (B, S) prompt token-by-token (the megakernel has no
-        batched prefill path yet). Returns the last token to seed
-        :meth:`generate` with ``start_pos=S-1``."""
+        """Feed a (B, S) prompt token-by-token (fallback when no
+        batched prefill builder was requested). Returns the last token
+        to seed :meth:`generate` with ``start_pos=S-1``."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         for pos in range(prompt_ids.shape[1] - 1):
             self.decode_step(prompt_ids[:, pos], pos)
         return prompt_ids[:, -1]
+
+    def prefill(self, prompt_ids, *, start_pos: int = 0):
+        """Batched prefill: the whole (B, S) prompt runs as ONE
+        megakernel launch (rows = (b, s) pairs; causal prefill
+        attention + block cache writes). Returns the last position's
+        logits (B, vocab); the cache then holds start_pos + S tokens.
+        Requires ``prefill_seq=S`` at construction."""
+        if self.prefill_builder is None:
+            raise ValueError("engine built without prefill_seq")
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        bsz, s = prompt_ids.shape
+        if s != self.prefill_seq or bsz != self.batch:
+            raise ValueError(f"prompt {prompt_ids.shape} != "
+                             f"({self.batch}, {self.prefill_seq})")
+        logits, self._arena, self.k_cache, self.v_cache = (
+            self._prefill_step(self._arena, self.k_cache, self.v_cache,
+                               prompt_ids.reshape(-1),
+                               jnp.asarray(start_pos, jnp.int32)))
+        return logits.reshape(bsz, s, -1)[:, -1]
 
     def generate(self, first_tokens, steps: int, *, start_pos: int = 0):
         """Greedy chain from (B,) seed tokens at cache position

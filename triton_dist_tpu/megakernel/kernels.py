@@ -41,6 +41,7 @@ class KernelConfig:
     mesh: MeshContext
     ar_ws_off: int          # arena row offset of the allreduce workspace
     ar_max_tiles: int       # max (B, W) tiles a single allreduce moves
+    seq: int = 1            # rows per batch entry (prefill: B*S rows)
 
 
 def _act(arena, off, tiles_b):
@@ -377,3 +378,176 @@ def allreduce_body(cfg, args, refs):
         return 0
 
     jax.lax.fori_loop(0, tiles, step, 0)
+
+
+def _rope_rows(x, pos_rows, hd, theta):
+    """x: (rows, hd) fp32; per-row positions pos_rows (rows, 1)."""
+    half = hd // 2
+    idx = jax.lax.broadcasted_iota(jnp.float32, (1, half), 1) * 2.0
+    inv = 1.0 / (theta ** (idx / hd))                 # (1, half)
+    ang = pos_rows.astype(jnp.float32) * inv          # (rows, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[:, :half], x[:, half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=1)
+
+
+def write_kv_prefill_body(cfg, args, refs, len_s):
+    """Batched prefill cache append: rows are (batch, seq) pairs in
+    b-major order; row r writes cache position base + r % seq of batch
+    r // seq. The whole (S, hd) block per (batch, head) lands in ONE
+    store — the real prefill path the round-1 decode chain lacked."""
+    arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
+                               refs["v_cache"])
+    va, vb, vsq = refs["va"], refs["vb"], refs["vsq"]
+    k_off, v_off, layer, knorm_off = args[0], args[1], args[2], args[3]
+    rows, hd, w = cfg.batch, cfg.hd, cfg.w
+    seq = cfg.seq
+    nb = rows // seq
+    base = len_s[0]
+    heads_per_tile = w // hd
+    kv_tiles = pl.cdiv(cfg.kv_loc * hd, w)
+    row_pos = base + jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), seq)
+
+    pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)], vb.at[pl.ds(0, 1)])
+    wrow = vb[0, :hd].astype(jnp.float32)
+
+    def per_tile(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(k_off + j * rows, rows)], va)
+        kt = va[...].astype(jnp.float32)
+
+        def per_head(hh, _):
+            kv_head = j * heads_per_tile + hh
+
+            @pl.when(kv_head < cfg.kv_loc)
+            def _():
+                head = jax.lax.dynamic_slice(kt, (0, hh * hd), (rows, hd))
+                head = _rms_rows(head, wrow, cfg.rms_eps)
+                head = _rope_rows(head, row_pos, hd, cfg.rope_theta)
+                for bb in range(nb):  # static batch
+                    vsq[...] = jax.lax.dynamic_slice(
+                        head, (bb * seq, 0), (seq, hd)).astype(vsq.dtype)
+                    pltpu.sync_copy(
+                        vsq, k_cache.at[layer, bb, pl.ds(base, seq),
+                                        kv_head, :])
+            return 0
+
+        jax.lax.fori_loop(0, heads_per_tile, per_head, 0)
+
+        pltpu.sync_copy(arena.at[pl.ds(v_off + j * rows, rows)], va)
+        vt = va[...]
+
+        def per_head_v(hh, _):
+            kv_head = j * heads_per_tile + hh
+
+            @pl.when(kv_head < cfg.kv_loc)
+            def _():
+                for bb in range(nb):
+                    vsq[...] = jax.lax.dynamic_slice(
+                        vt, (bb * seq + 0, hh * hd), (seq, hd)
+                    ).astype(vsq.dtype)
+                    pltpu.sync_copy(
+                        vsq, v_cache.at[layer, bb, pl.ds(base, seq),
+                                        kv_head, :])
+            return 0
+
+        jax.lax.fori_loop(0, heads_per_tile, per_head_v, 0)
+        return 0
+
+    jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
+
+
+def attn_prefill_body(cfg, args, refs, len_s):
+    """Batched causal prefill attention over the just-appended cache.
+
+    Rows are (batch, seq) pairs; row s of batch b attends cache
+    positions <= base + s. Each (batch, head) pair runs a (S, t_tile)
+    blocked online softmax — S query rows per MXU pass instead of the
+    decode body's single row (reference megakernel flash_attn task)."""
+    arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
+                                        refs["v_cache"], refs["va"],
+                                        refs["vkt"])
+    q_off, out_off, layer, qnorm_off = args[0], args[1], args[2], args[3]
+    rows, hd, w = cfg.batch, cfg.hd, cfg.w
+    seq = cfg.seq
+    nb = rows // seq
+    t_tile = vkt.shape[0]
+    base = len_s[0]
+    kv_len = base + seq
+    n_tiles_t = pl.cdiv(kv_len, t_tile)
+    group = cfg.h_loc // cfg.kv_loc
+    heads_per_tile = w // hd
+    row_pos = base + jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0), seq)
+
+    pltpu.sync_copy(arena.at[pl.ds(qnorm_off, 1)],
+                    refs["vb"].at[pl.ds(0, 1)])
+    qn_row = refs["vb"][0, :hd].astype(jnp.float32)
+
+    def per_qtile(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(q_off + j * rows, rows)], va)
+        qtile = va[...].astype(jnp.float32)
+        out_tile = jnp.zeros((rows, w), jnp.float32)
+
+        def per_head(hh, out_tile):
+            h_idx = j * heads_per_tile + hh
+            kv_head = jnp.minimum(h_idx // group, cfg.kv_loc - 1)
+            q = jax.lax.dynamic_slice(qtile, (0, hh * hd), (rows, hd))
+            q = _rms_rows(q, qn_row, cfg.rms_eps)
+            q = _rope_rows(q, row_pos, hd, cfg.rope_theta)
+            q = q / jnp.sqrt(jnp.float32(hd))
+
+            def per_batch(bb, out_tile):
+                qb = jax.lax.dynamic_slice(q, (bb * seq, 0), (seq, hd))
+                srow = jax.lax.broadcasted_iota(jnp.int32, (seq, 1), 0)
+
+                def tstep(tt, carry):
+                    m, l, acc = carry
+                    pltpu.sync_copy(
+                        k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
+                                   kv_head, :], vkt)
+                    kt = vkt[...].astype(jnp.float32)   # (t_tile, hd)
+                    s = jnp.dot(qb, kt.T,
+                                preferred_element_type=jnp.float32)
+                    tpos = tt * t_tile + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, t_tile), 1)
+                    mask = tpos <= (base + srow)        # causal
+                    s = jnp.where(mask, s, -jnp.inf)
+                    m_new = jnp.maximum(
+                        m, jnp.max(s, axis=1, keepdims=True))
+                    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe),
+                                  0.0)
+                    corr = jnp.where(jnp.isfinite(m),
+                                     jnp.exp(m - m_safe), 0.0)
+                    pltpu.sync_copy(
+                        v_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
+                                   kv_head, :], vkt)
+                    vt = vkt[...].astype(jnp.float32)
+                    acc = acc * corr + jnp.dot(
+                        p, vt, preferred_element_type=jnp.float32)
+                    l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+                    return (m_new, l, acc)
+
+                m0 = jnp.full((seq, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((seq, 1), jnp.float32)
+                acc0 = jnp.zeros((seq, hd), jnp.float32)
+                m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
+                                              (m0, l0, acc0))
+                o = acc / jnp.maximum(l, 1e-30)
+                upd = jax.lax.dynamic_update_slice(
+                    out_tile, o, (bb * seq, hh * hd))
+                return jnp.where(h_idx < cfg.h_loc, upd, out_tile)
+
+            return jax.lax.fori_loop(0, nb, per_batch, out_tile)
+
+        out_tile = jax.lax.fori_loop(0, heads_per_tile, per_head,
+                                     out_tile)
+        refs["acc"][...] = out_tile
+        pltpu.sync_copy(refs["acc"],
+                        arena.at[pl.ds(out_off + j * rows, rows)])
+        return 0
+
+    q_tiles = pl.cdiv(cfg.h_loc * hd, w)
+    jax.lax.fori_loop(0, q_tiles, per_qtile, 0)

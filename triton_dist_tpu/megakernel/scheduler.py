@@ -45,6 +45,11 @@ def _load_lib():
     lib.tdt_prune_deps.restype = ctypes.c_int32
     lib.tdt_prune_deps.argtypes = [ctypes.c_int32, i32p, i32p,
                                    ctypes.c_int32]
+    lib.tdt_schedule_mc.restype = ctypes.c_int32
+    lib.tdt_schedule_mc.argtypes = [
+        ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p, i32p, i32p,
+        i32p, i32p, i32p, i32p, i32p, i32p]
     _LIB = lib
     return lib
 
@@ -90,3 +95,56 @@ def schedule(n_tasks: int, src: Sequence[int], dst: Sequence[int], *,
     n_x = int(nxdeps.sum())
     return {"order": order, "core": core, "pos": pos,
             "n_cross_deps": nxdeps, "cross_deps": xdeps[:n_x]}
+
+
+def schedule_mc(n_tasks: int, src: Sequence[int], dst: Sequence[int], *,
+                num_cores: int, strategy: str = "round_robin",
+                task_cost: Sequence[int] = None,
+                pin_core: Sequence[int] = None, dep_opt: bool = True):
+    """Multi-core schedule with the sequential-safety guarantee
+    (``tdt_schedule_mc``): per-core queues padded with -1 NOOP slots so
+    merged (q-major) order respects every dependency, plus the edge
+    semaphore scoreboard (wait/signal tables per task).
+
+    strategy: "round_robin" | "zig_zag" | "cost_lpt" (static
+    load-balanced analogue of the reference's runtime scheduler).
+    """
+    lib = _load_lib()
+    s, d = _as_i32(src), _as_i32(dst)
+    if dep_opt and len(s):
+        s, d = prune_deps(n_tasks, s, d)
+    strat = {"round_robin": 0, "zig_zag": 1, "cost_lpt": 2}[strategy]
+    cost = _as_i32(task_cost if task_cost is not None
+                   else np.ones(n_tasks))
+    pin = _as_i32(pin_core if pin_core is not None
+                  else -np.ones(n_tasks))
+    # Worst case every task pads a full round: generous cap.
+    qlen_cap = 2 * n_tasks + num_cores
+    queue = np.zeros(qlen_cap * num_cores, np.int32)
+    wait_start = np.zeros(max(n_tasks, 1), np.int32)
+    wait_count = np.zeros(max(n_tasks, 1), np.int32)
+    wait_edges = np.zeros(max(len(s), 1), np.int32)
+    sig_start = np.zeros(max(n_tasks, 1), np.int32)
+    sig_count = np.zeros(max(n_tasks, 1), np.int32)
+    sig_edges = np.zeros(max(len(s), 1), np.int32)
+    sig_cores = np.zeros(max(len(s), 1), np.int32)
+    meta = np.zeros(2, np.int32)
+    rc = lib.tdt_schedule_mc(
+        n_tasks, _ptr(s), _ptr(d), len(s), num_cores, strat, _ptr(cost),
+        _ptr(pin), qlen_cap, _ptr(queue), _ptr(wait_start),
+        _ptr(wait_count), _ptr(wait_edges), _ptr(sig_start),
+        _ptr(sig_count), _ptr(sig_edges), _ptr(sig_cores), _ptr(meta))
+    if rc == -1:
+        raise ValueError("dependency cycle in task graph")
+    if rc != 0:
+        raise ValueError(f"scheduler error {rc}")
+    qlen, n_edges = int(meta[0]), int(meta[1])
+    return {
+        "queue": queue[:qlen * num_cores].reshape(qlen, num_cores),
+        "wait_start": wait_start, "wait_count": wait_count,
+        "wait_edges": wait_edges[:int(wait_count.sum())],
+        "sig_start": sig_start, "sig_count": sig_count,
+        "sig_edges": sig_edges[:int(sig_count.sum())],
+        "sig_cores": sig_cores[:int(sig_count.sum())],
+        "n_edges": n_edges,
+    }

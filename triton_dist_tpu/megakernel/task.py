@@ -22,6 +22,9 @@ class TaskType(enum.IntEnum):
     WRITE_KV = 5       # args: k_off, v_off, layer, kv_loc, hd
     ALLREDUCE = 6      # args: buf_off, rows, dim
     GATHER = 7         # args: table_off, out_off, d_tiles (ids via prefetch)
+    NOOP = 8           # queue padding slot (multi-core schedules)
+    WRITE_KV_PREFILL = 9   # args like WRITE_KV; rows are (b, s) pairs
+    ATTN_PREFILL = 10      # args like ATTN_DECODE; causal over new rows
 
 
 @dataclasses.dataclass
