@@ -202,5 +202,195 @@ def main():
     }))
 
 
+def battery():
+    """``bench.py --all``: execute EVERY fused op family once on the
+    real chip at production-ish shapes (round-1 gap: only
+    ag_gemm/gemm_rs had ever lowered on hardware — Mosaic-only failures
+    in the others were invisible). Single chip, so collectives run
+    rankless via force_kernel: the full Mosaic lowering (VMEM budgets,
+    semaphore tables, HBM-workspace rules) executes; only the ICI wire
+    is absent. Prints one JSON line per entry + a summary line."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    import triton_dist_tpu.ops as ops
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:1]), ("tp",))
+    mctx = MeshContext.from_mesh(mesh)
+    dt = jnp.bfloat16
+
+    def sm(fn, in_specs, out_specs=P(None, None)):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs,
+                                     check_vma=False))
+
+    k0 = jax.random.PRNGKey(0)
+    b4k = jax.random.normal(jax.random.PRNGKey(1), (4096, 4096), dt)
+    m1k = jax.random.normal(jax.random.PRNGKey(2), (1024, 4096), dt)
+
+    def run_gemm_ar():
+        ctx = ops.create_gemm_ar_context(mctx, block_n=512, block_k=1024)
+        small = jax.random.normal(k0, (128, 4096), dt)
+        f = sm(lambda x, w: ops.gemm_ar(x, w, ctx, force_kernel=True),
+               (P(None, None), P(None, None)))
+        out = np.asarray(f(small, b4k), np.float32)
+        want = np.asarray(small, np.float32) @ np.asarray(b4k, np.float32)
+        np.testing.assert_allclose(out, want, rtol=3e-2, atol=3.0)
+
+    def run_allreduce(method):
+        def go():
+            f = sm(lambda x: ops.all_reduce(x, ctx=mctx, axis="tp",
+                                            method=method,
+                                            force_kernel=True),
+                   (P(None, None),))
+            out = np.asarray(f(m1k), np.float32)
+            np.testing.assert_allclose(out, np.asarray(m1k, np.float32),
+                                       rtol=1e-2, atol=1e-2)
+        return go
+
+    def run_allgather(mode):
+        def go():
+            f = sm(lambda x: ops.all_gather(x, ctx=mctx, axis="tp",
+                                            mode=mode,
+                                            force_kernel=True),
+                   (P(None, None),))
+            out = np.asarray(f(m1k), np.float32)
+            np.testing.assert_allclose(out, np.asarray(m1k, np.float32))
+        return go
+
+    def run_a2a():
+        x = jax.random.normal(k0, (1, 1024, 4096), dt)
+        f = sm(lambda v: ops.all_to_all(v, ctx=mctx, axis="tp",
+                                        force_kernel=True),
+               (P(None, None, None),), P(None, None, None))
+        out = np.asarray(f(x), np.float32)
+        np.testing.assert_allclose(out, np.asarray(x, np.float32))
+
+    def run_ll_a2a():
+        # Decode-shape message (the op's contract: whole chunks stage
+        # in VMEM; big payloads belong on all_to_all).
+        x = jax.random.normal(k0, (1, 128, 4096), dt)
+        f = sm(lambda v: ops.ll_a2a(v, ctx=mctx, axis="tp",
+                                    force_kernel=True),
+               (P(None, None, None),), P(None, None, None))
+        out = np.asarray(f(x), np.float32)
+        np.testing.assert_allclose(out, np.asarray(x, np.float32),
+                                   rtol=0.05, atol=0.05)
+
+    def run_moe_rs():
+        y = jax.random.normal(k0, (2048, 8, 2048), dt)
+        w = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(3), (2048, 8)), -1)
+        f = sm(lambda yy, ww: ops.moe_reduce_rs(yy, ww, ctx=mctx,
+                                                axis="tp", block_m=256,
+                                                force_kernel=True),
+               (P(None, None, None), P(None, None)))
+        out = np.asarray(f(y, w), np.float32)
+        want = np.einsum("tkd,tk->td", np.asarray(y, np.float32),
+                         np.asarray(w, np.float32))
+        np.testing.assert_allclose(out, want, rtol=3e-2, atol=3e-1)
+
+    def run_ep_fused():
+        ctx = ops.create_ep_fused_context(
+            mctx, num_experts=4, topk=2, capacity_per_expert=512,
+            axis="tp", block_f=512, block_d=512)
+        tok = jax.random.normal(k0, (256, 1024), dt)
+        ids = jax.random.randint(jax.random.PRNGKey(4), (256, 2), 0, 4)
+        w = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(5), (256, 2)), -1
+        ).astype(dt)
+        kg, ku, kd = jax.random.split(jax.random.PRNGKey(6), 3)
+        wg = jax.random.normal(kg, (4, 1024, 1024), dt) * 0.03
+        wu = jax.random.normal(ku, (4, 1024, 1024), dt) * 0.03
+        wd = jax.random.normal(kd, (4, 1024, 1024), dt) * 0.03
+        f = sm(lambda *args: ops.ep_moe_fused(*args, ctx)[0],
+               (P(None, None),) * 3 + (P(None, None, None),) * 3)
+        out = f(tok, ids, w, wg, wu, wd)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def run_ulysses():
+        ctx = ops.create_ulysses_fused_context(mctx, axis="tp",
+                                               block_m=256, block_n=512)
+        wq = ops.group_qkv_columns(
+            jax.random.normal(k0, (2048, 32 * 128), dt) * 0.02,
+            n=1, num_heads=16, num_kv_heads=8, head_dim=128)
+        f = sm(lambda x, w: ops.qkv_gemm_a2a(x, w, ctx),
+               (P(None, None), P(None, None, None)),
+               P(None, None, None))
+        out = f(m1k[:1024, :2048].reshape(1024, 2048), wq)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def run_paged_decode():
+        kp = jax.random.normal(k0, (64, 8, 128, 128), dt) * 0.3
+        vp = jax.random.normal(jax.random.PRNGKey(7),
+                               (64, 8, 128, 128), dt) * 0.3
+        tbl = jnp.arange(64, dtype=jnp.int32).reshape(8, 8)
+        kv_len = jnp.full((8,), 777, jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(8), (8, 32, 128), dt)
+        out = jax.jit(lambda q_: ops.paged_flash_decode(
+            q_, kp, vp, tbl, kv_len))(q)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def run_megakernel():
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+        from triton_dist_tpu.models.config import ModelConfig
+
+        cfg = ModelConfig.tiny(vocab_size=4096, hidden_size=1024,
+                               intermediate_size=2048,
+                               num_hidden_layers=2,
+                               num_attention_heads=8,
+                               num_key_value_heads=4, head_dim=128)
+        eng = MegaKernelEngine(cfg, mesh, batch=4, max_len=256,
+                               prefill_seq=16)
+        prompts = jnp.ones((4, 16), jnp.int32)
+        logits = eng.prefill(prompts)
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
+        l2 = eng.decode_step(jnp.argmax(logits, -1).astype(jnp.int32), 16)
+        assert np.isfinite(np.asarray(l2, np.float32)).all()
+
+    entries = [
+        ("gemm_ar", run_gemm_ar),
+        ("allreduce_one_shot", run_allreduce("one_shot")),
+        ("allreduce_two_shot", run_allreduce("two_shot")),
+        ("allreduce_rhd", run_allreduce("recursive")),
+        ("allgather_ring", run_allgather("ring")),
+        ("allgather_full_mesh", run_allgather("full_mesh")),
+        ("all_to_all", run_a2a),
+        ("ll_a2a_int8", run_ll_a2a),
+        ("moe_reduce_rs", run_moe_rs),
+        ("ep_moe_fused", run_ep_fused),
+        ("ulysses_qkv_gemm_a2a", run_ulysses),
+        ("paged_flash_decode", run_paged_decode),
+        ("megakernel_prefill_decode", run_megakernel),
+    ]
+    results = []
+    for name, fn in entries:
+        t0 = time.perf_counter()
+        try:
+            fn()
+            ok, err = True, None
+        except Exception as e:  # record, keep going
+            ok, err = False, f"{type(e).__name__}: {str(e)[:160]}"
+        dt_s = time.perf_counter() - t0
+        rec = {"op": name, "ok": ok, "wall_s": round(dt_s, 2)}
+        if err:
+            rec["error"] = err
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    n_ok = sum(r["ok"] for r in results)
+    print(json.dumps({"metric": "hardware_battery_pass_rate",
+                      "value": round(n_ok / len(results), 4),
+                      "unit": "fraction", "vs_baseline": None,
+                      "passed": n_ok, "total": len(results)}))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--all" in sys.argv:
+        battery()
+    else:
+        main()

@@ -515,7 +515,11 @@ class ModelBuilder:
                     jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
                 ),
                 input_output_aliases={8: 0, 9: 1, 10: 2},
-                compiler_params=comm_compiler_params(),
+                # A rankless megakernel traces no barrier: Mosaic
+                # rejects a collective_id without one.
+                compiler_params=(comm_compiler_params() if self.n > 1
+                                 else pltpu.CompilerParams(
+                                     has_side_effects=True)),
             )(types, args, wait_tab, sig_tab, wait_edges, sig_edges,
               len_arr, tok_arr, arena, k_cache, v_cache)
 

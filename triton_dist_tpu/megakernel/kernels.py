@@ -134,14 +134,17 @@ def silu_mul_body(cfg, args, refs):
 
 
 def _rope_vec(x, pos, hd, theta):
-    """x: (rows, hd) fp32; rotate-half rope at scalar position pos."""
+    """x: (rows, hd) fp32; rotate-half rope at scalar position pos.
+    Everything stays 2-D — Mosaic's iota/vector ops have no 1-D form."""
     half = hd // 2
     # broadcasted_iota instead of arange: pallas kernels cannot capture
     # host constants.
-    idx = jax.lax.broadcasted_iota(jnp.float32, (1, half), 1)[0] * 2.0
-    inv = 1.0 / (theta ** (idx / hd))
-    ang = pos.astype(jnp.float32) * inv          # (half,)
-    cos, sin = jnp.cos(ang)[None], jnp.sin(ang)[None]
+    # Integer iota + cast: tpu.iota only produces integer vectors.
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, half), 1
+                                   ).astype(jnp.float32) * 2.0
+    inv = 1.0 / (theta ** (idx / hd))            # (1, half)
+    ang = pos.astype(jnp.float32) * inv          # (1, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
     x1, x2 = x[:, :half], x[:, half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
                            axis=1)
@@ -169,40 +172,36 @@ def write_kv_body(cfg, args, refs, len_s):
                     vb.at[pl.ds(0, 1)])  # (1, w) k_norm
     wrow = vb[0, :hd].astype(jnp.float32)
 
+    # Head loops are STATIC Python (and so are the column slices):
+    # Mosaic has no lowering for value-level dynamic_slice with traced
+    # starts, and heads_per_tile is tiny.
     def per_tile(j, _):
         pltpu.sync_copy(arena.at[pl.ds(k_off + j * b, b)], va)
         kt = va[...].astype(jnp.float32)        # (b, w)
 
-        def per_head(hh, _):
+        for hh in range(heads_per_tile):
             kv_head = j * heads_per_tile + hh
 
             @pl.when(kv_head < cfg.kv_loc)  # skip padding heads
             def _():
-                head = jax.lax.dynamic_slice(kt, (0, hh * hd), (b, hd))
+                head = kt[:, hh * hd:(hh + 1) * hd]
                 head = _rms_rows(head, wrow, cfg.rms_eps)
                 head = _rope_vec(head, pos, hd, cfg.rope_theta)
                 vhd[...] = head.astype(vhd.dtype)
                 pltpu.sync_copy(
                     vhd, k_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
-            return 0
-
-        jax.lax.fori_loop(0, heads_per_tile, per_head, 0)
 
         pltpu.sync_copy(arena.at[pl.ds(v_off + j * b, b)], va)
         vt = va[...]
 
-        def per_head_v(hh, _):
+        for hh in range(heads_per_tile):
             kv_head = j * heads_per_tile + hh
 
             @pl.when(kv_head < cfg.kv_loc)
             def _():
-                vhd[...] = jax.lax.dynamic_slice(
-                    vt, (0, hh * hd), (b, hd)).astype(vhd.dtype)
+                vhd[...] = vt[:, hh * hd:(hh + 1) * hd].astype(vhd.dtype)
                 pltpu.sync_copy(
                     vhd, v_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
-            return 0
-
-        jax.lax.fori_loop(0, heads_per_tile, per_head_v, 0)
         return 0
 
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
@@ -235,32 +234,36 @@ def attn_decode_body(cfg, args, refs, len_s):
     def per_qtile(j, _):
         pltpu.sync_copy(arena.at[pl.ds(q_off + j * b, b)], va)
         qtile = va[...].astype(jnp.float32)     # (b, w)
-        out_tile = jnp.zeros((b, w), jnp.float32)
+        col_blocks = []
 
-        def per_head(hh, out_tile):
+        # Static head/batch loops with concat assembly: Mosaic lowers
+        # neither dynamic_slice nor dynamic_update_slice on values.
+        for hh in range(heads_per_tile):
             h_idx = j * heads_per_tile + hh
             # Padding heads beyond h_loc compute garbage that is
             # discarded below; clamp the cache index to stay in bounds.
             kv_head = jnp.minimum(h_idx // group, cfg.kv_loc - 1)
-            q = jax.lax.dynamic_slice(qtile, (0, hh * hd), (b, hd))
+            q = qtile[:, hh * hd:(hh + 1) * hd]
             q = _rms_rows(q, qn_row, cfg.rms_eps)
             q = _rope_vec(q, pos, hd, cfg.rope_theta)
             q = q / jnp.sqrt(jnp.float32(hd))
+            row_blocks = []
 
-            def per_batch(bb, out_tile):
-                def tstep(tt, carry):
+            for bb in range(b):
+                # All-2-D online softmax: Mosaic has no 1-D vector ops.
+                def tstep(tt, carry, bb=bb, q=q, kv_head=kv_head):
                     m, l, acc = carry
                     pltpu.sync_copy(
                         k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
                                    kv_head, :], vkt)
                     kt = vkt[...].astype(jnp.float32)   # (t_tile, hd)
-                    qb = jax.lax.dynamic_slice(q, (bb, 0), (1, hd))
-                    s = jnp.dot(kt, qb[0],
+                    s = jnp.dot(q[bb:bb + 1], kt.T,
                                 preferred_element_type=jnp.float32)
                     tpos = tt * t_tile + jax.lax.broadcasted_iota(
-                        jnp.int32, (t_tile, 1), 0)[:, 0]
-                    s = jnp.where(tpos < kv_len, s, -jnp.inf)
-                    m_new = jnp.maximum(m, jnp.max(s))
+                        jnp.int32, (1, t_tile), 1)
+                    s = jnp.where(tpos < kv_len, s, -jnp.inf)  # (1, T)
+                    m_new = jnp.maximum(
+                        m, jnp.max(s, axis=1, keepdims=True))
                     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
                     p = jnp.where(jnp.isfinite(s),
                                   jnp.exp(s - m_safe), 0.0)
@@ -271,26 +274,22 @@ def attn_decode_body(cfg, args, refs, len_s):
                                    kv_head, :], vkt)
                     vt = vkt[...].astype(jnp.float32)
                     acc = acc * corr + jnp.dot(
-                        p[None, :], vt,
-                        preferred_element_type=jnp.float32)[0]
-                    l = l * corr + jnp.sum(p)
+                        p, vt, preferred_element_type=jnp.float32)
+                    l = l * corr + jnp.sum(p, axis=1, keepdims=True)
                     return (m_new, l, acc)
 
-                m0 = jnp.float32(-jnp.inf)
-                l0 = jnp.float32(0.0)
-                acc0 = jnp.zeros((hd,), jnp.float32)
+                m0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((1, 1), jnp.float32)
+                acc0 = jnp.zeros((1, hd), jnp.float32)
                 m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
                                               (m0, l0, acc0))
-                o = acc / jnp.maximum(l, 1e-30)
-                upd = jax.lax.dynamic_update_slice(
-                    out_tile, o[None], (bb, hh * hd))
-                return jnp.where(h_idx < cfg.h_loc, upd, out_tile)
+                row_blocks.append(acc / jnp.maximum(l, 1e-30))  # (1,hd)
 
-            return jax.lax.fori_loop(0, b, per_batch, out_tile)
+            blk = jnp.concatenate(row_blocks, axis=0)   # (b, hd)
+            # h_idx is traced (j rides the tile fori); zero padded heads.
+            col_blocks.append(jnp.where(h_idx < cfg.h_loc, blk, 0.0))
 
-        out_tile = jax.lax.fori_loop(0, heads_per_tile, per_head,
-                                     out_tile)
-        refs["acc"][...] = out_tile
+        refs["acc"][...] = jnp.concatenate(col_blocks, axis=1)
         pltpu.sync_copy(refs["acc"], arena.at[pl.ds(out_off + j * b, b)])
         return 0
 
@@ -383,7 +382,9 @@ def allreduce_body(cfg, args, refs):
 def _rope_rows(x, pos_rows, hd, theta):
     """x: (rows, hd) fp32; per-row positions pos_rows (rows, 1)."""
     half = hd // 2
-    idx = jax.lax.broadcasted_iota(jnp.float32, (1, half), 1) * 2.0
+    # Integer iota + cast: tpu.iota only produces integer vectors.
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, half), 1
+                                   ).astype(jnp.float32) * 2.0
     inv = 1.0 / (theta ** (idx / hd))                 # (1, half)
     ang = pos_rows.astype(jnp.float32) * inv          # (rows, half)
     cos, sin = jnp.cos(ang), jnp.sin(ang)
@@ -413,46 +414,41 @@ def write_kv_prefill_body(cfg, args, refs, len_s):
     pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)], vb.at[pl.ds(0, 1)])
     wrow = vb[0, :hd].astype(jnp.float32)
 
+    # Static head/batch loops with static column slices — Mosaic has
+    # no lowering for value-level dynamic_slice with traced starts.
     def per_tile(j, _):
         pltpu.sync_copy(arena.at[pl.ds(k_off + j * rows, rows)], va)
         kt = va[...].astype(jnp.float32)
 
-        def per_head(hh, _):
+        for hh in range(heads_per_tile):
             kv_head = j * heads_per_tile + hh
 
             @pl.when(kv_head < cfg.kv_loc)
             def _():
-                head = jax.lax.dynamic_slice(kt, (0, hh * hd), (rows, hd))
+                head = kt[:, hh * hd:(hh + 1) * hd]
                 head = _rms_rows(head, wrow, cfg.rms_eps)
                 head = _rope_rows(head, row_pos, hd, cfg.rope_theta)
                 for bb in range(nb):  # static batch
-                    vsq[...] = jax.lax.dynamic_slice(
-                        head, (bb * seq, 0), (seq, hd)).astype(vsq.dtype)
+                    vsq[...] = head[bb * seq:(bb + 1) * seq].astype(
+                        vsq.dtype)
                     pltpu.sync_copy(
                         vsq, k_cache.at[layer, bb, pl.ds(base, seq),
                                         kv_head, :])
-            return 0
-
-        jax.lax.fori_loop(0, heads_per_tile, per_head, 0)
 
         pltpu.sync_copy(arena.at[pl.ds(v_off + j * rows, rows)], va)
         vt = va[...]
 
-        def per_head_v(hh, _):
+        for hh in range(heads_per_tile):
             kv_head = j * heads_per_tile + hh
 
             @pl.when(kv_head < cfg.kv_loc)
             def _():
                 for bb in range(nb):
-                    vsq[...] = jax.lax.dynamic_slice(
-                        vt, (bb * seq + 0, hh * hd), (seq, hd)
-                    ).astype(vsq.dtype)
+                    vsq[...] = vt[bb * seq:(bb + 1) * seq,
+                                  hh * hd:(hh + 1) * hd].astype(vsq.dtype)
                     pltpu.sync_copy(
                         vsq, v_cache.at[layer, bb, pl.ds(base, seq),
                                         kv_head, :])
-            return 0
-
-        jax.lax.fori_loop(0, heads_per_tile, per_head_v, 0)
         return 0
 
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
@@ -488,21 +484,22 @@ def attn_prefill_body(cfg, args, refs, len_s):
     def per_qtile(j, _):
         pltpu.sync_copy(arena.at[pl.ds(q_off + j * rows, rows)], va)
         qtile = va[...].astype(jnp.float32)
-        out_tile = jnp.zeros((rows, w), jnp.float32)
+        col_blocks = []
 
-        def per_head(hh, out_tile):
+        for hh in range(heads_per_tile):
             h_idx = j * heads_per_tile + hh
             kv_head = jnp.minimum(h_idx // group, cfg.kv_loc - 1)
-            q = jax.lax.dynamic_slice(qtile, (0, hh * hd), (rows, hd))
+            q = qtile[:, hh * hd:(hh + 1) * hd]
             q = _rms_rows(q, qn_row, cfg.rms_eps)
             q = _rope_rows(q, row_pos, hd, cfg.rope_theta)
             q = q / jnp.sqrt(jnp.float32(hd))
+            row_blocks = []
 
-            def per_batch(bb, out_tile):
-                qb = jax.lax.dynamic_slice(q, (bb * seq, 0), (seq, hd))
+            for bb in range(nb):
+                qb = q[bb * seq:(bb + 1) * seq]
                 srow = jax.lax.broadcasted_iota(jnp.int32, (seq, 1), 0)
 
-                def tstep(tt, carry):
+                def tstep(tt, carry, bb=bb, qb=qb, kv_head=kv_head):
                     m, l, acc = carry
                     pltpu.sync_copy(
                         k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
@@ -535,16 +532,12 @@ def attn_prefill_body(cfg, args, refs, len_s):
                 acc0 = jnp.zeros((seq, hd), jnp.float32)
                 m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
                                               (m0, l0, acc0))
-                o = acc / jnp.maximum(l, 1e-30)
-                upd = jax.lax.dynamic_update_slice(
-                    out_tile, o, (bb * seq, hh * hd))
-                return jnp.where(h_idx < cfg.h_loc, upd, out_tile)
+                row_blocks.append(acc / jnp.maximum(l, 1e-30))
 
-            return jax.lax.fori_loop(0, nb, per_batch, out_tile)
+            blk = jnp.concatenate(row_blocks, axis=0)   # (rows, hd)
+            col_blocks.append(jnp.where(h_idx < cfg.h_loc, blk, 0.0))
 
-        out_tile = jax.lax.fori_loop(0, heads_per_tile, per_head,
-                                     out_tile)
-        refs["acc"][...] = out_tile
+        refs["acc"][...] = jnp.concatenate(col_blocks, axis=1)
         pltpu.sync_copy(refs["acc"],
                         arena.at[pl.ds(out_off + j * rows, rows)])
         return 0

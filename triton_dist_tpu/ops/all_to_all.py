@@ -50,14 +50,17 @@ def _a2a_kernel(x_ref, out_ref, send_sem, recv_sem, *, axis: str,
     dl.wait_arrivals(recv_sem, x_ref.at[0], n - 1)
 
 
-def all_to_all(x, *, ctx: MeshContext, axis: str = "ep"):
+def all_to_all(x, *, ctx: MeshContext, axis: str = "ep",
+               force_kernel: bool = False):
     """Per-shard all-to-all (inside shard_map): x (n, C, ...) where
     x[r] is the chunk destined for rank r; returns out (n, C, ...) where
     out[r] is the chunk received from rank r."""
     n = ctx.size(axis)
     if x.shape[0] != n:
         raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
-    if n == 1:
+    if n == 1 and not force_kernel:
+        # force_kernel keeps the pallas kernel even rankless so the
+        # hardware battery exercises its Mosaic lowering on one chip.
         return x
     kernel = functools.partial(_a2a_kernel, axis=axis, ctx=ctx)
     return core_call(

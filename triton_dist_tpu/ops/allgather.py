@@ -189,13 +189,13 @@ def all_gather_2d(x, *, ctx: MeshContext, inner_axis: str = "tp",
 
 
 def all_gather(x, *, ctx: MeshContext, axis: str = "tp",
-               mode: str = "ring"):
+               mode: str = "ring", force_kernel: bool = False):
     """Per-shard AllGather along ``axis`` (call inside shard_map).
 
     Returns the gathered array, shape ``(n * x.shape[0], *x.shape[1:])``.
     """
     n = ctx.size(axis)
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     out_shape = jax.ShapeDtypeStruct((n * x.shape[0],) + tuple(x.shape[1:]),
                                      x.dtype)

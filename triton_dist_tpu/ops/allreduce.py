@@ -143,19 +143,24 @@ def _rhd_kernel(x_ref, out_ref, recv_hbm, acc_v, tmp_v, send_sem,
 
 
 def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
+               force_kernel: bool = False,
                method: AllReduceMethod = None):
     """Per-shard AllReduce along ``axis`` (inside shard_map)."""
     n = ctx.size(axis)
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
+    if isinstance(method, str):
+        method = AllReduceMethod(method)
     if method is None:
         big = x.size * x.dtype.itemsize > (1 << 20)
         # TWO_SHOT requires dim0 divisible by the axis (ring RS layout).
         method = (AllReduceMethod.TWO_SHOT if big and x.shape[0] % n == 0
                   else AllReduceMethod.ONE_SHOT)
     if method == AllReduceMethod.TWO_SHOT:
-        scattered = reduce_scatter(x, ctx=ctx, axis=axis)
-        return all_gather(scattered, ctx=ctx, axis=axis)
+        scattered = reduce_scatter(x, ctx=ctx, axis=axis,
+                                   force_kernel=force_kernel)
+        return all_gather(scattered, ctx=ctx, axis=axis,
+                          force_kernel=force_kernel)
     if method == AllReduceMethod.RECURSIVE:
         rows = x.shape[0]
         if n & (n - 1) or rows % n:
@@ -178,15 +183,16 @@ def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
             comm=True,
             out_shape=(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
                        jax.ShapeDtypeStruct(
-                           (rows - rows // n,) + rest, x.dtype)),
+                           (max(rows - rows // n, tile_rows),) + rest,
+                           x.dtype)),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pl.ANY)),
             scratch_shapes=[
                 pltpu.VMEM((tile_rows,) + rest, x.dtype),  # acc_v
                 pltpu.VMEM((tile_rows,) + rest, x.dtype),  # tmp_v
-                pltpu.SemaphoreType.DMA((2 * logn,)),
-                pltpu.SemaphoreType.DMA((2 * logn,)),
+                pltpu.SemaphoreType.DMA((max(2 * logn, 1),)),
+                pltpu.SemaphoreType.DMA((max(2 * logn, 1),)),
             ],
         )(x)
         return out

@@ -103,7 +103,7 @@ def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
             pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
 
 
-def gemm_ar(a, b, ctx: GemmARContext):
+def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
     """Overlapped per-shard (A @ B) all-reduced along ``ctx.axis``.
 
     ``a``: (M, K_loc); ``b``: (K_loc, N). Returns the fully-reduced
@@ -114,7 +114,7 @@ def gemm_ar(a, b, ctx: GemmARContext):
     m, k_loc = a.shape
     _, n_dim = b.shape
     out_dtype = ctx.out_dtype or a.dtype
-    if n == 1:
+    if n == 1 and not force_kernel:
         return jnp.dot(a, b, preferred_element_type=jnp.float32
                        ).astype(out_dtype)
     tn = min(ctx.block_n, n_dim)

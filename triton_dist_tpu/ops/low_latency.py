@@ -38,6 +38,7 @@ from jax.experimental.pallas import tpu as pltpu
 import triton_dist_tpu.lang as dl
 from triton_dist_tpu.lang import core_call
 from triton_dist_tpu.parallel.mesh import MeshContext
+from triton_dist_tpu.utils.distributed import use_interpret
 
 
 def _factor(n: int, ndims: int) -> Tuple[int, ...]:
@@ -210,7 +211,7 @@ def wire_roundtrip(x, wire_dtype):
 
 def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
                    recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
-                   slot: int, wire_dtype):
+                   slot: int, wire_dtype, scale_w: int):
     """Quantize → put (payload + scales) → wait slot arrivals →
     dequantize. Buffers are indexed [side] (0 = outgoing, 1 = inbound
     — an arrival must never overwrite an outgoing chunk that hasn't
@@ -230,7 +231,11 @@ def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
         pltpu.sync_copy(x_ref.at[dst_rank], qv)
         q, scale = quantize_rows(qv[...], wire_dtype)
         qx[...] = q
-        sx[...] = scale
+        # Scales ride lane-aligned (col 0 is the value): HBM slices on
+        # hardware must align to the 128-lane tiling. Interpret mode
+        # keeps width 1 — its buffers starve past ~64 KB and it has no
+        # tiling constraint.
+        sx[...] = jnp.broadcast_to(scale, sx.shape)
         pltpu.sync_copy(qx, qbuf.at[0, dst_rank])
         pltpu.sync_copy(sx, sbuf.at[0, dst_rank])
 
@@ -263,7 +268,7 @@ def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
     for r in range(n):
         pltpu.sync_copy(qbuf.at[1, r], qx)
         pltpu.sync_copy(sbuf.at[1, r], sx)
-        qv[...] = (qx[...].astype(jnp.float32) * sx[...]
+        qv[...] = (qx[...].astype(jnp.float32) * sx[:, :1]
                    ).astype(qv.dtype)
         pltpu.sync_copy(qv, out_ref.at[r])
 
@@ -272,7 +277,7 @@ def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
 
 
 def ll_a2a(x, *, ctx: MeshContext, axis: str = "ep", step=0,
-           wire_dtype=jnp.int8):
+           wire_dtype=jnp.int8, force_kernel: bool = False):
     """Slot-parity low-latency all-to-all with in-kernel quantization.
 
     x: (n, C, d) — x[r] goes to rank r; returns (n, C, d) received
@@ -280,36 +285,43 @@ def ll_a2a(x, *, ctx: MeshContext, axis: str = "ep", step=0,
     parity picks the signal/buffer slot so two back-to-back calls never
     alias (reference v2 double-buffering). Wire format: ``wire_dtype``
     payload + per-row float32 scales.
+
+    Per-destination chunks stage whole in VMEM (decode messages are
+    small; C·d up to ~512K elements). Larger payloads belong on the
+    bandwidth-bound :func:`~triton_dist_tpu.ops.all_to_all`.
     """
     n = ctx.size(axis)
     if x.shape[0] != n:
         raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
     _, c, d = x.shape
     slot = int(step) % 2
-    if n == 1:
+    if n == 1 and not force_kernel:
         # Wire round-trip for parity with the distributed numerics.
         return wire_roundtrip(x, wire_dtype)
 
+    scale_w = 1 if use_interpret() else 128
     kernel = functools.partial(
         _ll_a2a_kernel, axis=axis, ctx=ctx, n_ranks=n, slot=slot,
-        wire_dtype=wire_dtype)
+        wire_dtype=wire_dtype, scale_w=scale_w)
     out, _, _ = core_call(
         kernel,
         comm=True,
         out_shape=(
             jax.ShapeDtypeStruct((n, c, d), x.dtype),
             jax.ShapeDtypeStruct((2, n, c, d), wire_dtype),
-            jax.ShapeDtypeStruct((2, n, c, 1), jnp.float32),
+            jax.ShapeDtypeStruct((2, n, c, scale_w), jnp.float32),
         ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            # Explicit HBM: with no pipelined output the compiler may
+            # try to stack-allocate these full-size buffers in VMEM.
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
         ),
         scratch_shapes=[
             pltpu.VMEM((c, d), wire_dtype),        # qx wire tile
-            pltpu.VMEM((c, 1), jnp.float32),       # sx scales tile
+            pltpu.VMEM((c, scale_w), jnp.float32),  # sx scales tile
             pltpu.VMEM((c, d), x.dtype),           # qv dequant tile
             pltpu.SemaphoreType.DMA((2, max(2 * (n - 1), 1))),
             pltpu.SemaphoreType.DMA((2,)),

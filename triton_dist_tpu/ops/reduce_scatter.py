@@ -64,22 +64,28 @@ def _ring_kernel(x_ref, out_ref, recv_hbm, send_hbm, acc_v, tmp_v,
                              recv_sem.at[step], right, axis=axis, ctx=ctx)
         copy.wait()
 
-    # Last arrival holds sum over the other n-1 devices for chunk ``me``.
-    pltpu.sync_copy(recv_hbm.at[n - 2], tmp_v)
-    pltpu.sync_copy(chunk(x_ref, me), acc_v)
-    acc_v[...] = acc_v[...] + tmp_v[...]
-    pltpu.sync_copy(acc_v, out_ref)
+    if n > 1:
+        # Last arrival holds the sum over the other n-1 devices for
+        # chunk ``me``.
+        pltpu.sync_copy(recv_hbm.at[n - 2], tmp_v)
+        pltpu.sync_copy(chunk(x_ref, me), acc_v)
+        acc_v[...] = acc_v[...] + tmp_v[...]
+        pltpu.sync_copy(acc_v, out_ref)
+    else:
+        # Rankless (forced): the scatter of one chunk is the chunk.
+        pltpu.sync_copy(chunk(x_ref, me), acc_v)
+        pltpu.sync_copy(acc_v, out_ref)
 
 
 def reduce_scatter(x, *, ctx: MeshContext, axis: str = "tp",
-                   mode: str = "ring"):
+                   mode: str = "ring", force_kernel: bool = False):
     """Per-shard ReduceScatter along ``axis`` over dim 0 (inside shard_map).
 
     ``x``: shape ``(n * c, ...)`` → returns ``(c, ...)`` summed across the
     axis.
     """
     n = ctx.size(axis)
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     if x.shape[0] % n:
         raise ValueError(f"dim0 {x.shape[0]} not divisible by axis size {n}")
@@ -92,7 +98,8 @@ def reduce_scatter(x, *, ctx: MeshContext, axis: str = "tp",
         kernel,
         comm=True,
         out_shape=(out_shape,
-                   jax.ShapeDtypeStruct((n - 1, csize) + rest, x.dtype),
+                   jax.ShapeDtypeStruct((max(n - 1, 1), csize) + rest,
+                                        x.dtype),
                    jax.ShapeDtypeStruct((csize,) + rest, x.dtype)),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
@@ -101,8 +108,8 @@ def reduce_scatter(x, *, ctx: MeshContext, axis: str = "tp",
         scratch_shapes=[
             pltpu.VMEM((csize,) + rest, x.dtype),       # acc_v
             pltpu.VMEM((csize,) + rest, x.dtype),       # tmp_v
-            pltpu.SemaphoreType.DMA((n - 1,)),           # send_sem
-            pltpu.SemaphoreType.DMA((n - 1,)),           # recv_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # recv_sem
         ],
     )(x)
     return out
