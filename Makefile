@@ -1,0 +1,23 @@
+# Top-level targets (reference: .github/workflows/amd-ci.yml battery).
+
+PY ?= python
+
+.PHONY: csrc test race ci bench-all
+
+csrc:
+	$(MAKE) -C csrc
+
+test: csrc
+	$(PY) -m pytest tests/ -x -q
+
+# The whole battery under the vector-clock race detector — the
+# deliberate signal-protocol checker (SURVEY.md section 5).
+race: csrc
+	TRITON_DIST_TPU_DETECT_RACES=1 $(PY) -m pytest \
+	    tests/test_shmem.py tests/test_collectives.py -x -q
+
+ci: test race
+
+# Hardware battery: every fused op once on the real chip (needs a TPU).
+bench-all:
+	$(PY) bench.py --all

@@ -145,3 +145,97 @@ def test_logical_device_id_2d(dp2tp4_mesh, dp2tp4_ctx):
     blocks = x.reshape(2, 4, 8, 128)
     expected = jnp.roll(blocks, 1, axis=1).reshape(64, 128)
     assert_allclose(y, expected)
+
+
+def test_race_detector_flags_sig_sem_only_consumer(tmp_path):
+    """putmem_signal_block's documented caveat, enforced by a test
+    (round-1 advisor finding): the remote sig_sem signal can overtake
+    the bulk data, so a consumer that waits on sig_sem ALONE and then
+    reads the destination is racy. The vector-clock interpreter must
+    refuse to let that pass silently — it either records the race or
+    aborts the run — while the correct discipline (recv_sem before the
+    read) runs clean. Subprocess-isolated: the bad run can tear down
+    the interpreter state.
+    """
+    import subprocess
+    import sys
+    import textwrap
+
+    script = tmp_path / "sig_sem_probe.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, %r)
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import Mesh, PartitionSpec as P
+        import jax._src.pallas.mosaic.interpret.interpret_pallas_call as ipc
+        import triton_dist_tpu.lang as dl
+        from triton_dist_tpu.lang import core_call, pallas_helpers
+        from triton_dist_tpu.parallel.mesh import MeshContext
+        from triton_dist_tpu.utils.testing import spmd
+
+        wait_recv_first = sys.argv[1] == "good"
+        mesh = Mesh(np.array(jax.devices()[:8]), ("tp",))
+        ctx = MeshContext.from_mesh(mesh)
+
+        def kern(x_ref, o_ref, sig_sem, send_sem, recv_sem, chk_v):
+            me = dl.rank("tp")
+            n = dl.num_ranks("tp")
+            peer = jax.lax.rem(me + 1, n)
+            dl.barrier_all("tp", ctx=ctx)
+            dl.putmem_signal_block(o_ref, x_ref, sig_sem, peer,
+                                   send_sem, recv_sem, axis="tp",
+                                   ctx=ctx)
+            dl.wait(sig_sem, 1)
+            if wait_recv_first:          # the documented discipline
+                dl.wait_arrivals(recv_sem, x_ref, 1)
+            pltpu.sync_copy(o_ref, chk_v)
+            if not wait_recv_first:
+                dl.wait_arrivals(recv_sem, x_ref, 1)
+            dl.barrier_all("tp", ctx=ctx)
+
+        pallas_helpers.interpret_arg = lambda: pltpu.InterpretParams(
+            dma_execution_mode="eager", detect_races=True)
+
+        def run(v):
+            return core_call(
+                kern, comm=True,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.REGULAR(()),
+                                pltpu.SemaphoreType.DMA(()),
+                                pltpu.SemaphoreType.DMA(()),
+                                pltpu.VMEM((8, 128), jnp.float32)])(v)
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        out = spmd(mesh, run, P("tp", None), P("tp", None))(x)
+        np.asarray(out)
+        if ipc.races is not None and ipc.races.races_found:
+            print("RACES_FOUND")
+        else:
+            print("CLEAN")
+    """) % "/root/repo")
+
+    def probe(mode):
+        try:
+            r = subprocess.run([sys.executable, str(script), mode],
+                               capture_output=True, text=True,
+                               timeout=240)
+            return r.returncode, r.stdout
+        except subprocess.TimeoutExpired:
+            return -1, "TIMEOUT"
+
+    rc, out = probe("good")
+    assert rc == 0 and "CLEAN" in out, (
+        f"correct discipline must run clean: rc={rc} out={out[-200:]}")
+    rc, out = probe("bad")
+    assert not (rc == 0 and "CLEAN" in out), (
+        "sig_sem-only consumer passed silently — the race detector "
+        "must flag, abort, or wedge on the protocol violation")

@@ -475,10 +475,22 @@ def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
             {"block_m": 256, "block_n": 256, "block_k": 512},
         ]
 
+    def _prune(cfg, a_, b_):
+        """Perf-model pruning (reference prunes the sweep with
+        gemm_perf_model.py before timing): veto configs whose modeled
+        VMEM footprint cannot lower — no wasted compiles."""
+        from triton_dist_tpu.tools.perf_model import ag_gemm_vmem_bytes
+
+        return ag_gemm_vmem_bytes(
+            cfg.get("block_m", 256), cfg.get("block_n", 256),
+            cfg.get("block_k", 512), a_.shape[0], a_.shape[1],
+            b_.shape[1] , a_.dtype.itemsize) <= 14 * 1024 * 1024
+
     @autotune("ag_gemm", configs,
               key_fn=lambda a_, b_, **kk: {
                   "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
-                  "dtype": str(a_.dtype), "world": mesh.size(axis)})
+                  "dtype": str(a_.dtype), "world": mesh.size(axis)},
+              prune_fn=_prune)
     def _run(a_, b_, block_m=256, block_n=256, block_k=512):
         ctx = create_ag_gemm_context(mesh, axis, block_m, block_n,
                                      block_k)

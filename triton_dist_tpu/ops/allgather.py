@@ -202,8 +202,8 @@ def all_gather(x, *, ctx: MeshContext, axis: str = "tp",
     if mode == "ring":
         kernel = functools.partial(_ring_kernel, axis=axis, ctx=ctx)
         scratch = [
-            pltpu.SemaphoreType.DMA((n - 1,)),
-            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
         ]
     elif mode == "full_mesh":
         kernel = functools.partial(_full_mesh_kernel, axis=axis, ctx=ctx)

@@ -58,6 +58,93 @@ def gdn_fwd(q, k, v, g, beta, *, initial_state=None, normalize_qk=True):
     return o.astype(v.dtype), S_final
 
 
+def gdn_fwd_chunked(q, k, v, g, beta, *, chunk: int = 64,
+                    initial_state=None, normalize_qk=True):
+    """Chunked WY-form GDN prefill (the reference ``gdn.py`` chunk
+    machinery, :56-63 onward): within each chunk the implicit delta-rule
+    updates are solved as ONE unit-lower-triangular system (the UT/WY
+    transform), turning the token-sequential recurrence into chunk-level
+    batched matmuls on the MXU; a ``scan`` carries the state across
+    chunks. O(S·C) work like the scan form, but C tokens per MXU pass
+    instead of rank-1 updates.
+
+    Derivation: with per-token decay γ_t = exp(g_t), cumulative
+    Γ_t = Πγ and update vectors u_t = β_t(v_t − (γ_t S_{t-1})ᵀ k_t),
+
+        (I + A) U = B,  A[t,s] = β_t e^{b_t−b_s} k_sᵀk_t (s < t),
+        B[t] = β_t (v_t − Γ_t S_0ᵀ k_t),
+        o_t = Γ_t S_0ᵀ q_t + Σ_{s≤t} e^{b_t−b_s} (k_sᵀ q_t) u_s,
+        S_C = Γ_C S_0 + Σ_s (Γ_C/Γ_s) k_s u_sᵀ,
+
+    all exponents b_t − b_s ≤ 0 for s ≤ t (g ≤ 0), so every factor is a
+    decay — numerically stable in fp32.
+
+    Same signature/returns as :func:`gdn_fwd`.
+    """
+    s, h, dk = q.shape
+    dv = v.shape[-1]
+    if normalize_qk:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
+                            1e-6)
+        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
+                            1e-6)
+    c = min(chunk, s)
+    pad = (-s) % c
+    if pad:
+        zpad = lambda x: jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        # β=0 ⇒ u=0 and g=0 ⇒ Γ unchanged: padding tokens are no-ops.
+        q, k, v = zpad(q), zpad(k), zpad(v)
+        g, beta = zpad(g), zpad(beta)
+    nc = (s + pad) // c
+
+    def chunkify(x):
+        return x.reshape(nc, c, *x.shape[1:]).astype(jnp.float32)
+
+    qc, kc, vc = chunkify(q), chunkify(k), chunkify(v)
+    gc, bc = chunkify(g), chunkify(beta)
+
+    if initial_state is None:
+        initial_state = jnp.zeros((h, dk, dv), jnp.float32)
+
+    tri_lo = jnp.tril(jnp.ones((c, c), jnp.float32), k=-1)   # s < t
+    tri_inc = jnp.tril(jnp.ones((c, c), jnp.float32))        # s <= t
+
+    def chunk_step(S0, inp):
+        qch, kch, vch, gch, bch = inp          # (C,H,·)
+        qh = qch.transpose(1, 0, 2)            # (H,C,dk)
+        kh = kch.transpose(1, 0, 2)
+        vh = vch.transpose(1, 0, 2)            # (H,C,dv)
+        bsum = jnp.cumsum(gch, axis=0).T       # (H,C) inclusive
+        gam = jnp.exp(bsum)                    # (H,C) Γ_t
+        beta_h = bch.T                         # (H,C)
+        # e^{b_t - b_s}, masked to the causal triangle (≤ 1 everywhere).
+        diff = jnp.exp(bsum[:, :, None] - bsum[:, None, :])  # (H,C,C)
+
+        kk = jnp.einsum("hsd,htd->hts", kh, kh)              # k_sᵀk_t
+        a_mat = beta_h[:, :, None] * diff * kk * tri_lo
+        s0k = jnp.einsum("hkv,htk->htv", S0, kh)             # S_0ᵀk_t
+        b_mat = beta_h[:, :, None] * (vh - gam[:, :, None] * s0k)
+        u = jax.scipy.linalg.solve_triangular(
+            jnp.eye(c, dtype=jnp.float32) + a_mat, b_mat,
+            lower=True, unit_diagonal=True)                  # (H,C,dv)
+
+        qk = jnp.einsum("hsd,htd->hts", kh, qh)              # k_sᵀq_t
+        m_mat = diff * qk * tri_inc
+        o = (gam[:, :, None]
+             * jnp.einsum("hkv,htk->htv", S0, qh)
+             + jnp.einsum("hts,hsv->htv", m_mat, u))         # (H,C,dv)
+
+        decay_to_end = jnp.exp(bsum[:, -1:] - bsum)          # Γ_C/Γ_s
+        s_new = (gam[:, -1, None, None] * S0
+                 + jnp.einsum("hs,hsk,hsv->hkv", decay_to_end, kh, u))
+        return s_new, o.transpose(1, 0, 2)                   # (C,H,dv)
+
+    S_final, o = jax.lax.scan(chunk_step, initial_state,
+                              (qc, kc, vc, gc, bc))
+    o = o.reshape(nc * c, h, dv)[:s]
+    return o.astype(v.dtype), S_final
+
+
 def gdn_decode_step(S, q, k, v, g, beta, *, normalize_qk=True):
     """Single-token step for inference. S: (H, dk, dv); q/k: (H, dk);
     v: (H, dv); g/beta: (H,). Returns (o (H, dv), S_new)."""

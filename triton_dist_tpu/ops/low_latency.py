@@ -211,7 +211,7 @@ def wire_roundtrip(x, wire_dtype):
 
 def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
                    recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
-                   slot: int, wire_dtype, scale_w: int):
+                   slot: int, wire_dtype):
     """Quantize → put (payload + scales) → wait slot arrivals →
     dequantize. Buffers are indexed [side] (0 = outgoing, 1 = inbound
     — an arrival must never overwrite an outgoing chunk that hasn't
@@ -302,7 +302,7 @@ def ll_a2a(x, *, ctx: MeshContext, axis: str = "ep", step=0,
     scale_w = 1 if use_interpret() else 128
     kernel = functools.partial(
         _ll_a2a_kernel, axis=axis, ctx=ctx, n_ranks=n, slot=slot,
-        wire_dtype=wire_dtype, scale_w=scale_w)
+        wire_dtype=wire_dtype)
     out, _, _ = core_call(
         kernel,
         comm=True,

@@ -64,3 +64,27 @@ def overlap_efficiency_bound(m: int, k: int, n: int, world: int, *,
     t_comm = collective_time_s(m * k * dtype_bytes // world, world,
                                kind="all_gather", chip=chip)
     return min(1.0, t_gemm / (t_gemm + max(t_comm - t_gemm, 0.0)))
+
+
+def ag_gemm_vmem_bytes(block_m: int, block_n: int, block_k: int,
+                       m_loc: int, kdim: int, n_loc: int,
+                       dtype_bytes: int = 2,
+                       panel_budget: int = 9 * 1024 * 1024) -> int:
+    """Model of ops/ag_gemm.py's VMEM footprint for a block config —
+    used to prune configs that cannot lower before any compile attempt
+    (reference: gemm_perf_model.py pruning the autotune space)."""
+    tm = min(block_m, m_loc)
+    while tm > 8 and tm * kdim * dtype_bytes > panel_budget:
+        tm //= 2
+    while tm > 1 and m_loc % tm:
+        tm //= 2
+    tn = min(block_n, n_loc)
+    tk = min(block_k, kdim)
+    panel = tm * kdim * dtype_bytes
+    n_i = max(m_loc // max(tm, 1), 1)
+    # Mirrors ops/ag_gemm.py exactly: double-buffering needs >1 panel.
+    n_buf = 2 if (n_i > 1 and 2 * panel <= panel_budget) else 1
+    b_tiles = 2 * tk * tn * dtype_bytes          # double-buffered
+    acc = tm * tn * 4
+    out = 2 * tm * tn * dtype_bytes
+    return n_buf * panel + b_tiles + acc + out
