@@ -311,6 +311,32 @@ def battery():
         out = f(tok, ids, w, wg, wu, wd)
         assert np.isfinite(np.asarray(out, np.float32)).all()
 
+    def run_a2a_gemm_fused():
+        x = jax.random.normal(k0, (1, 1024, 4096), dt)
+        f = sm(lambda v, w: ops.a2a_gemm_fused(
+            v, w, ops.create_a2a_gemm_context(mctx, "tp", block_m=512,
+                                              block_n=512, block_k=1024),
+            force_kernel=True),
+               (P(None, None, None), P(None, None)))
+        out = np.asarray(f(x, b4k), np.float32)
+        want = (np.asarray(x, np.float32).reshape(1024, 4096)
+                @ np.asarray(b4k, np.float32))
+        np.testing.assert_allclose(out, want, rtol=3e-2, atol=3.0)
+
+    def run_sp_ag_attention_fused():
+        from triton_dist_tpu.ops import sp_ag_attention_fused
+        s, h, kvh, hd = 2048, 16, 8, 128
+        q = jax.random.normal(k0, (s, h, hd), dt) * 0.3
+        kk = jax.random.normal(jax.random.PRNGKey(11), (s, kvh, hd),
+                               dt) * 0.3
+        vv = jax.random.normal(jax.random.PRNGKey(12), (s, kvh, hd),
+                               dt) * 0.3
+        f = sm(lambda a, b, c: sp_ag_attention_fused(
+            a, b, c, ctx=mctx, axis="tp", force_kernel=True),
+               (P(None, None, None),) * 3, P(None, None, None))
+        out = np.asarray(f(q, kk, vv), np.float32)
+        assert np.isfinite(out).all()
+
     def run_ulysses():
         ctx = ops.create_ulysses_fused_context(mctx, axis="tp",
                                                block_m=256, block_n=512)
@@ -361,6 +387,8 @@ def battery():
         ("all_to_all", run_a2a),
         ("ll_a2a_int8", run_ll_a2a),
         ("moe_reduce_rs", run_moe_rs),
+        ("a2a_gemm_fused", run_a2a_gemm_fused),
+        ("sp_ag_attention_fused", run_sp_ag_attention_fused),
         ("ep_moe_fused", run_ep_fused),
         ("ulysses_qkv_gemm_a2a", run_ulysses),
         ("paged_flash_decode", run_paged_decode),

@@ -174,15 +174,37 @@ def test_broadcast(tp8_mesh, tp8_ctx):
         assert_allclose(f(x), g(x))
 
 
-def test_a2a_gemm(tp8_mesh, tp8_ctx):
+@pytest.mark.parametrize("impl", ["fused", "pallas"])
+def test_a2a_gemm(tp8_mesh, tp8_ctx, impl):
     from triton_dist_tpu.ops import a2a_gemm, a2a_gemm_ref
 
     x = _rand((64, 2, 32), seed=61)   # per-shard (8, 2, 32)
     w = _rand((32, 16), seed=62)
     f = spmd(tp8_mesh,
-             lambda v, ww: a2a_gemm(v, ww, ctx=tp8_ctx, axis="tp"),
+             lambda v, ww: a2a_gemm(v, ww, ctx=tp8_ctx, axis="tp",
+                                    impl=impl),
              (P("tp", None, None), P(None, None)), P("tp", None))
     g = spmd(tp8_mesh,
              lambda v, ww: a2a_gemm_ref(v, ww, axis="tp"),
              (P("tp", None, None), P(None, None)), P("tp", None))
     assert_allclose(f(x, w), g(x, w), rtol=1e-4, atol=1e-4)
+
+
+def test_a2a_gemm_fused_return_recv(tp8_mesh, tp8_ctx):
+    """The fused kernel's second output is the post-A2A tensor."""
+    from triton_dist_tpu.ops.a2a_gemm import (
+        a2a_gemm_fused, create_a2a_gemm_context)
+    from triton_dist_tpu.ops.all_to_all import all_to_all_ref
+
+    x = _rand((64, 4, 32), seed=63)
+    w = _rand((32, 16), seed=64)
+    fctx = create_a2a_gemm_context(tp8_ctx, "tp")
+    f = spmd(tp8_mesh,
+             lambda v, ww: a2a_gemm_fused(v, ww, fctx, return_recv=True),
+             (P("tp", None, None), P(None, None)),
+             (P("tp", None), P("tp", None)))
+    out, recv = f(x, w)
+    g = spmd(tp8_mesh,
+             lambda v: all_to_all_ref(v, axis="tp").reshape(-1, v.shape[-1]),
+             P("tp", None, None), P("tp", None))
+    assert_allclose(recv, g(x))

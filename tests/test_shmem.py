@@ -6,6 +6,7 @@ primitive).
 """
 
 import functools
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -221,7 +222,7 @@ def test_race_detector_flags_sig_sem_only_consumer(tmp_path):
             print("RACES_FOUND")
         else:
             print("CLEAN")
-    """) % "/root/repo")
+    """) % str(Path(__file__).resolve().parents[1]))
 
     def probe(mode):
         try:

@@ -84,6 +84,44 @@ def test_sp_ag_attention_gqa(tp8_mesh, tp8_ctx):
     assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_ag_attention_fused_vs_ref(tp8_mesh, tp8_ctx, causal):
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 14)
+    k = _rand((s, h, hd), 15)
+    v = _rand((s, h, hd), 16)
+
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_fused(
+                 a, b, c, ctx=tp8_ctx, axis="tp", causal=causal,
+                 block_q=4, block_kv=8),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    g = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_ref(a, b, c, axis="tp",
+                                                 causal=causal),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
+
+
+def test_sp_ag_attention_fused_gqa(tp8_mesh, tp8_ctx):
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+
+    s, h, kvh, hd = 64, 8, 4, 16
+    q = _rand((s, h, hd), 17)
+    k = _rand((s, kvh, hd), 18)
+    v = _rand((s, kvh, hd), 19)
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_fused(
+                 a, b, c, ctx=tp8_ctx, axis="tp", block_q=8, block_kv=8),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    g = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_ref(a, b, c, axis="tp"),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
+
+
 def test_sp_flash_decode_vs_dense(tp8_mesh, tp8_ctx):
     b, h, kvh, hd, t = 4, 8, 4, 16, 64
     q = _rand((b, h, hd), 10)

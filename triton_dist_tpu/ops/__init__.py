@@ -59,7 +59,7 @@ from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
     paged_flash_decode, page_attend,
 )
 from triton_dist_tpu.ops.sp_ag_attention import (  # noqa: F401
-    sp_ag_attention, sp_ag_attention_ref,
+    sp_ag_attention, sp_ag_attention_ref, sp_ag_attention_fused,
 )
 from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
     sp_flash_decode, flash_decode_ref,
@@ -68,4 +68,6 @@ from triton_dist_tpu.ops.gdn import (  # noqa: F401
     gdn_fwd, gdn_decode_step, gdn_ref,
 )
 from triton_dist_tpu.ops.broadcast import broadcast, broadcast_ref  # noqa: F401
-from triton_dist_tpu.ops.a2a_gemm import a2a_gemm, a2a_gemm_ref  # noqa: F401
+from triton_dist_tpu.ops.a2a_gemm import (  # noqa: F401
+    a2a_gemm, a2a_gemm_ref, a2a_gemm_fused, create_a2a_gemm_context,
+)

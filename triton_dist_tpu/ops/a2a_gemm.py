@@ -1,24 +1,61 @@
-"""All-to-all + GEMM (sequence/expert resharding into a matmul).
+"""All-to-all + GEMM (sequence/expert resharding fused into a matmul).
 
 Reference: ``kernels/nvidia/all_to_all_single_gemm.py`` (474) /
-``all_to_all_single_2d.py`` — an A2A whose received chunks feed straight
-into a GEMM.
+``all_to_all_single_2d.py`` — an A2A whose received chunks feed a GEMM,
+with each chunk's tiles starting as soon as that chunk lands.
 
-Composition form: the low-latency direct-put A2A (``ops/all_to_all``)
-followed by the local GEMM; XLA fuses the unpack/reshape into the matmul
-prologue. (A tile-granular fusion where each arrived chunk starts its
-GEMM tile early — the reference's overlapped variant — is the planned
-kernel-level upgrade; at A2A message sizes the latency win is small on
-ICI.)
+TPU redesign (one kernel, no producer stream): all n-1 direct puts are
+issued up front (latency-optimal, same transport as ``ops/all_to_all``),
+then the GEMM grid walks chunks in ring-offset order starting with the
+local chunk:
+
+- ``k = 0``: my own chunk — zero exposed latency, read straight from the
+  input; meanwhile every remote chunk is already in flight.
+- ``k > 0``: chunk from source ``(me + k) % n`` — certified by one wait
+  on that source's dedicated arrival-semaphore slot, so a tile never
+  blocks on traffic it does not read (per-source slots, not a shared
+  counter: arrival order does not matter).
+
+Chunk rows are staged per row-tile into a full-K VMEM panel (double-
+buffered when the budget allows); B and C tiles ride pipelined
+BlockSpecs; fp32 accumulation over a tiled contraction.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
 from triton_dist_tpu.ops.all_to_all import all_to_all, all_to_all_ref
 from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class A2AGemmContext:
+    """Analogue of the reference's ``all_to_all_single_gemm`` context."""
+    mesh: MeshContext
+    axis: str = "tp"
+    block_m: int = 256
+    block_n: int = 256
+    block_k: int = 512
+    out_dtype: Optional[jnp.dtype] = None
+
+
+def create_a2a_gemm_context(mesh: MeshContext, axis: str = "tp",
+                            block_m: int = 256, block_n: int = 256,
+                            block_k: int = 512,
+                            out_dtype=None) -> A2AGemmContext:
+    return A2AGemmContext(mesh=mesh, axis=axis, block_m=block_m,
+                          block_n=block_n, block_k=block_k,
+                          out_dtype=out_dtype)
 
 
 def a2a_gemm_ref(x, w, *, axis: str = "tp", **_):
@@ -28,12 +65,210 @@ def a2a_gemm_ref(x, w, *, axis: str = "tp", **_):
                    preferred_element_type=jnp.float32).astype(x.dtype)
 
 
+def _a2a_gemm_kernel(x_ref, b_ref, o_ref, recv_ws, a_panel, acc_v,
+                     send_sem, recv_sem, panel_sem, local_sem, *,
+                     axis: str, ctx: MeshContext, c_loc: int, tm: int,
+                     tk: int, n_ranks: int, n_buf: int, write_recv: bool):
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    src = jax.lax.rem(me + k, n)  # chunk computed at grid step k
+
+    chunk_of = lambda r: recv_ws.at[pl.ds(r * c_loc, c_loc)]
+
+    first = jnp.logical_and(
+        k == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        # All-peer puts need the all-peer barrier (ops/all_to_all.py
+        # precedent): barrier_tile only certifies ring neighbours.
+        dl.barrier_all(axis, ctx=ctx)
+        if write_recv:
+            pltpu.make_async_copy(x_ref.at[me], chunk_of(me),
+                                  local_sem).start()
+        # Fire every outgoing chunk now; the k=0 local GEMM hides the
+        # flight time. Arrival slot is keyed by (src - dst) mod n so
+        # sender and receiver agree without any handshake:
+        # sender me -> peer (me+off) signals slot n-off-1; the receiver
+        # waits chunk (me+k) at slot k-1.
+        for off in range(1, n):
+            peer = jax.lax.rem(me + off, n)
+            dl.remote_put(x_ref.at[peer], chunk_of(me),
+                          send_sem.at[off - 1], recv_sem.at[n - off - 1],
+                          peer, axis=axis, ctx=ctx)
+
+    chunk_start = jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0))
+
+    @pl.when(jnp.logical_and(k > 0, chunk_start))
+    def _():
+        dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(src), 1)
+
+    def start_panel_copy(ii, buf):
+        """Stage row panel ii of this chunk (full K) into VMEM. The local
+        chunk reads straight from the input; received chunks read the
+        workspace (arrival certified above)."""
+        @pl.when(k == 0)
+        def _():
+            pltpu.make_async_copy(
+                x_ref.at[me, pl.ds(ii * tm, tm)], a_panel.at[buf],
+                panel_sem).start()
+
+        @pl.when(k > 0)
+        def _():
+            pltpu.make_async_copy(
+                recv_ws.at[pl.ds(src * c_loc + ii * tm, tm)],
+                a_panel.at[buf], panel_sem).start()
+
+    def wait_panel(buf):
+        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
+                              panel_sem).wait()
+
+    buf = jax.lax.rem(i, n_buf) if n_buf > 1 else 0
+
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _():
+        if n_buf == 1:
+            start_panel_copy(i, 0)
+            wait_panel(0)
+        else:
+            @pl.when(i == 0)
+            def _():
+                start_panel_copy(i, buf)
+            wait_panel(buf)
+
+            @pl.when(i + 1 < n_i)
+            def _():
+                start_panel_copy(i + 1, jax.lax.rem(i + 1, n_buf))
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_panel[buf, :, pl.ds(kk * tk, tk)], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+    last = jnp.logical_and(
+        k == n - 1,
+        jnp.logical_and(i == n_i - 1,
+                        jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for s in range(n - 1):
+            dl.wait_arrivals(send_sem.at[s], chunk_of(0), 1)
+
+    if write_recv:
+        @pl.when(last)
+        def _():
+            dl.wait_arrivals(local_sem, chunk_of(me), 1)
+
+
+def a2a_gemm_fused(x, w, ctx: A2AGemmContext, *,
+                   return_recv: bool = False, force_kernel: bool = False):
+    """Tile-fused A2A + GEMM (call inside shard_map).
+
+    ``x``: (n, C, d) per shard — ``x[r]`` is the chunk destined for rank
+    ``r``; ``w``: (d, N) local weight. Returns (n·C, N) = received tokens
+    through the GEMM; with ``return_recv=True`` also the post-A2A tensor
+    (the workspace the puts already filled, at no extra traffic).
+    """
+    mesh = ctx.mesh
+    n = mesh.size(ctx.axis)
+    if x.shape[0] != n:
+        raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
+    _, c_loc, d = x.shape
+    _, n_out = w.shape
+    out_dtype = ctx.out_dtype or x.dtype
+    if n == 1 and not force_kernel:
+        out = jnp.dot(x.reshape(c_loc, d), w,
+                      preferred_element_type=jnp.float32).astype(out_dtype)
+        return (out, x.reshape(c_loc, d)) if return_recv else out
+
+    tm = min(ctx.block_m, c_loc)
+    tn = min(ctx.block_n, n_out)
+    tk = min(ctx.block_k, d)
+    panel_budget = 9 * 1024 * 1024
+    while tm > 8 and tm * d * x.dtype.itemsize > panel_budget:
+        tm //= 2
+    while tm > 1 and c_loc % tm:
+        tm //= 2
+    while tn > 1 and n_out % tn:
+        tn //= 2
+    while tk > 1 and d % tk:
+        tk //= 2
+    n_i, n_j, n_k = c_loc // tm, n_out // tn, d // tk
+
+    panel_bytes = tm * d * x.dtype.itemsize
+    n_buf = 2 if (n_i > 1 and 2 * panel_bytes <= panel_budget) else 1
+
+    def c_index(k, i, j, kk):
+        me = jax.lax.axis_index(ctx.axis)
+        src = jax.lax.rem(me + k, n)
+        return (src * n_i + i, j)
+
+    kernel = functools.partial(
+        _a2a_gemm_kernel, axis=ctx.axis, ctx=mesh, c_loc=c_loc, tm=tm,
+        tk=tk, n_ranks=n, n_buf=n_buf, write_recv=return_recv)
+
+    out, recv = core_call(
+        kernel,
+        comm=True,
+        grid=(n, n_i, n_j, n_k),
+        out_shape=(jax.ShapeDtypeStruct((n * c_loc, n_out), out_dtype),
+                   jax.ShapeDtypeStruct((n * c_loc, d), x.dtype)),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # x (manual RDMA)
+            pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_buf, tm, d), x.dtype),        # a_panel (full K)
+            pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
+            pltpu.SemaphoreType.DMA(()),                # panel_sem
+            pltpu.SemaphoreType.DMA(()),                # local_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * c_loc * d * n_out,
+            bytes_accessed=(2 * n * c_loc * d + d * n_out * n * n_i
+                            + n * c_loc * n_out) * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(x, w)
+    return (out, recv) if return_recv else out
+
+
 def a2a_gemm(x, w, *, ctx: MeshContext, axis: str = "tp",
-             impl: str = "pallas"):
+             impl: str = "fused", **blocks):
     """x: (n, C, d) per-shard (chunk r → rank r); w: (d, N) local weight.
-    Returns (n·C, N): received tokens through the GEMM."""
+    Returns (n·C, N): received tokens through the GEMM.
+
+    ``impl``: "fused" (tile-fused kernel, default), "pallas" (direct-put
+    A2A then GEMM), "xla" (lax.all_to_all then GEMM).
+    """
+    if impl == "fused":
+        fctx = create_a2a_gemm_context(ctx, axis, **blocks)
+        return a2a_gemm_fused(x, w, fctx)
     if impl not in ("pallas", "xla"):
-        raise ValueError(f"unknown impl {impl!r} (expected 'pallas'/'xla')")
+        raise ValueError(f"unknown impl {impl!r} "
+                         "(expected 'fused'/'pallas'/'xla')")
     recv = (all_to_all(x, ctx=ctx, axis=axis) if impl == "pallas"
             else all_to_all_ref(x, axis=axis))
     n, c, d = recv.shape

@@ -1,22 +1,37 @@
-"""KV-allgather (ring) attention for long-context prefill.
+"""KV-allgather attention for long-context prefill (sequence parallel).
 
 Reference: ``kernels/nvidia/sp_ag_attention_intra_node.py`` (KV allgather
 push 2D :116, consumer FA forward waiting per-KV-tile :329) /
 ``_inter_node.py`` — the repo's ring-attention analogue: KV tiles stream
-in ring order and each rank's attention consumes a tile as soon as it
-lands (SURVEY.md §2.5).
+in and each rank's attention consumes a tile as soon as it lands
+(SURVEY.md §2.5).
 
-TPU redesign: queries stay sequence-sharded; KV chunks rotate around the
-ring via ``lax.ppermute`` while flash-style online-softmax state
-(m, l, acc) accumulates per step — XLA's latency-hiding scheduler
-overlaps each ppermute with the previous chunk's attention compute (the
-same producer/consumer overlap the reference builds by hand).
+Two forms:
+
+- :func:`sp_ag_attention` — XLA composition: KV chunks rotate around the
+  ring via ``lax.ppermute`` while flash-style online-softmax state
+  accumulates; overlap is delegated to XLA's latency-hiding scheduler.
+- :func:`sp_ag_attention_fused` — one Pallas kernel with explicit
+  kernel-controlled overlap (the reference's design): every rank pushes
+  its KV chunk to the peers that need it at kernel entry (causal prunes
+  the send set), then the attention grid walks chunks newest-first with
+  one per-source arrival-semaphore wait each — a query tile never blocks
+  on KV it does not read, and all chunk flight time hides under the
+  first query tile's compute.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
 
 
 def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True):
@@ -35,7 +50,7 @@ def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True):
     return _masked_attn(q, k_full, v_full, scores_mask_offset)
 
 
-def _masked_attn(q, k, v, q_offset):
+def _masked_attn(q, k, v, q_offset, causal: bool = True):
     """Dense attention where query global position = q_offset + row."""
     sq, h, hd = q.shape
     skv, kvh = k.shape[0], k.shape[1]
@@ -46,9 +61,10 @@ def _masked_attn(q, k, v, q_offset):
     scores = jnp.einsum("qhd,khd->hqk", q, k,
                         preferred_element_type=jnp.float32)
     scores /= jnp.sqrt(jnp.float32(hd))
-    qi = q_offset + jnp.arange(sq)[:, None]
-    ki = jnp.arange(skv)[None, :]
-    scores = jnp.where((ki <= qi)[None], scores, -jnp.inf)
+    if causal:
+        qi = q_offset + jnp.arange(sq)[:, None]
+        ki = jnp.arange(skv)[None, :]
+        scores = jnp.where((ki <= qi)[None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("hqk,khd->qhd", probs, v)
 
@@ -59,7 +75,7 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     if n == 1:
-        return _masked_attn(q, k, v, 0)
+        return _masked_attn(q, k, v, 0, causal=causal)
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
     rep = h // kvh
@@ -110,3 +126,239 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
     _, _, m, l, acc = carry
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(1, 0, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fused Pallas kernel: explicit per-chunk arrival waits
+# ---------------------------------------------------------------------------
+
+def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
+                       v_panel, m_v, l_v, acc_v, send_sem, recv_sem,
+                       k_sem, v_sem, *, axis: str, ctx: MeshContext,
+                       n_ranks: int, s_loc: int, kvh: int, rep: int,
+                       tq: int, tkv: int, causal: bool):
+    i = pl.program_id(0)   # query tile (outer: arrival waits only at i=0)
+    k = pl.program_id(1)   # chunk step; src = (me - k) mod n
+    n_i = pl.num_programs(0)
+    me = dl.rank(axis)
+    n = n_ranks
+    src = jax.lax.rem(me - k + n, n)
+    # Chunk-level causal pruning: chunk src > me is entirely in the
+    # future of every local query row. src = me - k without wrap when
+    # k <= me, so `k <= me` selects exactly the visible chunks.
+    need = (k <= me) if causal else (k >= 0)
+    n_kv = s_loc // tkv
+    hd = q_ref.shape[-1]
+    scale = 1.0 / (float(hd) ** 0.5)
+
+    first = jnp.logical_and(i == 0, k == 0)
+
+    @pl.when(first)
+    def _():
+        # Peers must be in-kernel before any remote traffic.
+        dl.barrier_all(axis, ctx=ctx)
+        # Push my KV chunk to every peer that will read it (causal: only
+        # ranks above me — the reference's AG push with the same pruning,
+        # sp_ag_attention_intra_node.py:116). Arrival slot is keyed by
+        # (src - dst) mod n so both sides agree without a handshake.
+        for off in range(1, n):
+            if causal:
+                peer = me + off          # no wrap: only peers above me
+                pred = peer < n
+            else:
+                peer = jax.lax.rem(me + off, n)
+                pred = jnp.bool_(True)
+
+            @pl.when(pred)
+            def _():
+                dl.remote_put(k_ref, k_ws.at[me], send_sem.at[0, off - 1],
+                              recv_sem.at[0, n - off - 1], peer,
+                              axis=axis, ctx=ctx)
+                dl.remote_put(v_ref, v_ws.at[me], send_sem.at[1, off - 1],
+                              recv_sem.at[1, n - off - 1], peer,
+                              axis=axis, ctx=ctx)
+
+    @pl.when(jnp.logical_and(i == 0, jnp.logical_and(k > 0, need)))
+    def _():
+        # Chunk src arrives at slot (src - me) mod n - 1 = n - k - 1.
+        dl.wait_arrivals(recv_sem.at[0, n - k - 1], k_ws.at[src], 1)
+        dl.wait_arrivals(recv_sem.at[1, n - k - 1], v_ws.at[src], 1)
+
+    @pl.when(k == 0)
+    def _():
+        m_v[...] = jnp.full_like(m_v, -jnp.inf)
+        l_v[...] = jnp.zeros_like(l_v)
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    n_t = kvh * n_kv  # flat KV-tile loop: t -> (head g, kv tile kvt)
+
+    def start_kv(t: int, buf: int):
+        """Stage KV tile t into panel slot buf: own chunk straight from
+        the input, received chunks from the RDMA-fed workspace. K and V
+        ride separate semaphores so the two copies overlap."""
+        g, kvt = t // n_kv, t % n_kv
+
+        @pl.when(k == 0)
+        def _():
+            pltpu.make_async_copy(
+                k_ref.at[g, pl.ds(kvt * tkv, tkv)], k_panel.at[buf],
+                k_sem).start()
+            pltpu.make_async_copy(
+                v_ref.at[g, pl.ds(kvt * tkv, tkv)], v_panel.at[buf],
+                v_sem).start()
+
+        @pl.when(k > 0)
+        def _():
+            pltpu.make_async_copy(
+                k_ws.at[src, g, pl.ds(kvt * tkv, tkv)], k_panel.at[buf],
+                k_sem).start()
+            pltpu.make_async_copy(
+                v_ws.at[src, g, pl.ds(kvt * tkv, tkv)], v_panel.at[buf],
+                v_sem).start()
+
+    def wait_kv(buf: int):
+        pltpu.make_async_copy(k_panel.at[buf], k_panel.at[buf],
+                              k_sem).wait()
+        pltpu.make_async_copy(v_panel.at[buf], v_panel.at[buf],
+                              v_sem).wait()
+
+    @pl.when(need)
+    def _():
+        q_tile = q_ref[...]  # (H, tq, hd) — pipelined by BlockSpec
+        for t in range(n_t):
+            g, kvt = t // n_kv, t % n_kv
+            buf = t % 2
+            # Double-buffered staging (ag_gemm panel pattern): tile t+1
+            # transfers while tile t computes; only t=0 blocks cold.
+            if t == 0:
+                start_kv(0, 0)
+            wait_kv(buf)
+            if t + 1 < n_t:
+                start_kv(t + 1, (t + 1) % 2)
+
+            q_g = q_tile[g * rep:(g + 1) * rep].reshape(rep * tq, hd)
+            s = jax.lax.dot_general(
+                q_g, k_panel[buf], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                row = jax.lax.broadcasted_iota(
+                    jnp.int32, (rep * tq, tkv), 0)
+                col = jax.lax.broadcasted_iota(
+                    jnp.int32, (rep * tq, tkv), 1)
+                qi = me * s_loc + i * tq + jax.lax.rem(row, tq)
+                ki = src * s_loc + kvt * tkv + col
+                s = jnp.where(ki <= qi, s, -jnp.inf)
+            m_old = m_v[g]
+            m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - m_safe[:, None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            corr = jnp.where(jnp.isfinite(m_old),
+                             jnp.exp(m_old - m_safe), 0.0)
+            l_v[g] = l_v[g] * corr + jnp.sum(p, axis=-1)
+            pv = jax.lax.dot_general(
+                p.astype(v_panel.dtype), v_panel[buf],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_v[g] = acc_v[g] * corr[:, None] + pv
+            m_v[g] = m_new
+
+    @pl.when(k == n - 1)
+    def _():
+        out = acc_v[...] / jnp.maximum(l_v[...], 1e-30)[..., None]
+        o_ref[...] = out.reshape(kvh, rep, tq, hd).reshape(
+            kvh * rep, tq, hd).astype(o_ref.dtype)
+
+    last = jnp.logical_and(i == n_i - 1, k == n - 1)
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        # Drain send semaphores (same predicates as the sends).
+        for off in range(1, n):
+            pred = (me + off < n) if causal else jnp.bool_(True)
+
+            @pl.when(pred)
+            def _():
+                dl.wait_arrivals(send_sem.at[0, off - 1], k_ref, 1)
+                dl.wait_arrivals(send_sem.at[1, off - 1], v_ref, 1)
+
+
+def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
+                          causal: bool = True, block_q: int = 256,
+                          block_kv: int = 1024,
+                          force_kernel: bool = False):
+    """Kernel-level KV-allgather attention (call inside shard_map).
+
+    q: (S_loc, H, hd); k/v: (S_loc, KVH, hd), sequence-sharded along
+    ``axis``. Returns (S_loc, H, hd). One Pallas kernel: full-mesh KV
+    push at entry (causal prunes the send set to ranks above me), then
+    the query-tile grid consumes chunks newest-first, each gated by one
+    arrival-semaphore wait — explicit comm/compute overlap, the
+    reference's ``sp_ag_attention_intra_node`` redesigned for counting
+    semaphores (no flag words, no producer stream).
+    """
+    n = ctx.size(axis)
+    s_loc, h, hd = q.shape
+    kvh = k.shape[1]
+    rep = h // kvh
+    if n == 1 and not force_kernel:
+        return _masked_attn(q, k, v, 0, causal=causal)
+
+    tq = min(block_q, s_loc)
+    while tq > 1 and s_loc % tq:
+        tq //= 2
+    tkv = min(block_kv, s_loc)
+    while tkv > 1 and s_loc % tkv:
+        tkv //= 2
+    n_qt = s_loc // tq
+
+    # Head-major layouts: per-head KV rows are contiguous for staging,
+    # and the chunk push is one dense (KVH, S_loc, hd) DMA.
+    q_h = jnp.transpose(q, (1, 0, 2))
+    k_h = jnp.transpose(k, (1, 0, 2))
+    v_h = jnp.transpose(v, (1, 0, 2))
+
+    kernel = functools.partial(
+        _sp_ag_attn_kernel, axis=axis, ctx=ctx, n_ranks=n, s_loc=s_loc,
+        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal)
+
+    o, _, _ = core_call(
+        kernel,
+        comm=True,
+        grid=(n_qt, n),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, s_loc, hd), q.dtype),
+            jax.ShapeDtypeStruct((n, kvh, s_loc, hd), k.dtype),  # k_ws
+            jax.ShapeDtypeStruct((n, kvh, s_loc, hd), v.dtype),  # v_ws
+        ),
+        in_specs=[
+            pl.BlockSpec((h, tq, hd), lambda i, kk: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec((h, tq, hd), lambda i, kk: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, tkv, hd), k.dtype),           # k_panel (dbuf)
+            pltpu.VMEM((2, tkv, hd), v.dtype),           # v_panel (dbuf)
+            pltpu.VMEM((kvh, rep * tq), jnp.float32),    # m_v
+            pltpu.VMEM((kvh, rep * tq), jnp.float32),    # l_v
+            pltpu.VMEM((kvh, rep * tq, hd), jnp.float32),  # acc_v
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),  # send_sem
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),  # recv_sem
+            pltpu.SemaphoreType.DMA(()),                  # k_sem
+            pltpu.SemaphoreType.DMA(()),                  # v_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * s_loc * s_loc * h * hd,
+            bytes_accessed=(2 * n * kvh * s_loc * hd * 2
+                            + s_loc * h * hd * 2) * q.dtype.itemsize,
+            transcendentals=n * s_loc * s_loc * h,
+        ),
+    )(q_h, k_h, v_h)
+    return jnp.transpose(o, (1, 0, 2))
