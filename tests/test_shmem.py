@@ -240,3 +240,103 @@ def test_race_detector_flags_sig_sem_only_consumer(tmp_path):
     assert not (rc == 0 and "CLEAN" in out), (
         "sig_sem-only consumer passed silently — the race detector "
         "must flag, abort, or wedge on the protocol violation")
+
+
+# ---------------------------------------------------------------------------
+# Teams, getmem, fence/quiet (libshmem surface)
+# ---------------------------------------------------------------------------
+
+def test_team_queries(dp2tp4_mesh, dp2tp4_ctx):
+    """team_my_pe / n_pes / translate over mesh-axis teams."""
+    from triton_dist_tpu.lang import Team, team_world, team_axis
+
+    world = team_world(dp2tp4_ctx)
+    tp = team_axis(dp2tp4_ctx, "tp")
+    dp = team_axis(dp2tp4_ctx, "dp")
+    assert world.n_pes() == 8 and tp.n_pes() == 4 and dp.n_pes() == 2
+
+    def probe():
+        return (jnp.full((1,), world.my_pe(), jnp.int32),
+                jnp.full((1,), tp.my_pe(), jnp.int32),
+                jnp.full((1,), world.translate_pe(world.my_pe(), tp),
+                         jnp.int32),
+                jnp.full((1,), tp.translate_pe(tp.my_pe(), world),
+                         jnp.int32))
+
+    w, t, w2t, t2w = spmd(dp2tp4_mesh, probe, (),
+                          (P(("dp", "tp")),) * 4)()
+    # Mesh is (dp=2, tp=4) outer-major: world pe = dp*4 + tp.
+    np.testing.assert_array_equal(np.asarray(w), np.arange(8))
+    np.testing.assert_array_equal(np.asarray(t), np.arange(8) % 4)
+    # world pe -> its tp-team pe is pe % 4; tp pe -> world pe restores.
+    np.testing.assert_array_equal(np.asarray(w2t), np.arange(8) % 4)
+    np.testing.assert_array_equal(np.asarray(t2w), np.arange(8))
+
+
+def test_team_device_id_addresses_remote_put(dp2tp4_mesh, dp2tp4_ctx):
+    """A put addressed via Team.device_id lands on the right device:
+    rotate buffers along the tp team using team PE arithmetic."""
+    from triton_dist_tpu.lang import team_axis
+
+    tp = team_axis(dp2tp4_ctx, "tp")
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        me = tp.my_pe()
+        n = tp.n_pes()
+        nxt = jax.lax.rem(me + 1, n)
+        dl.barrier_tile("tp", ctx=dp2tp4_ctx)
+        copy = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=out_ref, send_sem=send_sem,
+            recv_sem=recv_sem, device_id=tp.device_id(nxt),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        copy.start()
+        copy.wait()
+
+    def run(x):
+        return core_call(
+            kernel, comm=True,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    out = spmd(dp2tp4_mesh, run, P(("dp", "tp"), None),
+               P(("dp", "tp"), None))(x)
+    want = np.asarray(x).reshape(2, 4, 8, 128)
+    want = np.roll(want, 1, axis=1).reshape(64, 128)  # tp ring shift
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_getmem_block_pull_shift(tp8_mesh, tp8_ctx):
+    """Symmetric pull: every rank gets (me+2)'s buffer; result equals a
+    left-shift by 2 — the SPMD lockstep get realised by owner puts."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        n = dl.num_ranks("tp")
+        me = dl.rank("tp")
+        peer = jax.lax.rem(me + 2, n)        # whom I pull from
+        requester = jax.lax.rem(me - 2 + n, n)  # who pulls from me
+        dl.barrier_all("tp", ctx=tp8_ctx)
+        copy = dl.getmem_block(out_ref, x_ref, peer, requester,
+                               send_sem, recv_sem, axis="tp", ctx=tp8_ctx)
+        dl.quiet(copy)
+        dl.wait_arrivals(recv_sem, out_ref, 1)
+
+    def run(x):
+        return core_call(
+            kernel, comm=True,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    out = spmd(tp8_mesh, run, P("tp", None), P("tp", None))(x)
+    want = np.roll(np.asarray(x).reshape(8, 8, 128), -2, axis=0)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  want.reshape(64, 128))

@@ -122,6 +122,42 @@ def test_sp_ag_attention_fused_gqa(tp8_mesh, tp8_ctx):
     assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("inner,outer", [("tp", "dp"), ("dp", "tp")])
+def test_sp_ag_attention_2d_vs_ref(dp2tp4_mesh, dp2tp4_ctx, inner, outer,
+                                   causal):
+    """Hierarchical (mirror+relay) schedule == dense oracle, both axis
+    assignments (O=2/I=4 and O=4/I=2)."""
+    from triton_dist_tpu.ops import sp_ag_attention_2d
+    from triton_dist_tpu.ops.sp_ag_attention import _masked_attn
+
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 24)
+    k = _rand((s, h, hd), 25)
+    v = _rand((s, h, hd), 26)
+    s_loc = s // 8
+
+    def oracle(qs, ks, vs):
+        glob = (jax.lax.axis_index(outer) * jax.lax.axis_size(inner)
+                + jax.lax.axis_index(inner))
+        kf = jax.lax.all_gather(
+            jax.lax.all_gather(ks, inner, axis=0, tiled=True),
+            outer, axis=0, tiled=True)
+        vf = jax.lax.all_gather(
+            jax.lax.all_gather(vs, inner, axis=0, tiled=True),
+            outer, axis=0, tiled=True)
+        return _masked_attn(qs, kf, vf, glob * s_loc, causal=causal)
+
+    shard = P((outer, inner), None, None)
+    f = spmd(dp2tp4_mesh,
+             lambda a, b, c: sp_ag_attention_2d(
+                 a, b, c, ctx=dp2tp4_ctx, inner_axis=inner,
+                 outer_axis=outer, causal=causal, block_q=4, block_kv=8),
+             (shard,) * 3, shard)
+    g = spmd(dp2tp4_mesh, oracle, (shard,) * 3, shard)
+    assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
+
+
 def test_sp_flash_decode_vs_dense(tp8_mesh, tp8_ctx):
     b, h, kvh, hd, t = 4, 8, 4, 16, 64
     q = _rand((b, h, hd), 10)

@@ -27,8 +27,15 @@ from triton_dist_tpu.lang.shmem_device import (  # noqa: F401
     barrier_tile,
     local_copy,
     local_copy_async,
+    fence,
+    quiet,
     SIGNAL_SET,
     SIGNAL_ADD,
+)
+from triton_dist_tpu.lang.teams import (  # noqa: F401
+    Team,
+    team_world,
+    team_axis,
 )
 from triton_dist_tpu.lang.pallas_helpers import (  # noqa: F401
     core_call,

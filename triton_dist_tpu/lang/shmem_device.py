@@ -121,20 +121,60 @@ def putmem_signal_block(dst_ref, src_ref, sig_sem, peer, send_sem, recv_sem,
     return copy
 
 
-def getmem_block(dst_ref, src_ref, peer, send_sem, recv_sem, *, axis: str,
-                 ctx=None):
-    """One-sided get: fetch ``src_ref`` from ``peer`` into local ``dst_ref``.
+def getmem_block(dst_ref, src_ref, peer, requester, send_sem, recv_sem, *,
+                 axis: str, ctx=None):
+    """One-sided get in SPMD lockstep form: fetch ``peer``'s ``src_ref``
+    into my ``dst_ref`` (reference ``libshmem_device.getmem_block``).
 
-    TPU remote DMA is push-only, so a get is expressed as a remote-issued
-    put in the SPMD program: every device issues the symmetric put that
-    realises its peers' gets. For the common symmetric patterns
-    (all-gather pull schedules) this is what the collective kernels do;
-    a true single-sided get is emulated with a request/response semaphore
-    pair. Provided for API parity with ``libshmem_device.getmem_block``.
+    TPU remote DMA is push-only, so the get is realised by the data
+    owner. In an SPMD kernel every rank executes the same get, making
+    the access pattern a rank permutation: I pull from ``peer``, and by
+    symmetry ``requester`` — the rank with ``peer(requester) == me`` —
+    pulls from me (for a shift ``peer = (me+off) % n`` that is
+    ``requester = (me-off) % n``). This call issues the put that
+    realises the *requester's* get (my ``src_ref`` → the requester's
+    ``dst_ref``, symmetric address); my own ``dst_ref`` is filled by my
+    peer's matching put. Consume the result with
+    ``wait_arrivals(recv_sem, dst_ref, 1)`` — the reference's blocking
+    get maps to put + arrival wait. The full-mesh *pull* allgather
+    schedule (``low_latency_allgather.py``) is this pattern n-1 times.
     """
-    raise NotImplementedError(
-        "TPU RDMA is push-only; restructure as symmetric puts "
-        "(see ops/collectives) or use p2p request/response (ops/p2p).")
+    return remote_put(src_ref, dst_ref, send_sem, recv_sem, requester,
+                      axis=axis, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# Memory ordering (fence / quiet)
+# ---------------------------------------------------------------------------
+
+def fence(*copies):
+    """Local ordering of my outstanding puts (reference
+    ``libshmem_device.fence`` :176). Drains the given handles' send
+    semaphores: my source buffers are reusable and the payloads are
+    committed to the interconnect in order.
+
+    WEAKER THAN NVSHMEM fence: send-drain does NOT order *remote
+    delivery* — a subsequent :func:`notify` can still overtake the bulk
+    data in flight (same caveat as :func:`putmem_signal_block`). Remote
+    arrival is only certified on the receiver by its ``recv_sem`` wait;
+    there is no sender-side primitive for it on TPU.
+    """
+    for c in copies:
+        c.wait_send()
+
+
+def quiet(*copies):
+    """Local completion of my outstanding puts (reference
+    ``libshmem_device.quiet`` :166): after return, every given handle's
+    send side has drained — source buffers are safe to overwrite.
+
+    WEAKER THAN NVSHMEM quiet, which certifies remote completion: on
+    TPU only the *receiver* can certify arrival (its ``recv_sem``).
+    Do not follow quiet with a raced flag signal — consumers must wait
+    the DMA's own recv semaphore before reading the destination.
+    """
+    for c in copies:
+        c.wait_send()
 
 
 # ---------------------------------------------------------------------------

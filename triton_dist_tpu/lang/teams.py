@@ -1,0 +1,98 @@
+"""SHMEM teams over mesh axes.
+
+Reference: ``language/extra/libshmem_device.py`` team API — ``team_my_pe``
+(:69), ``team_n_pes`` (:74), ``barrier(team)`` (:126), ``team_translate_pe``
+(:475), plus the ``TEAM_WORLD / NODE`` constants (:512 onward).
+
+TPU redesign: a *team* is a tuple of named mesh axes. The mesh already
+carries the team structure the reference builds at runtime (NVSHMEM team
+split): ``Team(ctx, ("tp",))`` is the TP ring, ``Team(ctx, ("dp", "tp"))``
+is the world over both axes (outer-major flat PE order, matching the
+canonical mesh linearization in ``parallel/mesh.py``). PE numbering is
+the row-major flat index over the team's axes; translation between teams
+is coordinate re-linearization — no membership tables, no registration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence, Tuple
+
+import jax
+
+from triton_dist_tpu.parallel.mesh import MeshContext, logical_device_id
+
+
+@dataclasses.dataclass(frozen=True)
+class Team:
+    """A SHMEM team = an ordered tuple of mesh axes (outer-major)."""
+
+    ctx: MeshContext
+    axes: Tuple[str, ...]
+
+    def __post_init__(self):
+        for a in self.axes:
+            if a not in self.ctx.axes:
+                raise ValueError(f"axis {a!r} not in mesh {self.ctx.axes}")
+
+    # -- static queries ----------------------------------------------------
+    def n_pes(self) -> int:
+        """Reference ``team_n_pes`` (:74)."""
+        return math.prod(self.ctx.size(a) for a in self.axes)
+
+    # -- traced queries (inside shard_map) ---------------------------------
+    def my_pe(self):
+        """Flat PE id in this team (reference ``team_my_pe`` :69)."""
+        pe = 0
+        for a in self.axes:
+            pe = pe * self.ctx.size(a) + jax.lax.axis_index(a)
+        return pe
+
+    def coords(self, pe):
+        """Per-axis coordinates of flat PE id (outer-major)."""
+        out = []
+        for a in reversed(self.axes):
+            size = self.ctx.size(a)
+            out.append(jax.lax.rem(pe, size))
+            pe = jax.lax.div(pe, size)
+        return tuple(reversed(out))
+
+    def device_id(self, pe):
+        """Logical device id of team PE ``pe`` (my coordinates on every
+        axis outside the team). This is what remote DMA / semaphore
+        signals take — the analogue of NVSHMEM PE translation to
+        TEAM_WORLD before ``putmem`` (``team_translate_pe`` :475)."""
+        coords = dict(zip(self.axes, self.coords(pe)))
+        device_id = 0
+        for name, size in zip(self.ctx.axes, self.ctx.sizes):
+            idx = coords.get(name)
+            if idx is None:
+                idx = jax.lax.axis_index(name)
+            device_id = device_id * size + idx
+        return device_id
+
+    def translate_pe(self, pe, dest: "Team"):
+        """Reference ``team_translate_pe(src_team, pe, dest_team)``: the
+        PE id in ``dest`` of the device that is ``pe`` here, or -1-free
+        TPU form: only valid when that device is in ``dest`` (a device
+        is in every axis-team of its own mesh, so translation between
+        teams over subsets of axes is total given my off-team coords)."""
+        coords = dict(zip(self.axes, self.coords(pe)))
+        out = 0
+        for a in dest.axes:
+            idx = coords.get(a)
+            if idx is None:
+                idx = jax.lax.axis_index(a)
+            out = out * dest.ctx.size(a) + idx
+        return out
+
+
+def team_world(ctx: MeshContext) -> Team:
+    """All mesh axes, outer-major — NVSHMEM ``TEAM_WORLD``."""
+    return Team(ctx, tuple(ctx.axes))
+
+
+def team_axis(ctx: MeshContext, axis: str) -> Team:
+    """Single-axis team — the reference's NODE/intra-scope teams."""
+    return Team(ctx, (axis,))

@@ -60,6 +60,7 @@ from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
 )
 from triton_dist_tpu.ops.sp_ag_attention import (  # noqa: F401
     sp_ag_attention, sp_ag_attention_ref, sp_ag_attention_fused,
+    sp_ag_attention_2d,
 )
 from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
     sp_flash_decode, flash_decode_ref,

@@ -269,6 +269,9 @@ def a2a_gemm(x, w, *, ctx: MeshContext, axis: str = "tp",
     if impl not in ("pallas", "xla"):
         raise ValueError(f"unknown impl {impl!r} "
                          "(expected 'fused'/'pallas'/'xla')")
+    if blocks:
+        raise TypeError(f"block sizes {sorted(blocks)} only apply to "
+                        "impl='fused'")
     recv = (all_to_all(x, ctx=ctx, axis=axis) if impl == "pallas"
             else all_to_all_ref(x, axis=axis))
     n, c, d = recv.shape

@@ -23,6 +23,7 @@ Two forms:
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -134,14 +135,18 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
 
 def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
                        v_panel, m_v, l_v, acc_v, send_sem, recv_sem,
-                       k_sem, v_sem, *, axis: str, ctx: MeshContext,
-                       n_ranks: int, s_loc: int, kvh: int, rep: int,
-                       tq: int, tkv: int, causal: bool):
+                       k_sem, v_sem, *, inner_axis: str,
+                       outer_axis: Optional[str], ctx: MeshContext,
+                       n_inner: int, n_outer: int, s_loc: int, kvh: int,
+                       rep: int, tq: int, tkv: int, causal: bool):
     i = pl.program_id(0)   # query tile (outer: arrival waits only at i=0)
     k = pl.program_id(1)   # chunk step; src = (me - k) mod n
     n_i = pl.num_programs(0)
-    me = dl.rank(axis)
-    n = n_ranks
+    ni, no = n_inner, n_outer
+    n = ni * no
+    ii = dl.rank(inner_axis)
+    oo = dl.rank(outer_axis) if outer_axis is not None else 0
+    me = oo * ni + ii  # global rank, outer-major (canonical mesh order)
     src = jax.lax.rem(me - k + n, n)
     # Chunk-level causal pruning: chunk src > me is entirely in the
     # future of every local query row. src = me - k without wrap when
@@ -151,38 +156,99 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
     hd = q_ref.shape[-1]
     scale = 1.0 / (float(hd) ** 0.5)
 
+    def slot_for(src_glob, dst_glob):
+        """Arrival-semaphore slot for chunk ``src_glob`` at ``dst_glob``:
+        (src - dst) mod n - 1. The receiver processes that chunk at step
+        k = (dst - src) mod n, so this is slot n - k - 1 — matching the
+        receiver's wait below. Both sides compute it from rank
+        arithmetic — no handshake."""
+        return jax.lax.rem(src_glob - dst_glob + 2 * n, n) - 1
+
+    # Flat send-semaphore enumeration (n-1 sends total per rank):
+    # [0, ni-1)            inner pushes of my own chunk
+    # [ni-1, ni+no-2)      mirror pushes of my own chunk (one DCN hop
+    #                      per outer group — DCN traffic / n_inner)
+    # [ni+no-2, n-1)       relays of mirror chunks to my inner peers
+    _REL0 = ni - 1 + no - 1
+
     first = jnp.logical_and(i == 0, k == 0)
 
     @pl.when(first)
     def _():
-        # Peers must be in-kernel before any remote traffic.
-        dl.barrier_all(axis, ctx=ctx)
-        # Push my KV chunk to every peer that will read it (causal: only
-        # ranks above me — the reference's AG push with the same pruning,
-        # sp_ag_attention_intra_node.py:116). Arrival slot is keyed by
-        # (src - dst) mod n so both sides agree without a handshake.
-        for off in range(1, n):
+        # Peers must be in-kernel before any remote traffic (all-peer
+        # puts ride both axes, so both axes barrier).
+        dl.barrier_all(inner_axis, ctx=ctx)
+        if outer_axis is not None and no > 1:
+            dl.barrier_all(outer_axis, ctx=ctx)
+        # Push my KV chunk to every inner peer that will read it
+        # (causal prunes to higher ranks — the reference's AG push with
+        # the same pruning, sp_ag_attention_intra_node.py:116).
+        for off in range(1, ni):
             if causal:
-                peer = me + off          # no wrap: only peers above me
-                pred = peer < n
+                peer = ii + off          # no wrap: only peers above me
+                pred = peer < ni
             else:
-                peer = jax.lax.rem(me + off, n)
+                peer = jax.lax.rem(ii + off, ni)
                 pred = jnp.bool_(True)
+            dst = oo * ni + peer
 
             @pl.when(pred)
             def _():
-                dl.remote_put(k_ref, k_ws.at[me], send_sem.at[0, off - 1],
-                              recv_sem.at[0, n - off - 1], peer,
-                              axis=axis, ctx=ctx)
-                dl.remote_put(v_ref, v_ws.at[me], send_sem.at[1, off - 1],
-                              recv_sem.at[1, n - off - 1], peer,
-                              axis=axis, ctx=ctx)
+                dl.remote_put(k_ref, k_ws.at[me],
+                              send_sem.at[0, off - 1],
+                              recv_sem.at[0, slot_for(me, dst)], peer,
+                              axis=inner_axis, ctx=ctx)
+                dl.remote_put(v_ref, v_ws.at[me],
+                              send_sem.at[1, off - 1],
+                              recv_sem.at[1, slot_for(me, dst)], peer,
+                              axis=inner_axis, ctx=ctx)
+        # Mirror pushes: one copy of my chunk per other outer group, to
+        # the rank with my inner index (the group's relayer) — each
+        # chunk crosses the slow (DCN) axis exactly once
+        # (sp_ag_attention_inter_node.py's node-leader staging).
+        for m in range(1, no):
+            if causal:
+                peer_o = oo + m          # no wrap: only groups above
+                pred = peer_o < no
+            else:
+                peer_o = jax.lax.rem(oo + m, no)
+                pred = jnp.bool_(True)
+            dst = peer_o * ni + ii
+
+            @pl.when(pred)
+            def _():
+                dl.remote_put(k_ref, k_ws.at[me],
+                              send_sem.at[0, ni - 1 + m - 1],
+                              recv_sem.at[0, slot_for(me, dst)], peer_o,
+                              axis=outer_axis, ctx=ctx)
+                dl.remote_put(v_ref, v_ws.at[me],
+                              send_sem.at[1, ni - 1 + m - 1],
+                              recv_sem.at[1, slot_for(me, dst)], peer_o,
+                              axis=outer_axis, ctx=ctx)
 
     @pl.when(jnp.logical_and(i == 0, jnp.logical_and(k > 0, need)))
     def _():
         # Chunk src arrives at slot (src - me) mod n - 1 = n - k - 1.
         dl.wait_arrivals(recv_sem.at[0, n - k - 1], k_ws.at[src], 1)
         dl.wait_arrivals(recv_sem.at[1, n - k - 1], v_ws.at[src], 1)
+        # Relay: at step k = m*ni the chunk is my mirror's (same inner
+        # index, m groups below) — I am its relayer: forward it to my
+        # inner peers, who are all above it in global order.
+        for m in range(1, no):
+            @pl.when(k == m * ni)
+            def _():
+                for off in range(1, ni):
+                    peer = jax.lax.rem(ii + off, ni)
+                    dst = oo * ni + peer
+                    s_idx = _REL0 + (m - 1) * (ni - 1) + off - 1
+                    dl.remote_put(k_ws.at[src], k_ws.at[src],
+                                  send_sem.at[0, s_idx],
+                                  recv_sem.at[0, slot_for(src, dst)],
+                                  peer, axis=inner_axis, ctx=ctx)
+                    dl.remote_put(v_ws.at[src], v_ws.at[src],
+                                  send_sem.at[1, s_idx],
+                                  recv_sem.at[1, slot_for(src, dst)],
+                                  peer, axis=inner_axis, ctx=ctx)
 
     @pl.when(k == 0)
     def _():
@@ -274,35 +340,40 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
         # Drain send semaphores (same predicates as the sends).
-        for off in range(1, n):
-            pred = (me + off < n) if causal else jnp.bool_(True)
+        for off in range(1, ni):
+            pred = (ii + off < ni) if causal else jnp.bool_(True)
 
             @pl.when(pred)
             def _():
                 dl.wait_arrivals(send_sem.at[0, off - 1], k_ref, 1)
                 dl.wait_arrivals(send_sem.at[1, off - 1], v_ref, 1)
+        for m in range(1, no):
+            pred = (oo + m < no) if causal else jnp.bool_(True)
+
+            @pl.when(pred)
+            def _():
+                dl.wait_arrivals(send_sem.at[0, ni - 1 + m - 1], k_ref, 1)
+                dl.wait_arrivals(send_sem.at[1, ni - 1 + m - 1], v_ref, 1)
+        for m in range(1, no):
+            pred = (m * ni <= me) if causal else jnp.bool_(True)
+            for off in range(1, ni):
+                s_idx = _REL0 + (m - 1) * (ni - 1) + off - 1
+
+                @pl.when(pred)
+                def _():
+                    dl.wait_arrivals(send_sem.at[0, s_idx], k_ref, 1)
+                    dl.wait_arrivals(send_sem.at[1, s_idx], v_ref, 1)
 
 
-def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
-                          causal: bool = True, block_q: int = 256,
-                          block_kv: int = 1024,
-                          force_kernel: bool = False):
-    """Kernel-level KV-allgather attention (call inside shard_map).
-
-    q: (S_loc, H, hd); k/v: (S_loc, KVH, hd), sequence-sharded along
-    ``axis``. Returns (S_loc, H, hd). One Pallas kernel: full-mesh KV
-    push at entry (causal prunes the send set to ranks above me), then
-    the query-tile grid consumes chunks newest-first, each gated by one
-    arrival-semaphore wait — explicit comm/compute overlap, the
-    reference's ``sp_ag_attention_intra_node`` redesigned for counting
-    semaphores (no flag words, no producer stream).
-    """
-    n = ctx.size(axis)
+def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
+                     block_q, block_kv):
+    """Shared host-side setup for the 1D and hierarchical fused forms."""
+    ni = ctx.size(inner_axis)
+    no = ctx.size(outer_axis) if outer_axis is not None else 1
+    n = ni * no
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
     rep = h // kvh
-    if n == 1 and not force_kernel:
-        return _masked_attn(q, k, v, 0, causal=causal)
 
     tq = min(block_q, s_loc)
     while tq > 1 and s_loc % tq:
@@ -319,7 +390,8 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
     v_h = jnp.transpose(v, (1, 0, 2))
 
     kernel = functools.partial(
-        _sp_ag_attn_kernel, axis=axis, ctx=ctx, n_ranks=n, s_loc=s_loc,
+        _sp_ag_attn_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
+        ctx=ctx, n_inner=ni, n_outer=no, s_loc=s_loc,
         kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal)
 
     o, _, _ = core_call(
@@ -362,3 +434,49 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
         ),
     )(q_h, k_h, v_h)
     return jnp.transpose(o, (1, 0, 2))
+
+
+def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
+                          causal: bool = True, block_q: int = 256,
+                          block_kv: int = 1024,
+                          force_kernel: bool = False):
+    """Kernel-level KV-allgather attention (call inside shard_map).
+
+    q: (S_loc, H, hd); k/v: (S_loc, KVH, hd), sequence-sharded along
+    ``axis``. Returns (S_loc, H, hd). One Pallas kernel: full-mesh KV
+    push at entry (causal prunes the send set to ranks above me), then
+    the query-tile grid consumes chunks newest-first, each gated by one
+    arrival-semaphore wait — explicit comm/compute overlap, the
+    reference's ``sp_ag_attention_intra_node`` redesigned for counting
+    semaphores (no flag words, no producer stream).
+    """
+    n = ctx.size(axis)
+    if n == 1 and not force_kernel:
+        return _masked_attn(q, k, v, 0, causal=causal)
+    return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=axis,
+                            outer_axis=None, causal=causal,
+                            block_q=block_q, block_kv=block_kv)
+
+
+def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
+                       inner_axis: str = "sp", outer_axis: str = "dp",
+                       causal: bool = True, block_q: int = 256,
+                       block_kv: int = 1024):
+    """Hierarchical (ICI/DCN) KV-allgather attention — the inter-node
+    schedule (reference ``sp_ag_attention_inter_node.py:116,329,505``).
+
+    Sequence is sharded over (outer, inner) in global outer-major rank
+    order; inner rides ICI, outer crosses slices (DCN). Each KV chunk
+    crosses the slow axis ONCE — to the mirror rank with the same inner
+    index — which relays it to its inner peers in-kernel, so DCN traffic
+    shrinks by n_inner versus a flat full-mesh push, and mirror-hop
+    latency hides under the inner-group chunks that are consumed first
+    (the chunk order walks own group, then groups below).
+    """
+    ni = ctx.size(inner_axis)
+    no = ctx.size(outer_axis)
+    if ni * no == 1:
+        return _masked_attn(q, k, v, 0, causal=causal)
+    return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=inner_axis,
+                            outer_axis=outer_axis, causal=causal,
+                            block_q=block_q, block_kv=block_kv)
