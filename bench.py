@@ -360,22 +360,25 @@ def battery():
             q_, kp, vp, tbl, kv_len))(q)
         assert np.isfinite(np.asarray(out, np.float32)).all()
 
-    def run_megakernel():
-        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
-        from triton_dist_tpu.models.config import ModelConfig
+    def run_megakernel(paged):
+        def go():
+            from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+            from triton_dist_tpu.models.config import ModelConfig
 
-        cfg = ModelConfig.tiny(vocab_size=4096, hidden_size=1024,
-                               intermediate_size=2048,
-                               num_hidden_layers=2,
-                               num_attention_heads=8,
-                               num_key_value_heads=4, head_dim=128)
-        eng = MegaKernelEngine(cfg, mesh, batch=4, max_len=256,
-                               prefill_seq=16)
-        prompts = jnp.ones((4, 16), jnp.int32)
-        logits = eng.prefill(prompts)
-        assert np.isfinite(np.asarray(logits, np.float32)).all()
-        l2 = eng.decode_step(jnp.argmax(logits, -1).astype(jnp.int32), 16)
-        assert np.isfinite(np.asarray(l2, np.float32)).all()
+            cfg = ModelConfig.tiny(vocab_size=4096, hidden_size=1024,
+                                   intermediate_size=2048,
+                                   num_hidden_layers=2,
+                                   num_attention_heads=8,
+                                   num_key_value_heads=4, head_dim=128)
+            eng = MegaKernelEngine(cfg, mesh, batch=4, max_len=256,
+                                   prefill_seq=16, paged=paged)
+            prompts = jnp.ones((4, 16), jnp.int32)
+            logits = eng.prefill(prompts)
+            assert np.isfinite(np.asarray(logits, np.float32)).all()
+            l2 = eng.decode_step(
+                jnp.argmax(logits, -1).astype(jnp.int32), 16)
+            assert np.isfinite(np.asarray(l2, np.float32)).all()
+        return go
 
     entries = [
         ("gemm_ar", run_gemm_ar),
@@ -392,7 +395,8 @@ def battery():
         ("ep_moe_fused", run_ep_fused),
         ("ulysses_qkv_gemm_a2a", run_ulysses),
         ("paged_flash_decode", run_paged_decode),
-        ("megakernel_prefill_decode", run_megakernel),
+        ("megakernel_prefill_decode", run_megakernel(False)),
+        ("megakernel_paged", run_megakernel(True)),
     ]
     results = []
     for name, fn in entries:

@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 
-from triton_dist_tpu.parallel.mesh import MeshContext, logical_device_id
+from triton_dist_tpu.parallel.mesh import MeshContext
 
 
 @dataclasses.dataclass(frozen=True)

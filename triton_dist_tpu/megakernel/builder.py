@@ -46,7 +46,8 @@ class ModelBuilder:
                  max_len: int, axis: str = "tp",
                  tile_w: Optional[int] = None, t_tile: Optional[int] = None,
                  num_cores: int = 1, strategy: str = "round_robin",
-                 seq: int = 1):
+                 seq: int = 1, paged: bool = False,
+                 page: Optional[int] = None):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -78,6 +79,23 @@ class ModelBuilder:
         self.t_tile = t_tile or min(128, max_len)
         if max_len % self.t_tile:
             raise ValueError(f"t_tile={self.t_tile} must divide max_len={max_len}")
+        # Paged KV: the caches become page pools + a block table
+        # (reference mega_triton_kernel paged flash_decode). Alignment
+        # contract for single-slice access (kernels._kv_slice): cache
+        # reads span t_tile and prefill writes span seq, so both must
+        # divide the page; prefill bases must be seq-aligned.
+        self.paged = paged
+        self.page = 0
+        self.p_max = 0
+        if paged:
+            self.page = page or max(self.t_tile, seq)
+            if (self.page % self.t_tile or (seq > 1 and self.page % seq)
+                    or max_len % self.page):
+                raise ValueError(
+                    f"page={self.page} needs t_tile|page, seq|page and "
+                    f"page|max_len (t_tile={self.t_tile}, seq={seq}, "
+                    f"max_len={max_len})")
+            self.p_max = max_len // self.page
 
         n = self.n
         self.h_loc = cfg.num_attention_heads // n
@@ -393,10 +411,11 @@ class ModelBuilder:
             rope_theta=self.cfg.rope_theta, rms_eps=self.cfg.rms_norm_eps,
             n_ranks=self.n, axis=self.axis, mesh=self.mctx,
             ar_ws_off=self.ar_ws_off, ar_max_tiles=self.ar_max_tiles,
-            seq=self.seq)
+            seq=self.seq, paged=self.paged, page=self.page,
+            p_max=self.p_max)
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
-                wait_edges_s, sig_edges_s, len_s, tok_s,
+                wait_edges_s, sig_edges_s, len_s, tok_s, tbl_s,
                 arena_in, kc_in, vc_in, arena, k_cache, v_cache, va, vb,
                 vc, vw, acc, vhd, vkt, vsq, edge_sem, send_sem,
                 recv_sem):
@@ -408,7 +427,7 @@ class ModelBuilder:
         refs = {"arena": arena, "k_cache": k_cache, "v_cache": v_cache,
                 "va": va, "vb": vb, "vc": vc, "vw": vw, "acc": acc,
                 "vhd": vhd, "vkt": vkt, "vsq": vsq, "send_sem": send_sem,
-                "recv_sem": recv_sem}
+                "recv_sem": recv_sem, "tbl_s": tbl_s}
 
         # Scoreboard waits: block until every cross-core predecessor's
         # edge semaphore has been signalled (reference
@@ -465,12 +484,18 @@ class ModelBuilder:
         wait_edges = jnp.asarray(self.wait_edges)
         sig_edges = jnp.asarray(self.sig_edges)
 
-        def step(arena, k_cache, v_cache, token_ids, cache_len):
+        def step(arena, k_cache, v_cache, token_ids, cache_len,
+                 block_table=None):
             len_arr = jnp.asarray([cache_len], jnp.int32)
             tok_arr = jnp.asarray(token_ids, jnp.int32)
+            if block_table is None:
+                # Dense mode: a 1-element placeholder keeps the prefetch
+                # slot (and the traced signature) uniform.
+                block_table = jnp.zeros((1,), jnp.int32)
+            tbl_arr = jnp.asarray(block_table, jnp.int32).reshape(-1)
 
             grid_spec = pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=8,
+                num_scalar_prefetch=9,
                 grid=(self.qlen, self.num_cores),
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
                 out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
@@ -514,14 +539,14 @@ class ModelBuilder:
                     jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                     jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
                 ),
-                input_output_aliases={8: 0, 9: 1, 10: 2},
+                input_output_aliases={9: 0, 10: 1, 11: 2},
                 # A rankless megakernel traces no barrier: Mosaic
                 # rejects a collective_id without one.
                 compiler_params=(comm_compiler_params() if self.n > 1
                                  else pltpu.CompilerParams(
                                      has_side_effects=True)),
             )(types, args, wait_tab, sig_tab, wait_edges, sig_edges,
-              len_arr, tok_arr, arena, k_cache, v_cache)
+              len_arr, tok_arr, tbl_arr, arena, k_cache, v_cache)
 
             lt = self.vloc_tiles
             out_rows = jax.lax.dynamic_slice(

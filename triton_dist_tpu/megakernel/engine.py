@@ -27,17 +27,27 @@ class MegaKernelEngine:
                  max_len: int = 512, axis: str = "tp", params=None,
                  seed: int = 0, tile_w=None, t_tile=None,
                  keep_params: bool = False, prefill_seq: int = 0,
-                 num_cores: int = 1, strategy: str = "round_robin"):
+                 num_cores: int = 1, strategy: str = "round_robin",
+                 paged: bool = False, page=None, num_pages=None):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
         self.max_len = max_len
         self.batch = batch
+        self.paged = paged
+        if paged and page is None:
+            # One page size shared by the decode and prefill builders
+            # (they address the same pools): honor both alignment
+            # contracts (t_tile | page, prefill_seq | page).
+            import math
+            page = math.lcm(t_tile or min(128, max_len),
+                            prefill_seq if prefill_seq > 1 else 1)
         self.builder = ModelBuilder(cfg, mesh, batch=batch,
                                     max_len=max_len, axis=axis,
                                     tile_w=tile_w, t_tile=t_tile,
                                     num_cores=num_cores,
-                                    strategy=strategy)
+                                    strategy=strategy, paged=paged,
+                                    page=page)
         specs = dense.param_specs(cfg, axis)
         if params is None:
             params = dense.init_params(jax.random.PRNGKey(seed), cfg)
@@ -46,6 +56,7 @@ class MegaKernelEngine:
             params, specs)
 
         kvspec = P(None, None, None, axis, None)
+        tblspec = P(None)
         # Batched prefill shares the decode arena: both builders
         # allocate the (identical) weight region first, so offsets
         # coincide; the activation tail is per-run scratch and the
@@ -56,13 +67,15 @@ class MegaKernelEngine:
             self.prefill_builder = ModelBuilder(
                 cfg, mesh, batch=batch * prefill_seq, max_len=max_len,
                 axis=axis, tile_w=tile_w, t_tile=t_tile,
-                seq=prefill_seq, num_cores=num_cores, strategy=strategy)
+                seq=prefill_seq, num_cores=num_cores, strategy=strategy,
+                paged=paged, page=page)
             self.prefill_seq = prefill_seq
             pack_builder = self.prefill_builder
             pstep = self.prefill_builder.step_fn()
             self._prefill_step = jax.jit(jax.shard_map(
                 pstep, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None), P()),
+                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
+                          tblspec),
                 out_specs=(P(None, axis), P(axis, None), kvspec,
                            kvspec),
                 check_vma=False), donate_argnums=(0, 1, 2))
@@ -76,13 +89,30 @@ class MegaKernelEngine:
         step = self.builder.step_fn()
         self._step = jax.jit(jax.shard_map(
             step, mesh=mesh,
-            in_specs=(P(axis, None), kvspec, kvspec, P(None), P()),
+            in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
+                      tblspec),
             out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
             check_vma=False), donate_argnums=(0, 1, 2))
 
         n = mesh.shape[axis]
         kv = cfg.num_key_value_heads
-        shape = (cfg.num_hidden_layers, batch, max_len, kv, cfg.head_dim)
+        if paged:
+            # Page pools + identity block table (a serving layer swaps
+            # in its own allocator's table per call).
+            p_max = self.builder.p_max
+            self.num_pages = num_pages or batch * p_max
+            shape = (cfg.num_hidden_layers, self.num_pages,
+                     self.builder.page, kv, cfg.head_dim)
+            self.block_table = jnp.arange(batch * p_max, dtype=jnp.int32)
+            if self.num_pages < batch * p_max:
+                raise ValueError(
+                    f"num_pages {self.num_pages} < batch*p_max "
+                    f"{batch * p_max} (identity table needs one page "
+                    "per (batch, page index))")
+        else:
+            self.block_table = jnp.zeros((1,), jnp.int32)
+            shape = (cfg.num_hidden_layers, batch, max_len, kv,
+                     cfg.head_dim)
         self.k_cache = jax.device_put(
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
         self.v_cache = jax.device_put(
@@ -96,7 +126,7 @@ class MegaKernelEngine:
         logits, self._arena, self.k_cache, self.v_cache = self._step(
             self._arena, self.k_cache, self.v_cache,
             jnp.asarray(token_ids, jnp.int32),
-            jnp.asarray(cache_len, jnp.int32))
+            jnp.asarray(cache_len, jnp.int32), self.block_table)
         return logits
 
     def prefill_chain(self, prompt_ids):
@@ -116,6 +146,12 @@ class MegaKernelEngine:
         Requires ``prefill_seq=S`` at construction."""
         if self.prefill_builder is None:
             raise ValueError("engine built without prefill_seq")
+        if self.paged and int(start_pos) % self.prefill_seq:
+            # _kv_slice takes one slice per (batch, head) span; a base
+            # that is not seq-aligned could cross a page silently.
+            raise ValueError(
+                f"paged prefill needs start_pos % prefill_seq == 0 "
+                f"(got {start_pos} % {self.prefill_seq})")
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         bsz, s = prompt_ids.shape
         if s != self.prefill_seq or bsz != self.batch:
@@ -124,7 +160,8 @@ class MegaKernelEngine:
         logits, self._arena, self.k_cache, self.v_cache = (
             self._prefill_step(self._arena, self.k_cache, self.v_cache,
                                prompt_ids.reshape(-1),
-                               jnp.asarray(start_pos, jnp.int32)))
+                               jnp.asarray(start_pos, jnp.int32),
+                               self.block_table))
         return logits.reshape(bsz, s, -1)[:, -1]
 
     def generate(self, first_tokens, steps: int, *, start_pos: int = 0):

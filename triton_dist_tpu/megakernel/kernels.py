@@ -42,11 +42,32 @@ class KernelConfig:
     ar_ws_off: int          # arena row offset of the allreduce workspace
     ar_max_tiles: int       # max (B, W) tiles a single allreduce moves
     seq: int = 1            # rows per batch entry (prefill: B*S rows)
+    # Paged KV (reference mega_triton_kernel paged flash_decode task):
+    # the cache is a page pool (layers, n_pages, page, kv_loc, hd) and a
+    # per-batch block table maps page index -> pool slot.
+    paged: bool = False
+    page: int = 0           # page length (builder: t_tile | page, seq | page)
+    p_max: int = 0          # pages per sequence (max_len // page)
 
 
 def _act(arena, off, tiles_b):
     """Contiguous activation slab: ``tiles_b`` rows of the arena."""
     return arena.at[pl.ds(off, tiles_b)]
+
+
+def _kv_slice(cache, refs, cfg, layer, bb, start, span, kv_head):
+    """Cache slice (span, hd) of batch ``bb`` at global KV position
+    ``start``: dense direct index, or block-table indirection in paged
+    mode (pool slot ``tbl[bb, start // page]``, offset ``start % page``).
+    The builder guarantees spans never cross a page (t_tile | page,
+    seq | page, and page-aligned bases), so one slice is always enough —
+    the same alignment contract as ``ops/paged_flash_decode``."""
+    if not cfg.paged:
+        return cache.at[layer, bb, pl.ds(start, span), kv_head, :]
+    tbl_s = refs["tbl_s"]
+    pid = tbl_s[bb * cfg.p_max + start // cfg.page]
+    return cache.at[layer, pid,
+                    pl.ds(jax.lax.rem(start, cfg.page), span), kv_head, :]
 
 
 # ---------------------------------------------------------------------------
@@ -188,8 +209,18 @@ def write_kv_body(cfg, args, refs, len_s):
                 head = _rms_rows(head, wrow, cfg.rms_eps)
                 head = _rope_vec(head, pos, hd, cfg.rope_theta)
                 vhd[...] = head.astype(vhd.dtype)
-                pltpu.sync_copy(
-                    vhd, k_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                if not cfg.paged:
+                    # Dense layout stores all batches of one position
+                    # contiguously — one copy.
+                    pltpu.sync_copy(
+                        vhd,
+                        k_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                else:
+                    for bb in range(b):  # per-batch pages
+                        pltpu.sync_copy(
+                            vhd.at[pl.ds(bb, 1)],
+                            _kv_slice(k_cache, refs, cfg, layer, bb,
+                                      pos, 1, kv_head))
 
         pltpu.sync_copy(arena.at[pl.ds(v_off + j * b, b)], va)
         vt = va[...]
@@ -200,8 +231,16 @@ def write_kv_body(cfg, args, refs, len_s):
             @pl.when(kv_head < cfg.kv_loc)
             def _():
                 vhd[...] = vt[:, hh * hd:(hh + 1) * hd].astype(vhd.dtype)
-                pltpu.sync_copy(
-                    vhd, v_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                if not cfg.paged:
+                    pltpu.sync_copy(
+                        vhd,
+                        v_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                else:
+                    for bb in range(b):
+                        pltpu.sync_copy(
+                            vhd.at[pl.ds(bb, 1)],
+                            _kv_slice(v_cache, refs, cfg, layer, bb,
+                                      pos, 1, kv_head))
         return 0
 
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
@@ -254,8 +293,8 @@ def attn_decode_body(cfg, args, refs, len_s):
                 def tstep(tt, carry, bb=bb, q=q, kv_head=kv_head):
                     m, l, acc = carry
                     pltpu.sync_copy(
-                        k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
-                                   kv_head, :], vkt)
+                        _kv_slice(k_cache, refs, cfg, layer, bb,
+                                  tt * t_tile, t_tile, kv_head), vkt)
                     kt = vkt[...].astype(jnp.float32)   # (t_tile, hd)
                     s = jnp.dot(q[bb:bb + 1], kt.T,
                                 preferred_element_type=jnp.float32)
@@ -270,8 +309,8 @@ def attn_decode_body(cfg, args, refs, len_s):
                     corr = jnp.where(jnp.isfinite(m),
                                      jnp.exp(m - m_safe), 0.0)
                     pltpu.sync_copy(
-                        v_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
-                                   kv_head, :], vkt)
+                        _kv_slice(v_cache, refs, cfg, layer, bb,
+                                  tt * t_tile, t_tile, kv_head), vkt)
                     vt = vkt[...].astype(jnp.float32)
                     acc = acc * corr + jnp.dot(
                         p, vt, preferred_element_type=jnp.float32)
@@ -432,8 +471,8 @@ def write_kv_prefill_body(cfg, args, refs, len_s):
                     vsq[...] = head[bb * seq:(bb + 1) * seq].astype(
                         vsq.dtype)
                     pltpu.sync_copy(
-                        vsq, k_cache.at[layer, bb, pl.ds(base, seq),
-                                        kv_head, :])
+                        vsq, _kv_slice(k_cache, refs, cfg, layer, bb,
+                                       base, seq, kv_head))
 
         pltpu.sync_copy(arena.at[pl.ds(v_off + j * rows, rows)], va)
         vt = va[...]
@@ -447,8 +486,8 @@ def write_kv_prefill_body(cfg, args, refs, len_s):
                     vsq[...] = vt[bb * seq:(bb + 1) * seq,
                                   hh * hd:(hh + 1) * hd].astype(vsq.dtype)
                     pltpu.sync_copy(
-                        vsq, v_cache.at[layer, bb, pl.ds(base, seq),
-                                        kv_head, :])
+                        vsq, _kv_slice(v_cache, refs, cfg, layer, bb,
+                                       base, seq, kv_head))
         return 0
 
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
@@ -502,8 +541,8 @@ def attn_prefill_body(cfg, args, refs, len_s):
                 def tstep(tt, carry, bb=bb, qb=qb, kv_head=kv_head):
                     m, l, acc = carry
                     pltpu.sync_copy(
-                        k_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
-                                   kv_head, :], vkt)
+                        _kv_slice(k_cache, refs, cfg, layer, bb,
+                                  tt * t_tile, t_tile, kv_head), vkt)
                     kt = vkt[...].astype(jnp.float32)   # (t_tile, hd)
                     s = jnp.dot(qb, kt.T,
                                 preferred_element_type=jnp.float32)
@@ -519,8 +558,8 @@ def attn_prefill_body(cfg, args, refs, len_s):
                     corr = jnp.where(jnp.isfinite(m),
                                      jnp.exp(m - m_safe), 0.0)
                     pltpu.sync_copy(
-                        v_cache.at[layer, bb, pl.ds(tt * t_tile, t_tile),
-                                   kv_head, :], vkt)
+                        _kv_slice(v_cache, refs, cfg, layer, bb,
+                                  tt * t_tile, t_tile, kv_head), vkt)
                     vt = vkt[...].astype(jnp.float32)
                     acc = acc * corr + jnp.dot(
                         p, vt, preferred_element_type=jnp.float32)
