@@ -32,3 +32,67 @@ def test_perf_model_sanity():
     # Overlap bound in (0, 1]; big compute → full hiding.
     b = overlap_efficiency_bound(8192, 8192, 8192, 8)
     assert 0.0 < b <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Topology introspection (tools/topology.py)
+# ---------------------------------------------------------------------------
+
+class _FakeDev:
+    """Stub with the TPU device attribute surface."""
+
+    def __init__(self, id, coords, slice_index=0, kind="TPU v5p",
+                 process_index=0):
+        self.id = id
+        self.coords = coords
+        self.slice_index = slice_index
+        self.device_kind = kind
+        self.platform = "tpu"
+        self.process_index = process_index
+        self.core_on_chip = 0
+
+
+def test_topology_torus_hops_and_neighbors():
+    from triton_dist_tpu.tools import topology as T
+
+    # 4x2 torus, one slice.
+    devs = [_FakeDev(i, (i % 4, i // 4)) for i in range(8)]
+    mat = T.link_matrix(devs)
+    assert mat[0][0] == 0
+    assert mat[0][1] == 1           # +x neighbour
+    assert mat[0][3] == 1           # x wraps: 0 -> 3 is one hop
+    assert mat[0][4] == 1           # +y neighbour (y=2: no wrap gain)
+    assert mat[0][7] == 2           # (3,1): wrap x (1) + y (1)
+    nb = T.neighbors(devs)
+    assert set(nb[0]) == {1, 3, 4}  # 2-long y axis: single y link
+
+    dims = T.torus_dims(T.describe_devices(devs))
+    assert dims == (4, 2)
+
+
+def test_topology_slices_and_chip():
+    from triton_dist_tpu.tools import topology as T
+    from triton_dist_tpu.tools.perf_model import V5E
+
+    devs = ([_FakeDev(i, (i, 0), slice_index=0) for i in range(4)]
+            + [_FakeDev(4 + i, (i, 0), slice_index=1) for i in range(4)])
+    groups = T.slice_groups(devs)
+    assert groups == {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+    # Cross-slice pairs ride DCN: hop distance is None.
+    mat = T.link_matrix(devs)
+    assert mat[0][4] is None and mat[0][1] == 1
+
+    assert T.detect_chip([_FakeDev(0, (0, 0), kind="TPU v5 lite")]) is V5E
+    s = T.summary(devs)
+    assert s["num_devices"] == 8 and s["torus_dims"] == [4, 1]
+
+
+def test_topology_cpu_fallback():
+    """CPU/interpret devices (no coords) degrade gracefully."""
+    from triton_dist_tpu.tools import topology as T
+
+    infos = T.describe_devices(jax.devices()[:2])
+    assert all(i.coords is None for i in infos)
+    mat = T.link_matrix(jax.devices()[:2])
+    assert mat[0][0] == 0 and mat[0][1] == 1
+    assert T.summary(jax.devices()[:2])["num_devices"] == 2

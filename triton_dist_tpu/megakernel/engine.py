@@ -35,12 +35,15 @@ class MegaKernelEngine:
         self.max_len = max_len
         self.batch = batch
         self.paged = paged
+        # Resolve the tile once; both builders and the page default use
+        # the same value (no silently-divergent default formulas).
+        t_tile = t_tile or min(128, max_len)
         if paged and page is None:
             # One page size shared by the decode and prefill builders
             # (they address the same pools): honor both alignment
             # contracts (t_tile | page, prefill_seq | page).
             import math
-            page = math.lcm(t_tile or min(128, max_len),
+            page = math.lcm(t_tile,
                             prefill_seq if prefill_seq > 1 else 1)
         self.builder = ModelBuilder(cfg, mesh, batch=batch,
                                     max_len=max_len, axis=axis,
