@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 ITERS_LO, ITERS_HI = 8, 72
+ITERS_HI_FINAL = 200   # long final chains: slope error ~ noise / (hi-lo)
 REPEATS = 5
 SWEEP_REPEATS = 3
 
@@ -41,28 +42,29 @@ AG_GEMM_CONFIGS = (
 )
 
 
-def _timed_chain(step, a, b, repeats=REPEATS):
-    """step: (a, b) -> out; returns seconds/iter via two-point slope."""
+def _make_chain(step, iters):
     import jax
     import jax.numpy as jnp
 
-    def make_chain(iters):
-        @jax.jit
-        def chain(a, b):
-            def body(_, a):
-                out = step(a, b)
-                # Visible scalar bump: forces true sequential execution
-                # (an invisible-in-bf16 bump lets XLA hoist the op).
-                bump = (out.reshape(-1)[0].astype(jnp.float32) * 1e-3
-                        ).astype(a.dtype)
-                return jnp.clip(a + bump, -4.0, 4.0)
-            s = jax.lax.fori_loop(0, iters, body, a)
-            return jnp.sum(s.astype(jnp.float32))
-        return chain
+    @jax.jit
+    def chain(a, b):
+        def body(_, a):
+            out = step(a, b)
+            # Visible scalar bump: forces true sequential execution
+            # (an invisible-in-bf16 bump lets XLA hoist the op).
+            bump = (out.reshape(-1)[0].astype(jnp.float32) * 1e-3
+                    ).astype(a.dtype)
+            return jnp.clip(a + bump, -4.0, 4.0)
+        s = jax.lax.fori_loop(0, iters, body, a)
+        return jnp.sum(s.astype(jnp.float32))
+    return chain
 
+
+def _timed_chain(step, a, b, repeats=REPEATS):
+    """step: (a, b) -> out; returns seconds/iter via two-point slope."""
     times = {}
     for iters in (ITERS_LO, ITERS_HI):
-        chain = make_chain(iters)
+        chain = _make_chain(step, iters)
         v = np.asarray(chain(a, b))  # warmup/compile
         assert np.isfinite(v), "benchmark chain produced non-finite value"
         best = float("inf")
@@ -72,6 +74,38 @@ def _timed_chain(step, a, b, repeats=REPEATS):
             best = min(best, time.perf_counter() - t0)
         times[iters] = best
     return (times[ITERS_HI] - times[ITERS_LO]) / (ITERS_HI - ITERS_LO)
+
+
+def _timed_chain_group(entries, repeats=REPEATS, lo=ITERS_LO,
+                       hi=ITERS_HI_FINAL):
+    """Interleaved slope timing for a group of steps.
+
+    entries: {name: (step, a, b)} -> {name: seconds/iter}. Every repeat
+    samples EVERY chain back-to-back, so slow phases of the tunnel (or
+    the chip) hit numerator and denominator alike — the round-1 failure
+    mode was sequential timing letting drift between two measurements
+    swing the efficiency ratio +-15%.
+    """
+    chains = {}
+    for name, (step, a, b) in entries.items():
+        per = {}
+        for iters in (lo, hi):
+            c = _make_chain(step, iters)
+            v = np.asarray(c(a, b))  # warmup/compile
+            assert np.isfinite(v), f"chain {name!r} produced non-finite"
+            per[iters] = c
+        chains[name] = per
+    best = {name: {lo: float("inf"), hi: float("inf")}
+            for name in entries}
+    for _ in range(repeats):
+        for name, (step, a, b) in entries.items():
+            for iters in (lo, hi):
+                t0 = time.perf_counter()
+                np.asarray(chains[name][iters](a, b))
+                dt = time.perf_counter() - t0
+                best[name][iters] = min(best[name][iters], dt)
+    return {name: (best[name][hi] - best[name][lo]) / (hi - lo)
+            for name in entries}
 
 
 def main():
@@ -154,12 +188,6 @@ def main():
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-1)
     tune.store_autotune_data(tune_key, best_cfg, seconds=sweep[0][0])
 
-    # Final numbers: one full-repeat slope measurement each — same
-    # protocol for numerator and denominator so noise doesn't bias the
-    # ratio (the sweep samples only pick the config).
-    t_compute = max(_timed_chain(compute_step, a_full, b), 1e-9)
-    t_fused = max(_timed_chain(fused_step, a, b), 1e-9)
-
     # Secondary: GEMM+RS efficiency on the transposed problem.
     from triton_dist_tpu.ops import gemm_rs, create_gemm_rs_context
     rs_ctx = create_gemm_rs_context(mctx, block_m=1024, block_n=128,
@@ -178,7 +206,61 @@ def main():
             mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
             out_specs=P("tp", None), check_vma=False)(x, w)
 
-    t_rs = max(_timed_chain(rs_fused, a_rs, b_rs), 1e-9)
+    # Tertiary: SP ring-attention kernel efficiency vs XLA's own dense
+    # attention (the measurement the round-1 verdict flagged as missing
+    # for the SP/CP family). Single-chip only: at n > 1 the fused op
+    # solves a sequence-sharded n*S problem the dense chain doesn't —
+    # the ratio would compare different problems (a proper multi-chip
+    # attention benchmark needs sharded inputs + a global oracle).
+    group = {
+        "compute": (compute_step, a_full, b),
+        "fused": (fused_step, a, b),
+        "rs": (rs_fused, a_rs, b_rs),
+    }
+    if n == 1:
+        from triton_dist_tpu.ops import sp_ag_attention_fused
+        from triton_dist_tpu.ops.sp_ag_attention import _masked_attn
+
+        s_len, h_n, kvh_n, hd_n = 2048, 16, 8, 128
+        qa = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(4), (s_len, h_n, hd_n),
+                              dtype) * 0.3,
+            NamedSharding(mesh, P(None, None, None)))
+        kv_a = tuple(
+            jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(5 + i),
+                                  (s_len, kvh_n, hd_n), dtype) * 0.3,
+                NamedSharding(mesh, P(None, None, None)))
+            for i in range(2))
+
+        def attn_fused(q_, kv_):
+            return jax.shard_map(
+                lambda qq, kk, vv: sp_ag_attention_fused(
+                    qq, kk, vv, ctx=mctx, axis="tp", force_kernel=True),
+                mesh=mesh, in_specs=(P(None, None, None),) * 3,
+                out_specs=P(None, None, None), check_vma=False)(q_, *kv_)
+
+        def attn_xla(q_, kv_):
+            return _masked_attn(q_, kv_[0], kv_[1], 0).astype(q_.dtype)
+
+        # Correctness gate before timing (same policy as ag_gemm above:
+        # a fast wrong kernel is worthless).
+        np.testing.assert_allclose(
+            np.asarray(attn_fused(qa, kv_a), np.float32),
+            np.asarray(attn_xla(qa, kv_a), np.float32),
+            rtol=3e-2, atol=3e-2)
+        group["attn_fused"] = (attn_fused, qa, kv_a)
+        group["attn_xla"] = (attn_xla, qa, kv_a)
+
+    # Final numbers: every chain interleaved in ONE measurement group —
+    # numerator and denominator see the same tunnel/chip conditions.
+    times = _timed_chain_group(group)
+    t_compute = max(times["compute"], 1e-9)
+    t_fused = max(times["fused"], 1e-9)
+    t_rs = max(times["rs"], 1e-9)
+    t_attn_fused = max(times.get("attn_fused", 0.0), 1e-9)
+    t_attn_xla = times.get("attn_xla")
+
     eff = t_compute / t_fused
     flops = 2 * m_full * k_dim * n_dim / max(n, 1)
     print(json.dumps({
@@ -194,6 +276,13 @@ def main():
             "fused_tflops_per_chip": round(flops / t_fused / 1e12, 2),
             "gemm_rs_ms": round(t_rs * 1e3, 3),
             "gemm_rs_efficiency": round(float(t_compute / t_rs), 4),
+            "sp_attn_fused_ms": (round(t_attn_fused * 1e3, 3)
+                                 if t_attn_xla else None),
+            "sp_attn_xla_ms": (round(t_attn_xla * 1e3, 3)
+                               if t_attn_xla else None),
+            "sp_attn_kernel_efficiency": (
+                round(float(t_attn_xla / t_attn_fused), 4)
+                if t_attn_xla else None),
             "shape_m_k_n": [m_full, k_dim, n_dim],
             "best_config": best_cfg,
             "swept_ms": {f"{c['block_m']}x{c['block_n']}x{c['block_k']}":

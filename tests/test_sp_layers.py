@@ -47,6 +47,14 @@ def test_ulysses_layer_vs_dense(tp8_mesh, tp8_ctx):
     expected = o.reshape(s, h * hd) @ params["wo"]
     assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
 
+    # The fused path (QKV-GEMM+A2A / O-A2A+GEMM kernels) must match the
+    # same oracle — the layer switch for ops/ulysses_fused.
+    g = spmd(tp8_mesh,
+             lambda p, v: ulysses_sp.fwd(p, v, CFG, axis="tp",
+                                         ctx=tp8_ctx, impl="fused"),
+             (ulysses_sp.param_specs(), P("tp", None)), P("tp", None))
+    assert_allclose(g(params, x), expected, rtol=1e-4, atol=1e-4)
+
 
 def test_sp_flash_decode_layer(tp8_mesh, tp8_ctx):
     params = tp_attn.init(jax.random.PRNGKey(2), CFG)

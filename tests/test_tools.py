@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from triton_dist_tpu.tools import (
     compile_aot, load_aot, gemm_time_s, collective_time_s,
@@ -96,3 +97,28 @@ def test_topology_cpu_fallback():
     mat = T.link_matrix(jax.devices()[:2])
     assert mat[0][0] == 0 and mat[0][1] == 1
     assert T.summary(jax.devices()[:2])["num_devices"] == 2
+
+
+def test_aot_cache_manifest(tmp_path):
+    """AOT bundle: multiple named kernels, manifest round-trip through
+    a FRESH cache object, signature validation on call."""
+    import jax.numpy as jnp
+    from triton_dist_tpu.tools.aot import AOTCache
+
+    cache = AOTCache(str(tmp_path / "aot"))
+    x = jnp.ones((8, 16), jnp.float32)
+    y = jnp.ones((16, 4), jnp.float32)
+    cache.add("matmul", lambda a, b: a @ b, (x, y))
+    cache.add("double", lambda a: a * 2.0, (x,))
+    assert cache.names() == ["double", "matmul"]
+
+    fresh = AOTCache(str(tmp_path / "aot"))  # rehydrate from disk only
+    out = fresh.call("matmul", x, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ y))
+    np.testing.assert_allclose(np.asarray(fresh.call("double", x)),
+                               2.0 * np.asarray(x))
+
+    with pytest.raises(TypeError, match="signature mismatch"):
+        fresh.call("matmul", y, x)
+    with pytest.raises(KeyError):
+        fresh.get("missing")

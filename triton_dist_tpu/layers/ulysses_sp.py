@@ -36,7 +36,17 @@ def param_specs() -> Dict:
 
 def fwd(params, x, cfg, *, axis: str = "sp", ctx: MeshContext = None,
         impl: str = "pallas", causal: bool = True):
-    """x: (S_loc, d) sequence-sharded along ``axis`` → same layout out."""
+    """x: (S_loc, d) sequence-sharded along ``axis`` → same layout out.
+
+    ``impl``: "xla" (lax.all_to_all transport), "pallas" (direct-put
+    A2A kernel), or "fused" — the QKV projection scatters tiles to
+    their head-owners as the GEMM produces them and the O projection
+    consumes arriving partials under the MXU
+    (``ops/ulysses_fused``, the reference's defining Ulysses kernels).
+    """
+    if impl == "fused":
+        return _fwd_fused(params, x, cfg, axis=axis, ctx=ctx,
+                          causal=causal)
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     hd = cfg.head_dim
@@ -60,3 +70,38 @@ def fwd(params, x, cfg, *, axis: str = "sp", ctx: MeshContext = None,
     o = post_attn_a2a(o, axis=axis, ctx=ctx, impl=impl)
 
     return jnp.dot(o.reshape(s_loc, h * hd), params["wo"]).astype(x.dtype)
+
+
+def _fwd_fused(params, x, cfg, *, axis: str, ctx: MeshContext,
+               causal: bool):
+    """Fused path: GEMM+A2A both directions (``ulysses_attn_fused``);
+    q/k norm + rope applied on the post-A2A full-sequence heads via the
+    ``qk_transform`` hook (elementwise per (position, head), so the
+    order swap with the transport is exact)."""
+    from triton_dist_tpu.ops.ulysses_fused import (
+        create_ulysses_fused_context, ulysses_attn_fused,
+        group_qkv_columns, group_o_rows)
+
+    n = ctx.size(axis)
+    hd = cfg.head_dim
+    h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+    s = n * x.shape[0]
+    fctx = create_ulysses_fused_context(ctx, axis=axis)
+
+    # Group the projection columns by owner rank (serving code should
+    # pre-group once; under jit on constant params XLA folds this).
+    w_qkv = group_qkv_columns(
+        jnp.concatenate([params["wq"], params["wk"], params["wv"]],
+                        axis=1),
+        n=n, num_heads=h, num_kv_heads=kvh, head_dim=hd)
+    w_o = group_o_rows(params["wo"], n=n, num_heads=h, head_dim=hd)
+
+    def norm_rope(q, k):
+        positions = jnp.arange(s)[None]  # global positions, src-major
+        q, k = tp_attn._norm_rope(q[None], k[None], params, cfg,
+                                  positions)
+        return q[0], k[0]
+
+    return ulysses_attn_fused(
+        x, w_qkv, w_o, fctx, num_heads=h, num_kv_heads=kvh, head_dim=hd,
+        causal=causal, qk_transform=norm_rope).astype(x.dtype)

@@ -398,12 +398,15 @@ def group_o_rows(w_o, *, n: int, num_heads: int, head_dim: int):
 def ulysses_attn_fused(x, w_qkv_grouped, w_o_grouped, ctx:
                        UlyssesFusedContext, *, num_heads: int,
                        num_kv_heads: int, head_dim: int,
-                       causal: bool = True):
+                       causal: bool = True, qk_transform=None):
     """Full fused Ulysses attention block: qkv_gemm_a2a → attention on
     my heads over the full sequence → o_a2a_gemm.
 
-    x: (S_loc, d). Returns (S_loc, d). The reference composes the same
-    pair around its FA kernel (``sp_ulysess_qkv_gemm_all2all.py`` +
+    x: (S_loc, d). Returns (S_loc, d). ``qk_transform(q, k)`` (full-
+    sequence (S, heads, hd) values) lets layers insert per-position
+    head transforms (q/k norm + rope) between the A2A and the
+    attention. The reference composes the same pair around its FA
+    kernel (``sp_ulysess_qkv_gemm_all2all.py`` +
     ``sp_ulysess_o_all2all_gemm.py``)."""
     from triton_dist_tpu.layers.tp_attn import sdpa
 
@@ -418,5 +421,7 @@ def ulysses_attn_fused(x, w_qkv_grouped, w_o_grouped, ctx:
     k = qkv[:, h_loc * head_dim:(h_loc + kv_loc) * head_dim
             ].reshape(s, kv_loc, head_dim)
     v = qkv[:, (h_loc + kv_loc) * head_dim:].reshape(s, kv_loc, head_dim)
+    if qk_transform is not None:
+        q, k = qk_transform(q, k)
     o = sdpa(q[None], k[None], v[None], causal=causal)[0]  # (S, h_loc, hd)
     return o_a2a_gemm(o.reshape(s, h_loc * head_dim), w_o_grouped, ctx)

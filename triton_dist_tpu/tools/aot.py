@@ -52,3 +52,79 @@ def load_aot(path: str) -> AOTExecutable:
     with open(path, "rb") as f:
         blob = f.read()
     return AOTExecutable(jexport.deserialize(blob))
+
+
+class AOTCache:
+    """A directory of exported kernels with a manifest — the analogue of
+    the reference's AOT bundle (``tools/compile_aot.py`` compiles a
+    *list* of kernels into C sources + cubins consumed by a name-keyed
+    runtime cache, ``triton_aot_runtime.h:33``).
+
+    Layout: ``<dir>/manifest.json`` mapping name → {file, args
+    signature, jax version}; one ``.jaxexport`` blob per kernel.
+    ``get`` validates the call signature against the manifest (shape /
+    dtype mismatches raise instead of mis-executing — the runtime-side
+    argument checks the reference generates into its C stubs) and the
+    recorded jax version (serialized StableHLO has bounded
+    forward-compat).
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self._manifest_path = os.path.join(directory, "manifest.json")
+        self._loaded = {}
+
+    def _read_manifest(self) -> dict:
+        import json
+
+        if not os.path.exists(self._manifest_path):
+            return {}
+        with open(self._manifest_path) as f:
+            return json.load(f)
+
+    @staticmethod
+    def _sig(args) -> list:
+        return [[list(a.shape), str(a.dtype)] if hasattr(a, "shape")
+                else [None, repr(a)] for a in args]
+
+    def add(self, name: str, fn: Callable, example_args: Sequence,
+            *, platforms: Sequence[str] = None) -> str:
+        """Export ``fn`` under ``name`` and record it in the manifest."""
+        import json
+
+        path = os.path.join(self.dir, f"{name}.jaxexport")
+        compile_aot(fn, example_args, path, platforms=platforms)
+        manifest = self._read_manifest()
+        manifest[name] = {"file": os.path.basename(path),
+                          "signature": self._sig(example_args),
+                          "jax": jax.__version__}
+        with open(self._manifest_path, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        self._loaded.pop(name, None)
+        return path
+
+    def names(self):
+        return sorted(self._read_manifest())
+
+    def get(self, name: str) -> AOTExecutable:
+        manifest = self._read_manifest()
+        if name not in manifest:
+            raise KeyError(
+                f"{name!r} not in AOT cache {self.dir} "
+                f"(have {sorted(manifest)})")
+        if name not in self._loaded:
+            self._loaded[name] = load_aot(
+                os.path.join(self.dir, manifest[name]["file"]))
+        return self._loaded[name]
+
+    def call(self, name: str, *args):
+        """Signature-checked call (the generated-stub arg validation)."""
+        entry = self._read_manifest()[name]
+        got = self._sig(args)
+        want = entry["signature"]
+        if [g for g in got if g[0] is not None] != \
+                [w for w in want if w[0] is not None]:
+            raise TypeError(
+                f"AOT kernel {name!r} signature mismatch: exported "
+                f"{want}, called with {got}")
+        return self.get(name)(*args)
