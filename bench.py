@@ -358,6 +358,17 @@ def battery():
         out = np.asarray(f(x), np.float32)
         np.testing.assert_allclose(out, np.asarray(x, np.float32))
 
+    def run_fast_allgather():
+        # push_2d exercises the factored-grid _push_nd_kernel (push_1d
+        # delegates to the full-mesh AG already covered above).
+        x = jax.random.normal(k0, (128, 4096), dt)  # decode-shape msg
+        f = sm(lambda v: ops.fast_allgather(v, ctx=mctx, axis="tp",
+                                            mode="push_2d",
+                                            force_kernel=True),
+               (P(None, None),))
+        out = np.asarray(f(x), np.float32)
+        np.testing.assert_allclose(out, np.asarray(x, np.float32))
+
     def run_ll_a2a():
         # Decode-shape message (the op's contract: whole chunks stage
         # in VMEM; big payloads belong on all_to_all).
@@ -477,6 +488,7 @@ def battery():
         ("allgather_ring", run_allgather("ring")),
         ("allgather_full_mesh", run_allgather("full_mesh")),
         ("all_to_all", run_a2a),
+        ("fast_allgather_push", run_fast_allgather),
         ("ll_a2a_int8", run_ll_a2a),
         ("moe_reduce_rs", run_moe_rs),
         ("a2a_gemm_fused", run_a2a_gemm_fused),

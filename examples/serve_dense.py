@@ -27,6 +27,9 @@ def main():
     ap.add_argument("--mode", default="fused",
                     choices=["xla", "fused", "fused_ar"])
     ap.add_argument("--megakernel", action="store_true")
+    ap.add_argument("--paged", action="store_true",
+                    help="megakernel paged-KV cache (page pool + block "
+                         "table) instead of the dense cache")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -55,7 +58,8 @@ def main():
         mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
         max_len = -(-(args.prompt_len + args.gen_len) // 16) * 16
         eng = MegaKernelEngine(cfg, mesh1d, batch=args.batch,
-                               max_len=max_len, tile_w=16, t_tile=16)
+                               max_len=max_len, tile_w=16, t_tile=16,
+                               paged=args.paged)
         t0 = time.perf_counter()
         seed = eng.prefill_chain(ids)
         toks = np.asarray(eng.generate(seed, steps=args.gen_len,

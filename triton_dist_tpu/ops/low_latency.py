@@ -137,7 +137,7 @@ def _block_rank(me, b, dims: Sequence[int], strides: Sequence[int],
 
 
 def fast_allgather(x, *, ctx: MeshContext, axis: str = "tp",
-                   mode: str = "push_1d"):
+                   mode: str = "push_1d", force_kernel: bool = False):
     """Latency-optimized AllGather for small messages (decode path).
 
     mode: "push_1d" (direct, 1 hop), "push_2d" / "push_3d" (factored
@@ -146,7 +146,7 @@ def fast_allgather(x, *, ctx: MeshContext, axis: str = "tp",
     with push-only TPU remote DMA.
     """
     n = ctx.size(axis)
-    if n == 1:
+    if n == 1 and not force_kernel:
         return x
     if mode == "pull":
         raise NotImplementedError(
@@ -155,7 +155,8 @@ def fast_allgather(x, *, ctx: MeshContext, axis: str = "tp",
             "low_latency_allgather.py:798)")
     if mode == "push_1d":
         from triton_dist_tpu.ops.allgather import all_gather
-        return all_gather(x, ctx=ctx, axis=axis, mode="full_mesh")
+        return all_gather(x, ctx=ctx, axis=axis, mode="full_mesh",
+                          force_kernel=force_kernel)
     ndims = {"push_2d": 2, "push_3d": 3}.get(mode)
     if ndims is None:
         raise ValueError(f"unknown fast_allgather mode {mode!r}")
