@@ -1,0 +1,51 @@
+"""Tutorial 10: kernel-level ring attention (long-context prefill).
+
+Reference: ``sp_ag_attention_intra_node.py`` (KV push + per-tile
+consumer waits) / ``_inter_node.py`` (node-staged relay). One Pallas
+kernel per rank: KV chunks are pushed at entry (causal prunes the send
+set), the query-tile grid consumes each chunk after ONE arrival-
+semaphore wait, and the hierarchical form crosses the slow (DCN) axis
+once per chunk via a mirror rank that relays in-kernel.
+Run: python tutorials/10_ring_attention.py
+"""
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu as tdt
+from triton_dist_tpu.layers.tp_attn import sdpa
+from triton_dist_tpu.ops import sp_ag_attention_fused, sp_ag_attention_2d
+from triton_dist_tpu.utils.testing import spmd
+
+s, h, hd = 64, 4, 16
+q = jax.random.normal(jax.random.PRNGKey(0), (s, h, hd)) * 0.3
+k = jax.random.normal(jax.random.PRNGKey(1), (s, h, hd)) * 0.3
+v = jax.random.normal(jax.random.PRNGKey(2), (s, h, hd)) * 0.3
+want = np.asarray(sdpa(q[None], k[None], v[None], causal=True)[0])
+
+# --- 1D: all 8 ranks on one (ICI) axis ---------------------------------
+mesh = tdt.make_mesh(sp=8)
+ctx = tdt.MeshContext.from_mesh(mesh)
+f = spmd(mesh,
+         lambda a, b, c: sp_ag_attention_fused(
+             a, b, c, ctx=ctx, axis="sp", block_q=4, block_kv=8),
+         (P("sp", None, None),) * 3, P("sp", None, None))
+out = np.asarray(f(q, k, v))
+print("1D fused ring attention max err:", np.abs(out - want).max())
+
+# --- 2D: sequence over dp (DCN) x sp (ICI), mirror+relay schedule ------
+mesh2 = tdt.make_mesh(dp=2, sp=4)
+ctx2 = tdt.MeshContext.from_mesh(mesh2)
+shard = P(("dp", "sp"), None, None)
+g = spmd(mesh2,
+         lambda a, b, c: sp_ag_attention_2d(
+             a, b, c, ctx=ctx2, inner_axis="sp", outer_axis="dp",
+             block_q=4, block_kv=8),
+         (shard,) * 3, shard)
+out2 = np.asarray(g(q, k, v))
+print("2D hierarchical ring attention max err:",
+      np.abs(out2 - want).max())
