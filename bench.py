@@ -411,6 +411,50 @@ def battery():
         out = f(tok, ids, w, wg, wu, wd)
         assert np.isfinite(np.asarray(out, np.float32)).all()
 
+    def run_grouped(op):
+        """Shared harness for the grouped-GEMM family: sorted-layout
+        prep, the op under test, and the tile-einsum oracle."""
+        def go():
+            e, d, ff, t, kk, tm = 8, 2048, 2048, 1024, 2, 256
+            x = jax.random.normal(k0, (t, d), dt)
+            ids = jax.random.randint(jax.random.PRNGKey(13), (t, kk),
+                                     0, e)
+            w = jax.random.normal(jax.random.PRNGKey(14), (e, d, ff),
+                                  dt) * 0.02
+            x_s, te, _ = jax.jit(
+                lambda a, b: ops.prepare_grouped_tokens(a, b, e, tm)
+            )(x, ids)
+            if op == "ag":
+                ctx = ops.create_ag_moe_context(
+                    mctx, num_experts=e, block_m=tm, block_n=512,
+                    block_k=1024)
+                f = sm(lambda a, ww, t_: ops.ag_group_gemm(
+                    a, ww, t_, ctx, force_kernel=True),
+                       (P(None, None), P(None, None, None), P(None)))
+            else:
+                f = jax.jit(lambda a, ww, t_: ops.grouped_gemm_tiles(
+                    a, ww, t_, block_n=512, block_k=1024))
+            out = np.asarray(f(x_s, w, te), np.float32)
+            tiles = np.asarray(x_s, np.float32).reshape(-1, tm, d)
+            want = np.einsum("ima,iaf->imf", tiles,
+                             np.asarray(w, np.float32)[np.asarray(te)])
+            np.testing.assert_allclose(out, want.reshape(out.shape),
+                                       rtol=3e-2, atol=3.0)
+        return go
+
+    def run_moe_ar():
+        y = jax.random.normal(k0, (128, 8, 2048), dt)
+        w = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(17), (128, 8)), -1)
+        f = sm(lambda yy, ww: ops.moe_reduce_ar(yy, ww, ctx=mctx,
+                                                axis="tp", block_n=512,
+                                                force_kernel=True),
+               (P(None, None, None), P(None, None)))
+        out = np.asarray(f(y, w), np.float32)
+        want = np.einsum("tkd,tk->td", np.asarray(y, np.float32),
+                         np.asarray(w, np.float32))
+        np.testing.assert_allclose(out, want, rtol=3e-2, atol=3e-1)
+
     def run_a2a_gemm_fused():
         x = jax.random.normal(k0, (1, 1024, 4096), dt)
         f = sm(lambda v, w: ops.a2a_gemm_fused(
@@ -491,6 +535,9 @@ def battery():
         ("fast_allgather_push", run_fast_allgather),
         ("ll_a2a_int8", run_ll_a2a),
         ("moe_reduce_rs", run_moe_rs),
+        ("moe_reduce_ar", run_moe_ar),
+        ("ag_group_gemm", run_grouped("ag")),
+        ("grouped_gemm_tiles", run_grouped("local")),
         ("a2a_gemm_fused", run_a2a_gemm_fused),
         ("sp_ag_attention_fused", run_sp_ag_attention_fused),
         ("ep_moe_fused", run_ep_fused),

@@ -180,6 +180,60 @@ def test_moe_reduce_rs_vs_oracle(tp8_mesh, tp8_ctx):
     assert_allclose(f(y, w), g(y, w), rtol=1e-5, atol=1e-5)
 
 
+def test_moe_reduce_ar_vs_oracle(tp8_mesh, tp8_ctx):
+    """Fused weighted-combine + one-shot allreduce == XLA combine +
+    psum (reference moe_reduce_ar small-batch epilogue)."""
+    from triton_dist_tpu.ops.moe_reduce import (
+        moe_reduce_ar, moe_reduce_ar_ref,
+    )
+
+    y = _rand((8, 2, 64), 52)    # small T: the decode regime
+    w = jax.nn.softmax(_rand((8, 2), 53), axis=-1)
+
+    f = spmd(tp8_mesh,
+             lambda yy, ww: moe_reduce_ar(yy, ww, ctx=tp8_ctx, axis="tp",
+                                          block_n=16),
+             (P(None, None, None), P(None, None)), P(None, None))
+    g = spmd(tp8_mesh,
+             lambda yy, ww: moe_reduce_ar_ref(yy, ww, axis="tp"),
+             (P(None, None, None), P(None, None)), P(None, None))
+    assert_allclose(f(y, w), g(y, w), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("epilogue", ["rs", "ar"])
+def test_tp_moe_fully_fused_vs_layer(tp8_mesh, tp8_ctx, epilogue):
+    """AG-fused grouped GEMM + Pallas down-proj + fused epilogue == the
+    unfused layer path (reference allgather_group_gemm + moe_reduce_*
+    pipeline)."""
+    cfg = ModelConfig.tiny_moe()
+    params = ep_moe.init(jax.random.PRNGKey(62), cfg)
+    tokens = _rand((64, cfg.hidden_size), 63)
+
+    fused = spmd(
+        tp8_mesh,
+        lambda p, t: tp_moe.fwd_fused(
+            p, t, topk=cfg.num_experts_per_tok,
+            num_experts=cfg.num_experts, mesh_ctx=tp8_ctx, axis="tp",
+            # block_m=4 keeps the ring workspace under the ~96 KB ceiling
+            # where the CPU interpret harness can deadlock (large
+            # callback copies starve the 1-thread XLA CPU pool).
+            block_m=4, epilogue=epilogue),
+        (tp_moe.param_specs("tp"), P("tp", None)),
+        P("tp", None) if epilogue == "rs" else P(None, None))(
+            params, tokens)
+    plain = spmd(
+        tp8_mesh,
+        lambda p, t: tp_moe.fwd(
+            p, t, topk=cfg.num_experts_per_tok,
+            num_experts=cfg.num_experts, axis="tp"),
+        (tp_moe.param_specs("tp"), P("tp", None)),
+        P("tp", None))(params, tokens)
+    # "ar" returns the full (T, d) replicated; out_specs gather the
+    # "rs" path to the same full shape at the host, so both compare
+    # directly against the plain layer output.
+    assert_allclose(fused, plain, rtol=2e-4, atol=2e-4)
+
+
 def test_tp_moe_layer_fused_epilogue(tp8_mesh, tp8_ctx):
     """TP-MoE with the fused moe_reduce_rs epilogue == the psum_scatter
     layer path."""

@@ -60,3 +60,70 @@ def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
                          topk_w.astype(jnp.float32))
     return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
                                 tiled=True).astype(x.dtype)
+
+
+def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
+              axis: str = "tp", block_m: int = 64, block_n: int = 256,
+              block_k: int = 512, norm_topk_prob: bool = True,
+              epilogue: str = "rs"):
+    """Fully-fused TP-MoE forward: AG fused into the gate/up grouped
+    GEMM (:func:`~triton_dist_tpu.ops.ag_moe.ag_group_gemm`), Pallas
+    down-projection in the sorted layout, and a fused combine epilogue —
+    the reference's ``allgather_group_gemm.py`` + ``moe_reduce_rs.py``
+    (``epilogue="rs"``) / ``moe_reduce_ar.py`` (``epilogue="ar"``)
+    pipeline. The *activation* tensors never ride an XLA collective;
+    routing metadata (tile→expert maps, source indices, top-k weights —
+    a few KB) still allgathers in XLA, and the un-sort back to flat
+    token order is an XLA scatter-add.
+
+    x: (T_loc, d) token-sharded along ``axis``. Returns (T_loc, d)
+    token-sharded for ``"rs"``; the full replicated (n·T_loc, d) for
+    ``"ar"`` (decode: every rank needs the activations).
+    """
+    from triton_dist_tpu.ops.ag_moe import (
+        create_ag_moe_context, ag_group_gemm, prepare_grouped_tokens,
+    )
+    from triton_dist_tpu.ops.group_gemm import grouped_gemm_tiles
+    from triton_dist_tpu.ops.moe_reduce import moe_reduce_ar, moe_reduce_rs
+
+    if epilogue not in ("rs", "ar"):
+        raise ValueError(f"unknown epilogue {epilogue!r} "
+                         "(expected 'rs' or 'ar')")
+    n = mesh_ctx.size(axis)
+    t_loc, d = x.shape
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+    x_s, te, row_src = prepare_grouped_tokens(x, topk_ids, num_experts,
+                                              block_m)
+    s_loc = x_s.shape[0]
+
+    w_gu = jnp.concatenate([params["w_gate"], params["w_up"]], axis=-1)
+    f_loc = params["w_gate"].shape[-1]
+    agctx = create_ag_moe_context(
+        mesh_ctx, num_experts=num_experts, axis=axis, block_m=block_m,
+        block_n=min(block_n, 2 * f_loc), block_k=min(block_k, d))
+    h = ag_group_gemm(x_s, w_gu, te, agctx)          # (S_full, 2·F_loc)
+    g, u = h[:, :f_loc], h[:, f_loc:]
+    act = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
+           ).astype(x.dtype)
+
+    te_all = jax.lax.all_gather(te, axis, axis=0, tiled=True)
+    y_sorted = grouped_gemm_tiles(
+        act, params["w_down"], te_all,
+        block_n=min(block_n, d), block_k=min(block_k, f_loc))
+
+    # Un-sort the gathered rows to (T_full, K, d) flat order; padding
+    # rows add zero into row 0.
+    src_all = jax.lax.all_gather(row_src, axis, axis=0, tiled=True)
+    chunk_base = jnp.repeat(
+        jnp.arange(n, dtype=jnp.int32) * (t_loc * topk), s_loc)
+    valid = src_all >= 0
+    gsrc = jnp.where(valid, src_all + chunk_base, 0)
+    y = jnp.zeros((n * t_loc * topk, d), y_sorted.dtype).at[gsrc].add(
+        jnp.where(valid[:, None], y_sorted, 0))
+    y = y.reshape(n * t_loc, topk, d)
+
+    w_full = jax.lax.all_gather(topk_w, axis, axis=0, tiled=True)
+    if epilogue == "ar":
+        return moe_reduce_ar(y, w_full, ctx=mesh_ctx, axis=axis)
+    return moe_reduce_rs(y, w_full, ctx=mesh_ctx, axis=axis)

@@ -40,7 +40,11 @@ from triton_dist_tpu.ops.ep_fused import (  # noqa: F401
     ep_gemm_combine, ep_moe_fused,
 )
 from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
-    grouped_gemm, grouped_swiglu, sort_by_expert,
+    grouped_gemm, grouped_gemm_tiles, grouped_swiglu, sort_by_expert,
+)
+from triton_dist_tpu.ops.ag_moe import (  # noqa: F401
+    AGMoEContext, create_ag_moe_context, ag_group_gemm, ag_moe_ref,
+    prepare_grouped_tokens, padded_rows,
 )
 from triton_dist_tpu.ops.ulysses import (  # noqa: F401
     pre_attn_a2a, post_attn_a2a, ulysses_attn,
@@ -53,7 +57,7 @@ from triton_dist_tpu.ops.low_latency import (  # noqa: F401
     fast_allgather, ll_a2a,
 )
 from triton_dist_tpu.ops.moe_reduce import (  # noqa: F401
-    moe_reduce_rs, moe_reduce_rs_ref,
+    moe_reduce_rs, moe_reduce_rs_ref, moe_reduce_ar, moe_reduce_ar_ref,
 )
 from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
     paged_flash_decode, page_attend,

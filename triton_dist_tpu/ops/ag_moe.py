@@ -1,0 +1,331 @@
+"""Fused AllGather + grouped GEMM (AG-MoE, tensor-parallel MoE prologue).
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_group_gemm.py``
+(996 LoC — ``ag_group_gemm``: token shards are allgathered while the
+persistent grouped GEMM consumes already-arrived shards, with a
+token-block swizzle mapping output tiles to experts) + the sorting
+helpers in ``moe_utils.py`` (:508).
+
+TPU redesign: the reference's dynamic token-block swizzle becomes a
+**static tile→expert map** fed through scalar prefetch:
+
+- Each rank sorts its (topk-replicated) tokens expert-major with every
+  expert segment padded to the row-tile size ``block_m``
+  (:func:`prepare_grouped_tokens`). Tile ``i`` of a chunk then belongs to
+  exactly one expert, so the weight BlockSpec's ``index_map`` can pick
+  ``w[tile_expert[c, i]]`` — XLA's pipeline prefetches the right expert's
+  weight tile with zero in-kernel control flow (the TPU answer to the
+  reference's per-tile ``expert_id`` loads).
+- The ring schedule is :func:`~triton_dist_tpu.ops.ag_gemm.ag_gemm`'s:
+  grid step ``k`` computes the chunk owned by rank ``(me - k) % n``; my
+  own chunk starts the MXU immediately, each received chunk is certified
+  by one DMA-semaphore arrival and forwarded right while it is consumed.
+- The per-rank tile→expert maps are tiny ``(n, S/block_m)`` int32 —
+  allgathered in XLA up front (the reference ships its splits via
+  ``get_ag_splits_and_recv_offset_for_dispatch``-style metadata
+  exchange, ``ep_all2all_fused.py:1924``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.lang as dl
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.parallel.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class AGMoEContext:
+    """Analogue of the reference's ``MoE_AllGatherGroupGEMMTensorParallelContext``
+    (``allgather_group_gemm.py``)."""
+    mesh: MeshContext
+    axis: str = "tp"
+    num_experts: int = 8
+    block_m: int = 128
+    block_n: int = 256
+    block_k: int = 512
+    out_dtype: Optional[jnp.dtype] = None
+
+
+def create_ag_moe_context(mesh: MeshContext, *, num_experts: int,
+                          axis: str = "tp", block_m: int = 128,
+                          block_n: int = 256, block_k: int = 512,
+                          out_dtype=None) -> AGMoEContext:
+    return AGMoEContext(mesh=mesh, axis=axis, num_experts=num_experts,
+                        block_m=block_m, block_n=block_n,
+                        block_k=block_k, out_dtype=out_dtype)
+
+
+def padded_rows(num_tokens: int, topk: int, num_experts: int,
+                block_m: int) -> int:
+    """Static row count of the sorted layout: every expert segment is
+    padded up to a multiple of ``block_m``, so the worst case adds
+    ``block_m - 1`` rows per expert."""
+    total = num_tokens * topk + num_experts * (block_m - 1)
+    return -(-total // block_m) * block_m
+
+
+def prepare_grouped_tokens(x, topk_ids, num_experts: int, block_m: int
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort topk-replicated tokens expert-major with ``block_m``-aligned
+    expert segments (the static-shape analogue of the reference's
+    ``moe_utils.py`` token sort + block alignment via the host CUDA op
+    ``moe_ag_scatter_align_block_size``).
+
+    x: (T, d); topk_ids: (T, K).
+    Returns ``(x_sorted (S, d), tile_expert (S//block_m,) int32,
+    row_src (S,) int32)`` where ``row_src[r]`` is the flat (token·K + k)
+    assignment a sorted row came from, or -1 for padding rows.
+    """
+    t, d = x.shape
+    k = topk_ids.shape[1]
+    e = num_experts
+    tm = block_m
+    flat = topk_ids.reshape(-1).astype(jnp.int32)          # (TK,)
+    tk_total = t * k
+    s_pad = padded_rows(t, k, e, tm)
+
+    counts = jnp.bincount(flat, length=e)                  # (E,)
+    pad_counts = (-(-counts // tm) * tm).astype(jnp.int32)
+    seg_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(pad_counts)[:-1].astype(jnp.int32)])
+    one_hot = jax.nn.one_hot(flat, e, dtype=jnp.int32)
+    rank_within = jnp.take_along_axis(
+        jnp.cumsum(one_hot, axis=0) - 1, flat[:, None], axis=1)[:, 0]
+    dest = seg_off[flat] + rank_within                     # (TK,)
+
+    x_rep = jnp.repeat(x, k, axis=0)
+    x_sorted = jnp.zeros((s_pad, d), x.dtype).at[dest].set(x_rep)
+    row_src = jnp.full((s_pad,), -1, jnp.int32).at[dest].set(
+        jnp.arange(tk_total, dtype=jnp.int32))
+
+    bounds = jnp.cumsum(pad_counts)                        # (E,)
+    n_tiles = s_pad // tm
+    tile_expert = jnp.searchsorted(
+        bounds, jnp.arange(n_tiles, dtype=jnp.int32) * tm, side="right"
+    ).astype(jnp.int32)
+    # Tail tiles past the last used row compute garbage against the last
+    # expert; their rows carry row_src == -1 and are dropped on unsort.
+    tile_expert = jnp.minimum(tile_expert, e - 1)
+    return x_sorted, tile_expert, row_src
+
+
+def ag_moe_ref(x_sorted, w, tile_expert, *, axis: str = "tp"):
+    """Oracle: XLA allgather + per-tile dense matmul."""
+    x_full = jax.lax.all_gather(x_sorted, axis, axis=0, tiled=True)
+    te_full = jax.lax.all_gather(tile_expert, axis, axis=0, tiled=True)
+    tm = x_sorted.shape[0] // tile_expert.shape[0]
+    tiles = x_full.reshape(-1, tm, x_full.shape[-1])
+    out = jnp.einsum("ima,iaf->imf", tiles.astype(jnp.float32),
+                     w[te_full].astype(jnp.float32))
+    return out.reshape(x_full.shape[0], w.shape[-1]).astype(x_sorted.dtype)
+
+
+def _ag_moe_kernel(te_ref, a_ref, b_ref, o_ref, a_ws, a_panel, acc_v,
+                   send_sem, recv_sem, panel_sem, *, axis: str,
+                   ctx: MeshContext, s_loc: int, tm: int, tk: int,
+                   n_ranks: int, n_buf: int):
+    """Grid (n, n_i, n_j, n_k) — ``ag_gemm``'s ring-in-grid schedule;
+    the expert weight tile rides the BlockSpec pipeline, selected by the
+    prefetched tile→expert map (``te_ref`` is consumed by the index
+    maps; the body only orchestrates the ring + row panels)."""
+    del te_ref  # consumed by the weight/output index maps
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    c = jax.lax.rem(me - k + n, n)
+    right = jax.lax.rem(me + 1, n)
+
+    chunk_of = lambda r: a_ws.at[pl.ds(r * s_loc, s_loc)]
+
+    first = jnp.logical_and(
+        k == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_tile(axis, ctx=ctx)
+        if n > 1:
+            dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
+                          recv_sem.at[0], right, axis=axis, ctx=ctx)
+
+    chunk_start = jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0))
+
+    @pl.when(jnp.logical_and(k > 0, chunk_start))
+    def _():
+        dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(c), 1)
+
+        @pl.when(k < n - 1)
+        def _():
+            dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
+                          recv_sem.at[k], right, axis=axis, ctx=ctx)
+
+    def start_panel_copy(ii, buf):
+        @pl.when(k == 0)
+        def _():
+            pltpu.make_async_copy(a_ref.at[pl.ds(ii * tm, tm)],
+                                  a_panel.at[buf], panel_sem).start()
+
+        @pl.when(k > 0)
+        def _():
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(c * s_loc + ii * tm, tm)],
+                a_panel.at[buf], panel_sem).start()
+
+    def wait_panel(buf):
+        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
+                              panel_sem).wait()
+
+    buf = jax.lax.rem(i, n_buf) if n_buf > 1 else 0
+
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _():
+        if n_buf == 1:
+            start_panel_copy(i, 0)
+            wait_panel(0)
+        else:
+            @pl.when(i == 0)
+            def _():
+                start_panel_copy(i, buf)
+            wait_panel(buf)
+
+            @pl.when(i + 1 < n_i)
+            def _():
+                start_panel_copy(i + 1, jax.lax.rem(i + 1, n_buf))
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_panel[buf, :, pl.ds(kk * tk, tk)], b_ref[0],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+    last = jnp.logical_and(
+        k == n - 1,
+        jnp.logical_and(i == n_i - 1,
+                        jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(jnp.logical_and(last, n > 1))
+    def _():
+        for s in range(n - 1):
+            dl.wait_arrivals(send_sem.at[s], chunk_of(0), 1)
+
+
+def ag_group_gemm(x_sorted, w, tile_expert, ctx: AGMoEContext, *,
+                  force_kernel: bool = False):
+    """Overlapped AllGather(sorted tokens) @ per-expert weights.
+
+    Call inside ``shard_map``. ``x_sorted``: (S_loc, d) expert-major,
+    ``block_m``-aligned (from :func:`prepare_grouped_tokens`);
+    ``w``: (E, d, F_loc) every expert's ffn shard; ``tile_expert``:
+    (S_loc // block_m,) this rank's tile→expert map.
+    Returns (n·S_loc, F_loc) in global sorted order.
+    """
+    mesh = ctx.mesh
+    n = mesh.size(ctx.axis)
+    s_loc, d = x_sorted.shape
+    e, _, f_loc = w.shape
+    out_dtype = ctx.out_dtype or x_sorted.dtype
+    tm = min(ctx.block_m, s_loc)
+    if s_loc % tm:
+        raise ValueError(f"block_m={tm} must divide S_loc={s_loc}")
+    if tile_expert.shape[0] != s_loc // tm:
+        raise ValueError(
+            f"tile_expert has {tile_expert.shape[0]} tiles, expected "
+            f"{s_loc // tm} (S_loc={s_loc} / block_m={tm})")
+    if n == 1 and not force_kernel:
+        tiles = x_sorted.reshape(-1, tm, d)
+        out = jnp.einsum("ima,iaf->imf", tiles.astype(jnp.float32),
+                         w[tile_expert].astype(jnp.float32))
+        return out.reshape(s_loc, f_loc).astype(out_dtype)
+
+    tn = min(ctx.block_n, f_loc)
+    tk = min(ctx.block_k, d)
+    panel_budget = 9 * 1024 * 1024
+    while tm > 8 and tm * d * x_sorted.dtype.itemsize > panel_budget:
+        tm //= 2
+    if tm != min(ctx.block_m, s_loc):
+        raise ValueError(
+            f"block_m={ctx.block_m} row panel exceeds the VMEM budget "
+            f"for K={d}; re-prepare tokens with block_m<={tm}")
+    if f_loc % tn or d % tk:
+        raise ValueError(
+            f"block sizes (block_n={tn}, block_k={tk}) must divide "
+            f"(F_loc={f_loc}, K={d})")
+    n_i, n_j, n_k = s_loc // tm, f_loc // tn, d // tk
+    s_full = n * s_loc
+
+    # Every rank needs every chunk's tile→expert map for its weight
+    # prefetch; (n, n_i) int32 is negligible traffic.
+    te_all = jax.lax.all_gather(tile_expert, ctx.axis, axis=0)
+
+    def b_index(k, i, j, kk, te_ref):
+        me = jax.lax.axis_index(ctx.axis)
+        c = jax.lax.rem(me - k + n, n)
+        return (te_ref[c, i], kk, j)
+
+    def c_index(k, i, j, kk, te_ref):
+        me = jax.lax.axis_index(ctx.axis)
+        c = jax.lax.rem(me - k + n, n)
+        return (c * n_i + i, j)
+
+    panel_bytes = tm * d * x_sorted.dtype.itemsize
+    n_buf = 2 if (n_i > 1 and 2 * panel_bytes <= panel_budget) else 1
+
+    kernel = functools.partial(
+        _ag_moe_kernel, axis=ctx.axis, ctx=mesh, s_loc=s_loc, tm=tm,
+        tk=tk, n_ranks=n, n_buf=n_buf)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n, n_i, n_j, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),   # sorted tokens (RDMA)
+            pl.BlockSpec((1, tk, tn), b_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),   # gather workspace
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_buf, tm, d), x_sorted.dtype),  # a_panel
+            pltpu.VMEM((tm, tn), jnp.float32),           # acc_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # send_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # recv_sem
+            pltpu.SemaphoreType.DMA(()),                 # panel_sem
+        ],
+    )
+
+    out, _a_full = core_call(
+        kernel,
+        comm=True,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((s_full, f_loc), out_dtype),
+                   jax.ShapeDtypeStruct((s_full, d), x_sorted.dtype)),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * s_full * d * f_loc,
+            bytes_accessed=(s_full * d + e * d * f_loc + s_full * f_loc)
+            * x_sorted.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(te_all, x_sorted, w)
+    return out

@@ -3,15 +3,29 @@
 Reference: ``python/triton_dist/kernels/nvidia/group_gemm.py`` (1102 LoC
 persistent grouped GEMM with token-block swizzle) + ``moe_utils.py``.
 
-TPU form: tokens sorted by expert + ``jax.lax.ragged_dot`` (XLA's native
-grouped matmul, which tiles onto the MXU with group offsets) — the
-idiomatic equivalent of the reference's swizzled persistent kernel.
+Two TPU forms:
+
+- :func:`grouped_gemm` / :func:`grouped_swiglu`: tokens sorted by expert
+  + ``jax.lax.ragged_dot`` (XLA's native grouped matmul, which tiles
+  onto the MXU with group offsets) — the zero-maintenance path.
+- :func:`grouped_gemm_tiles`: a Pallas kernel over the ``block_m``-
+  aligned expert-major layout of
+  :func:`~triton_dist_tpu.ops.ag_moe.prepare_grouped_tokens`. The
+  reference's token-block swizzle becomes a scalar-prefetched
+  tile→expert map selecting the weight tile in the BlockSpec
+  ``index_map`` — the same machinery :func:`~triton_dist_tpu.ops.ag_moe.
+  ag_group_gemm` uses, minus the ring; kept local so MoE layers can run
+  sorted-layout down-projections without leaving the fused data layout.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import core_call
 
 
 def sort_by_expert(tokens, expert_ids, num_experts: int):
@@ -32,6 +46,75 @@ def grouped_gemm(x, w, group_sizes):
     return jax.lax.ragged_dot(x, w, group_sizes,
                               preferred_element_type=jnp.float32
                               ).astype(x.dtype)
+
+
+def _gg_tiles_kernel(te_ref, x_ref, w_ref, o_ref, acc_v):
+    del te_ref  # consumed by the weight index map
+    kk = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(x_ref[...], w_ref[0],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+
+def grouped_gemm_tiles(x_sorted, w, tile_expert, *, block_n: int = 256,
+                       block_k: int = 512, out_dtype=None,
+                       interpret=None):
+    """Pallas grouped GEMM over a ``block_m``-aligned expert-major layout.
+
+    ``x_sorted``: (S, d) with every row tile owned by one expert;
+    ``w``: (E, d, f); ``tile_expert``: (S // block_m,) int32. The row
+    tile size is inferred from ``tile_expert``. Returns (S, f).
+    """
+    s, d = x_sorted.shape
+    e, _, f = w.shape
+    n_tiles = tile_expert.shape[0]
+    if s % n_tiles:
+        raise ValueError(f"S={s} not divisible by {n_tiles} tiles")
+    tm = s // n_tiles
+    tn = min(block_n, f)
+    tk = min(block_k, d)
+    if f % tn or d % tk:
+        raise ValueError(
+            f"block sizes (block_n={tn}, block_k={tk}) must divide "
+            f"(f={f}, d={d})")
+    n_j, n_k = f // tn, d // tk
+    out_dtype = out_dtype or x_sorted.dtype
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles, n_j, n_k),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, kk, te: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, tn),
+                         lambda i, j, kk, te: (te[i], kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk, te: (i, j),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+    )
+    return core_call(
+        _gg_tiles_kernel,
+        grid_spec=grid_spec,
+        interpret=interpret,
+        out_shape=jax.ShapeDtypeStruct((s, f), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * s * d * f,
+            bytes_accessed=(s * d + e * d * f + s * f)
+            * x_sorted.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(tile_expert, x_sorted, w)
 
 
 def grouped_swiglu(x, w_gate, w_up, w_down, group_sizes):

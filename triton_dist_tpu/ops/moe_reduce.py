@@ -32,6 +32,111 @@ from triton_dist_tpu.lang import core_call
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
+def moe_reduce_ar_ref(y, w, *, axis: str = "tp"):
+    """Oracle: XLA combine + psum (the reference's unfused AR epilogue)."""
+    partial = jnp.einsum("tkd,tk->td", y.astype(jnp.float32),
+                         w.astype(jnp.float32))
+    return jax.lax.psum(partial, axis).astype(y.dtype)
+
+
+def _moe_ar_kernel(y_ref, w_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
+                   send_sem, recv_sem, *, axis: str, ctx: MeshContext,
+                   tn: int, n_ranks: int):
+    j = pl.program_id(0)
+    n_j = pl.num_programs(0)
+    me = dl.rank(axis)
+    n = n_ranks
+
+    @pl.when(j == 0)
+    def _():
+        dl.barrier_all(axis, ctx=ctx)
+
+    # Weighted top-k combine of this rank's partial for tile j.
+    part_v[...] = jnp.einsum(
+        "tqk,tkd->tqd", w_ref[...].astype(jnp.float32)[:, None, :],
+        y_ref[...].astype(jnp.float32))[:, 0]
+
+    my_slot = gather_hbm.at[me, :, pl.ds(j * tn, tn)]
+    pltpu.sync_copy(part_v, my_slot)
+
+    # One-shot push to every peer; transport overlaps the next tile's
+    # combine (the reference's moe_reduce_ar small-batch scheme).
+    for peer_off in range(1, n):
+        peer = jax.lax.rem(me + peer_off, n)
+        dl.remote_put(my_slot, my_slot, send_sem.at[peer_off - 1],
+                      recv_sem, peer, axis=axis, ctx=ctx)
+
+    @pl.when(j == n_j - 1)
+    def _():
+        tile_ref = gather_hbm.at[0, :, pl.ds(0, tn)]
+        dl.wait_arrivals(recv_sem, tile_ref, (n - 1) * n_j)
+        for s in range(n - 1):
+            dl.wait_arrivals(send_sem.at[s], tile_ref, n_j)
+        for jj in range(n_j):
+            acc = None
+            for r in range(n):
+                pltpu.sync_copy(
+                    gather_hbm.at[r, :, pl.ds(jj * tn, tn)], tmp_v)
+                acc = tmp_v[...] if acc is None else acc + tmp_v[...]
+            out_v[...] = acc.astype(out_v.dtype)
+            pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
+
+
+def moe_reduce_ar(y, w, *, ctx: MeshContext, axis: str = "tp",
+                  block_n: int = 512, force_kernel: bool = False):
+    """Fused weighted combine + one-shot AllReduce (decode epilogue).
+
+    Reference: ``moe_reduce_ar.py`` (:692) — for small decode batches
+    the RS+AG round-trip costs two latencies; here each rank pushes its
+    combined partial tile-by-tile to every peer and reduces locally.
+
+    y: (T, K, d) per-(token, top-k) expert outputs (this rank's ffn
+    partial); w: (T, K). Returns the fully-reduced (T, d) on every rank.
+    """
+    n = ctx.size(axis)
+    t, k, d = y.shape
+    if w.shape != (t, k):
+        raise ValueError(f"weights {w.shape} != {(t, k)}")
+    if n == 1 and not force_kernel:
+        return jnp.einsum("tkd,tk->td", y.astype(jnp.float32),
+                          w.astype(jnp.float32)).astype(y.dtype)
+    tn = min(block_n, d)
+    while tn > 1 and d % tn:
+        tn //= 2
+    n_j = d // tn
+
+    kernel = functools.partial(_moe_ar_kernel, axis=axis, ctx=ctx,
+                               tn=tn, n_ranks=n)
+    out, _gather_ws = core_call(
+        kernel,
+        comm=True,
+        grid=(n_j,),
+        out_shape=(jax.ShapeDtypeStruct((t, d), y.dtype),
+                   jax.ShapeDtypeStruct((n, t, d), jnp.float32)),
+        in_specs=[
+            pl.BlockSpec((t, k, tn), lambda j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, k), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((t, tn), jnp.float32),             # part_v
+            pltpu.VMEM((t, tn), jnp.float32),             # tmp_v
+            pltpu.VMEM((t, tn), y.dtype),                 # out_v
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),    # send_sem
+            pltpu.SemaphoreType.DMA(()),                  # recv_sem
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t * k * d + n * t * d,
+            bytes_accessed=(t * k * d + t * k + (n + 1) * t * d) * 4,
+            transcendentals=0,
+        ),
+    )(y, w)
+    return out
+
+
 def moe_reduce_rs_ref(y, w, *, axis: str = "tp"):
     """Oracle: XLA combine + psum_scatter (round-1 tp_moe epilogue)."""
     partial = jnp.einsum("tkd,tk->td", y.astype(jnp.float32),
