@@ -41,6 +41,14 @@ AG_GEMM_CONFIGS = (
     {"block_m": 1024, "block_n": 128, "block_k": 2048},
 )
 
+# gemm_rs gets the same treatment (round-1 winner first): its detail
+# number rode a single hardcoded config and drifted with tunnel noise.
+GEMM_RS_CONFIGS = (
+    {"block_m": 1024, "block_n": 128, "block_k": 4096},
+    {"block_m": 512, "block_n": 128, "block_k": 4096},
+    {"block_m": 1024, "block_n": 256, "block_k": 4096},
+)
+
 
 def _make_chain(step, iters):
     import jax
@@ -166,19 +174,25 @@ def main():
     if cached is not None and cached not in configs:
         configs.append(cached)  # extra candidate from a previous run
 
-    sweep, errors = [], []
-    for cfg in configs:
-        step = make_fused_step(cfg)
-        try:
-            t = max(_timed_chain(step, a, b, repeats=SWEEP_REPEATS), 1e-9)
-        except Exception as e:
-            # Config doesn't lower at these shapes (e.g. VMEM overflow)
-            # — legal to skip, same policy as the autotuner.
-            errors.append(f"{cfg}: {type(e).__name__}: {str(e)[:200]}")
-            continue
-        sweep.append((t, cfg, step))
-    assert sweep, "no ag_gemm config compiled:\n" + "\n".join(errors)
-    sweep.sort(key=lambda e: e[0])
+    def _sweep(name, cfgs, make_step, *args):
+        """Time each config briefly; return sorted [(t, cfg, step)].
+        Configs that fail to lower (e.g. VMEM overflow) are skipped —
+        the autotuner's policy."""
+        results, errs = [], []
+        for cfg in cfgs:
+            step = make_step(cfg)
+            try:
+                t = max(_timed_chain(step, *args, repeats=SWEEP_REPEATS),
+                        1e-9)
+            except Exception as e:
+                errs.append(f"{cfg}: {type(e).__name__}: {str(e)[:200]}")
+                continue
+            results.append((t, cfg, step))
+        assert results, f"no {name} config compiled:\n" + "\n".join(errs)
+        results.sort(key=lambda e: e[0])
+        return results
+
+    sweep = _sweep("ag_gemm", configs, make_fused_step, a, b)
     _, best_cfg, fused_step = sweep[0]
 
     # Correctness gate before persisting or timing: a fast wrong kernel
@@ -188,10 +202,9 @@ def main():
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-1)
     tune.store_autotune_data(tune_key, best_cfg, seconds=sweep[0][0])
 
-    # Secondary: GEMM+RS efficiency on the transposed problem.
+    # Secondary: GEMM+RS efficiency on the transposed problem — swept
+    # over configs like ag_gemm above.
     from triton_dist_tpu.ops import gemm_rs, create_gemm_rs_context
-    rs_ctx = create_gemm_rs_context(mctx, block_m=1024, block_n=128,
-                                    block_k=4096)
     a_rs = jax.device_put(
         jax.random.normal(jax.random.PRNGKey(2), (m_full, k_dim), dtype),
         NamedSharding(mesh, P(None, "tp")))
@@ -199,12 +212,31 @@ def main():
         jax.random.normal(jax.random.PRNGKey(3), (k_dim, n_dim), dtype),
         NamedSharding(mesh, P("tp", None)))
 
-    def rs_fused(x, w):
-        return jax.shard_map(
-            lambda xs, ws: gemm_rs(xs, ws, rs_ctx,
-                                   force_kernel=(n == 1)),
-            mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
-            out_specs=P("tp", None), check_vma=False)(x, w)
+    def make_rs_step(cfg):
+        ctx = create_gemm_rs_context(mctx, **cfg)
+
+        def rs_step(x, w):
+            return jax.shard_map(
+                lambda xs, ws: gemm_rs(xs, ws, ctx,
+                                       force_kernel=(n == 1)),
+                mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P("tp", None), check_vma=False)(x, w)
+        return rs_step
+
+    rs_key = tune.make_key("gemm_rs_bench", m=m_full, k=k_dim, n=n_dim,
+                           dtype=str(dtype.dtype), world=n)
+    rs_cached = tune.load_autotune_data(rs_key)
+    rs_configs = list(GEMM_RS_CONFIGS)
+    if rs_cached is not None and rs_cached not in rs_configs:
+        rs_configs.append(rs_cached)
+    rs_sweep = _sweep("gemm_rs", rs_configs, make_rs_step, a_rs, b_rs)
+    rs_best_cfg, rs_fused = rs_sweep[0][1], rs_sweep[0][2]
+    got_rs = np.asarray(rs_fused(a_rs, b_rs), np.float32)
+    want_rs = (np.asarray(a_rs, np.float32)
+               @ np.asarray(b_rs, np.float32))
+    np.testing.assert_allclose(got_rs, want_rs, rtol=3e-2, atol=3e-1)
+    tune.store_autotune_data(rs_key, rs_best_cfg,
+                             seconds=rs_sweep[0][0])
 
     # Tertiary: SP ring-attention kernel efficiency vs XLA's own dense
     # attention (the measurement the round-1 verdict flagged as missing
@@ -276,6 +308,7 @@ def main():
             "fused_tflops_per_chip": round(flops / t_fused / 1e12, 2),
             "gemm_rs_ms": round(t_rs * 1e3, 3),
             "gemm_rs_efficiency": round(float(t_compute / t_rs), 4),
+            "gemm_rs_best_config": rs_best_cfg,
             "sp_attn_fused_ms": (round(t_attn_fused * 1e3, 3)
                                  if t_attn_xla else None),
             "sp_attn_xla_ms": (round(t_attn_xla * 1e3, 3)
@@ -504,6 +537,20 @@ def battery():
             q_, kp, vp, tbl, kv_len))(q)
         assert np.isfinite(np.asarray(out, np.float32)).all()
 
+    def run_hybrid_gdn():
+        from triton_dist_tpu.models import Engine, ModelConfig, qwen_next
+
+        cfg = ModelConfig.tiny_next(
+            hidden_size=256, intermediate_size=512,
+            num_attention_heads=8, num_key_value_heads=4, head_dim=32,
+            gdn_num_heads=8, gdn_head_dim_k=32, gdn_head_dim_v=32)
+        eng = Engine(cfg, mesh, mode="xla", max_len=128, seed=7,
+                     model=qwen_next)
+        ids = jax.random.randint(jax.random.PRNGKey(18), (2, 64), 0,
+                                 cfg.vocab_size)
+        toks = np.asarray(eng.serve(ids, gen_len=8))
+        assert toks.shape == (2, 8) and np.isfinite(toks).all()
+
     def run_megakernel(paged):
         def go():
             from triton_dist_tpu.megakernel.engine import MegaKernelEngine
@@ -543,6 +590,7 @@ def battery():
         ("ep_moe_fused", run_ep_fused),
         ("ulysses_qkv_gemm_a2a", run_ulysses),
         ("paged_flash_decode", run_paged_decode),
+        ("hybrid_gdn_engine", run_hybrid_gdn),
         ("megakernel_prefill_decode", run_megakernel(False)),
         ("megakernel_paged", run_megakernel(True)),
     ]

@@ -39,3 +39,31 @@ def test_moe_model_tp_vs_ep(tp8_mesh, tp8_ctx):
     logits_ep = f_ep(params, ids)
     assert logits_tp.shape == (2, 32, cfg.vocab_size)
     assert_allclose(logits_ep, logits_tp, rtol=2e-3, atol=2e-3)
+
+
+def test_moe_model_fused_vs_xla(tp8_mesh, tp8_ctx):
+    """mode="fused" (fused attention GEMMs + fully-fused TP-MoE blocks)
+    matches the XLA-collective forward token-for-token."""
+    from triton_dist_tpu.models.dense import make_fwd_contexts
+
+    # 8 experts keeps the AG-MoE ring workspace (E·block_m-bounded) well
+    # under the interpret harness's ~96 KB starvation ceiling.
+    cfg = ModelConfig.tiny_moe(num_experts=8)
+    params = qwen_moe.init_params(jax.random.PRNGKey(2), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 0,
+                             cfg.vocab_size)
+    ctxs = make_fwd_contexts(tp8_ctx, "tp", block_m=8, block_n=8,
+                             block_k=32)
+
+    def run(mode):
+        return spmd(
+            tp8_mesh,
+            lambda p, i: qwen_moe.forward_tokens(
+                p, i, cfg, moe_impl="tp", mode=mode, ctxs=ctxs,
+                # block_m=4 keeps the AG-MoE ring workspace under the
+                # interpret harness's ~96 KB buffer ceiling.
+                moe_block_m=4),
+            (qwen_moe.param_specs(cfg, moe_impl="tp"), P(None, None)),
+            P(None, None, None))(params, ids)
+
+    assert_allclose(run("fused"), run("xla"), rtol=2e-3, atol=2e-3)

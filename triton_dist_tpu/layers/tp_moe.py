@@ -102,14 +102,16 @@ def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
     agctx = create_ag_moe_context(
         mesh_ctx, num_experts=num_experts, axis=axis, block_m=block_m,
         block_n=min(block_n, 2 * f_loc), block_k=min(block_k, d))
-    h = ag_group_gemm(x_s, w_gu, te, agctx)          # (S_full, 2·F_loc)
+    # One gather serves both the AG-GEMM weight prefetch ((n, tiles)
+    # layout) and the down-projection's global map (flat layout).
+    te_all = jax.lax.all_gather(te, axis, axis=0)
+    h = ag_group_gemm(x_s, w_gu, te, agctx, te_all=te_all)  # (S_full, 2F)
     g, u = h[:, :f_loc], h[:, f_loc:]
     act = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)
            ).astype(x.dtype)
 
-    te_all = jax.lax.all_gather(te, axis, axis=0, tiled=True)
     y_sorted = grouped_gemm_tiles(
-        act, params["w_down"], te_all,
+        act, params["w_down"], te_all.reshape(-1),
         block_n=min(block_n, d), block_k=min(block_k, f_loc))
 
     # Un-sort the gathered rows to (T_full, K, d) flat order; padding

@@ -29,10 +29,31 @@ class ModelConfig:
     num_experts_per_tok: int = 8
     moe_intermediate_size: int = 768
     norm_topk_prob: bool = True
+    # Hybrid linear-attention fields (Qwen3-Next: GDN gated-delta-rule
+    # layers with a full-attention layer every `full_attn_interval`;
+    # 0 GDN heads = pure full attention). The reference ships the GDN
+    # kernel (``kernels/nvidia/gdn.py``) for this family.
+    gdn_num_heads: int = 0
+    gdn_head_dim_k: int = 128
+    gdn_head_dim_v: int = 128
+    full_attn_interval: int = 4
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_hybrid(self) -> bool:
+        return self.gdn_num_heads > 0
+
+    def layer_is_full_attn(self, layer_idx: int) -> bool:
+        """Hybrid schedule: layers (interval-1, 2·interval-1, …) are full
+        attention, the rest GDN (Qwen3-Next places the softmax layer
+        last in each block of `full_attn_interval`)."""
+        if not self.is_hybrid:
+            return True
+        return layer_idx % self.full_attn_interval == (
+            self.full_attn_interval - 1)
 
     @classmethod
     def qwen3_8b(cls) -> "ModelConfig":
@@ -65,6 +86,30 @@ class ModelConfig:
                     num_key_value_heads=8, head_dim=8, num_experts=16,
                     num_experts_per_tok=2, moe_intermediate_size=32,
                     model_name="qwen3-moe-tiny")
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def qwen3_next_80b_a3b(cls) -> "ModelConfig":
+        """Qwen3-Next-80B-A3B geometry: 48 layers, 3 GDN : 1 full-attn,
+        MoE FFN (512 experts, 10 active + shared omitted)."""
+        return cls(hidden_size=2048, intermediate_size=5120,
+                   num_hidden_layers=48, num_attention_heads=16,
+                   num_key_value_heads=2, head_dim=256,
+                   num_experts=512, num_experts_per_tok=10,
+                   moe_intermediate_size=512,
+                   gdn_num_heads=32, gdn_head_dim_k=128,
+                   gdn_head_dim_v=128, full_attn_interval=4,
+                   model_name="qwen3-next-80b-a3b")
+
+    @classmethod
+    def tiny_next(cls, **kw) -> "ModelConfig":
+        """Hybrid GDN/full-attention tiny config for the CPU mesh."""
+        base = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=4, num_attention_heads=8,
+                    num_key_value_heads=8, head_dim=8,
+                    gdn_num_heads=8, gdn_head_dim_k=8, gdn_head_dim_v=8,
+                    full_attn_interval=2, model_name="qwen3-next-tiny")
         base.update(kw)
         return cls(**base)
 

@@ -49,6 +49,14 @@ def make_fwd_contexts(mesh: MeshContext, axis: str = "tp",
     )
 
 
+def cache_specs(axis: str = "tp") -> KVCache:
+    """PartitionSpec pytree for :class:`KVCache` (KV heads sharded along
+    ``axis``) — the Engine's shard_map in/out spec for the cache."""
+    return KVCache(k=P(None, None, None, axis, None),
+                   v=P(None, None, None, axis, None),
+                   length=P())
+
+
 def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
     keys = jax.random.split(key, cfg.num_hidden_layers + 2)
     layers = []

@@ -23,25 +23,33 @@ from triton_dist_tpu.parallel.mesh import MeshContext
 
 
 class Engine:
-    """Greedy-decoding TP inference engine over a mesh."""
+    """Greedy-decoding TP inference engine over a mesh.
+
+    ``model`` is any module exposing the dense functional contract
+    (``init_params`` / ``param_specs`` / ``prefill`` / ``decode_step`` /
+    ``cache_specs``) — ``models.dense`` by default,
+    ``models.qwen_next`` for the hybrid GDN family.
+    """
 
     def __init__(self, cfg: ModelConfig, mesh: Mesh, *, axis: str = "tp",
                  mode: str = "xla", dtype=jnp.float32, max_len: int = 512,
                  params=None, seed: int = 0,
                  block_m: int = 256, block_n: int = 256,
-                 block_k: int = 512):
+                 block_k: int = 512, model=None):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
         self.mode = mode
         self.max_len = max_len
+        model = model if model is not None else dense
+        self.model = model
         mctx = MeshContext.from_mesh(mesh)
         self.ctxs = dense.make_fwd_contexts(mctx, axis, block_m, block_n,
                                             block_k)
 
-        specs = dense.param_specs(cfg, axis)
+        specs = model.param_specs(cfg, axis)
         if params is None:
-            params = dense.init_params(jax.random.PRNGKey(seed), cfg, dtype)
+            params = model.init_params(jax.random.PRNGKey(seed), cfg, dtype)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, specs, is_leaf=lambda x: isinstance(x, jax.Array)
@@ -49,16 +57,14 @@ class Engine:
         self._specs = specs
 
         def _prefill(params, ids):
-            return dense.prefill(params, ids, cfg, mode=mode, axis=axis,
+            return model.prefill(params, ids, cfg, mode=mode, axis=axis,
                                  ctxs=self.ctxs, max_len=max_len)
 
         def _decode(params, tok, cache):
-            return dense.decode_step(params, tok, cache, cfg, mode=mode,
+            return model.decode_step(params, tok, cache, cfg, mode=mode,
                                      axis=axis, ctxs=self.ctxs)
 
-        kv_spec = KVCache(k=P(None, None, None, axis, None),
-                          v=P(None, None, None, axis, None),
-                          length=P())
+        kv_spec = model.cache_specs(axis)
         self._prefill = jax.jit(jax.shard_map(
             _prefill, mesh=mesh,
             in_specs=(specs, P(None, None)),

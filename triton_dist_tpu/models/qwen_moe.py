@@ -69,7 +69,8 @@ def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
                    moe_impl: str = "tp", mode: str = "xla",
                    axis: str = "tp", ep_ctx: Optional[EPContext] = None,
-                   ctxs: FwdContexts = FwdContexts()):
+                   ctxs: FwdContexts = FwdContexts(),
+                   moe_block_m: int = 64):
     """Per-shard all-token forward → (B, S, vocab) logits.
 
     For ``moe_impl="ep"`` the residual stream is token-sharded along the
@@ -90,9 +91,21 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
         x = x + attn_out
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         if moe_impl == "tp":
-            moe_out = tp_moe.fwd(lp["moe"], h, topk=cfg.num_experts_per_tok,
-                                 num_experts=cfg.num_experts, axis=axis,
-                                 norm_topk_prob=cfg.norm_topk_prob)
+            if mode == "fused" and ctxs.ag is not None:
+                # Fully-fused pipeline: AG-fused grouped GEMM + Pallas
+                # down-proj + fused RS epilogue (the reference's
+                # ag_group_gemm/moe_reduce_rs layer pairing).
+                moe_out = tp_moe.fwd_fused(
+                    lp["moe"], h, topk=cfg.num_experts_per_tok,
+                    num_experts=cfg.num_experts,
+                    mesh_ctx=ctxs.ag.mesh, axis=axis,
+                    block_m=moe_block_m,
+                    norm_topk_prob=cfg.norm_topk_prob)
+            else:
+                moe_out = tp_moe.fwd(
+                    lp["moe"], h, topk=cfg.num_experts_per_tok,
+                    num_experts=cfg.num_experts, axis=axis,
+                    norm_topk_prob=cfg.norm_topk_prob)
         else:
             moe_out = ep_moe.fwd(lp["moe"], h, ep_ctx,
                                  topk=cfg.num_experts_per_tok,

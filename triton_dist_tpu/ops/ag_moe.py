@@ -98,9 +98,18 @@ def prepare_grouped_tokens(x, topk_ids, num_experts: int, block_m: int
     seg_off = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32),
          jnp.cumsum(pad_counts)[:-1].astype(jnp.int32)])
-    one_hot = jax.nn.one_hot(flat, e, dtype=jnp.int32)
-    rank_within = jnp.take_along_axis(
-        jnp.cumsum(one_hot, axis=0) - 1, flat[:, None], axis=1)[:, 0]
+    # Within-expert rank via stable sort: position in the expert-major
+    # order minus the start of that expert's run — O(TK log TK) with no
+    # (TK, E) intermediate (a one-hot cumsum would be O(TK·E)).
+    order = jnp.argsort(flat, stable=True)                 # (TK,)
+    sorted_exp = flat[order]
+    seg_start = jnp.searchsorted(sorted_exp,
+                                 jnp.arange(e, dtype=jnp.int32),
+                                 side="left").astype(jnp.int32)
+    rank_sorted = (jnp.arange(tk_total, dtype=jnp.int32)
+                   - seg_start[sorted_exp])
+    rank_within = jnp.zeros((tk_total,), jnp.int32).at[order].set(
+        rank_sorted)
     dest = seg_off[flat] + rank_within                     # (TK,)
 
     x_rep = jnp.repeat(x, k, axis=0)
@@ -231,13 +240,15 @@ def _ag_moe_kernel(te_ref, a_ref, b_ref, o_ref, a_ws, a_panel, acc_v,
 
 
 def ag_group_gemm(x_sorted, w, tile_expert, ctx: AGMoEContext, *,
-                  force_kernel: bool = False):
+                  te_all=None, force_kernel: bool = False):
     """Overlapped AllGather(sorted tokens) @ per-expert weights.
 
     Call inside ``shard_map``. ``x_sorted``: (S_loc, d) expert-major,
     ``block_m``-aligned (from :func:`prepare_grouped_tokens`);
     ``w``: (E, d, F_loc) every expert's ffn shard; ``tile_expert``:
-    (S_loc // block_m,) this rank's tile→expert map.
+    (S_loc // block_m,) this rank's tile→expert map. Pass ``te_all``
+    (the (n, S_loc // block_m) allgathered maps) if the caller already
+    gathered them — saves one collective launch.
     Returns (n·S_loc, F_loc) in global sorted order.
     """
     mesh = ctx.mesh
@@ -258,25 +269,33 @@ def ag_group_gemm(x_sorted, w, tile_expert, ctx: AGMoEContext, *,
                          w[tile_expert].astype(jnp.float32))
         return out.reshape(s_loc, f_loc).astype(out_dtype)
 
+    # Snap tiles down to divisors (the moe_reduce convention: the layer
+    # path must accept any model shape the unfused path would).
     tn = min(ctx.block_n, f_loc)
+    while tn > 1 and f_loc % tn:
+        tn //= 2
     tk = min(ctx.block_k, d)
+    while tk > 1 and d % tk:
+        tk //= 2
+    # tm is fixed by the prepared layout, so an over-budget row panel
+    # cannot be shrunk here — report the largest block_m that fits.
     panel_budget = 9 * 1024 * 1024
-    while tm > 8 and tm * d * x_sorted.dtype.itemsize > panel_budget:
-        tm //= 2
-    if tm != min(ctx.block_m, s_loc):
+    max_tm = tm
+    while max_tm > 8 and max_tm * d * x_sorted.dtype.itemsize > panel_budget:
+        max_tm //= 2
+    if max_tm != tm:
         raise ValueError(
             f"block_m={ctx.block_m} row panel exceeds the VMEM budget "
-            f"for K={d}; re-prepare tokens with block_m<={tm}")
-    if f_loc % tn or d % tk:
-        raise ValueError(
-            f"block sizes (block_n={tn}, block_k={tk}) must divide "
-            f"(F_loc={f_loc}, K={d})")
+            f"for K={d}; re-prepare tokens with block_m<={max_tm}")
     n_i, n_j, n_k = s_loc // tm, f_loc // tn, d // tk
     s_full = n * s_loc
 
     # Every rank needs every chunk's tile→expert map for its weight
     # prefetch; (n, n_i) int32 is negligible traffic.
-    te_all = jax.lax.all_gather(tile_expert, ctx.axis, axis=0)
+    if te_all is None:
+        te_all = jax.lax.all_gather(tile_expert, ctx.axis, axis=0)
+    elif te_all.shape != (n, n_i):
+        raise ValueError(f"te_all {te_all.shape} != {(n, n_i)}")
 
     def b_index(k, i, j, kk, te_ref):
         me = jax.lax.axis_index(ctx.axis)

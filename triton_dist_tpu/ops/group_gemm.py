@@ -80,12 +80,14 @@ def grouped_gemm_tiles(x_sorted, w, tile_expert, *, block_n: int = 256,
     if s % n_tiles:
         raise ValueError(f"S={s} not divisible by {n_tiles} tiles")
     tm = s // n_tiles
+    # Snap tiles down to divisors so any model shape the ragged_dot path
+    # accepts also lowers here.
     tn = min(block_n, f)
+    while tn > 1 and f % tn:
+        tn //= 2
     tk = min(block_k, d)
-    if f % tn or d % tk:
-        raise ValueError(
-            f"block sizes (block_n={tn}, block_k={tk}) must divide "
-            f"(f={f}, d={d})")
+    while tk > 1 and d % tk:
+        tk //= 2
     n_j, n_k = f // tn, d // tk
     out_dtype = out_dtype or x_sorted.dtype
 

@@ -1,0 +1,63 @@
+"""Tutorial 11: AG-MoE — allgather fused into a grouped GEMM.
+
+Reference capability: ``kernels/nvidia/allgather_group_gemm.py``
+(``ag_group_gemm``) + ``moe_reduce_rs.py`` — the TP-MoE pipeline where
+token shards are allgathered *inside* the expert GEMM and expert
+partials are combined *inside* the reduce-scatter.
+
+The TPU form in three steps:
+
+1. :func:`prepare_grouped_tokens` sorts each rank's (topk-replicated)
+   tokens expert-major with every expert segment padded to the row-tile
+   size, so each output tile belongs to exactly one expert — the
+   static-shape replacement for the reference's token-block swizzle.
+2. :func:`ag_group_gemm` runs the ring allgather inside the grouped
+   GEMM: my shard computes immediately, each arriving shard is certified
+   by one DMA-semaphore wait and forwarded while the MXU consumes it.
+   The per-tile expert weight is chosen by a scalar-prefetched
+   tile→expert map in the BlockSpec index_map — zero in-kernel control
+   flow.
+3. ``layers/tp_moe.fwd_fused`` chains this with the Pallas down-
+   projection (:func:`grouped_gemm_tiles`) and the fused
+   ``moe_reduce_rs`` epilogue.
+
+Run: python tutorials/11_ag_moe.py
+"""
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu as tdt
+from triton_dist_tpu.ops import (ag_group_gemm, ag_moe_ref,
+                                 create_ag_moe_context,
+                                 prepare_grouped_tokens)
+from triton_dist_tpu.utils.testing import spmd
+
+mesh = tdt.make_mesh(tp=8)
+mctx = tdt.MeshContext.from_mesh(mesh)
+E, K, T, D, F, TM = 4, 2, 16, 32, 32, 8     # F = per-rank ffn shard
+ctx = create_ag_moe_context(mctx, num_experts=E, block_m=TM,
+                            block_n=16, block_k=16)
+
+tok = jax.random.normal(jax.random.PRNGKey(0), (8 * T, D))
+ids = jax.random.randint(jax.random.PRNGKey(1), (8 * T, K), 0, E)
+w = jax.random.normal(jax.random.PRNGKey(2), (E, D, F)) * D ** -0.5
+
+# Step 1: expert-major tile-aligned layout, per rank.
+x_s, te, row_src = spmd(
+    mesh, lambda a, b: prepare_grouped_tokens(a, b, E, TM),
+    (P("tp", None), P("tp", None)),
+    (P("tp", None), P("tp"), P("tp")))(tok, ids)
+
+# Step 2: ring-AG fused into the grouped GEMM vs the XLA oracle.
+run = lambda fn: spmd(mesh, fn,
+                      (P("tp", None), P(None, None, None), P("tp")),
+                      P(None, None))(x_s, w, te)
+got = np.asarray(run(lambda a, ww, t_: ag_group_gemm(a, ww, t_, ctx)))
+want = np.asarray(run(ag_moe_ref))
+print("AG-MoE fused grouped GEMM max err:", np.abs(got - want).max())
+print("output:", got.shape, "(global sorted rows × ffn shard)")
