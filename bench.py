@@ -46,7 +46,10 @@ AG_GEMM_CONFIGS = (
 GEMM_RS_CONFIGS = (
     {"block_m": 1024, "block_n": 128, "block_k": 4096},
     {"block_m": 512, "block_n": 128, "block_k": 4096},
-    {"block_m": 1024, "block_n": 256, "block_k": 4096},
+    # NOT 1024x256x4096: 20 MB scoped VMEM > the 16 MB limit — it can
+    # OOM asynchronously mid-sweep where the skip-on-compile-failure
+    # policy cannot catch it.
+    {"block_m": 512, "block_n": 128, "block_k": 2048},
 )
 
 
@@ -197,7 +200,9 @@ def main():
 
     # Correctness gate before persisting or timing: a fast wrong kernel
     # is worthless (and must not poison the tune cache).
-    got = np.asarray(fused_step(a, b), np.float32)
+    # jit the gate: the eager path compiles separately (and near VMEM
+    # limits can fail where the measured jitted path does not).
+    got = np.asarray(jax.jit(fused_step)(a, b), np.float32)
     want = np.asarray(compute_step(a_full, b), np.float32)
     np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-1)
     tune.store_autotune_data(tune_key, best_cfg, seconds=sweep[0][0])
@@ -231,7 +236,7 @@ def main():
         rs_configs.append(rs_cached)
     rs_sweep = _sweep("gemm_rs", rs_configs, make_rs_step, a_rs, b_rs)
     rs_best_cfg, rs_fused = rs_sweep[0][1], rs_sweep[0][2]
-    got_rs = np.asarray(rs_fused(a_rs, b_rs), np.float32)
+    got_rs = np.asarray(jax.jit(rs_fused)(a_rs, b_rs), np.float32)
     want_rs = (np.asarray(a_rs, np.float32)
                @ np.asarray(b_rs, np.float32))
     np.testing.assert_allclose(got_rs, want_rs, rtol=3e-2, atol=3e-1)
@@ -537,6 +542,104 @@ def battery():
             q_, kp, vp, tbl, kv_len))(q)
         assert np.isfinite(np.asarray(out, np.float32)).all()
 
+    def run_decode_perf():
+        """Decode throughput, layer engine vs megakernel, measured as
+        the slope between two on-device greedy-decode loop lengths (the
+        tunnel RTT cancels) — the reference's ``bench_qwen3.py``
+        comparison."""
+        from triton_dist_tpu.models import ModelConfig, dense
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        cfg = ModelConfig.tiny(
+            vocab_size=8192, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, head_dim=128)
+        B, PRE, LEN = 8, 128, 512
+        specs = dense.param_specs(cfg, "tp")
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, s)),
+            dense.init_params(jax.random.PRNGKey(0), cfg), specs)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (B, PRE), 0,
+                                 cfg.vocab_size)
+        kv_spec = dense.cache_specs("tp")
+
+        prefill = jax.jit(jax.shard_map(
+            lambda p, i: dense.prefill(p, i, cfg, max_len=LEN),
+            mesh=mesh, in_specs=(specs, P(None, None)),
+            out_specs=(P(None, None), kv_spec), check_vma=False))
+        logits0, cache0 = prefill(params, ids)
+        tok0 = jnp.argmax(logits0, -1).astype(jnp.int32)
+
+        def make_layer_loop(iters):
+            def inner(p, tok, cache):
+                def body(_, carry):
+                    tok, cache = carry
+                    lg, cache = dense.decode_step(p, tok, cache, cfg)
+                    return (jnp.argmax(lg, -1).astype(jnp.int32), cache)
+                tok, cache = jax.lax.fori_loop(0, iters, body,
+                                               (tok, cache))
+                return tok
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=(specs, P(None), kv_spec),
+                out_specs=P(None), check_vma=False))
+
+        def slope(make, lo=8, hi=32, reps=3):
+            best = {}
+            for it in (lo, hi):
+                f = make(it)
+                f()  # compile + warm
+                b = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    f()
+                    b = min(b, time.perf_counter() - t0)
+                best[it] = b
+            return (best[hi] - best[lo]) / (hi - lo)
+
+        t_layer = slope(lambda it: (
+            lambda f=make_layer_loop(it): np.asarray(
+                f(params, tok0, cache0))))
+
+        # Megakernel: same loop over the persistent-kernel step.
+        mk = MegaKernelEngine(cfg, mesh, batch=B, max_len=LEN,
+                              prefill_seq=PRE)
+        mk.prefill(ids)
+        step = mk.builder.step_fn()
+        kvspec_mk = P(None, None, None, "tp", None)
+
+        def make_mk_loop(iters):
+            def inner(arena, k, v, tok, tbl):
+                def body(i, carry):
+                    tok, arena, k, v = carry
+                    lg, arena, k, v = step(arena, k, v, tok, PRE + i,
+                                           tbl)
+                    return (jnp.argmax(lg, -1).astype(jnp.int32),
+                            arena, k, v)
+                out = jax.lax.fori_loop(
+                    0, iters, body, (tok, arena, k, v))
+                return out[0]
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(P("tp", None), kvspec_mk, kvspec_mk, P(None),
+                          P(None)),
+                out_specs=P(None), check_vma=False))
+
+        t_mk = slope(lambda it: (
+            lambda f=make_mk_loop(it): np.asarray(
+                f(mk._arena, mk.k_cache, mk.v_cache, tok0,
+                  mk.block_table))))
+        return {"layer_tok_s": round(B / max(t_layer, 1e-9), 1),
+                "megakernel_tok_s": round(B / max(t_mk, 1e-9), 1),
+                "batch": B, "prefix": PRE,
+                # On TPU, jit already compiles the whole layer decode
+                # into ONE executable, so the megakernel's
+                # launch-elimination win (the reference's GPU story)
+                # does not transfer; its persistent task loop pays
+                # interpreter overhead instead. Kept as an honest
+                # capability measurement.
+                "note": "layer decode is one XLA executable under jit"}
+
     def run_hybrid_gdn():
         from triton_dist_tpu.models import Engine, ModelConfig, qwen_next
 
@@ -591,19 +694,23 @@ def battery():
         ("ulysses_qkv_gemm_a2a", run_ulysses),
         ("paged_flash_decode", run_paged_decode),
         ("hybrid_gdn_engine", run_hybrid_gdn),
+        ("engine_decode_throughput", run_decode_perf),
         ("megakernel_prefill_decode", run_megakernel(False)),
         ("megakernel_paged", run_megakernel(True)),
     ]
     results = []
     for name, fn in entries:
         t0 = time.perf_counter()
+        extra = None
         try:
-            fn()
+            extra = fn()   # optional dict of measured numbers
             ok, err = True, None
         except Exception as e:  # record, keep going
             ok, err = False, f"{type(e).__name__}: {str(e)[:160]}"
         dt_s = time.perf_counter() - t0
         rec = {"op": name, "ok": ok, "wall_s": round(dt_s, 2)}
+        if isinstance(extra, dict):
+            rec.update(extra)
         if err:
             rec["error"] = err
         results.append(rec)
