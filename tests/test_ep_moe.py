@@ -113,6 +113,89 @@ def test_tp_moe_layer_vs_dense_oracle(tp8_mesh, tp8_ctx):
     assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
 
 
+def test_ep_dispatch_combine_dropfree_roundtrip(tp8_mesh, tp8_ctx):
+    """Default (capacity=None) mode: exact-splits ragged dispatch.
+    Identity experts roundtrip exactly, num_dropped is structurally 0."""
+    T, d, E, K = 16, 32, 16, 2
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K, axis="tp")
+    tokens = _rand((8 * T, d), 30)
+    ids = jax.random.randint(jax.random.PRNGKey(31), (8 * T, K), 0, E)
+    w = jax.nn.softmax(_rand((8 * T, K), 32), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        return ep_combine(recv, state, w_, ctx), state.num_dropped[None]
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)),
+             (P("tp", None), P("tp")))
+    out, dropped = f(tokens, ids, w)
+    expected = tokens * jnp.sum(w, axis=-1, keepdims=True)
+    assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+    assert int(np.sum(np.asarray(dropped))) == 0
+
+
+def test_ep_dropfree_adversarial_skew_model_forward(tp8_mesh, tp8_ctx):
+    """Worst-case routing skew — EVERY token on every rank routed to
+    the experts of ONE rank — through the full MoE layer forward. The
+    capped mode would drop most tokens here; the drop-free default must
+    equal the dense oracle to float tolerance (VERDICT r2 #2)."""
+    cfg = ModelConfig.tiny_moe()
+    T = 16
+    params = ep_moe.init(jax.random.PRNGKey(40), cfg)
+    # Router forced: logits hugely favor experts 0 and 1 (both live on
+    # rank 0 for tiny_moe's num_experts/8 layout).
+    router = np.zeros((cfg.hidden_size, cfg.num_experts), np.float32)
+    router[:, 0] = 40.0
+    router[:, 1] = 20.0
+    params["router"] = jnp.asarray(router)
+    # Positive tokens: the linear router's logit is 40·sum(token), so a
+    # negative-sum token would invert the intended skew.
+    tokens = jnp.abs(_rand((8 * T, cfg.hidden_size), 41)) + 0.1
+    ctx = create_ep_context(tp8_ctx, num_experts=cfg.num_experts,
+                            topk=cfg.num_experts_per_tok, axis="tp")
+
+    f = spmd(tp8_mesh,
+             lambda p, t: ep_moe.fwd(p, t, ctx,
+                                     topk=cfg.num_experts_per_tok),
+             (ep_moe.param_specs("tp"), P("tp", None)), P("tp", None))
+    out = f(params, tokens)
+
+    ids, w = ep_moe.route(params["router"], tokens,
+                          cfg.num_experts_per_tok)
+    assert set(np.unique(np.asarray(ids))) <= {0, 1}  # skew took hold
+
+    def expert_fn(tok, e):
+        g = tok @ params["w_gate"][e]
+        u = tok @ params["w_up"][e]
+        return ((jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32))
+                .astype(tok.dtype)) @ params["w_down"][e]
+
+    expected = ep_moe_ref(tokens, ids, w, expert_fn, cfg.num_experts)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_ep_dropfree_quantized_wire(tp8_mesh, tp8_ctx):
+    """Drop-free mode composes with on-wire quantization: scales ride a
+    second ragged transport."""
+    T, d, E, K = 16, 32, 16, 2
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K, axis="tp",
+                            wire_dtype=jnp.dtype("int8"))
+    tokens = _rand((8 * T, d), 33)
+    ids = jax.random.randint(jax.random.PRNGKey(34), (8 * T, K), 0, E)
+    w = jax.nn.softmax(_rand((8 * T, K), 35), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        return ep_combine(recv, state, w_, ctx)
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)), P("tp", None))
+    out = np.asarray(f(tokens, ids, w))
+    expected = np.asarray(tokens * jnp.sum(w, axis=-1, keepdims=True))
+    np.testing.assert_allclose(out, expected, rtol=0.08, atol=0.08)
+
+
 def test_ep_capacity_overflow_drops(tp8_mesh, tp8_ctx):
     """Tokens beyond capacity are dropped (zero contribution), not
     corrupted."""

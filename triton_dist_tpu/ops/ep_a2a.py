@@ -6,15 +6,27 @@ low-latency double-buffered variant ``low_latency_all_to_all_v2.py``
 (``dispatch_kernel_v2`` :156, ``combine_kernel_v2`` :360,
 ``create_ep_ll_a2a_ctx`` :628).
 
-XLA/TPU redesign around static shapes (the reference already pads to
-MAX_M, ``README.md:133-145``): per-(src,dst) capacity ``C`` slots —
+XLA/TPU redesign around static shapes. Two modes:
 
-1. routing plan in plain XLA ops (cumsum/sort, no host sync),
-2. one low-latency all-to-all (``ops/all_to_all.py``) moving
-   ``(n, C, d)``; overflow tokens beyond C are dropped (zero weight),
-3. receiver sorts arrivals by local expert for the grouped GEMM,
-4. combine reverses the route with a second all-to-all and applies the
-   top-k weights at the source (weights never travel).
+**Drop-free dynamic splits (default, ``capacity=None``)** — the TPU
+analogue of the reference's exact-splits machinery
+(``get_ag_splits_and_recv_offset_for_dispatch``,
+``ep_all2all_fused.py:1924``): assignments are stable-sorted by
+destination rank, the exact per-(src,dst) counts matrix is exchanged
+with one tiny ``all_gather``, and only the real tokens travel via
+``lax.ragged_all_to_all`` into a receive buffer statically sized to the
+provable worst case (every global assignment routed here). No token can
+ever drop; wire traffic equals the actual splits, as in the reference.
+
+**Capped (``capacity=C``, opt-in)** — per-(src,dst) capacity ``C``
+slots; overflow tokens beyond C are dropped with zero weight and
+counted (``DispatchState.num_dropped``). This is the GShard-style
+inference capacity policy, useful when the worst-case receive buffer
+is too large; it is no longer the default.
+
+Both modes: receiver sorts arrivals by local expert for the grouped
+GEMM; combine reverses the route and applies the top-k weights at the
+source (weights never travel).
 """
 
 from __future__ import annotations
@@ -38,8 +50,10 @@ class EPContext:
     axis: str = "ep"
     num_experts: int = 8
     topk: int = 2
-    capacity: int = 128  # max tokens per (src rank, dst rank) pair
-    impl: str = "pallas"  # "pallas" | "xla" transport
+    # None (default): drop-free ragged dispatch sized from exact splits.
+    # int C: capped mode, max C tokens per (src rank, dst rank) pair.
+    capacity: Optional[int] = None
+    impl: str = "pallas"  # "pallas" | "xla" transport (capped mode)
     # On-wire quantization (reference low-latency a2a v2's optional fp8
     # online quant): tokens travel as wire_dtype with per-token scales.
     wire_dtype: Optional[object] = None  # e.g. jnp.float8_e4m3fn, jnp.int8
@@ -50,7 +64,7 @@ class EPContext:
 
 
 def create_ep_context(mesh: MeshContext, *, num_experts: int, topk: int,
-                      capacity: int, axis: str = "ep",
+                      capacity: Optional[int] = None, axis: str = "ep",
                       impl: str = "pallas",
                       wire_dtype=None) -> EPContext:
     if num_experts % mesh.size(axis):
@@ -82,6 +96,174 @@ class DispatchState:
 
 jax.tree_util.register_pytree_node(
     DispatchState, DispatchState.tree_flatten, DispatchState.tree_unflatten)
+
+
+@dataclasses.dataclass
+class RaggedDispatchState:
+    """Routing metadata for the drop-free (dynamic splits) mode.
+
+    perm: (T*K,) stable sort of assignments by destination rank (the
+    send order); counts_mat: (n, n) exact global splits, C[s, d] =
+    number of (token, k) assignments source s routed to destination d
+    — the TPU-resident form of the reference's exchanged splits cumsum.
+    num_dropped is always 0 (kept for API parity with DispatchState).
+    """
+    perm: jax.Array
+    counts_mat: jax.Array
+    valid: jax.Array        # (T, K) all-True
+    num_dropped: jax.Array = None
+
+    def tree_flatten(self):
+        return (self.perm, self.counts_mat, self.valid,
+                self.num_dropped), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    RaggedDispatchState, RaggedDispatchState.tree_flatten,
+    RaggedDispatchState.tree_unflatten)
+
+
+def _excl_cumsum(x):
+    return jnp.concatenate(
+        [jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
+
+
+def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis):
+    """Ragged all-to-all with packed-by-source-rank output layout.
+
+    On TPU this is one ``ragged-all-to-all`` HLO — only the real rows
+    cross ICI. XLA:CPU has no ThunkEmitter for that opcode, so off-TPU
+    (the 8-device CPU test mesh, the driver's dryrun) the same
+    semantics are emulated with a dense tiled all-to-all padded to the
+    worst case per pair; numerics are identical, only the wire padding
+    differs. ``out_off`` must describe the packed-by-source layout
+    (offset of my chunk in dst's buffer = packed prefix of earlier
+    sources), which is what both callers construct — the emulation
+    produces exactly that layout directly.
+    """
+    if jax.default_backend() == "tpu":
+        return jax.lax.ragged_all_to_all(
+            operand, out, in_off.astype(jnp.int32),
+            send_sz.astype(jnp.int32), out_off.astype(jnp.int32),
+            recv_sz.astype(jnp.int32), axis_name=axis)
+    n = in_off.shape[0]
+    s_rows = operand.shape[0]
+    r_rows = out.shape[0]
+    j = jnp.arange(s_rows)
+    dst = jnp.clip(jnp.searchsorted(in_off, j, side="right") - 1, 0,
+                   n - 1)
+    pos = j - in_off[dst]
+    v_send = pos < send_sz[dst]
+    buf = jnp.zeros((n, s_rows) + operand.shape[1:], operand.dtype)
+    buf = buf.at[dst, jnp.where(v_send, pos, s_rows)].set(
+        operand, mode="drop")
+    recv = all_to_all_ref(buf, axis=axis)        # (n, s_rows, ...)
+    roff = _excl_cumsum(recv_sz)
+    p = jnp.arange(s_rows)[None, :]
+    tgt = jnp.where(p < recv_sz[:, None], roff[:, None] + p, r_rows)
+    return out.at[tgt.reshape(-1)].set(
+        recv.reshape((n * s_rows,) + operand.shape[1:]), mode="drop")
+
+
+def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
+    """Exact-splits dispatch: zero drops by construction.
+
+    The receive buffer is statically sized to n·T·K rows — the provable
+    worst case (every assignment in the job routed to this rank). Only
+    ``sum(recv_sizes)`` rows actually travel or hold data; the valid
+    region is the packed prefix (sources land in rank order)."""
+    n = ctx.mesh.size(ctx.axis)
+    t, d = tokens.shape
+    k = topk_ids.shape[1]
+    tk = t * k
+    e_loc = ctx.experts_per_rank
+    rank = jax.lax.axis_index(ctx.axis)
+
+    dst_rank = (topk_ids // e_loc).reshape(-1)            # (TK,)
+    perm = jnp.argsort(dst_rank, stable=True)             # send order
+    send_tok = jnp.repeat(tokens, k, axis=0)[perm]        # (TK, d)
+    send_exp = (topk_ids % e_loc).reshape(-1)[perm]       # (TK,)
+
+    send_counts = jnp.bincount(dst_rank, length=n).astype(jnp.int32)
+    counts_mat = jax.lax.all_gather(send_counts, ctx.axis)     # (n, n)
+
+    in_off = _excl_cumsum(send_counts)
+    # Where my chunk starts in destination i's buffer: the packed
+    # prefix of earlier sources, sum_{s<rank} C[s, i].
+    out_off = jnp.sum(
+        jnp.where(jnp.arange(n)[:, None] < rank, counts_mat, 0), axis=0)
+    recv_sz = counts_mat[:, rank]
+
+    if ctx.wire_dtype is not None:
+        from triton_dist_tpu.ops.low_latency import quantize_rows
+
+        q, scale = quantize_rows(send_tok, ctx.wire_dtype)
+        rq = _ragged_a2a(q, jnp.zeros((n * tk, d), q.dtype),
+                         in_off, send_counts, out_off, recv_sz, ctx.axis)
+        rs = _ragged_a2a(scale, jnp.zeros((n * tk, 1), scale.dtype),
+                         in_off, send_counts, out_off, recv_sz, ctx.axis)
+        recv_tok = (rq.astype(jnp.float32) * rs).astype(tokens.dtype)
+    else:
+        recv_tok = _ragged_a2a(
+            send_tok, jnp.zeros((n * tk, d), tokens.dtype),
+            in_off, send_counts, out_off, recv_sz, ctx.axis)
+    recv_exp = _ragged_a2a(
+        send_exp[:, None], jnp.full((n * tk, 1), -1, jnp.int32),
+        in_off, send_counts, out_off, recv_sz, ctx.axis)[:, 0]
+    # Sources land packed in rank order → valid slots are exactly the
+    # prefix. Mask the tail regardless of the output buffer's fill
+    # value (unwritten regions are not guaranteed preserved).
+    recv_exp = jnp.where(jnp.arange(n * tk) < jnp.sum(recv_sz),
+                         recv_exp, -1)
+
+    state = RaggedDispatchState(
+        perm=perm, counts_mat=counts_mat,
+        valid=jnp.ones((t, k), bool),
+        num_dropped=jnp.zeros((), jnp.int32))
+    return recv_tok, recv_exp, state
+
+
+def _ep_combine_dropfree(expert_out, state: RaggedDispatchState,
+                         topk_weights, ctx: EPContext):
+    """Reverse the ragged route and apply top-k weights at the source."""
+    n = ctx.mesh.size(ctx.axis)
+    t, k = topk_weights.shape
+    tk = t * k
+    d = expert_out.shape[-1]
+    rank = jax.lax.axis_index(ctx.axis)
+    counts_mat = state.counts_mat
+
+    recv_sz = counts_mat[:, rank]        # what I hold, per source
+    in_off = _excl_cumsum(recv_sz)
+    # Returning chunk to source s lands where s packed its sends to me:
+    # s's own exclusive cumsum of C[s, :] up to my rank.
+    out_off = jnp.sum(
+        jnp.where(jnp.arange(n)[None, :] < rank, counts_mat, 0), axis=1)
+    send_back = counts_mat[rank, :]      # what each source gets back
+
+    if ctx.wire_dtype is not None:
+        from triton_dist_tpu.ops.low_latency import quantize_rows
+
+        q, scale = quantize_rows(expert_out, ctx.wire_dtype)
+        rq = _ragged_a2a(q, jnp.zeros((tk, d), q.dtype),
+                         in_off, recv_sz, out_off, send_back, ctx.axis)
+        rs = _ragged_a2a(scale, jnp.zeros((tk, 1), scale.dtype),
+                         in_off, recv_sz, out_off, send_back, ctx.axis)
+        back = (rq.astype(jnp.float32) * rs).astype(expert_out.dtype)
+    else:
+        back = _ragged_a2a(
+            expert_out, jnp.zeros((tk, d), expert_out.dtype),
+            in_off, recv_sz, out_off, send_back, ctx.axis)
+    # back is in send (sorted) order — invert the sort.
+    unsorted = jnp.zeros_like(back).at[state.perm].set(back)
+    gathered = unsorted.reshape(t, k, d)
+    return jnp.einsum("tkd,tk->td", gathered.astype(jnp.float32),
+                      topk_weights.astype(jnp.float32)
+                      ).astype(expert_out.dtype)
 
 
 def _transport(ctx: EPContext, x):
@@ -119,9 +301,12 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
     """Route tokens to the ranks owning their top-k experts.
 
     tokens: (T, d); topk_ids: (T, K) global expert ids.
-    Returns (recv_tokens (n*C, d), recv_expert (n*C,) local expert id or
-    -1 for empty slots, state: DispatchState).
+    Returns (recv_tokens (R, d), recv_expert (R,) local expert id or
+    -1 for empty slots, state). R = n*T*K in the default drop-free mode
+    (exact splits, ragged transport), n*C in capped mode.
     """
+    if ctx.capacity is None:
+        return _ep_dispatch_dropfree(tokens, topk_ids, ctx)
     n = ctx.mesh.size(ctx.axis)
     t, d = tokens.shape
     k = topk_ids.shape[1]
@@ -164,8 +349,10 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
 def ep_combine(expert_out, state: DispatchState, topk_weights,
                ctx: EPContext):
     """Return expert outputs to their source ranks and reduce with the
-    top-k weights. expert_out: (n*C, d) in the same slot order as
-    ep_dispatch's recv_tokens. Returns (T, d)."""
+    top-k weights. expert_out: same row order as ep_dispatch's
+    recv_tokens. Returns (T, d)."""
+    if isinstance(state, RaggedDispatchState):
+        return _ep_combine_dropfree(expert_out, state, topk_weights, ctx)
     n = ctx.mesh.size(ctx.axis)
     cap = ctx.capacity
     d = expert_out.shape[-1]
