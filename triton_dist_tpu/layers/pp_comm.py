@@ -1,18 +1,36 @@
-"""Pipeline-parallel communication layer.
+"""Pipeline-parallel communication + schedules.
 
 Reference: ``layers/nvidia/pp_block.py:36,102`` ``PPCommLayer`` /
 ``PyTorchP2P`` over the p2p put/get kernels (``kernels/nvidia/p2p.py``),
 benchmarked by ``bench_pp.py``.
 
-TPU form: stage boundaries are one-sided puts to the next stage
-(``ops/p2p.py``) or ``lax.ppermute`` (``impl="xla"``); a simple
-GPipe-style microbatch schedule helper runs a list of stage functions
-under ``shard_map``.
+TPU form (SPMD over a ``pp`` mesh axis):
+
+- :func:`send_next` — stage boundary as one one-sided put
+  (``ops/p2p.py``) or ``lax.ppermute``.
+- :func:`gpipe_forward` — the real pipeline schedule: the batch is
+  split into M microbatches and run for ``M + S - 1`` lockstep ticks
+  inside ``lax.scan``; each rank computes ONLY its own stage per tick
+  (params are pp-sharded, so the rank-local ``stage_fn`` *is* the
+  stage), activations shift one stage per tick. Per-rank FLOPs are
+  ``(M + S - 1) / (M · S)`` of the sequential total — → 1/S for large
+  M, against the ``jnp.where``-masked relay's S× waste (the round-2
+  shim this replaces).
+- Backward: the schedule is a pure ``scan``+``ppermute`` program, so
+  ``jax.grad`` through it yields the reverse pipeline automatically —
+  backward microbatches drain in LIFO order, which is exactly the
+  synchronous GPipe backward. Wrap ``stage_fn`` in ``jax.checkpoint``
+  to keep activation memory at one stash per tick (the 1F1B memory
+  motivation, achieved here by rematerialization instead of schedule
+  interleaving — the TPU/XLA-idiomatic trade).
+- :func:`pipeline_forward` — the unbatched relay (kept for inference
+  bring-up and as the oracle in tests); it computes every stage's
+  ``where``-mask on every rank and is NOT the performance path.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -32,16 +50,56 @@ def send_next(x, *, axis: str = "pp", ctx: MeshContext = None,
     return p2p_put(x, perm, ctx=ctx, axis=axis)
 
 
+def gpipe_forward(stage_fn: Callable, x_mb, *, axis: str = "pp",
+                  ctx: MeshContext = None, impl: str = "xla",
+                  collect: str = "broadcast", remat: bool = False):
+    """Microbatched GPipe schedule (the reference's ``pp_block`` relay
+    generalized to a full pipeline, ``bench_pp.py`` workload).
+
+    stage_fn: ``h -> h`` for THIS rank's stage — close over the
+    rank-local (pp-sharded) parameters; it runs once per tick, so each
+    rank performs only its own stage's FLOPs.
+    x_mb: ``(M, mb, ...)`` microbatches; only stage 0 reads them.
+    collect: ``"broadcast"`` returns ``(M, mb, ...)`` replicated on all
+    ranks (a one-hot psum off the last stage); ``"last"`` returns the
+    raw per-rank tick outputs for schedule-level tests.
+    remat: wrap the per-tick stage compute in ``jax.checkpoint`` so the
+    backward pipeline rematerializes instead of stashing every tick.
+    """
+    me = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    m = x_mb.shape[0]
+    ticks = m + n - 1
+
+    def one_tick(h_carry, t):
+        # Receive the upstream stage's previous output; stage 0 feeds
+        # the next microbatch instead (clipped index — ticks past M
+        # feed a dummy that never reaches the output window).
+        h_in = send_next(h_carry, axis=axis, ctx=ctx, impl=impl)
+        feed = x_mb[jnp.clip(t, 0, m - 1)]
+        h_in = jnp.where(me == 0, feed.astype(h_carry.dtype), h_in)
+        h_out = (jax.checkpoint(stage_fn) if remat else stage_fn)(h_in)
+        return h_out.astype(h_carry.dtype), h_out
+
+    h0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+    _, ys = jax.lax.scan(one_tick, h0, jnp.arange(ticks))
+    # Microbatch i leaves the last stage at tick i + n - 1.
+    outs = ys[n - 1:]
+    if collect == "last":
+        return outs
+    # where, not multiply-by-mask: warmup/drain ticks run stage_fn on
+    # garbage carries on non-final ranks, and a NaN there would poison
+    # the psum (NaN·0 = NaN).
+    return jax.lax.psum(jnp.where(me == n - 1, outs, 0), axis)
+
+
 def pipeline_forward(stage_fn: Callable, x, *, num_stages: int,
                      axis: str = "pp", ctx: MeshContext = None,
                      impl: str = "xla"):
-    """Run ``stage_fn(stage_index, h)`` through all pipeline stages.
-
-    Every rank holds its stage's layers; activations flow stage to
-    stage; rank ``num_stages-1`` ends with the final output, which is
-    broadcast back. (A microbatched 1F1B schedule is the training-side
-    extension; inference forward only needs the relay.)
-    """
+    """Unbatched stage relay: activations ripple through all stages with
+    every rank lockstep-computing and ``where``-masking. S× redundant
+    compute — bring-up/oracle only; use :func:`gpipe_forward` with
+    microbatches for the real schedule."""
     me = jax.lax.axis_index(axis)
     h = x
     for stage in range(num_stages):
@@ -50,7 +108,5 @@ def pipeline_forward(stage_fn: Callable, x, *, num_stages: int,
         h = jnp.where(active, h_new, h)
         if stage < num_stages - 1:
             h = send_next(h, axis=axis, ctx=ctx, impl=impl)
-            # Only the next stage consumes it; others carry h unchanged.
-    # Broadcast final stage's result to all ranks (psum of a one-hot).
     keep = (me == num_stages - 1).astype(h.dtype)
     return jax.lax.psum(h * keep, axis)

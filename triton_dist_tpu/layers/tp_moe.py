@@ -27,6 +27,26 @@ def param_specs(axis: str = "tp") -> Dict:
     }
 
 
+def _expert_mlp(params, x, *, topk: int, num_experts: int,
+                norm_topk_prob: bool):
+    """Shared expert-compute core: route → replicate per selected
+    expert → sort by expert → grouped SwiGLU over the local ffn slice →
+    weighted un-sort. Returns ``(out (t, k, d), topk_w (t, k))`` —
+    prefill (`fwd`) and decode (`fwd_ar`) differ only in the
+    surrounding collectives."""
+    t, d = x.shape
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+    k = topk_ids.shape[1]
+    flat_exp = topk_ids.reshape(-1)
+    tok_rep = jnp.repeat(x, k, axis=0)
+    sorted_tok, group_sizes, inv = sort_by_expert(tok_rep, flat_exp,
+                                                  num_experts)
+    out = grouped_swiglu(sorted_tok, params["w_gate"], params["w_up"],
+                         params["w_down"], group_sizes)
+    return out[inv].reshape(t, k, d), topk_w
+
+
 def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
         norm_topk_prob: bool = True, mesh_ctx=None):
     """x: (tokens_loc, d) token-sharded along ``axis`` → same layout out.
@@ -36,20 +56,9 @@ def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
     reference ``moe_reduce_rs.py`` pairing) instead of the XLA
     combine + ``psum_scatter`` round-trip."""
     x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
-    t, d = x_full.shape
-    topk_ids, topk_w = route(params["router"], x_full, topk,
-                             norm_topk_prob=norm_topk_prob)
-
-    # Replicate each token per selected expert, sort by expert, grouped
-    # GEMM over the local ffn slice, then weighted un-sort.
-    k = topk_ids.shape[1]
-    flat_exp = topk_ids.reshape(-1)
-    tok_rep = jnp.repeat(x_full, k, axis=0)
-    sorted_tok, group_sizes, inv = sort_by_expert(tok_rep, flat_exp,
-                                                  num_experts)
-    out = grouped_swiglu(sorted_tok, params["w_gate"], params["w_up"],
-                         params["w_down"], group_sizes)
-    out = out[inv].reshape(t, k, d)
+    out, topk_w = _expert_mlp(params, x_full, topk=topk,
+                              num_experts=num_experts,
+                              norm_topk_prob=norm_topk_prob)
     if mesh_ctx is not None:
         from triton_dist_tpu.ops.moe_reduce import moe_reduce_rs
 
@@ -60,6 +69,23 @@ def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
                          topk_w.astype(jnp.float32))
     return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
                                 tiled=True).astype(x.dtype)
+
+
+def fwd_ar(params, x, *, topk: int, num_experts: int, axis: str = "tp",
+           norm_topk_prob: bool = True):
+    """Decode-path TP-MoE on a *replicated* batch (the GEMM+AR regime,
+    reference ``gemm_allreduce_layer.py`` pairing for MoE): every rank
+    routes the same rows, computes the grouped SwiGLU over its ffn
+    shard, and the weighted combine is completed by one AllReduce.
+
+    x: (b, d) identical on all ranks → (b, d) identical on all ranks.
+    """
+    out, topk_w = _expert_mlp(params, x, topk=topk,
+                              num_experts=num_experts,
+                              norm_topk_prob=norm_topk_prob)
+    partial = jnp.einsum("tkd,tk->td", out.astype(jnp.float32),
+                         topk_w.astype(jnp.float32))
+    return jax.lax.psum(partial, axis).astype(x.dtype)
 
 
 def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
@@ -82,6 +108,7 @@ def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
     """
     from triton_dist_tpu.ops.ag_moe import (
         create_ag_moe_context, ag_group_gemm, prepare_grouped_tokens,
+        suggested_block_m,
     )
     from triton_dist_tpu.ops.group_gemm import grouped_gemm_tiles
     from triton_dist_tpu.ops.moe_reduce import moe_reduce_ar, moe_reduce_rs
@@ -93,6 +120,9 @@ def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
     t_loc, d = x.shape
     topk_ids, topk_w = route(params["router"], x, topk,
                              norm_topk_prob=norm_topk_prob)
+    # Cap the row tile for large-E configs so expert-segment padding
+    # (E·(block_m-1) worst case) stays bounded by the real rows.
+    block_m = suggested_block_m(t_loc, topk, num_experts, block_m)
     x_s, te, row_src = prepare_grouped_tokens(x, topk_ids, num_experts,
                                               block_m)
     s_loc = x_s.shape[0]

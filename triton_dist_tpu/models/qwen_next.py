@@ -13,6 +13,11 @@ ag_gemm/gemm_rs (prefill) and gemm_ar (decode).
 The hybrid cache pairs the softmax layers' :class:`KVCache` with the GDN
 layers' recurrent states (B, H_loc, dk, dv) — constant memory in
 sequence length, the point of the architecture for long context.
+
+MoE configs (``cfg.is_moe``, e.g. ``qwen3_next_80b_a3b``) replace the
+dense FFN with a TP-MoE block: grouped SwiGLU over the local ffn shard
+(fused AG-grouped-GEMM pipeline in "fused" prefill) and the GEMM+AR
+regime for replicated decode rows.
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.layers import gdn_attn, tp_attn, tp_mlp
+from triton_dist_tpu.layers import ep_moe, gdn_attn, tp_attn, tp_mlp, tp_moe
 from triton_dist_tpu.layers.norm import rms_norm
 from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.models.dense import (
@@ -82,7 +87,12 @@ def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
                  else gdn_attn.init(ka, cfg, dtype))
         layers.append({
             "mixer": mixer,
-            "mlp": tp_mlp.init(km, cfg, dtype),
+            # MoE FFN when configured (Qwen3-Next-80B-A3B is MoE; the
+            # r2 advisor flagged that dropping it silently served the
+            # wrong architecture). Router/expert weights are shared
+            # between the tp and ep layer forms.
+            "mlp": (ep_moe.init(km, cfg, dtype) if cfg.is_moe
+                    else tp_mlp.init(km, cfg, dtype)),
             "ln_attn": jnp.ones((cfg.hidden_size,), dtype),
             "ln_mlp": jnp.ones((cfg.hidden_size,), dtype),
         })
@@ -105,7 +115,8 @@ def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
                  else gdn_attn.param_specs(axis))
         layers.append({
             "mixer": mixer,
-            "mlp": tp_mlp.param_specs(axis),
+            "mlp": (tp_moe.param_specs(axis) if cfg.is_moe
+                    else tp_mlp.param_specs(axis)),
             "ln_attn": P(None),
             "ln_mlp": P(None),
         })
@@ -160,9 +171,25 @@ def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
                     (ordinal, 0, 0, 0, 0))
         x = x + mix_out
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        x = x + tp_mlp.fwd(lp["mlp"], h, mode=mode, axis=axis,
-                           ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
-                           ar_ctx=ctxs.ar)
+        if cfg.is_moe:
+            if mode == "fused" and ctxs.ag is not None:
+                ffn_out = tp_moe.fwd_fused(
+                    lp["mlp"], h, topk=cfg.num_experts_per_tok,
+                    num_experts=cfg.num_experts,
+                    mesh_ctx=ctxs.ag.mesh, axis=axis,
+                    block_m=ctxs.ag.block_m, block_n=ctxs.ag.block_n,
+                    block_k=ctxs.ag.block_k,
+                    norm_topk_prob=cfg.norm_topk_prob)
+            else:
+                ffn_out = tp_moe.fwd(
+                    lp["mlp"], h, topk=cfg.num_experts_per_tok,
+                    num_experts=cfg.num_experts, axis=axis,
+                    norm_topk_prob=cfg.norm_topk_prob)
+        else:
+            ffn_out = tp_mlp.fwd(lp["mlp"], h, mode=mode, axis=axis,
+                                 ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                                 ar_ctx=ctxs.ar)
+        x = x + ffn_out
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
     if mode in ("xla", "fused"):
         x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
@@ -225,10 +252,19 @@ def decode_step(params, token_ids, cache: HybridCache,
                 new_states, st[None], (ordinal, 0, 0, 0, 0))
         x = x + mix_out
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
-        x = x + tp_mlp.fwd(lp["mlp"], h, mode=mlp_mode, axis=axis,
-                           ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
-                           ar_ctx=ctxs.ar)
+        if cfg.is_moe:
+            # Replicated decode rows: grouped SwiGLU over the local ffn
+            # shard + one AllReduce (the GEMM+AR decode regime).
+            x = x + tp_moe.fwd_ar(lp["mlp"], h,
+                                  topk=cfg.num_experts_per_tok,
+                                  num_experts=cfg.num_experts,
+                                  axis=axis,
+                                  norm_topk_prob=cfg.norm_topk_prob)
+        else:
+            mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+            x = x + tp_mlp.fwd(lp["mlp"], h, mode=mlp_mode, axis=axis,
+                               ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                               ar_ctx=ctxs.ar)
 
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
     logits = _lm_head(params, x, axis)

@@ -34,6 +34,8 @@ from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
 )
 from triton_dist_tpu.ops.ep_a2a import (  # noqa: F401
     EPContext, create_ep_context, ep_dispatch, ep_combine, ep_moe_ref,
+    EP2DContext, create_ep2d_context, ep_dispatch_2d, ep_combine_2d,
+    ragged_exchange, ragged_return,
 )
 from triton_dist_tpu.ops.ep_fused import (  # noqa: F401
     EPFusedContext, create_ep_fused_context, ep_route, ep_dispatch_gemm,

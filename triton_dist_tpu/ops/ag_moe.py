@@ -68,9 +68,28 @@ def padded_rows(num_tokens: int, topk: int, num_experts: int,
                 block_m: int) -> int:
     """Static row count of the sorted layout: every expert segment is
     padded up to a multiple of ``block_m``, so the worst case adds
-    ``block_m - 1`` rows per expert."""
+    ``block_m - 1`` rows per expert.
+
+    The padding scales as ``E·(block_m - 1)``: for large-E configs
+    (e.g. 512 experts at block_m=128) it dominates the layout at
+    realistic token counts, and fully-padded tail tiles still burn MXU
+    work against expert E-1. Pick ``block_m`` with
+    :func:`suggested_block_m` so padding stays bounded by the real
+    row count."""
     total = num_tokens * topk + num_experts * (block_m - 1)
     return -(-total // block_m) * block_m
+
+
+def suggested_block_m(num_tokens: int, topk: int, num_experts: int,
+                      block_m: int, floor: int = 8) -> int:
+    """Largest power-of-two cap of ``block_m`` whose worst-case padding
+    (``E·(block_m-1)`` rows) does not exceed the real row count
+    ``T·K`` — the guard against the large-E regime where padding tiles
+    would dominate the grouped GEMM."""
+    while block_m > floor and num_experts * (block_m - 1) > (
+            num_tokens * topk):
+        block_m = max(floor, block_m // 2)
+    return block_m
 
 
 def prepare_grouped_tokens(x, topk_ids, num_experts: int, block_m: int

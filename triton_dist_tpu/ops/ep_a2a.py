@@ -169,59 +169,127 @@ def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis):
         recv.reshape((n * s_rows,) + operand.shape[1:]), mode="drop")
 
 
-def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
-    """Exact-splits dispatch: zero drops by construction.
+@dataclasses.dataclass
+class ExchangeState:
+    """One ragged exchange hop: sort permutation + global counts."""
+    perm: jax.Array        # (N,) stable sort of rows by destination
+    counts_mat: jax.Array  # (n, n) C[s, d] = rows s sent to d
 
-    The receive buffer is statically sized to n·T·K rows — the provable
-    worst case (every assignment in the job routed to this rank). Only
-    ``sum(recv_sizes)`` rows actually travel or hold data; the valid
-    region is the packed prefix (sources land in rank order)."""
-    n = ctx.mesh.size(ctx.axis)
-    t, d = tokens.shape
-    k = topk_ids.shape[1]
-    tk = t * k
-    e_loc = ctx.experts_per_rank
-    rank = jax.lax.axis_index(ctx.axis)
+    def tree_flatten(self):
+        return (self.perm, self.counts_mat), None
 
-    dst_rank = (topk_ids // e_loc).reshape(-1)            # (TK,)
-    perm = jnp.argsort(dst_rank, stable=True)             # send order
-    send_tok = jnp.repeat(tokens, k, axis=0)[perm]        # (TK, d)
-    send_exp = (topk_ids % e_loc).reshape(-1)[perm]       # (TK,)
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
 
-    send_counts = jnp.bincount(dst_rank, length=n).astype(jnp.int32)
-    counts_mat = jax.lax.all_gather(send_counts, ctx.axis)     # (n, n)
+
+jax.tree_util.register_pytree_node(
+    ExchangeState, ExchangeState.tree_flatten,
+    ExchangeState.tree_unflatten)
+
+
+def ragged_exchange(arrays, dst, axis: str, fills=None):
+    """Drop-free exchange of rows by destination index along ``axis``.
+
+    arrays: tuple of (N, ...) row-aligned payloads; dst: (N,) int32
+    destination (within the axis), or -1 for rows that must not travel
+    (they sort to the tail and are excluded from the counts). Returns
+    (recv_arrays, state): each recv array is (n·N, ...) with valid rows
+    packed at the front in source-rank order; invalid tail rows hold
+    ``fills[i]``. This is the generic hop both the flat and the
+    hierarchical (ICI×DCN) EP dispatch build on.
+    """
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    n_rows = dst.shape[0]
+    key = jnp.where(dst < 0, n, dst)
+    perm = jnp.argsort(key, stable=True)
+    send_counts = jnp.bincount(key[perm], length=n).astype(jnp.int32)
+    counts_mat = jax.lax.all_gather(send_counts, axis)      # (n, n)
 
     in_off = _excl_cumsum(send_counts)
-    # Where my chunk starts in destination i's buffer: the packed
-    # prefix of earlier sources, sum_{s<rank} C[s, i].
     out_off = jnp.sum(
         jnp.where(jnp.arange(n)[:, None] < rank, counts_mat, 0), axis=0)
     recv_sz = counts_mat[:, rank]
+    total = jnp.sum(recv_sz)
+
+    if fills is None:
+        fills = tuple(0 for _ in arrays)
+    recv = []
+    for arr, fill in zip(arrays, fills):
+        squeeze = arr.ndim == 1
+        a = arr[perm]
+        if squeeze:
+            a = a[:, None]
+        out = jnp.full((n * n_rows,) + a.shape[1:], fill, a.dtype)
+        r = _ragged_a2a(a, out, in_off, send_counts, out_off, recv_sz,
+                        axis)
+        r = jnp.where(
+            (jnp.arange(n * n_rows) < total).reshape(
+                (-1,) + (1,) * (r.ndim - 1)),
+            r, jnp.asarray(fill, r.dtype))
+        recv.append(r[:, 0] if squeeze else r)
+    return tuple(recv), ExchangeState(perm=perm, counts_mat=counts_mat)
+
+
+def ragged_return(array, state: ExchangeState, axis: str, *,
+                  out_rows: int, fill=0):
+    """Reverse a :func:`ragged_exchange` hop: rows travel back to their
+    source and are unsorted to the original row order. Rows that never
+    traveled (dst was -1) come back as ``fill``."""
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    counts_mat = state.counts_mat
+
+    recv_sz = counts_mat[:, rank]
+    in_off = _excl_cumsum(recv_sz)
+    out_off = jnp.sum(
+        jnp.where(jnp.arange(n)[None, :] < rank, counts_mat, 0), axis=1)
+    send_back = counts_mat[rank, :]
+
+    squeeze = array.ndim == 1
+    a = array[:, None] if squeeze else array
+    out = jnp.full((out_rows,) + a.shape[1:], fill, a.dtype)
+    back = _ragged_a2a(a, out, in_off, recv_sz, out_off, send_back, axis)
+    # Valid rows occupy the sorted prefix; unsort. Tail (untraveled)
+    # rows keep their scatter source — mask them to fill afterwards.
+    n_valid = jnp.sum(send_back)
+    unsorted = jnp.full_like(back, fill).at[state.perm].set(
+        jnp.where((jnp.arange(out_rows) < n_valid).reshape(
+            (-1,) + (1,) * (back.ndim - 1)),
+            back, jnp.asarray(fill, back.dtype)))
+    return unsorted[:, 0] if squeeze else unsorted
+
+
+def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
+    """Exact-splits dispatch: zero drops by construction.
+
+    One :func:`ragged_exchange` hop keyed by destination rank. The
+    receive buffer is statically sized to n·T·K rows — the provable
+    worst case (every assignment in the job routed to this rank). Only
+    ``sum(recv_sizes)`` rows actually travel or hold data; the valid
+    region is the packed prefix (sources land in rank order)."""
+    t, d = tokens.shape
+    k = topk_ids.shape[1]
+    e_loc = ctx.experts_per_rank
+
+    dst_rank = (topk_ids // e_loc).reshape(-1).astype(jnp.int32)
+    local_exp = (topk_ids % e_loc).reshape(-1).astype(jnp.int32)
+    rep_tok = jnp.repeat(tokens, k, axis=0)               # (TK, d)
 
     if ctx.wire_dtype is not None:
         from triton_dist_tpu.ops.low_latency import quantize_rows
 
-        q, scale = quantize_rows(send_tok, ctx.wire_dtype)
-        rq = _ragged_a2a(q, jnp.zeros((n * tk, d), q.dtype),
-                         in_off, send_counts, out_off, recv_sz, ctx.axis)
-        rs = _ragged_a2a(scale, jnp.zeros((n * tk, 1), scale.dtype),
-                         in_off, send_counts, out_off, recv_sz, ctx.axis)
+        q, scale = quantize_rows(rep_tok, ctx.wire_dtype)
+        (rq, rs, recv_exp), st = ragged_exchange(
+            (q, scale, local_exp), dst_rank, ctx.axis, fills=(0, 0, -1))
         recv_tok = (rq.astype(jnp.float32) * rs).astype(tokens.dtype)
     else:
-        recv_tok = _ragged_a2a(
-            send_tok, jnp.zeros((n * tk, d), tokens.dtype),
-            in_off, send_counts, out_off, recv_sz, ctx.axis)
-    recv_exp = _ragged_a2a(
-        send_exp[:, None], jnp.full((n * tk, 1), -1, jnp.int32),
-        in_off, send_counts, out_off, recv_sz, ctx.axis)[:, 0]
-    # Sources land packed in rank order → valid slots are exactly the
-    # prefix. Mask the tail regardless of the output buffer's fill
-    # value (unwritten regions are not guaranteed preserved).
-    recv_exp = jnp.where(jnp.arange(n * tk) < jnp.sum(recv_sz),
-                         recv_exp, -1)
+        (recv_tok, recv_exp), st = ragged_exchange(
+            (rep_tok, local_exp), dst_rank, ctx.axis, fills=(0, -1))
 
     state = RaggedDispatchState(
-        perm=perm, counts_mat=counts_mat,
+        perm=st.perm, counts_mat=st.counts_mat,
         valid=jnp.ones((t, k), bool),
         num_dropped=jnp.zeros((), jnp.int32))
     return recv_tok, recv_exp, state
@@ -229,38 +297,23 @@ def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
 
 def _ep_combine_dropfree(expert_out, state: RaggedDispatchState,
                          topk_weights, ctx: EPContext):
-    """Reverse the ragged route and apply top-k weights at the source."""
-    n = ctx.mesh.size(ctx.axis)
+    """Reverse the ragged route (:func:`ragged_return`) and apply the
+    top-k weights at the source."""
     t, k = topk_weights.shape
     tk = t * k
     d = expert_out.shape[-1]
-    rank = jax.lax.axis_index(ctx.axis)
-    counts_mat = state.counts_mat
-
-    recv_sz = counts_mat[:, rank]        # what I hold, per source
-    in_off = _excl_cumsum(recv_sz)
-    # Returning chunk to source s lands where s packed its sends to me:
-    # s's own exclusive cumsum of C[s, :] up to my rank.
-    out_off = jnp.sum(
-        jnp.where(jnp.arange(n)[None, :] < rank, counts_mat, 0), axis=1)
-    send_back = counts_mat[rank, :]      # what each source gets back
+    st = ExchangeState(perm=state.perm, counts_mat=state.counts_mat)
 
     if ctx.wire_dtype is not None:
         from triton_dist_tpu.ops.low_latency import quantize_rows
 
         q, scale = quantize_rows(expert_out, ctx.wire_dtype)
-        rq = _ragged_a2a(q, jnp.zeros((tk, d), q.dtype),
-                         in_off, recv_sz, out_off, send_back, ctx.axis)
-        rs = _ragged_a2a(scale, jnp.zeros((tk, 1), scale.dtype),
-                         in_off, recv_sz, out_off, send_back, ctx.axis)
+        rq = ragged_return(q, st, ctx.axis, out_rows=tk)
+        rs = ragged_return(scale, st, ctx.axis, out_rows=tk)
         back = (rq.astype(jnp.float32) * rs).astype(expert_out.dtype)
     else:
-        back = _ragged_a2a(
-            expert_out, jnp.zeros((tk, d), expert_out.dtype),
-            in_off, recv_sz, out_off, send_back, ctx.axis)
-    # back is in send (sorted) order — invert the sort.
-    unsorted = jnp.zeros_like(back).at[state.perm].set(back)
-    gathered = unsorted.reshape(t, k, d)
+        back = ragged_return(expert_out, st, ctx.axis, out_rows=tk)
+    gathered = back.reshape(t, k, d)
     return jnp.einsum("tkd,tk->td", gathered.astype(jnp.float32),
                       topk_weights.astype(jnp.float32)
                       ).astype(expert_out.dtype)
@@ -367,6 +420,113 @@ def ep_combine(expert_out, state: DispatchState, topk_weights,
     w = jnp.where(state.valid, topk_weights, 0.0)
     return jnp.einsum("tkd,tk->td", gathered.astype(jnp.float32),
                       w.astype(jnp.float32)).astype(expert_out.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class EP2DContext:
+    """Hierarchical EP geometry over a (outer, inner) = (DCN, ICI)
+    2-axis mesh. Analogue of the reference's two-level inter-node
+    dispatch (``all_to_all_vdev_2d_offset_inter_node.py``): tokens hop
+    intra-node first (cheap ICI), aggregated per node, then cross the
+    slow DCN axis once — never n_ici separate DCN sends.
+
+    Expert ownership is outer-major: expert ``e`` lives on global rank
+    ``e // experts_per_rank`` with rank = dcn_idx·n_ici + ici_idx.
+    """
+    mesh: MeshContext
+    outer_axis: str = "dcn"
+    inner_axis: str = "ici"
+    num_experts: int = 8
+    topk: int = 2
+
+    @property
+    def experts_per_rank(self) -> int:
+        n = (self.mesh.size(self.outer_axis)
+             * self.mesh.size(self.inner_axis))
+        return self.num_experts // n
+
+
+def create_ep2d_context(mesh: MeshContext, *, num_experts: int,
+                        topk: int, outer_axis: str = "dcn",
+                        inner_axis: str = "ici") -> EP2DContext:
+    n = mesh.size(outer_axis) * mesh.size(inner_axis)
+    if num_experts % n:
+        raise ValueError(f"num_experts={num_experts} not divisible by "
+                         f"{outer_axis}x{inner_axis}={n}")
+    return EP2DContext(mesh=mesh, outer_axis=outer_axis,
+                       inner_axis=inner_axis, num_experts=num_experts,
+                       topk=topk)
+
+
+@dataclasses.dataclass
+class Dispatch2DState:
+    """Reverse-route metadata: one ExchangeState per hop."""
+    inner: ExchangeState
+    outer: ExchangeState
+    inner_rows: int
+    outer_rows: int
+
+    def tree_flatten(self):
+        return (self.inner, self.outer), (self.inner_rows,
+                                          self.outer_rows)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+
+jax.tree_util.register_pytree_node(
+    Dispatch2DState, Dispatch2DState.tree_flatten,
+    Dispatch2DState.tree_unflatten)
+
+
+def ep_dispatch_2d(tokens, topk_ids, ctx: EP2DContext):
+    """Two-hop drop-free dispatch: (d0,i0) → (d0,i1) over ICI, then
+    (d0,i1) → (d1,i1) over DCN. The ICI hop lands every assignment on
+    the local member whose inner index matches the target, so the DCN
+    hop is a single per-node aggregated exchange.
+
+    Returns (recv_tokens (R, d), recv_expert (R,), state);
+    R = n_dcn · n_ici · T · K (worst case, static).
+    """
+    n_ici = ctx.mesh.size(ctx.inner_axis)
+    t, d = tokens.shape
+    k = topk_ids.shape[1]
+    e_loc = ctx.experts_per_rank
+
+    owner = (topk_ids // e_loc).reshape(-1)          # global rank
+    dst_ici = (owner % n_ici).astype(jnp.int32)
+    dst_dcn = (owner // n_ici).astype(jnp.int32)
+    local_exp = (topk_ids % e_loc).reshape(-1).astype(jnp.int32)
+
+    rep_tok = jnp.repeat(tokens, k, axis=0)           # (TK, d)
+    (tok1, dcn1, exp1), st_inner = ragged_exchange(
+        (rep_tok, dst_dcn, local_exp), dst_ici, ctx.inner_axis,
+        fills=(0, -1, -1))
+    (tok2, exp2), st_outer = ragged_exchange(
+        (tok1, exp1), dcn1, ctx.outer_axis, fills=(0, -1))
+
+    state = Dispatch2DState(inner=st_inner, outer=st_outer,
+                            inner_rows=t * k,
+                            outer_rows=tok1.shape[0])
+    return tok2, exp2, state
+
+
+def ep_combine_2d(expert_out, state: Dispatch2DState, topk_weights,
+                  ctx: EP2DContext):
+    """Reverse both hops and reduce with the top-k weights at the
+    source. expert_out: rows aligned with ep_dispatch_2d's
+    recv_tokens."""
+    t, k = topk_weights.shape
+    d = expert_out.shape[-1]
+    back1 = ragged_return(expert_out, state.outer, ctx.outer_axis,
+                          out_rows=state.outer_rows)
+    back0 = ragged_return(back1, state.inner, ctx.inner_axis,
+                          out_rows=state.inner_rows)
+    gathered = back0.reshape(t, k, d)
+    return jnp.einsum("tkd,tk->td", gathered.astype(jnp.float32),
+                      topk_weights.astype(jnp.float32)
+                      ).astype(expert_out.dtype)
 
 
 def ep_moe_ref(tokens, topk_ids, topk_weights, expert_fn, num_experts):

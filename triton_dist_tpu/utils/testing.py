@@ -23,10 +23,23 @@ def spmd(mesh: Mesh, fn, in_specs, out_specs, jit: bool = True):
     The analogue of launching a reference test under torchrun
     (``scripts/launch.sh``): inside ``fn`` the code sees per-device
     shards and named axes.
+
+    The wrapper blocks until the result is ready: the interpret-mode
+    Pallas engine deadlocks if an unrelated JAX computation is
+    dispatched while a multi-kernel program is in flight (its vector-
+    clock io_callbacks dispatch nested jnp ops that starve the CPU
+    client's thread pool), so tests must never overlap an SPMD run
+    with oracle computation.
     """
     mapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
-    return jax.jit(mapped) if jit else mapped
+    compiled = jax.jit(mapped) if jit else mapped
+
+    def call(*args, **kwargs):
+        return jax.block_until_ready(compiled(*args, **kwargs))
+
+    call.lower = getattr(compiled, "lower", None)
+    return call
 
 
 def assert_allclose(actual: Any, desired: Any, rtol: float = 1e-5,
