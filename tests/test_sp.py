@@ -158,6 +158,111 @@ def test_sp_ag_attention_2d_vs_ref(dp2tp4_mesh, dp2tp4_ctx, inner, outer,
     assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
 
 
+def _varlen_oracle(q_full, k_full, v_full, cu):
+    """Ragged dense oracle, independent of the implementation's mask
+    helpers: slice the packed batch at each boundary and run plain
+    causal attention per sequence."""
+    cu = np.asarray(cu)
+    out = np.zeros(np.asarray(q_full).shape, np.float32)
+    for b, e in zip(cu[:-1], cu[1:]):
+        if e <= b:
+            continue
+        seg = sdpa(jnp.asarray(q_full)[None, b:e],
+                   jnp.asarray(k_full)[None, b:e],
+                   jnp.asarray(v_full)[None, b:e], causal=True)[0]
+        out[b:e] = np.asarray(seg, np.float32)
+    return out
+
+
+CU_MIXED = jnp.array([0, 5, 19, 40, 51, 64], jnp.int32)       # mixed
+CU_PADDED = jnp.array([0, 24, 64, 64, 64, 64, 64], jnp.int32)  # padded
+CU_ONE = jnp.array([0, 64], jnp.int32)                         # degenerate
+
+
+@pytest.mark.parametrize("cu", [CU_MIXED, CU_PADDED, CU_ONE],
+                         ids=["mixed", "padded", "single"])
+def test_sp_ag_attention_varlen_vs_oracle(tp8_mesh, tp8_ctx, cu):
+    """XLA ring varlen == ragged dense oracle (reference
+    sp_ag_attention_intra_node.py:113 cu_seqlens batches)."""
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 27)
+    k = _rand((s, h, hd), 28)
+    v = _rand((s, h, hd), 29)
+
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention(a, b, c, axis="tp",
+                                             cu_seqlens=cu),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    out = f(q, k, v)
+    expected = _varlen_oracle(q, k, v, cu)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("cu", [CU_MIXED, CU_PADDED, CU_ONE],
+                         ids=["mixed", "padded", "single"])
+def test_sp_ag_attention_fused_varlen_vs_oracle(tp8_mesh, tp8_ctx, cu):
+    """Fused kernel varlen (per-sequence masks + span-pruned sends) ==
+    ragged dense oracle. CU_MIXED places sequence boundaries both
+    inside chunks and across them; CU_PADDED makes ranks 4..7 share no
+    sequence with ranks 0..2, exercising the send pruning."""
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 30)
+    k = _rand((s, h, hd), 31)
+    v = _rand((s, h, hd), 32)
+
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_fused(
+                 a, b, c, ctx=tp8_ctx, axis="tp", block_q=4, block_kv=8,
+                 cu_seqlens=cu),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    out = f(q, k, v)
+    expected = _varlen_oracle(q, k, v, cu)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_sp_ag_attention_fused_varlen_gqa_multitile(tp8_mesh, tp8_ctx):
+    """Varlen fused with GQA (rep=2) and multiple KV tiles per chunk
+    (block_kv < S_loc) — exercises the rep-row repetition in qi and the
+    kvt*tkv offset in sid_k that the base varlen tests never hit."""
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+
+    s, h, kvh, hd = 64, 8, 4, 16
+    q = _rand((s, h, hd), 36)
+    k = _rand((s, kvh, hd), 37)
+    v = _rand((s, kvh, hd), 38)
+    cu = CU_MIXED
+
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_fused(
+                 a, b, c, ctx=tp8_ctx, axis="tp", block_q=4, block_kv=4,
+                 cu_seqlens=cu),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    out = f(q, k, v)
+    rep = h // kvh
+    expected = _varlen_oracle(q, jnp.repeat(k, rep, axis=1),
+                              jnp.repeat(v, rep, axis=1), cu)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_sp_ag_attention_varlen_single_equals_causal(tp8_mesh, tp8_ctx):
+    """Degenerate one-sequence cu must reproduce the plain causal path
+    bit-for-bit (same code path modulo masks)."""
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 33)
+    k = _rand((s, h, hd), 34)
+    v = _rand((s, h, hd), 35)
+    f_var = spmd(tp8_mesh,
+                 lambda a, b, c: sp_ag_attention(a, b, c, axis="tp",
+                                                 cu_seqlens=CU_ONE),
+                 (P("tp", None, None),) * 3, P("tp", None, None))
+    f_pl = spmd(tp8_mesh,
+                lambda a, b, c: sp_ag_attention(a, b, c, axis="tp"),
+                (P("tp", None, None),) * 3, P("tp", None, None))
+    assert_allclose(f_var(q, k, v), f_pl(q, k, v), rtol=0, atol=0)
+
+
 def test_sp_flash_decode_vs_dense(tp8_mesh, tp8_ctx):
     b, h, kvh, hd, t = 4, 8, 4, 16, 64
     q = _rand((b, h, hd), 10)

@@ -35,10 +35,13 @@ from triton_dist_tpu.lang import core_call
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
-def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True):
-    """Oracle: gather full KV then dense causal attention."""
+def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True,
+                        cu_seqlens=None):
+    """Oracle: gather full KV then dense (per-sequence) causal attention."""
     from triton_dist_tpu.layers.tp_attn import sdpa
 
+    if cu_seqlens is not None and not causal:
+        raise ValueError("varlen (cu_seqlens) requires causal=True")
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     s_loc = q.shape[0]
@@ -48,11 +51,26 @@ def sp_ag_attention_ref(q, k, v, *, axis: str = "sp", causal: bool = True):
         return sdpa(q[None], k_full[None], v_full[None], causal=False)[0]
     # Causal with the query offset of this rank's sequence slice.
     scores_mask_offset = me * s_loc
-    return _masked_attn(q, k_full, v_full, scores_mask_offset)
+    return _masked_attn(q, k_full, v_full, scores_mask_offset,
+                        cu_seqlens=cu_seqlens)
 
 
-def _masked_attn(q, k, v, q_offset, causal: bool = True):
-    """Dense attention where query global position = q_offset + row."""
+def _seq_of(cu_seqlens, pos):
+    """Sequence id of each packed position: count of sequence ends
+    ``cu_seqlens[1:]`` at or before ``pos``. Positions in
+    ``[cu[j], cu[j+1])`` get id j; duplicate (padding) boundaries at the
+    total length leave earlier ids untouched."""
+    cu = cu_seqlens.astype(jnp.int32)
+    return jnp.sum(cu[1:] <= pos[..., None], axis=-1).astype(jnp.int32)
+
+
+def _masked_attn(q, k, v, q_offset, causal: bool = True, cu_seqlens=None):
+    """Dense attention where query global position = q_offset + row.
+
+    With ``cu_seqlens`` ((num_seqs+1,) packed boundaries, cu[0]=0,
+    cu[-1]=total), attention is additionally confined to each query's
+    own sequence — the varlen form (reference
+    ``sp_ag_attention_intra_node.py:113`` cu_seqlens batches)."""
     sq, h, hd = q.shape
     skv, kvh = k.shape[0], k.shape[1]
     if kvh != h:
@@ -65,18 +83,33 @@ def _masked_attn(q, k, v, q_offset, causal: bool = True):
     if causal:
         qi = q_offset + jnp.arange(sq)[:, None]
         ki = jnp.arange(skv)[None, :]
-        scores = jnp.where((ki <= qi)[None], scores, -jnp.inf)
+        mask = ki <= qi
+        if cu_seqlens is not None:
+            mask = jnp.logical_and(
+                mask, _seq_of(cu_seqlens, qi) == _seq_of(cu_seqlens, ki))
+        # No fully-masked-row guard needed: a causal query always sees
+        # itself (ki==qi is same-sequence and <=).
+        scores = jnp.where(mask[None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("hqk,khd->qhd", probs, v)
 
 
-def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
+def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
+                    cu_seqlens=None):
     """Ring KV attention. q/k/v per-shard: (S_loc, H|KV, hd), sequence
-    sharded along ``axis``. Returns (S_loc, H, hd)."""
+    sharded along ``axis``. Returns (S_loc, H, hd).
+
+    ``cu_seqlens`` ((num_seqs+1,) int32 packed-batch boundaries,
+    replicated, cu[0]=0 and cu[-1]=n·S_loc; pad unused tail entries
+    with the total) switches to the varlen form: each query attends
+    causally within its own sequence only."""
+    if cu_seqlens is not None and not causal:
+        raise ValueError("varlen (cu_seqlens) requires causal=True")
     n = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     if n == 1:
-        return _masked_attn(q, k, v, 0, causal=causal)
+        return _masked_attn(q, k, v, 0, causal=causal,
+                            cu_seqlens=cu_seqlens)
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
     rep = h // kvh
@@ -96,7 +129,12 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
                            kc.astype(jnp.float32)
                            ).reshape(h, s_loc, s_loc) * scale
         if causal:
-            s_blk = jnp.where((ki <= qi)[None], s_blk, -jnp.inf)
+            mask = ki <= qi
+            if cu_seqlens is not None:
+                mask = jnp.logical_and(
+                    mask,
+                    _seq_of(cu_seqlens, qi) == _seq_of(cu_seqlens, ki))
+            s_blk = jnp.where(mask[None], s_blk, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))      # (h, q)
         # Guard fully-masked rows (m_new = -inf) against NaN.
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -133,12 +171,13 @@ def sp_ag_attention(q, k, v, *, axis: str = "sp", causal: bool = True):
 # Fused Pallas kernel: explicit per-chunk arrival waits
 # ---------------------------------------------------------------------------
 
-def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
-                       v_panel, m_v, l_v, acc_v, send_sem, recv_sem,
-                       k_sem, v_sem, *, inner_axis: str,
+def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
+                       k_panel, v_panel, m_v, l_v, acc_v, send_sem,
+                       recv_sem, k_sem, v_sem, *, inner_axis: str,
                        outer_axis: Optional[str], ctx: MeshContext,
                        n_inner: int, n_outer: int, s_loc: int, kvh: int,
-                       rep: int, tq: int, tkv: int, causal: bool):
+                       rep: int, tq: int, tkv: int, causal: bool,
+                       varlen: bool):
     i = pl.program_id(0)   # query tile (outer: arrival waits only at i=0)
     k = pl.program_id(1)   # chunk step; src = (me - k) mod n
     n_i = pl.num_programs(0)
@@ -148,10 +187,30 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
     oo = dl.rank(outer_axis) if outer_axis is not None else 0
     me = oo * ni + ii  # global rank, outer-major (canonical mesh order)
     src = jax.lax.rem(me - k + n, n)
+
+    if varlen:
+        # Per-sequence send/compute pruning: chunk dst reads chunk
+        # src < dst iff some packed sequence spans both — and a
+        # contiguous sequence touching both chunks must cover every
+        # position between them, so the test collapses to "the
+        # sequence id at src's last row equals the id at dst's first
+        # row". Sender, receiver, and drain all derive the same
+        # predicate from the replicated cu_seqlens — no handshake.
+        def span_need(src_g, dst_g):
+            s_end = jnp.sum(cu_ref[:, 1:] <= (src_g + 1) * s_loc - 1)
+            d_start = jnp.sum(cu_ref[:, 1:] <= dst_g * s_loc)
+            return s_end == d_start
+    else:
+        def span_need(src_g, dst_g):
+            return jnp.bool_(True)
+
     # Chunk-level causal pruning: chunk src > me is entirely in the
     # future of every local query row. src = me - k without wrap when
     # k <= me, so `k <= me` selects exactly the visible chunks.
     need = (k <= me) if causal else (k >= 0)
+    if varlen:
+        need = jnp.logical_and(
+            need, jnp.logical_or(k == 0, span_need(src, me)))
     n_kv = s_loc // tkv
     hd = q_ref.shape[-1]
     scale = 1.0 / (float(hd) ** 0.5)
@@ -191,6 +250,8 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
                 peer = jax.lax.rem(ii + off, ni)
                 pred = jnp.bool_(True)
             dst = oo * ni + peer
+            if varlen:
+                pred = jnp.logical_and(pred, span_need(me, dst))
 
             @pl.when(pred)
             def _():
@@ -313,7 +374,18 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
                     jnp.int32, (rep * tq, tkv), 1)
                 qi = me * s_loc + i * tq + jax.lax.rem(row, tq)
                 ki = src * s_loc + kvt * tkv + col
-                s = jnp.where(ki <= qi, s, -jnp.inf)
+                mask = ki <= qi
+                if varlen:
+                    # Sequence ids vary only along rows (qi) / cols
+                    # (ki): compute them as a column/row vector against
+                    # the (1, m) boundary array, then broadcast.
+                    sid_q = jnp.sum(cu_ref[:, 1:] <= qi[:, :1],
+                                    axis=1, keepdims=True)   # (R, 1)
+                    cu_col = cu_ref[:, 1:].reshape(-1, 1)
+                    sid_k = jnp.sum(cu_col <= ki[:1, :],
+                                    axis=0, keepdims=True)   # (1, T)
+                    mask = jnp.logical_and(mask, sid_q == sid_k)
+                s = jnp.where(mask, s, -jnp.inf)
             m_old = m_v[g]
             m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
             m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -342,6 +414,9 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
         # Drain send semaphores (same predicates as the sends).
         for off in range(1, ni):
             pred = (ii + off < ni) if causal else jnp.bool_(True)
+            if varlen:
+                pred = jnp.logical_and(
+                    pred, span_need(me, oo * ni + ii + off))
 
             @pl.when(pred)
             def _():
@@ -366,7 +441,7 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, o_ref, k_ws, v_ws, k_panel,
 
 
 def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
-                     block_q, block_kv):
+                     block_q, block_kv, cu_seqlens=None):
     """Shared host-side setup for the 1D and hierarchical fused forms."""
     ni = ctx.size(inner_axis)
     no = ctx.size(outer_axis) if outer_axis is not None else 1
@@ -374,6 +449,14 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
     rep = h // kvh
+
+    varlen = cu_seqlens is not None
+    if varlen:
+        cu2d = jnp.asarray(cu_seqlens, jnp.int32).reshape(1, -1)
+    else:
+        # Degenerate single-sequence boundaries keep one kernel
+        # signature; the varlen branches are compiled out.
+        cu2d = jnp.array([[0, n * s_loc]], jnp.int32)
 
     tq = min(block_q, s_loc)
     while tq > 1 and s_loc % tq:
@@ -392,7 +475,7 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
     kernel = functools.partial(
         _sp_ag_attn_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
         ctx=ctx, n_inner=ni, n_outer=no, s_loc=s_loc,
-        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal)
+        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal, varlen=varlen)
 
     o, _, _ = core_call(
         kernel,
@@ -408,6 +491,8 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, cu2d.shape[1]), lambda i, kk: (0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((h, tq, hd), lambda i, kk: (0, i, 0),
@@ -432,13 +517,13 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
                             + s_loc * h * hd * 2) * q.dtype.itemsize,
             transcendentals=n * s_loc * s_loc * h,
         ),
-    )(q_h, k_h, v_h)
+    )(q_h, k_h, v_h, cu2d)
     return jnp.transpose(o, (1, 0, 2))
 
 
 def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
                           causal: bool = True, block_q: int = 256,
-                          block_kv: int = 1024,
+                          block_kv: int = 1024, cu_seqlens=None,
                           force_kernel: bool = False):
     """Kernel-level KV-allgather attention (call inside shard_map).
 
@@ -449,19 +534,29 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
     arrival-semaphore wait — explicit comm/compute overlap, the
     reference's ``sp_ag_attention_intra_node`` redesigned for counting
     semaphores (no flag words, no producer stream).
+
+    ``cu_seqlens`` ((num_seqs+1,) int32 replicated packed boundaries,
+    cu[0]=0, cu[-1]=n·S_loc) enables the varlen form (reference
+    ``sp_ag_attention_intra_node.py:113``): per-sequence causal masks,
+    and chunk pushes are pruned to destinations that actually share a
+    sequence with the source chunk.
     """
+    if cu_seqlens is not None and not causal:
+        raise ValueError("varlen (cu_seqlens) requires causal=True")
     n = ctx.size(axis)
     if n == 1 and not force_kernel:
-        return _masked_attn(q, k, v, 0, causal=causal)
+        return _masked_attn(q, k, v, 0, causal=causal,
+                            cu_seqlens=cu_seqlens)
     return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=axis,
                             outer_axis=None, causal=causal,
-                            block_q=block_q, block_kv=block_kv)
+                            block_q=block_q, block_kv=block_kv,
+                            cu_seqlens=cu_seqlens)
 
 
 def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
                        inner_axis: str = "sp", outer_axis: str = "dp",
                        causal: bool = True, block_q: int = 256,
-                       block_kv: int = 1024):
+                       block_kv: int = 1024, cu_seqlens=None):
     """Hierarchical (ICI/DCN) KV-allgather attention — the inter-node
     schedule (reference ``sp_ag_attention_inter_node.py:116,329,505``).
 
@@ -473,6 +568,14 @@ def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
     latency hides under the inner-group chunks that are consumed first
     (the chunk order walks own group, then groups below).
     """
+    if cu_seqlens is not None:
+        # The mirror/relay forwarding decisions would each need the
+        # span predicate threaded through three send tiers; the varlen
+        # workload is the reference's intra-node form, so the 1D fused
+        # kernel (or the XLA ring form, any mesh) covers it.
+        raise NotImplementedError(
+            "varlen is supported by sp_ag_attention_fused (1D) and "
+            "sp_ag_attention (XLA ring); not the hierarchical schedule")
     ni = ctx.size(inner_axis)
     no = ctx.size(outer_axis)
     if ni * no == 1:
