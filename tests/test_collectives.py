@@ -64,6 +64,31 @@ def test_p2p_put_shift(tp8_mesh, tp8_ctx):
     assert_allclose(f(x), g(x))
 
 
+def test_p2p_put_multicast_grad(tp8_mesh, tp8_ctx):
+    """The custom VJP must SUM fan-in cotangents when the forward perm
+    multicasts one source to several destinations (the inverse perm
+    converges several edges on one rank — raced puts would drop one)."""
+    x = _rand((8, 128))
+    perm = [(0, 3), (0, 2), (1, 5)]   # rank 0 multicasts to 2 edges
+
+    def loss_pallas(v):
+        # Each rank seeds its own received tile's cotangent; backward
+        # transport must deliver (and SUM) them at the sources.
+        return jnp.sum(p2p_put(v, perm, ctx=tp8_ctx, axis="tp") ** 2)
+
+    g_pal = spmd(tp8_mesh, lambda v: jax.grad(loss_pallas)(v),
+                 P("tp", None), P("tp", None))(x)
+    # Oracle (lax.ppermute rejects multicast, so hand-derived): with
+    # y_dst = x_src per edge and L_dst = sum y_dst², the fan-in of
+    # cotangents gives dL/dx_r = 2·outdeg(r)·x_r.
+    outdeg = np.zeros((8, 1), np.float32)
+    for s, _ in perm:
+        outdeg[s] += 1.0
+    want = 2.0 * outdeg[:, None] * np.asarray(x).reshape(8, 1, 128)
+    assert_allclose(g_pal, want.reshape(np.asarray(g_pal).shape),
+                    rtol=1e-5, atol=1e-5)
+
+
 def test_p2p_put_partial(tp8_mesh, tp8_ctx):
     """Non-receivers must see zeros."""
     x = _rand((64, 128))

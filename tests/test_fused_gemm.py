@@ -93,6 +93,23 @@ def test_gemm_rs_bf16(tp8_mesh, tp8_ctx):
                     jnp.asarray(g(a, b), jnp.float32), rtol=5e-2, atol=5e-1)
 
 
+@pytest.mark.parametrize("variant", ["ll", "one_shot"])
+def test_gemm_ar_variants(tp8_mesh, tp8_ctx, variant):
+    """Both exchange schemes vs the psum oracle, with n_j > 1 so the ll
+    variant's lagged per-tile reduce pipeline is actually exercised
+    (reference: low_latency_gemm_allreduce_op, gemm_allreduce.py:669)."""
+    m, k, n_dim = 16, 128, 128
+    a = _rand((m, k), 40)
+    b = _rand((k, n_dim), 41)
+    ctx = create_gemm_ar_context(tp8_ctx, block_n=16, block_k=8,
+                                 variant=variant)
+    f = spmd(tp8_mesh, lambda x, w: gemm_ar(x, w, ctx),
+             (P(None, "tp"), P("tp", None)), P(None, None))
+    g = spmd(tp8_mesh, lambda x, w: gemm_ar_ref(x, w),
+             (P(None, "tp"), P("tp", None)), P(None, None))
+    assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)
+
+
 def test_gemm_ar_bf16(tp8_mesh, tp8_ctx):
     m, k, n_dim = 16, 256, 64
     a = _rand((m, k), 10, jnp.bfloat16)
@@ -129,6 +146,46 @@ def test_ag_gemm_bf16(tp8_mesh, tp8_ctx):
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(jnp.asarray(f(a, b), jnp.float32),
                     jnp.asarray(g(a, b), jnp.float32), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("variant", ["panel", "pipelined"])
+def test_ag_gemm_sim_ranks(variant):
+    """Self-simulated ring on a 1-device mesh (the bench.py single-chip
+    overlap proxy): the full ring schedule runs with self-targeted puts
+    and must reproduce the plain matmul."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((256, 32), 50)
+    b = _rand((32, 64), 51)
+    ctx = create_ag_gemm_context(ctx1, block_m=16, block_n=8,
+                                 variant=variant)
+    f = spmd(mesh1, lambda x, w: ag_gemm(x, w, ctx, sim_ranks=4),
+             (P(None, None), P(None, None)), P(None, None))
+    want = jnp.dot(a, b)
+    assert_allclose(f(a, b), want, rtol=1e-4, atol=1e-4)
+
+
+def test_ag_gemm_sim_ranks_return_ag():
+    """Sim mode must also fill the gather workspace correctly."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((128, 32), 52)
+    b = _rand((32, 64), 53)
+    ctx = create_ag_gemm_context(ctx1, block_m=16, block_n=8)
+    f = spmd(mesh1,
+             lambda x, w: ag_gemm(x, w, ctx, sim_ranks=4, return_ag=True),
+             (P(None, None), P(None, None)), (P(None, None), P(None, None)))
+    c, a_full = f(a, b)
+    assert_allclose(a_full, a)
+    assert_allclose(c, jnp.dot(a, b), rtol=1e-4, atol=1e-4)
 
 
 def test_ag_gemm_pipelined_variant(tp8_mesh, tp8_ctx):

@@ -48,16 +48,20 @@ def test_gpipe_equals_sequential(tp8_mesh, tp8_ctx, impl):
                     rtol=1e-5, atol=1e-5)
 
 
-def test_gpipe_grad_equals_sequential(tp8_mesh, tp8_ctx):
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_gpipe_grad_equals_sequential(tp8_mesh, tp8_ctx, impl):
     """jax.grad through the scan+ppermute schedule IS the synchronous
-    GPipe backward; gradients must match the sequential model."""
+    GPipe backward; gradients must match the sequential model. The
+    pallas boundary differentiates through p2p_put's custom VJP
+    (inverted-permutation transport)."""
     w = _stages_params(2)
     x_mb = jax.random.normal(jax.random.PRNGKey(3), (M, MB, D))
 
     def pp_loss(w_all, xs):
         # Inside shard_map the rank-local shard is w_all (1, D, D).
         out = gpipe_forward(lambda h: jnp.tanh(h @ w_all[0]), xs,
-                            axis="tp", remat=True)
+                            axis="tp", remat=True, impl=impl,
+                            ctx=tp8_ctx if impl == "pallas" else None)
         # out is replicated but every rank's loss copy back-propagates
         # through the schedule's final psum (whose transpose sums
         # cotangents across ranks), so the per-rank loss must carry a

@@ -19,7 +19,10 @@ TPU form (SPMD over a ``pp`` mesh axis):
 - Backward: the schedule is a pure ``scan``+``ppermute`` program, so
   ``jax.grad`` through it yields the reverse pipeline automatically —
   backward microbatches drain in LIFO order, which is exactly the
-  synchronous GPipe backward. Wrap ``stage_fn`` in ``jax.checkpoint``
+  synchronous GPipe backward. This holds for both boundary impls:
+  ``impl="xla"`` differentiates ``lax.ppermute`` natively, and
+  ``impl="pallas"`` differentiates through :func:`p2p_put`'s custom
+  VJP (cotangents ride the inverted permutation). Wrap ``stage_fn`` in ``jax.checkpoint``
   to keep activation memory at one stash per tick (the 1F1B memory
   motivation, achieved here by rematerialization instead of schedule
   interleaving — the TPU/XLA-idiomatic trade).

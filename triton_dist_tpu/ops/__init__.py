@@ -28,6 +28,7 @@ from triton_dist_tpu.ops.gemm_rs import (  # noqa: F401
 )
 from triton_dist_tpu.ops.gemm_ar import (  # noqa: F401
     GemmARContext, create_gemm_ar_context, gemm_ar, gemm_ar_ref,
+    gemm_ar_tuned,
 )
 from triton_dist_tpu.ops.all_to_all import (  # noqa: F401
     all_to_all, all_to_all_ref,

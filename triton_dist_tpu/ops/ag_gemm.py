@@ -112,7 +112,7 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                     ctx: MeshContext, m_loc: int, tm: int, tk: int,
                     n_ranks: int, n_buf: int, write_ag: bool,
                     straggler_rank: int = -1,
-                    straggler_delay_iters: int = 0):
+                    straggler_delay_iters: int = 0, sim: bool = False):
     k = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -140,10 +140,21 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
         # chunk lands in a_ws asynchronously (and only if the caller
         # wants the gathered A back) — drained at kernel exit.
         if write_ag:
-            pltpu.make_async_copy(a_ref, chunk_of(me), local_sem).start()
+            src0 = (a_ref.at[pl.ds(0, m_loc)] if sim else a_ref)
+            pltpu.make_async_copy(src0, chunk_of(me), local_sem).start()
         if n > 1:
-            dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
-                          recv_sem.at[0], right, axis=axis, ctx=ctx)
+            if sim:
+                # Self-simulated ring (single-chip overlap proxy): the
+                # chunk step k+1 will need is DMA'd from the input to my
+                # own workspace — identical schedule/semaphores/traffic
+                # to the real ring, peer = self, wire = HBM.
+                nxt = jax.lax.rem(me - 1 + n, n)
+                dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
+                              chunk_of(nxt), send_sem.at[0],
+                              recv_sem.at[0], me, axis=axis, ctx=ctx)
+            else:
+                dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
+                              recv_sem.at[0], right, axis=axis, ctx=ctx)
 
     chunk_start = jnp.logical_and(
         i == 0, jnp.logical_and(j == 0, kk == 0))
@@ -156,8 +167,14 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
         # Forward it right (steps 1..n-2) while we compute on it.
         @pl.when(k < n - 1)
         def _():
-            dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
-                          recv_sem.at[k], right, axis=axis, ctx=ctx)
+            if sim:
+                nxt = jax.lax.rem(me - (k + 1) + n, n)
+                dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
+                              chunk_of(nxt), send_sem.at[k],
+                              recv_sem.at[k], me, axis=axis, ctx=ctx)
+            else:
+                dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
+                              recv_sem.at[k], right, axis=axis, ctx=ctx)
 
     def start_panel_copy(ii, buf):
         """Start panel ii of chunk c into a_panel[buf]. My own chunk
@@ -165,7 +182,8 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
         from the workspace (arrival already certified above)."""
         @pl.when(k == 0)
         def _():
-            pltpu.make_async_copy(a_ref.at[pl.ds(ii * tm, tm)],
+            off = (me * m_loc if sim else 0)
+            pltpu.make_async_copy(a_ref.at[pl.ds(off + ii * tm, tm)],
                                   a_panel.at[buf], panel_sem).start()
 
         @pl.when(k > 0)
@@ -225,14 +243,14 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
     if write_ag:
         @pl.when(last)
         def _():
-            dl.wait_arrivals(local_sem, a_ref, 1)
+            dl.wait_arrivals(
+                local_sem, a_ref.at[pl.ds(0, m_loc)] if sim else a_ref, 1)
 
 
-def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
-                       recv_sem, *, axis: str, ctx: MeshContext,
+def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
                        m_loc: int, n_ranks: int,
                        straggler_rank: int = -1,
-                       straggler_delay_iters: int = 0):
+                       straggler_delay_iters: int = 0, sim: bool = False):
     """Fully-pipelined variant: A blocks arrive through the regular
     Pallas double-buffered pipeline reading the RDMA-fed workspace
     (``a_ws`` is the *aliased output* of the pipelined input ``a_pipe``).
@@ -243,7 +261,17 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
     chunk ``k``), so the data is in HBM before its first prefetch is
     issued. Requires >= 2 bodies per chunk (host falls back to the
     panel variant otherwise).
+
+    ``sim=True`` (single-chip overlap proxy): the ring is driven with
+    self-targeted puts whose source is an extra ``a_any`` input holding
+    the full A — same schedule, semaphores, and per-step traffic, peer
+    = self, wire = HBM.
     """
+    if sim:
+        a_any, o_ref, a_ws, acc_v, send_sem, recv_sem = refs
+    else:
+        a_any = None
+        o_ref, a_ws, acc_v, send_sem, recv_sem = refs
     k = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -256,6 +284,7 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
     right = jax.lax.rem(me + 1, n)
 
     chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
+    sim_chunk = lambda r: a_any.at[pl.ds(r * m_loc, m_loc)] if sim else None
     lin = (i * n_j + j) * n_k + kk          # body index within chunk k
     chunk_len = n_i * n_j * n_k
 
@@ -266,9 +295,15 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
         _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         dl.barrier_tile(axis, ctx=ctx)
         if n > 1:
-            # Ring kick-off: send my chunk (pre-placed by the host).
-            dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
-                          recv_sem.at[0], right, axis=axis, ctx=ctx)
+            if sim:
+                nxt = jax.lax.rem(me - 1 + n, n)
+                dl.remote_put(sim_chunk(nxt), chunk_of(nxt),
+                              send_sem.at[0], recv_sem.at[0], me,
+                              axis=axis, ctx=ctx)
+            else:
+                # Ring kick-off: send my chunk (pre-placed by the host).
+                dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
+                              recv_sem.at[0], right, axis=axis, ctx=ctx)
 
     # Early wait: during chunk k's second-to-last body, certify chunk
     # k+1's arrival (slot k) and forward it — before the pipeline
@@ -280,9 +315,15 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
 
         @pl.when(k + 1 < n - 1)
         def _():
-            dl.remote_put(chunk_of(nxt), chunk_of(nxt),
-                          send_sem.at[k + 1], recv_sem.at[k + 1], right,
-                          axis=axis, ctx=ctx)
+            if sim:
+                nxt2 = jax.lax.rem(me - (k + 2) + 2 * n, n)
+                dl.remote_put(sim_chunk(nxt2), chunk_of(nxt2),
+                              send_sem.at[k + 1], recv_sem.at[k + 1], me,
+                              axis=axis, ctx=ctx)
+            else:
+                dl.remote_put(chunk_of(nxt), chunk_of(nxt),
+                              send_sem.at[k + 1], recv_sem.at[k + 1],
+                              right, axis=axis, ctx=ctx)
 
     @pl.when(kk == 0)
     def _():
@@ -303,14 +344,17 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, o_ref, a_ws, acc_v, send_sem,
 
 
 def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
-                out_dtype, tm, tn, tk, n_i, n_j, n_k):
+                out_dtype, tm, tn, tk, n_i, n_j, n_k, sim=False):
     mesh = ctx.mesh
     m_full = n * m_loc
     me = jax.lax.axis_index(ctx.axis)
     # Pre-place the local chunk so chunk k=0's pipeline reads are valid
-    # from the first body.
+    # from the first body. In sim mode the "local chunk" is slice `me`
+    # (= 0) of the full input; the rest arrives via the self-ring.
+    local = (jax.lax.dynamic_slice(a, (me * m_loc, 0), (m_loc, kdim))
+             if sim else a)
     a_ws_init = jax.lax.dynamic_update_slice(
-        jnp.zeros((m_full, kdim), a.dtype), a, (me * m_loc, 0))
+        jnp.zeros((m_full, kdim), a.dtype), local, (me * m_loc, 0))
 
     def a_index(k, i, j, kk):
         me_ = jax.lax.axis_index(ctx.axis)
@@ -320,7 +364,17 @@ def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
     kernel = functools.partial(
         _ag_gemm_kernel_v2, axis=ctx.axis, ctx=mesh, m_loc=m_loc,
         n_ranks=n, straggler_rank=ctx.straggler_rank,
-        straggler_delay_iters=ctx.straggler_delay_iters)
+        straggler_delay_iters=ctx.straggler_delay_iters, sim=sim)
+
+    in_specs = [
+        pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [a_ws_init, b]
+    if sim:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # a_any
+        operands.append(a)
 
     out, a_full = core_call(
         kernel,
@@ -328,11 +382,7 @@ def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
         grid=(n, n_i, n_j, n_k),
         out_shape=(jax.ShapeDtypeStruct((m_full, n_loc), out_dtype),
                    jax.ShapeDtypeStruct((m_full, kdim), a.dtype)),
-        in_specs=[
-            pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((tm, tn),
                          lambda k, i, j, kk: (
@@ -353,12 +403,12 @@ def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
                             + m_full * n_loc) * a.dtype.itemsize,
             transcendentals=0,
         ),
-    )(a_ws_init, b)
+    )(*operands)
     return out, a_full
 
 
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
-            force_kernel: bool = False):
+            force_kernel: bool = False, sim_ranks: int = 0):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
 
     ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;
@@ -367,12 +417,28 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     gathered A — the workspace the ring already filled, exposed as a
     second kernel output at no extra traffic (reference reuses the AG
     buffer for QKV projections, ``layers/nvidia/tp_attn.py``).
+
+    ``sim_ranks > 1`` (requires a size-1 mesh axis): single-chip overlap
+    proxy — A is split into ``sim_ranks`` chunks and the FULL ring
+    schedule runs with self-targeted RDMA puts: identical control flow,
+    semaphore waits, staging, and per-step compute:comm ratio to the
+    real multi-chip kernel; only the wire is HBM instead of ICI. This is
+    what bench.py measures when one chip is available.
     """
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m_loc, kdim = a.shape
     _, n_loc = b.shape
     out_dtype = ctx.out_dtype or a.dtype
+    sim = False
+    if sim_ranks and sim_ranks > 1:
+        if n != 1:
+            raise ValueError("sim_ranks requires a size-1 mesh axis "
+                             f"(got {n} ranks)")
+        if m_loc % sim_ranks:
+            raise ValueError(f"M={m_loc} not divisible by "
+                             f"sim_ranks={sim_ranks}")
+        n, m_loc, sim = sim_ranks, m_loc // sim_ranks, True
     if n == 1 and not force_kernel:
         # force_kernel=True keeps the pallas pipeline even rankless —
         # used by bench.py to measure kernel compute efficiency on one
@@ -401,7 +467,8 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
 
     if ctx.variant == "pipelined" and n_i * n_j * n_k >= 2:
         out, a_full = _ag_gemm_v2(a, b, ctx, n, m_loc, kdim, n_loc,
-                                  out_dtype, tm, tn, tk, n_i, n_j, n_k)
+                                  out_dtype, tm, tn, tk, n_i, n_j, n_k,
+                                  sim=sim)
         return (out, a_full) if return_ag else out
 
     def c_index(k, i, j, kk):
@@ -419,7 +486,7 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
         tk=tk, n_ranks=n, n_buf=n_buf, write_ag=return_ag,
         straggler_rank=ctx.straggler_rank,
-        straggler_delay_iters=ctx.straggler_delay_iters)
+        straggler_delay_iters=ctx.straggler_delay_iters, sim=sim)
 
     # The gather workspace is always a second kernel output: Mosaic only
     # allows VMEM/SMEM/semaphore scratch on real TPUs, and as an output

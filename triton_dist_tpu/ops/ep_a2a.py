@@ -67,6 +67,19 @@ def create_ep_context(mesh: MeshContext, *, num_experts: int, topk: int,
                       capacity: Optional[int] = None, axis: str = "ep",
                       impl: str = "pallas",
                       wire_dtype=None) -> EPContext:
+    """Build the EP dispatch/combine context.
+
+    MEMORY SCALING of the drop-free default (``capacity=None``): the
+    receive buffer and grouped-GEMM row space are statically sized at
+    the worst case ``n_ranks * T * topk`` rows per rank (XLA needs
+    static shapes; the reference sizes transfers from the exchanged
+    splits at runtime instead). At production scale this is multi-GB —
+    e.g. 64-rank EP, T=4096, topk=10, d=2048 bf16 ≈ 10 GB — so large
+    meshes should pass an explicit ``capacity`` (max tokens per
+    (src, dst) rank pair, with counted drops) or keep per-rank T small.
+    The hierarchical 2D path (``ep_dispatch_2d``) reduces the factor to
+    the ICI group size for the intra-slice hop.
+    """
     if num_experts % mesh.size(axis):
         raise ValueError(
             f"num_experts={num_experts} not divisible by ep={mesh.size(axis)}")

@@ -9,12 +9,13 @@ Recurrence (per head, state S ∈ R^{dk×dv}):
     S_t = Ŝ_t + β_t · k_t (v_t − Ŝ_tᵀ k_t)ᵀ   (delta rule)
     o_t = S_tᵀ q_t
 
-Implementation: ``lax.scan`` over time with the state resident in
-registers/VMEM — the natural TPU form (each step is two rank-1 updates
-plus two matvecs; XLA fuses the scan body onto the VPU/MXU). The
-reference's chunked WY-representation kernel is a planned optimization
-for long-sequence prefill; decode and moderate prefill are
-scan-efficient on TPU.
+Implementation: two paths. :func:`gdn_fwd` is a ``lax.scan`` over time
+with the state resident in registers/VMEM — the natural TPU form for
+decode (each step is two rank-1 updates plus two matvecs; XLA fuses the
+scan body onto the VPU/MXU). :func:`gdn_fwd_chunked` (below) is the
+chunked WY/UT-transform prefill kernel — the analogue of the
+reference's chunked kernel — and is the layer's long-sequence prefill
+path (``layers/gdn_attn.py``).
 """
 
 from __future__ import annotations

@@ -1,15 +1,28 @@
 """Fused GEMM + AllReduce (small-M / decode path).
 
 Reference: ``python/triton_dist/kernels/nvidia/gemm_allreduce.py`` (840
-LoC) — ``gemm_allreduce_op`` and the fused multimem low-latency variant;
-used by ``GemmARLayer`` (``layers/nvidia/gemm_allreduce_layer.py:34``)
-for small-batch decode where ReduceScatter+AllGather latency dominates.
+LoC) — ``gemm_allreduce_op`` and ``low_latency_gemm_allreduce_op``
+(:669-840, the fused multimem variant behind the reference's largest e2e
+wins, ``docs/getting-started/e2e/e2e_dense.md:34-38``); used by
+``GemmARLayer`` (``layers/nvidia/gemm_allreduce_layer.py:34``) for
+small-batch decode where ReduceScatter+AllGather latency dominates.
 
-TPU redesign: one-shot scheme in one kernel — each device computes its
-K-shard partial product tile-by-tile, pushes each finished tile to every
-peer's gather workspace (the transfer of tile t overlaps the MXU on tile
-t+1), then reduces the n arrivals locally. Latency-optimal when M is a
-few hundred rows (decode); for large M use :func:`gemm_rs` + AllGather.
+TPU redesign — two schemes in one kernel family:
+
+- ``variant="one_shot"``: each device computes its K-shard partial
+  product tile-by-tile, pushes each finished tile to every peer's
+  gather workspace (the transfer of tile t overlaps the MXU on tile
+  t+1), then reduces all n arrivals locally in one tail pass.
+- ``variant="ll"`` (default — the ``low_latency_gemm_allreduce_op``
+  analogue): the reduction is folded into the GEMM epilogue with a
+  one-tile lag — after pushing tile ``j``, the kernel reduces tile
+  ``j-1`` (whose n-way arrivals completed under tile ``j``'s matmul),
+  so only the final tile's reduction is exposed latency. NVLS multimem
+  (switch-side reduction) has no ICI analogue; the arrival-lag pipeline
+  is the TPU form of "reduce under the next tile's compute".
+
+Latency-optimal when M is a few hundred rows (decode); for large M use
+:func:`gemm_rs` + AllGather.
 """
 
 from __future__ import annotations
@@ -35,18 +48,67 @@ class GemmARContext:
     block_n: int = 512
     block_k: int = 512
     out_dtype: Optional[jnp.dtype] = None
+    # "ll" = low-latency: per-tile reduction pipelined one tile behind
+    # the pushes (reference low_latency_gemm_allreduce_op,
+    # gemm_allreduce.py:669). "one_shot" = reduce everything in a tail
+    # pass after the last push (reference gemm_allreduce_op).
+    variant: str = "ll"
 
 
 def create_gemm_ar_context(mesh: MeshContext, axis: str = "tp",
                            block_n: int = 512, block_k: int = 512,
-                           out_dtype=None) -> GemmARContext:
+                           out_dtype=None,
+                           variant: str = "ll") -> GemmARContext:
+    if variant not in ("ll", "one_shot"):
+        raise ValueError(f"unknown gemm_ar variant {variant!r} "
+                         "(expected 'll' or 'one_shot')")
     return GemmARContext(mesh=mesh, axis=axis, block_n=block_n,
-                         block_k=block_k, out_dtype=out_dtype)
+                         block_k=block_k, out_dtype=out_dtype,
+                         variant=variant)
 
 
 def gemm_ar_ref(a, b, *, axis: str = "tp", **_):
     partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
     return jax.lax.psum(partial, axis).astype(a.dtype)
+
+
+# --- shared bodies for both exchange schemes -------------------------------
+
+def _ar_accumulate(part_v, a_ref, b_ref, j, kk, axis, ctx):
+    """Entry barrier + K-blocked partial-product accumulation."""
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _():
+        dl.barrier_all(axis, ctx=ctx)
+
+    @pl.when(kk == 0)
+    def _():
+        part_v[...] = jnp.zeros_like(part_v)
+
+    part_v[...] += jnp.dot(a_ref[...], b_ref[...],
+                           preferred_element_type=jnp.float32)
+
+
+def _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
+                  recv_sem_tile, axis, ctx):
+    """Land my finished partial tile and push it to every peer; the
+    transfers overlap the next tile's matmul."""
+    my_slot = gather_hbm.at[me, :, pl.ds(j * tn, tn)]
+    pltpu.sync_copy(part_v, my_slot)
+    for peer_off in range(1, n):
+        peer = jax.lax.rem(me + peer_off, n)
+        dl.remote_put(my_slot, my_slot, send_sem.at[peer_off - 1],
+                      recv_sem_tile, peer, axis=axis, ctx=ctx)
+
+
+def _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n):
+    """Sum the n gather slots of tile ``jj`` into the output (arrivals
+    must already be certified by the caller's semaphore wait)."""
+    acc = None
+    for r in range(n):
+        pltpu.sync_copy(gather_hbm.at[r, :, pl.ds(jj * tn, tn)], tmp_v)
+        acc = tmp_v[...] if acc is None else acc + tmp_v[...]
+    out_v[...] = acc.astype(out_v.dtype)
+    pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
 
 
 def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
@@ -59,48 +121,76 @@ def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
     me = dl.rank(axis)
     n = n_ranks
 
-    @pl.when(jnp.logical_and(j == 0, kk == 0))
-    def _():
-        dl.barrier_all(axis, ctx=ctx)
-
-    # Partial product for this N-tile, accumulated over K blocks.
-    @pl.when(kk == 0)
-    def _():
-        part_v[...] = jnp.zeros_like(part_v)
-
-    part_v[...] += jnp.dot(a_ref[...], b_ref[...],
-                           preferred_element_type=jnp.float32)
+    _ar_accumulate(part_v, a_ref, b_ref, j, kk, axis, ctx)
 
     @pl.when(kk == n_k - 1)
     def _():
-        my_slot = gather_hbm.at[me, :, pl.ds(j * tn, tn)]
-        pltpu.sync_copy(part_v, my_slot)
-
-        # Push the finished tile to every peer; transfers overlap the
-        # next tile's matmul.
-        for peer_off in range(1, n):
-            peer = jax.lax.rem(me + peer_off, n)
-            dl.remote_put(my_slot, my_slot,
-                          send_sem.at[(peer_off - 1)], recv_sem, peer,
-                          axis=axis, ctx=ctx)
+        _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
+                      recv_sem, axis, ctx)
 
     @pl.when(jnp.logical_and(j == n_j - 1, kk == n_k - 1))
     def _():
-        # All tiles pushed; await the (n-1) peers' full partials.
+        # All tiles pushed; await the (n-1) peers' full partials, then
+        # reduce everything in one tail pass.
         tile_ref = gather_hbm.at[0, :, pl.ds(0, tn)]
         dl.wait_arrivals(recv_sem, tile_ref, (n - 1) * n_j)
         for t in range(n - 1):
             dl.wait_arrivals(send_sem.at[t], tile_ref, n_j)
-
-        # Reduce: sum the n gather slots into the output.
         for jj in range(n_j):
-            acc = None
-            for r in range(n):
-                pltpu.sync_copy(
-                    gather_hbm.at[r, :, pl.ds(jj * tn, tn)], tmp_v)
-                acc = tmp_v[...] if acc is None else acc + tmp_v[...]
-            out_v[...] = acc.astype(out_v.dtype)
-            pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
+            _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n)
+
+
+def _gemm_ar_ll_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v,
+                       out_v, send_sem, recv_sem, *, axis: str,
+                       ctx: MeshContext, m: int, tn: int, n_ranks: int):
+    """Low-latency variant: per-N-tile one-shot exchange with the n-way
+    reduction pipelined ONE TILE BEHIND the pushes.
+
+    Tile ``j``'s schedule (reference ``low_latency_gemm_allreduce_op``,
+    ``gemm_allreduce.py:669-840`` — multimem reduce-on-store becomes an
+    arrival-lag reduce, since ICI has no switch-side reduction):
+
+    1. matmul tile ``j`` over the K blocks (MXU);
+    2. push the finished partial to every peer (async, rides under the
+       next tile's matmul) with a per-tile arrival semaphore;
+    3. reduce tile ``j-1``: its (n-1) remote arrivals completed while
+       tile ``j`` was on the MXU, so the wait is (amortized) free.
+
+    Only the LAST tile's reduction is exposed; the one-shot variant
+    exposes all ``n_j`` reductions in a tail pass.
+    """
+    j = pl.program_id(0)
+    kk = pl.program_id(1)
+    n_j = pl.num_programs(0)
+    n_k = pl.num_programs(1)
+    me = dl.rank(axis)
+    n = n_ranks
+
+    _ar_accumulate(part_v, a_ref, b_ref, j, kk, axis, ctx)
+
+    def reduce_tile(jj):
+        """Wait tile jj's (n-1) arrivals, then sum-and-emit."""
+        dl.wait_arrivals(recv_sem.at[jj],
+                         gather_hbm.at[0, :, pl.ds(jj * tn, tn)], n - 1)
+        _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
+                      recv_sem.at[j], axis, ctx)
+
+        # Lagged reduce: tile j-1's arrivals rode under tile j's matmul.
+        @pl.when(j > 0)
+        def _():
+            reduce_tile(j - 1)
+
+        @pl.when(j == n_j - 1)
+        def _():
+            reduce_tile(n_j - 1)   # the only exposed reduction
+            # Drain send semaphores before kernel exit.
+            tile_ref = gather_hbm.at[0, :, pl.ds(0, tn)]
+            for t in range(n - 1):
+                dl.wait_arrivals(send_sem.at[t], tile_ref, n_j)
 
 
 def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
@@ -125,8 +215,16 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
             f"(N={n_dim}, K_loc={k_loc})")
     n_j, n_k = n_dim // tn, k_loc // tk
 
-    kernel = functools.partial(_gemm_ar_kernel, axis=ctx.axis, ctx=mesh,
-                               m=m, tn=tn, n_ranks=n)
+    if ctx.variant == "ll":
+        kernel = functools.partial(_gemm_ar_ll_kernel, axis=ctx.axis,
+                                   ctx=mesh, m=m, tn=tn, n_ranks=n)
+        # Per-tile arrival semaphores: tile j's reduce waits only its
+        # own arrivals, so tiles pipeline independently.
+        recv_shape = (n_j,)
+    else:
+        kernel = functools.partial(_gemm_ar_kernel, axis=ctx.axis,
+                                   ctx=mesh, m=m, tn=tn, n_ranks=n)
+        recv_shape = ()
     # Gather workspace is a second output (no HBM scratch on real TPUs).
     out, _gather_ws = core_call(
         kernel,
@@ -147,7 +245,7 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
             pltpu.VMEM((m, tn), jnp.float32),             # tmp_v
             pltpu.VMEM((m, tn), out_dtype),               # out_v
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),    # send_sem
-            pltpu.SemaphoreType.DMA(()),                  # recv_sem
+            pltpu.SemaphoreType.DMA(recv_shape),          # recv_sem
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * m * k_loc * n_dim,
@@ -157,3 +255,31 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
         ),
     )(a, b)
     return out
+
+
+def gemm_ar_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
+                  configs=None, **kw):
+    """Autotuned gemm_ar: sweeps the ll/one_shot crossover and block
+    configs per (shape, dtype, world) key and persists the winner
+    (reference: the ll-vs-default dispatch in ``gemm_allreduce.py`` is a
+    hand-picked M threshold; here the crossover is measured)."""
+    from triton_dist_tpu.autotuner import autotune
+
+    if configs is None:
+        configs = [
+            {"variant": "ll", "block_n": 512, "block_k": 1024},
+            {"variant": "ll", "block_n": 1024, "block_k": 1024},
+            {"variant": "ll", "block_n": 512, "block_k": 2048},
+            {"variant": "one_shot", "block_n": 512, "block_k": 1024},
+        ]
+
+    @autotune("gemm_ar", configs,
+              key_fn=lambda a_, b_, **kk: {
+                  "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
+                  "dtype": str(a_.dtype), "world": mesh.size(axis)})
+    def _run(a_, b_, variant="ll", block_n=512, block_k=1024):
+        ctx = create_gemm_ar_context(mesh, axis, block_n, block_k,
+                                     variant=variant)
+        return gemm_ar(a_, b_, ctx, **kw)
+
+    return _run(a, b)

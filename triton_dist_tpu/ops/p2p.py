@@ -56,13 +56,7 @@ def _p2p_kernel(x_ref, out_ref, zero_v, send_sem, recv_sem, *,
             dl.wait_arrivals(recv_sem, out_ref, cnt)
 
 
-def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
-            axis: str = "pp"):
-    """One-sided put along a static permutation (inside shard_map).
-
-    Devices that receive nothing get zeros (matching ``lax.ppermute``).
-    """
-    perm = tuple((int(s), int(d)) for s, d in perm)
+def _p2p_put_impl(x, perm, ctx, axis):
     kernel = functools.partial(_p2p_kernel, axis=axis, ctx=ctx, perm=perm)
     return core_call(
         kernel,
@@ -76,3 +70,55 @@ def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
             pltpu.SemaphoreType.DMA(()),
         ],
     )(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _p2p_put_diff(x, perm, ctx, axis):
+    return _p2p_put_impl(x, perm, ctx, axis)
+
+
+def _p2p_put_fwd(x, perm, ctx, axis):
+    return _p2p_put_impl(x, perm, ctx, axis), None
+
+
+def _p2p_put_bwd(perm, ctx, axis, _res, g):
+    # The op computes lax.ppermute(x, perm); its transpose is the put
+    # along the inverted permutation — so jax.grad through a
+    # pallas-boundary pipeline schedule (gpipe_forward impl="pallas")
+    # yields the reverse pipeline, matching the XLA path's autodiff.
+    # Multicast forwards (one src on several edges) invert to several
+    # cotangents converging on one destination; the kernel's puts to a
+    # shared out_ref would race, so route each fan-in edge in its own
+    # round (unique destinations per round) and SUM the rounds.
+    inv = [(d, s) for s, d in perm]
+    rounds = []
+    while inv:
+        seen, this_round, rest = set(), [], []
+        for edge in inv:
+            if edge[1] in seen:
+                rest.append(edge)
+            else:
+                seen.add(edge[1])
+                this_round.append(edge)
+        rounds.append(tuple(this_round))
+        inv = rest
+    acc = jnp.zeros_like(g)   # empty perm ⇒ zero gradient, not None
+    for r in rounds:
+        acc = acc + _p2p_put_impl(g, r, ctx, axis)
+    return (acc,)
+
+
+_p2p_put_diff.defvjp(_p2p_put_fwd, _p2p_put_bwd)
+
+
+def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
+            axis: str = "pp"):
+    """One-sided put along a static permutation (inside shard_map).
+
+    Devices that receive nothing get zeros (matching ``lax.ppermute``).
+    Differentiable: a custom VJP transports cotangents along the
+    inverted permutation (the ppermute transpose), so the pallas
+    pipeline boundary supports ``jax.grad`` like the XLA path.
+    """
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    return _p2p_put_diff(x, perm, ctx, axis)
