@@ -30,6 +30,11 @@ def main():
     ap.add_argument("--paged", action="store_true",
                     help="megakernel paged-KV cache (page pool + block "
                          "table) instead of the dense cache")
+    ap.add_argument("--model", default="dense",
+                    choices=["dense", "qwen_moe"])
+    ap.add_argument("--moe-impl", default="tp", choices=["tp", "ep"],
+                    help="qwen_moe only: TP experts (ffn-sharded) or EP "
+                         "experts (dispatch/combine all-to-all)")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -45,7 +50,10 @@ def main():
 
     # vocab kept small so the megakernel arena stays under the CPU
     # interpret-mode per-buffer limit (docs/testing.md).
-    cfg = ModelConfig.tiny(vocab_size=64)
+    if args.model == "qwen_moe":
+        cfg = ModelConfig.tiny_moe(vocab_size=64, num_experts=8)
+    else:
+        cfg = ModelConfig.tiny(vocab_size=64)
     mesh = tdt.make_mesh(tp=args.tp)
     ids = jax.random.randint(jax.random.PRNGKey(0),
                              (args.batch, args.prompt_len), 0,
@@ -66,9 +74,21 @@ def main():
                                        start_pos=args.prompt_len - 1))
         dt = time.perf_counter() - t0
     else:
-        eng = Engine(cfg, mesh, mode=args.mode,
+        extra, mode = {}, args.mode
+        if args.model == "qwen_moe":
+            from triton_dist_tpu.models import qwen_moe
+
+            # MoE serve runs the XLA collectives; the fused MoE blocks
+            # are exercised by forward_tokens/tests at these tiny shapes.
+            extra = {"model": qwen_moe, "moe_impl": args.moe_impl}
+            if args.mode != "xla":
+                print(f"note: --model qwen_moe serves in mode=xla "
+                      f"(requested --mode {args.mode} applies to the "
+                      "dense model only)")
+            mode = "xla"
+        eng = Engine(cfg, mesh, mode=mode,
                      max_len=args.prompt_len + args.gen_len,
-                     block_m=8, block_n=8, block_k=32)
+                     block_m=8, block_n=8, block_k=32, **extra)
         t0 = time.perf_counter()
         toks = np.asarray(eng.serve(ids, gen_len=args.gen_len))
         dt = time.perf_counter() - t0

@@ -68,6 +68,65 @@ def fwd(params, x, ep_ctx: EPContext, *, topk: int,
     return ep_combine(expert_out, state, topk_w, ep_ctx)
 
 
+def fwd_2d(params, x, ep2d_ctx, *, topk: int,
+           norm_topk_prob: bool = True):
+    """Hierarchical (ICI×DCN) EP forward: same structure as :func:`fwd`
+    but the dispatch/combine ride the two-hop schedule
+    (``ops/ep_a2a.ep_dispatch_2d`` — ICI hop first, one aggregated DCN
+    exchange; reference ``all_to_all_vdev_2d_offset_inter_node.py``)."""
+    from triton_dist_tpu.ops.ep_a2a import ep_dispatch_2d, ep_combine_2d
+
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+    recv_tok, recv_exp, state = ep_dispatch_2d(x, topk_ids, ep2d_ctx)
+    sorted_tok, group_sizes, inv = sort_by_expert(
+        recv_tok, recv_exp, ep2d_ctx.experts_per_rank)
+    expert_out = grouped_swiglu(sorted_tok, params["w_gate"],
+                                params["w_up"], params["w_down"],
+                                group_sizes)
+    return ep_combine_2d(expert_out[inv], state, topk_w, ep2d_ctx)
+
+
+def fwd_decode(params, x, *, topk: int, axis: str = "ep",
+               norm_topk_prob: bool = True):
+    """Replicated-token EP decode (the small-batch AR regime): every
+    rank computes only its LOCAL expert shard's contributions for the
+    whole (tiny) batch and one AllReduce completes the combine — zero
+    dispatch round-trips. This is the TPU latency-optimal analogue of
+    the reference's low-latency EP a2a decode
+    (``low_latency_all_to_all_v2.py``): at decode M, two a2a hops cost
+    more than the masked local compute (each rank runs E/n experts over
+    B rows; B is a handful at decode, so FLOPs are noise and the psum
+    rides the layer's existing collective slot).
+
+    x: (B, d) identical on all ranks → (B, d) identical on all ranks.
+    """
+    topk_ids, topk_w = route(params["router"], x, topk,
+                             norm_topk_prob=norm_topk_prob)
+    if isinstance(axis, (tuple, list)):
+        # Hierarchical expert sharding (outer-major rank order, matching
+        # EP2DContext and P((outer, inner)) param specs).
+        axis = tuple(axis)
+        me = jnp.int32(0)
+        for nm in axis:
+            me = me * jax.lax.axis_size(nm) + jax.lax.axis_index(nm)
+    else:
+        me = jax.lax.axis_index(axis)
+    e_loc = params["w_gate"].shape[0]        # local expert shard
+    ge = me * e_loc + jnp.arange(e_loc)      # my experts' global ids
+    # (B, e_loc) combine weight mass routed to my experts.
+    sel = (topk_ids[:, :, None] == ge[None, None, :])
+    w_be = jnp.einsum("bk,bke->be", topk_w.astype(jnp.float32),
+                      sel.astype(jnp.float32))
+    xg = jnp.einsum("bd,edf->ebf", x, params["w_gate"])
+    xu = jnp.einsum("bd,edf->ebf", x, params["w_up"])
+    act = jax.nn.silu(xg.astype(jnp.float32)) * xu.astype(jnp.float32)
+    y = jnp.einsum("ebf,efd->ebd", act.astype(x.dtype),
+                   params["w_down"])        # (e_loc, B, d)
+    out = jnp.einsum("ebd,be->bd", y.astype(jnp.float32), w_be)
+    return jax.lax.psum(out, axis).astype(x.dtype)
+
+
 def fwd_fused(params, x, ep_ctx: EPFusedContext, *, topk: int,
               norm_topk_prob: bool = True):
     """Mega-EP forward: dispatch fused into the up-projection grouped

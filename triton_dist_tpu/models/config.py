@@ -129,19 +129,36 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, hf_cfg) -> "ModelConfig":
-        """Build from a transformers AutoConfig (reference loads HF
-        checkpoints, ``models/dense.py:150``)."""
+        """Build from a transformers AutoConfig OR a raw ``config.json``
+        dict (reference loads HF checkpoints, ``models/dense.py:150``).
+        The single HF→ModelConfig mapper: covers dense, MoE
+        (Qwen3-MoE), and hybrid GDN (Qwen3-Next) field sets.
+        """
+        if isinstance(hf_cfg, dict):
+            get = lambda k, d=None: hf_cfg.get(k, d)
+        else:
+            get = lambda k, d=None: getattr(hf_cfg, k, d)
+        d = get("hidden_size", 4096)
+        heads = get("num_attention_heads", 32)
         return cls(
-            vocab_size=hf_cfg.vocab_size,
-            hidden_size=hf_cfg.hidden_size,
-            intermediate_size=hf_cfg.intermediate_size,
-            num_hidden_layers=hf_cfg.num_hidden_layers,
-            num_attention_heads=hf_cfg.num_attention_heads,
-            num_key_value_heads=hf_cfg.num_key_value_heads,
-            head_dim=getattr(hf_cfg, "head_dim",
-                             hf_cfg.hidden_size // hf_cfg.num_attention_heads),
-            rms_norm_eps=hf_cfg.rms_norm_eps,
-            rope_theta=getattr(hf_cfg, "rope_theta", 1_000_000.0),
-            tie_word_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
-            model_name=getattr(hf_cfg, "model_type", "qwen3"),
+            vocab_size=get("vocab_size", 151936),
+            hidden_size=d,
+            intermediate_size=get("intermediate_size", 4 * d),
+            num_hidden_layers=get("num_hidden_layers", 32),
+            num_attention_heads=heads,
+            num_key_value_heads=get("num_key_value_heads", heads),
+            head_dim=get("head_dim") or d // heads,
+            rms_norm_eps=get("rms_norm_eps", 1e-6),
+            rope_theta=get("rope_theta", 1_000_000.0),
+            max_position_embeddings=get("max_position_embeddings", 40960),
+            tie_word_embeddings=get("tie_word_embeddings", False),
+            model_name=get("model_type", "qwen3"),
+            num_experts=get("num_experts", 0) or 0,
+            num_experts_per_tok=get("num_experts_per_tok", 8) or 8,
+            moe_intermediate_size=get("moe_intermediate_size", 768) or 768,
+            norm_topk_prob=get("norm_topk_prob", True),
+            gdn_num_heads=get("linear_num_value_heads", 0) or 0,
+            gdn_head_dim_k=get("linear_key_head_dim", 128) or 128,
+            gdn_head_dim_v=get("linear_value_head_dim", 128) or 128,
+            full_attn_interval=get("full_attention_interval", 4) or 4,
         )

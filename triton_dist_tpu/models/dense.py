@@ -97,15 +97,22 @@ def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
     }
 
 
-def _layer_fwd_prefill(layer_params, x, cfg, *, batch, mode, axis, ctxs):
+def _layer_fwd_prefill(layer_params, x, cfg, *, batch, mode, axis, ctxs,
+                       ffn_fn=None):
+    """``ffn_fn(layer_params, h) -> h`` overrides the FFN block — the
+    hook the MoE model plugs its expert block into (dense default:
+    tp_mlp)."""
     h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
     attn_out, kv = tp_attn.fwd_prefill(
         layer_params["attn"], h, cfg, batch=batch, mode=mode, axis=axis,
         ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
     x = x + attn_out
     h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
-    x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mode, axis=axis,
-                       ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+    if ffn_fn is None:
+        x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mode, axis=axis,
+                           ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+    else:
+        x = x + ffn_fn(layer_params, h)
     return x, kv
 
 
@@ -123,7 +130,7 @@ def _embed_tokens(params, input_ids, *, mode, axis):
 
 
 def _forward_trunk(params, input_ids, cfg: ModelConfig, *, mode, axis,
-                   ctxs, cache: Optional[KVCache]):
+                   ctxs, cache: Optional[KVCache], ffn_fn=None):
     """Shared prefill/all-token forward: embed → layers (optionally
     recording KV) → final norm → gather to full tokens. Returns
     (x (B*S, d) full, cache)."""
@@ -131,7 +138,8 @@ def _forward_trunk(params, input_ids, cfg: ModelConfig, *, mode, axis,
     x = _embed_tokens(params, input_ids, mode=mode, axis=axis)
     for li, layer_params in enumerate(params["layers"]):
         x, kv = _layer_fwd_prefill(
-            layer_params, x, cfg, batch=b, mode=mode, axis=axis, ctxs=ctxs)
+            layer_params, x, cfg, batch=b, mode=mode, axis=axis,
+            ctxs=ctxs, ffn_fn=ffn_fn)
         if cache is not None:
             cache = cache.write_prefill(li, *kv)
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
@@ -149,7 +157,7 @@ def _lm_head(params, x, axis):
 
 def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
             axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
-            max_len: Optional[int] = None):
+            max_len: Optional[int] = None, ffn_fn=None):
     """Per-shard prefill. input_ids: (B, S) replicated. Returns
     (logits (B, vocab) for the last position, KVCache per-shard).
 
@@ -164,7 +172,8 @@ def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
                           cfg.head_dim,
                           dtype=params["embed"].dtype)
     x, cache = _forward_trunk(params, input_ids, cfg, mode=mode,
-                              axis=axis, ctxs=ctxs, cache=cache)
+                              axis=axis, ctxs=ctxs, cache=cache,
+                              ffn_fn=ffn_fn)
     cache = dataclasses.replace(cache, length=jnp.asarray(s, jnp.int32))
     last = x.reshape(b, s, cfg.hidden_size)[:, -1]
     return _lm_head(params, last, axis), cache
@@ -184,11 +193,15 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
 
 def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
                 mode: str = "xla", axis: str = "tp",
-                ctxs: FwdContexts = FwdContexts()):
+                ctxs: FwdContexts = FwdContexts(), ffn_fn=None):
     """One decode step. token_ids: (B,) replicated. Returns
     (logits (B, vocab), updated cache). Decode always runs with a
     replicated (B, d) residual (M is tiny) — the reference's
-    AR/gemm_ar decode regime (``e2e_dense.md:25,34``)."""
+    AR/gemm_ar decode regime (``e2e_dense.md:25,34``).
+
+    ``ffn_fn(layer_params, h) -> h`` overrides the FFN block (the MoE
+    model's hook); the dense default is tp_mlp in the AR regime.
+    """
     b = token_ids.shape[0]
     x = params["embed"][token_ids]
     pos = cache.length
@@ -206,10 +219,13 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
             new_v, lv[None], (li, 0, 0, 0, 0))
         x = x + attn_out
         h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
-        mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
-        x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
-                           axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
-                           ar_ctx=ctxs.ar)
+        if ffn_fn is None:
+            mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+            x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
+                               axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                               ar_ctx=ctxs.ar)
+        else:
+            x = x + ffn_fn(layer_params, h)
 
     x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
     logits_loc = jnp.dot(x, params["lm_head"].T,

@@ -35,7 +35,17 @@ class Engine:
                  mode: str = "xla", dtype=jnp.float32, max_len: int = 512,
                  params=None, seed: int = 0,
                  block_m: int = 256, block_n: int = 256,
-                 block_k: int = 512, model=None):
+                 block_k: int = 512, model=None,
+                 moe_impl: Optional[str] = None, ep_axis=None,
+                 ep_capacity: Optional[int] = None):
+        """``moe_impl`` selects the MoE regime for ``models.qwen_moe``
+        ("tp" | "ep"); with ``"ep"`` the Engine builds the EPContext
+        itself (reference: the Engine serving the MoE demo). ``ep_axis``
+        is the expert axis name, or an ``(outer, inner)`` tuple for the
+        hierarchical ICI-by-DCN dispatch (``create_ep2d_context``);
+        ``ep_capacity`` opts into the capped-drop dispatch (see
+        ``create_ep_context`` for the drop-free mode's memory scaling).
+        """
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -47,7 +57,42 @@ class Engine:
         self.ctxs = dense.make_fwd_contexts(mctx, axis, block_m, block_n,
                                             block_k)
 
-        specs = model.param_specs(cfg, axis)
+        # A MoE-contract model (param_specs takes moe_impl) defaults to
+        # TP experts when the caller didn't pick a regime — so
+        # Engine(model=qwen_moe) works out of the box.
+        import inspect
+
+        takes_moe = "moe_impl" in inspect.signature(
+            model.param_specs).parameters
+        if moe_impl is None and takes_moe:
+            moe_impl = "tp"
+
+        model_kwargs = {}
+        if moe_impl is not None:
+            from triton_dist_tpu.ops.ep_a2a import (
+                create_ep_context, create_ep2d_context,
+            )
+
+            ep_ctx = None
+            if moe_impl == "ep":
+                if isinstance(ep_axis, (tuple, list)):
+                    ep_ctx = create_ep2d_context(
+                        mctx, num_experts=cfg.num_experts,
+                        topk=cfg.num_experts_per_tok,
+                        outer_axis=ep_axis[0], inner_axis=ep_axis[1])
+                else:
+                    ep_ctx = create_ep_context(
+                        mctx, num_experts=cfg.num_experts,
+                        topk=cfg.num_experts_per_tok,
+                        capacity=ep_capacity, axis=ep_axis or axis)
+            model_kwargs = {"moe_impl": moe_impl, "ep_ctx": ep_ctx}
+            spec_ep_axis = (tuple(ep_axis) if isinstance(
+                ep_axis, (tuple, list)) else (ep_axis or axis))
+            specs = model.param_specs(cfg, moe_impl=moe_impl, axis=axis,
+                                      ep_axis=spec_ep_axis)
+        else:
+            specs = model.param_specs(cfg, axis)
+        self.model_kwargs = model_kwargs
         if params is None:
             params = model.init_params(jax.random.PRNGKey(seed), cfg, dtype)
         self.params = jax.tree.map(
@@ -58,11 +103,13 @@ class Engine:
 
         def _prefill(params, ids):
             return model.prefill(params, ids, cfg, mode=mode, axis=axis,
-                                 ctxs=self.ctxs, max_len=max_len)
+                                 ctxs=self.ctxs, max_len=max_len,
+                                 **model_kwargs)
 
         def _decode(params, tok, cache):
             return model.decode_step(params, tok, cache, cfg, mode=mode,
-                                     axis=axis, ctxs=self.ctxs)
+                                     axis=axis, ctxs=self.ctxs,
+                                     **model_kwargs)
 
         kv_spec = model.cache_specs(axis)
         self._prefill = jax.jit(jax.shard_map(

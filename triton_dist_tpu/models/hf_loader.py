@@ -64,3 +64,84 @@ def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
         "ln_f": g("model.norm.weight"),
         "lm_head": lm_head,
     }
+
+
+def moe_params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
+                                  dtype=jnp.bfloat16) -> Dict:
+    """Map a Qwen3-MoE HF state dict to the qwen_moe param pytree
+    (per-expert gate/up/down stacked to (E, d, f) / (E, f, d);
+    HF names: ``mlp.experts.N.{gate,up,down}_proj``, router =
+    ``mlp.gate``)."""
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+    gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
+
+    def stack_T(prefix, proj):
+        return jnp.stack([
+            jnp.asarray(_to_np(
+                state[f"{prefix}experts.{e}.{proj}.weight"]).T, dtype)
+            for e in range(cfg.num_experts)])
+
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "attn": {
+                "wq": gT(p + "self_attn.q_proj.weight"),
+                "wk": gT(p + "self_attn.k_proj.weight"),
+                "wv": gT(p + "self_attn.v_proj.weight"),
+                "wo": gT(p + "self_attn.o_proj.weight"),
+                "q_norm": g(p + "self_attn.q_norm.weight"),
+                "k_norm": g(p + "self_attn.k_norm.weight"),
+            },
+            "moe": {
+                "router": gT(p + "mlp.gate.weight"),
+                "w_gate": stack_T(p + "mlp.", "gate_proj"),
+                "w_up": stack_T(p + "mlp.", "up_proj"),
+                "w_down": stack_T(p + "mlp.", "down_proj"),
+            },
+            "ln_attn": g(p + "input_layernorm.weight"),
+            "ln_mlp": g(p + "post_attention_layernorm.weight"),
+        })
+    embed = g("model.embed_tokens.weight")
+    return {
+        "embed": embed,
+        "layers": layers,
+        "ln_f": g("model.norm.weight"),
+        "lm_head": (embed if cfg.tie_word_embeddings
+                    else g("lm_head.weight")),
+    }
+
+
+def config_from_hf(hf: Dict) -> ModelConfig:
+    """Alias of :meth:`ModelConfig.from_hf_config` (the single
+    HF→ModelConfig mapper — dense, MoE, and hybrid GDN fields)."""
+    return ModelConfig.from_hf_config(hf)
+
+
+def load_hf_checkpoint(path: str, dtype=jnp.bfloat16):
+    """Load a LOCAL HuggingFace checkpoint directory (``config.json`` +
+    ``*.safetensors`` shards) → ``(ModelConfig, params pytree)``.
+
+    The zero-egress analogue of the reference's from-pretrained path
+    (``models/dense.py:150`` init_parameters): point it at an
+    already-downloaded snapshot directory. Dense Qwen3 state dicts map
+    via :func:`params_from_hf_state_dict`, MoE (``num_experts > 0``)
+    via :func:`moe_params_from_hf_state_dict`.
+    """
+    import glob as _glob
+    import json
+    import os
+
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = config_from_hf(json.load(f))
+    state: Dict = {}
+    shards = sorted(_glob.glob(os.path.join(path, "*.safetensors")))
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    for shard in shards:
+        state.update(load_file(shard))
+    mapper = (moe_params_from_hf_state_dict if cfg.is_moe
+              else params_from_hf_state_dict)
+    return cfg, mapper(state, cfg, dtype)

@@ -66,6 +66,57 @@ def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
             "lm_head": P(axis, None)}
 
 
+def _moe_block(lp, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
+               ep_ctx, moe_block_m):
+    """One MoE FFN block in the requested parallel regime (the
+    ``ffn_fn`` hook plugged into the dense trunk/decode)."""
+    if moe_impl == "tp":
+        if mode == "fused" and ctxs.ag is not None:
+            # Fully-fused pipeline: AG-fused grouped GEMM + Pallas
+            # down-proj + fused RS epilogue (the reference's
+            # ag_group_gemm/moe_reduce_rs layer pairing).
+            return tp_moe.fwd_fused(
+                lp["moe"], h, topk=cfg.num_experts_per_tok,
+                num_experts=cfg.num_experts,
+                mesh_ctx=ctxs.ag.mesh, axis=axis, block_m=moe_block_m,
+                norm_topk_prob=cfg.norm_topk_prob)
+        return tp_moe.fwd(
+            lp["moe"], h, topk=cfg.num_experts_per_tok,
+            num_experts=cfg.num_experts, axis=axis,
+            norm_topk_prob=cfg.norm_topk_prob)
+    from triton_dist_tpu.ops.ep_a2a import EP2DContext
+
+    if isinstance(ep_ctx, EP2DContext):
+        return ep_moe.fwd_2d(lp["moe"], h, ep_ctx,
+                             topk=cfg.num_experts_per_tok,
+                             norm_topk_prob=cfg.norm_topk_prob)
+    return ep_moe.fwd(lp["moe"], h, ep_ctx,
+                      topk=cfg.num_experts_per_tok,
+                      norm_topk_prob=cfg.norm_topk_prob)
+
+
+def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
+    """Small-batch (decode) MoE FFN: TP experts via ``tp_moe.fwd_ar``
+    (the GEMM+AR pairing), EP experts via ``ep_moe.fwd_decode``
+    (masked-local-experts + psum — see its docstring for why this
+    beats a dispatch round-trip at decode M)."""
+    from triton_dist_tpu.ops.ep_a2a import EP2DContext
+
+    if moe_impl == "tp":
+        return tp_moe.fwd_ar(lp["moe"], h, topk=cfg.num_experts_per_tok,
+                             num_experts=cfg.num_experts, axis=axis,
+                             norm_topk_prob=cfg.norm_topk_prob)
+    if isinstance(ep_ctx, EP2DContext):
+        ep_axis = (ep_ctx.outer_axis, ep_ctx.inner_axis)
+    elif isinstance(ep_ctx, EPContext):
+        ep_axis = ep_ctx.axis
+    else:
+        ep_axis = axis
+    return ep_moe.fwd_decode(lp["moe"], h, topk=cfg.num_experts_per_tok,
+                             axis=ep_axis,
+                             norm_topk_prob=cfg.norm_topk_prob)
+
+
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
                    moe_impl: str = "tp", mode: str = "xla",
                    axis: str = "tp", ep_ctx: Optional[EPContext] = None,
@@ -76,47 +127,64 @@ def forward_tokens(params, input_ids, cfg: ModelConfig, *,
     For ``moe_impl="ep"`` the residual stream is token-sharded along the
     *ep* axis (each rank owns its tokens); attention still runs TP over
     ``axis`` (= the same axis for a 1D mesh: tp and ep traffic share it,
-    matching the reference's single-group EP demos).
+    matching the reference's single-group EP demos). ``ep_ctx`` may be
+    an :class:`EPContext` (flat) or ``EP2DContext`` (hierarchical
+    ICI-then-DCN dispatch, ``ops/ep_a2a.ep_dispatch_2d``).
+
+    The transformer trunk is ``dense._forward_trunk`` with the MoE
+    block plugged in via its ``ffn_fn`` hook — one trunk, two models.
     """
-    from triton_dist_tpu.models.dense import _embed_tokens, _lm_head
+    import functools
+
+    from triton_dist_tpu.models.dense import _forward_trunk, _lm_head
 
     b, s = input_ids.shape
-    x = _embed_tokens(params, input_ids, mode=mode, axis=axis)
-
-    for lp in params["layers"]:
-        h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
-        attn_out, _ = tp_attn.fwd_prefill(
-            lp["attn"], h, cfg, batch=b, mode=mode, axis=axis,
-            ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
-        x = x + attn_out
-        h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        if moe_impl == "tp":
-            if mode == "fused" and ctxs.ag is not None:
-                # Fully-fused pipeline: AG-fused grouped GEMM + Pallas
-                # down-proj + fused RS epilogue (the reference's
-                # ag_group_gemm/moe_reduce_rs layer pairing).
-                moe_out = tp_moe.fwd_fused(
-                    lp["moe"], h, topk=cfg.num_experts_per_tok,
-                    num_experts=cfg.num_experts,
-                    mesh_ctx=ctxs.ag.mesh, axis=axis,
-                    block_m=moe_block_m,
-                    norm_topk_prob=cfg.norm_topk_prob)
-            else:
-                moe_out = tp_moe.fwd(
-                    lp["moe"], h, topk=cfg.num_experts_per_tok,
-                    num_experts=cfg.num_experts, axis=axis,
-                    norm_topk_prob=cfg.norm_topk_prob)
-        else:
-            moe_out = ep_moe.fwd(lp["moe"], h, ep_ctx,
-                                 topk=cfg.num_experts_per_tok,
-                                 norm_topk_prob=cfg.norm_topk_prob)
-        x = x + moe_out
-
-    from triton_dist_tpu.models.dense import _lm_head
-
-    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
-    if mode in ("xla", "fused"):
-        x = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    ffn = functools.partial(_moe_block, cfg=cfg, moe_impl=moe_impl,
+                            mode=mode, axis=axis, ctxs=ctxs,
+                            ep_ctx=ep_ctx, moe_block_m=moe_block_m)
+    x, _ = _forward_trunk(params, input_ids, cfg, mode=mode, axis=axis,
+                          ctxs=ctxs, cache=None, ffn_fn=ffn)
     return _lm_head(params, x, axis).reshape(b, s, cfg.vocab_size)
 
 
+# --- Engine serve contract (delegates to models.dense with the MoE
+# --- ffn_fn hook) -----------------------------------------------------------
+
+def cache_specs(axis: str = "tp"):
+    from triton_dist_tpu.models import dense as _dense
+
+    return _dense.cache_specs(axis)
+
+
+def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
+            axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
+            max_len: Optional[int] = None, moe_impl: str = "tp",
+            ep_ctx: Optional[EPContext] = None, moe_block_m: int = 64):
+    """Per-shard prefill → (last-position logits (B, vocab), KVCache).
+    Same contract as ``dense.prefill`` (the Engine's model protocol,
+    reference ``Engine._init_model`` + ``DenseLLM.inference``)."""
+    import functools
+
+    from triton_dist_tpu.models import dense as _dense
+
+    ffn = functools.partial(_moe_block, cfg=cfg, moe_impl=moe_impl,
+                            mode=mode, axis=axis, ctxs=ctxs,
+                            ep_ctx=ep_ctx, moe_block_m=moe_block_m)
+    return _dense.prefill(params, input_ids, cfg, mode=mode, axis=axis,
+                          ctxs=ctxs, max_len=max_len, ffn_fn=ffn)
+
+
+def decode_step(params, token_ids, cache, cfg: ModelConfig, *,
+                mode: str = "xla", axis: str = "tp",
+                ctxs: FwdContexts = FwdContexts(), moe_impl: str = "tp",
+                ep_ctx=None):
+    """One decode step on a replicated (B,) token batch — the dense
+    decode loop with the MoE small-batch FFN plugged in."""
+    import functools
+
+    from triton_dist_tpu.models import dense as _dense
+
+    ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
+                            axis=axis, ep_ctx=ep_ctx)
+    return _dense.decode_step(params, token_ids, cache, cfg, mode=mode,
+                              axis=axis, ctxs=ctxs, ffn_fn=ffn)
