@@ -350,8 +350,8 @@ def main():
 
         def rs_step(x, w):
             return jax.shard_map(
-                lambda xs, ws: gemm_rs(xs, ws, ctx,
-                                       force_kernel=(n == 1)),
+                lambda xs, ws: gemm_rs(xs, ws, ctx, sim_ranks=sim,
+                                       force_kernel=(n == 1 and not sim)),
                 mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
                 out_specs=P("tp", None), check_vma=False)(x, w)
         return rs_step

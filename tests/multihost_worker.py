@@ -1,0 +1,60 @@
+"""Inner SPMD worker for the multi-host integration test.
+
+Launched by ``scripts/launch.py`` (2 processes x 4 virtual CPU devices)
+— the localhost analogue of a 2-host x 4-chip pod slice. Exercises the
+full multi-host contract: env bring-up (initialize_distributed), the
+canonical mesh with the DCN axis outermost (docs/build.md), cross-
+process collectives over both axes, and MeshContext logical-id
+addressing.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from triton_dist_tpu.utils.distributed import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+initialize_distributed()   # reads COORDINATOR_ADDRESS/NUM_PROCESSES/...
+
+import jax                                       # noqa: E402
+import jax.numpy as jnp                          # noqa: E402
+import numpy as np                               # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import triton_dist_tpu as tdt                    # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+# dp is the outer (DCN) axis: each process' 4 local devices form its tp
+# group, matching the pod model where ICI is intra-host and DCN crosses.
+mesh = tdt.make_mesh(dp=2, tp=4, devices=jax.devices())
+mctx = tdt.MeshContext.from_mesh(mesh)
+assert mctx.size("dp") == 2 and mctx.size("tp") == 4
+
+x = jax.device_put(
+    jnp.arange(16.0).reshape(8, 2),
+    NamedSharding(mesh, P(("dp", "pp", "ep", "sp", "tp"), None)))
+
+
+def spmd(v):
+    def inner(u):
+        total = jax.lax.psum(u, ("dp", "tp"))              # DCN + ICI
+        row = jax.lax.all_gather(u, "tp", axis=0, tiled=True)  # ICI only
+        return total, jax.lax.psum(row, ("dp",)) / 2.0
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=P(("dp", "pp", "ep", "sp", "tp"), None),
+        out_specs=(P(None, None), P(None, None)), check_vma=False)(v)
+
+
+total, row_mean = jax.jit(spmd)(x)
+np.testing.assert_allclose(
+    np.asarray(jax.device_get(total))[0], [56.0, 64.0])
+assert np.asarray(jax.device_get(row_mean)).shape == (4, 2)
+dist_print("multihost contract OK", allowed_ranks="all")
+print(f"RESULT_OK rank={jax.process_index()}", flush=True)

@@ -169,6 +169,24 @@ def test_ag_gemm_sim_ranks(variant):
     assert_allclose(f(a, b), want, rtol=1e-4, atol=1e-4)
 
 
+def test_gemm_rs_sim_ranks():
+    """Self-simulated ring for gemm_rs: full schedule and traffic with
+    received partials runtime-weighted to zero — the output must be the
+    plain local GEMM (the n=1 reduce)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((256, 32), 54)
+    b = _rand((32, 64), 55)
+    ctx = create_gemm_rs_context(ctx1, block_m=16, block_n=16)
+    f = spmd(mesh1, lambda x, w: gemm_rs(x, w, ctx, sim_ranks=4),
+             (P(None, None), P(None, None)), P(None, None))
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)
+
+
 def test_ag_gemm_sim_ranks_return_ag():
     """Sim mode must also fill the gather workspace correctly."""
     import numpy as np

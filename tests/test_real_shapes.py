@@ -1,0 +1,159 @@
+"""Production-row-count correctness per fused family (VERDICT r3 #3).
+
+The interpret-mode CPU harness starves when a single pallas buffer
+exceeds ~64 KB/device (tests/test_fused_gemm.py note), which previously
+capped every multi-device test at a few hundred rows — the Mosaic-
+relevant failure class this suite targets is INDEX ARITHMETIC at real
+row counts (>=2048 rows: multi-chunk ring offsets, tile/expert maps,
+page tables), so each family runs TALL-AND-NARROW: real M/S/T, small
+d/K, every buffer under the limit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.utils.testing import spmd, assert_allclose
+
+
+def _rand(shape, seed, dtype=jnp.float32, scale=1.0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype) * scale
+
+
+def test_real_rows_ag_gemm(tp8_mesh, tp8_ctx):
+    """M = 2048 global rows (256/rank, 4 row tiles per ring chunk)."""
+    from triton_dist_tpu.ops import (ag_gemm, ag_gemm_ref,
+                                     create_ag_gemm_context)
+
+    a = _rand((2048, 8), 0, jnp.bfloat16)
+    b = _rand((8, 8), 1, jnp.bfloat16)
+    ctx = create_ag_gemm_context(tp8_ctx, block_m=64, block_n=8,
+                                 block_k=8)
+    f = spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    assert_allclose(jnp.asarray(f(a, b), jnp.float32),
+                    jnp.asarray(g(a, b), jnp.float32),
+                    rtol=2e-2, atol=2e-2)
+
+
+def test_real_rows_gemm_rs(tp8_mesh, tp8_ctx):
+    """M = 2048 with the ring-accumulate workspace at 256 rows/rank."""
+    from triton_dist_tpu.ops import (gemm_rs, gemm_rs_ref,
+                                     create_gemm_rs_context)
+
+    a = _rand((2048, 64), 2, jnp.bfloat16, 0.2)
+    b = _rand((64, 8), 3, jnp.bfloat16, 0.2)
+    ctx = create_gemm_rs_context(tp8_ctx, block_m=64, block_n=8,
+                                 block_k=8)
+    f = spmd(tp8_mesh, lambda x, w: gemm_rs(x, w, ctx),
+             (P(None, "tp"), P("tp", None)), P("tp", None))
+    g = spmd(tp8_mesh, lambda x, w: gemm_rs_ref(x, w),
+             (P(None, "tp"), P("tp", None)), P("tp", None))
+    assert_allclose(jnp.asarray(f(a, b), jnp.float32),
+                    jnp.asarray(g(a, b), jnp.float32),
+                    rtol=2e-2, atol=2e-1)
+
+
+def test_real_rows_ep_dispatch(tp8_mesh, tp8_ctx):
+    """T = 2048 tokens PER RANK (16384 global assignments at K=2)
+    through the drop-free exact-splits dispatch/combine."""
+    from triton_dist_tpu.ops.ep_a2a import (
+        create_ep_context, ep_dispatch, ep_combine,
+    )
+
+    T, d, E, K = 2048, 4, 16, 2
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K, axis="tp")
+    tokens = _rand((8 * T, d), 4)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (8 * T, K), 0, E)
+    w = jax.nn.softmax(_rand((8 * T, K), 6), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        return ep_combine(recv, state, w_, ctx)
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)),
+             P("tp", None))
+    out = f(tokens, ids, w)
+    expected = tokens * jnp.sum(w, axis=-1, keepdims=True)
+    assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_real_rows_ulysses_qkv_a2a(tp8_mesh, tp8_ctx):
+    """S = 2048 global sequence rows through the fused QKV A2A."""
+    from triton_dist_tpu.ops import (create_ulysses_fused_context,
+                                     qkv_gemm_a2a)
+
+    N, s_loc, d, cols = 8, 256, 8, 4
+    ctx = create_ulysses_fused_context(tp8_ctx, axis="tp", block_m=32,
+                                       block_n=4)
+    x = _rand((N * s_loc, d), 7)
+    w = _rand((N, d, cols), 8, scale=d ** -0.5)
+
+    f = spmd(tp8_mesh,
+             lambda xs, ws: qkv_gemm_a2a(xs, ws, ctx)[None],
+             (P("tp", None), P(None, None, None)),
+             P("tp", None, None, None))
+    got = np.asarray(f(x, w))
+    xs = np.asarray(x).reshape(N, s_loc, d)
+    for me in range(N):
+        want = np.einsum("nsd,dc->nsc", xs, np.asarray(w)[me])
+        np.testing.assert_allclose(got[me], want, rtol=2e-4, atol=2e-4)
+
+
+def test_real_rows_sp_ag_attention_fused(tp8_mesh, tp8_ctx):
+    """S = 2048 global sequence through the fused ring-attention
+    kernel (8 query tiles x 4 KV tiles per chunk per rank)."""
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+    from triton_dist_tpu.ops.sp_ag_attention import sp_ag_attention_ref
+
+    s_loc, h, hd = 256, 1, 4
+    q = _rand((s_loc * 8, h, hd), 9, scale=0.5)
+    k = _rand((s_loc * 8, h, hd), 10, scale=0.5)
+    v = _rand((s_loc * 8, h, hd), 11, scale=0.5)
+    f = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_fused(
+                 a, b, c, ctx=tp8_ctx, axis="tp", block_q=32,
+                 block_kv=64),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    g = spmd(tp8_mesh,
+             lambda a, b, c: sp_ag_attention_ref(a, b, c, axis="tp"),
+             (P("tp", None, None),) * 3, P("tp", None, None))
+    assert_allclose(f(q, k, v), g(q, k, v), rtol=1e-4, atol=1e-4)
+
+
+def test_real_rows_paged_decode():
+    """KV length 2000 over a 32-page pool (page 64) — real-scale block
+    tables and page-boundary arithmetic."""
+    from triton_dist_tpu.ops import paged_flash_decode
+
+    npages, kvh, page, hd, B = 64, 1, 64, 4, 2
+    kp = _rand((npages, kvh, page, hd), 12, jnp.bfloat16, 0.3)
+    vp = _rand((npages, kvh, page, hd), 13, jnp.bfloat16, 0.3)
+    per = npages // B
+    tbl = jnp.arange(B * per, dtype=jnp.int32).reshape(B, per)
+    kv_len = jnp.array([2000, 1537], jnp.int32)
+    q = _rand((B, 4, hd), 14, jnp.bfloat16, 0.3)
+    out = jax.jit(lambda q_: paged_flash_decode(
+        q_, kp, vp, tbl, kv_len))(q)
+    out = np.asarray(out, np.float32)
+    assert out.shape == (B, 4, hd) and np.isfinite(out).all()
+
+    # Dense oracle from the same pages.
+    kf = np.asarray(kp, np.float32).reshape(npages * page, hd)
+    vf = np.asarray(vp, np.float32).reshape(npages * page, hd)
+    qf = np.asarray(q, np.float32)
+    for b in range(B):
+        rows = np.asarray(tbl[b]).reshape(-1)
+        kk = kf[np.concatenate([np.arange(p * page, (p + 1) * page)
+                                for p in rows])][:int(kv_len[b])]
+        vv = vf[np.concatenate([np.arange(p * page, (p + 1) * page)
+                                for p in rows])][:int(kv_len[b])]
+        s = (qf[b] @ kk.T) / np.sqrt(hd)
+        p_ = np.exp(s - s.max(-1, keepdims=True))
+        p_ /= p_.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out[b], p_ @ vv, rtol=5e-2, atol=5e-2)

@@ -246,6 +246,36 @@ def test_sp_ag_attention_fused_varlen_gqa_multitile(tp8_mesh, tp8_ctx):
     assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("inner,outer", [("tp", "dp"), ("dp", "tp")])
+@pytest.mark.parametrize("cu", [CU_MIXED, CU_PADDED, CU_ONE],
+                         ids=["mixed", "padded", "single"])
+def test_sp_ag_attention_2d_varlen_vs_oracle(dp2tp4_mesh, dp2tp4_ctx,
+                                             inner, outer, cu):
+    """Hierarchical schedule varlen == ragged oracle (VERDICT r3 #7:
+    the span predicate is threaded through all three send tiers —
+    mirror pushes, group-level mirror acceptance, per-peer relays).
+    CU_MIXED crosses chunk AND group boundaries; CU_PADDED makes the
+    upper ranks share no sequence with the lower ones, exercising the
+    mirror-skip and relay pruning; both axis assignments run."""
+    from triton_dist_tpu.ops import sp_ag_attention_2d
+
+    s, h, hd = 64, 4, 16
+    q = _rand((s, h, hd), 33)
+    k = _rand((s, h, hd), 34)
+    v = _rand((s, h, hd), 35)
+
+    shard = P((outer, inner), None, None)
+    f = spmd(dp2tp4_mesh,
+             lambda a, b, c: sp_ag_attention_2d(
+                 a, b, c, ctx=dp2tp4_ctx, inner_axis=inner,
+                 outer_axis=outer, block_q=4, block_kv=8,
+                 cu_seqlens=cu),
+             (shard,) * 3, shard)
+    out = f(q, k, v)
+    expected = _varlen_oracle(q, k, v, cu)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
 def test_sp_ag_attention_varlen_single_equals_causal(tp8_mesh, tp8_ctx):
     """Degenerate one-sequence cu must reproduce the plain causal path
     bit-for-bit (same code path modulo masks)."""

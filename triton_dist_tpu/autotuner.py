@@ -53,6 +53,17 @@ def autotune(op_name: str, configs: Sequence[Dict[str, Any]],
 
             candidates = [c for c in configs
                           if prune_fn is None or prune_fn(c, *args)]
+            n_total = len(list(configs))
+            if n_total - len(candidates):
+                # Reference logs its perf-model pruning too
+                # (gemm_perf_model.py); the count makes the veto
+                # behaviour observable. Rank-0 only: every process
+                # traces the same deterministic sweep.
+                from triton_dist_tpu.utils.distributed import dist_print
+
+                dist_print(f"[autotune:{op_name}] perf-model vetoed "
+                           f"{n_total - len(candidates)}/{n_total} "
+                           "configs", prefix=False)
             if not candidates:
                 return fn(*args, **kwargs)
             best_cfg, best_t = None, float("inf")

@@ -25,6 +25,7 @@ from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401
 )
 from triton_dist_tpu.ops.gemm_rs import (  # noqa: F401
     GemmRSContext, create_gemm_rs_context, gemm_rs, gemm_rs_ref,
+    gemm_rs_tuned,
 )
 from triton_dist_tpu.ops.gemm_ar import (  # noqa: F401
     GemmARContext, create_gemm_ar_context, gemm_ar, gemm_ar_ref,
@@ -43,7 +44,8 @@ from triton_dist_tpu.ops.ep_fused import (  # noqa: F401
     ep_gemm_combine, ep_moe_fused,
 )
 from triton_dist_tpu.ops.group_gemm import (  # noqa: F401
-    grouped_gemm, grouped_gemm_tiles, grouped_swiglu, sort_by_expert,
+    grouped_gemm, grouped_gemm_tiles, grouped_gemm_tiles_tuned,
+    grouped_swiglu, sort_by_expert,
 )
 from triton_dist_tpu.ops.ag_moe import (  # noqa: F401
     AGMoEContext, create_ag_moe_context, ag_group_gemm, ag_moe_ref,

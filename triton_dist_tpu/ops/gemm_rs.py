@@ -57,10 +57,15 @@ def gemm_rs_ref(a, b, *, axis: str = "tp", **_):
                                 tiled=True).astype(a.dtype)
 
 
-def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
-                    out_v, send_sem, recv_sem, *, axis: str,
-                    ctx: MeshContext, m_loc: int, tm: int, tn: int,
-                    n_ranks: int):
+def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
+                    acc_v, tmp_v, out_v, send_sem, recv_sem, *,
+                    axis: str, ctx: MeshContext, m_loc: int, tm: int,
+                    tn: int, n_ranks: int, sim: bool = False):
+    """``sim=True`` (single-chip overlap proxy): the ring runs against
+    myself — sends, waits, adds, and per-step traffic are all real, but
+    the received partial is folded with the runtime weight ``w_ref``
+    (0 in sim, 1 in real — a value the compiler cannot fold away), so
+    the per-chunk outputs stay the verifiable local GEMM result."""
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -70,7 +75,7 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
     n_k = pl.num_programs(3)
     me = dl.rank(axis)
     n = n_ranks
-    right = jax.lax.rem(me + 1, n)
+    right = me if sim else jax.lax.rem(me + 1, n)
 
     first = jnp.logical_and(
         s == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
@@ -99,11 +104,12 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
     def _():
         @pl.when(s > 0)
         def _():
-            # Add the accumulated partial from upstream devices.
+            # Add the accumulated partial from upstream devices (weight
+            # 1.0; the sim self-ring weights it 0.0 — same VPU work).
             pltpu.sync_copy(
                 recv_hbm.at[s - 1, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
                 tmp_v)
-            acc_v[...] = acc_v[...] + tmp_v[...]
+            acc_v[...] = acc_v[...] + tmp_v[...] * w_ref[0, 0]
 
         @pl.when(s < n - 1)
         def _():
@@ -117,16 +123,25 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
                               send_sem.at[s], recv_sem.at[s], right,
                               axis=axis, ctx=ctx)
 
-        @pl.when(s == n - 1)
-        def _():
-            # Fully reduced tile of my own chunk (manual store: the
-            # output is only defined at the last ring step, so it cannot
-            # be a pipelined BlockSpec). Note at s == n-1 the recv add
-            # above (s > 0) has already folded in the upstream partials;
-            # with n == 1 (forced rankless) acc is the whole result.
+        if sim:
+            # Every chunk's (local-partial) result is emitted so the
+            # whole output is checkable against the plain GEMM.
+            c = jax.lax.rem(me - s - 1 + 2 * n, n)
             out_v[...] = acc_v[...].astype(out_v.dtype)
-            pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
+            pltpu.sync_copy(out_v, o_ref.at[pl.ds(c * m_loc + i * tm, tm),
                                             pl.ds(j * tn, tn)])
+        else:
+            @pl.when(s == n - 1)
+            def _():
+                # Fully reduced tile of my own chunk (manual store: the
+                # output is only defined at the last ring step, so it
+                # cannot be a pipelined BlockSpec). Note at s == n-1 the
+                # recv add above (s > 0) has already folded in the
+                # upstream partials; with n == 1 (forced rankless) acc
+                # is the whole result.
+                out_v[...] = acc_v[...].astype(out_v.dtype)
+                pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
+                                                pl.ds(j * tn, tn)])
 
     last = jnp.logical_and(
         s == n - 1,
@@ -139,18 +154,31 @@ def _gemm_rs_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, acc_v, tmp_v,
             dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
 
 
-def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
+def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
+            sim_ranks: int = 0):
     """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``.
 
     ``a``: (M, K_loc) — activations, K sharded (row-parallel);
     ``b``: (K_loc, N) — row-parallel weight shard.
     Returns C shard of shape (M / n, N).
+
+    ``sim_ranks > 1`` (requires a size-1 mesh axis): single-chip overlap
+    proxy — the ring runs with self-targeted puts at the full schedule
+    and traffic; the output is the FULL (M, N) local GEMM (received
+    partials are runtime-weighted to zero so every chunk stays
+    verifiable). What bench.py measures on one chip.
     """
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m_full, k_loc = a.shape
     _, n_dim = b.shape
     out_dtype = ctx.out_dtype or a.dtype
+    sim = False
+    if sim_ranks and sim_ranks > 1:
+        if n != 1:
+            raise ValueError("sim_ranks requires a size-1 mesh axis "
+                             f"(got {n} ranks)")
+        n, sim = sim_ranks, True
     if n == 1 and not force_kernel:
         # force_kernel=True keeps the pallas pipeline even rankless
         # (single-chip kernel-efficiency benchmarking, like ag_gemm).
@@ -175,7 +203,11 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
 
     kernel = functools.partial(
         _gemm_rs_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
-        tn=tn, n_ranks=n)
+        tn=tn, n_ranks=n, sim=sim)
+
+    # Runtime fold weight for received partials (see kernel docstring).
+    w_recv = jnp.full((1, 1), 0.0 if sim else 1.0, jnp.float32)
+    out_rows = m_full if sim else m_loc
 
     # Ring workspaces are extra outputs (Mosaic forbids HBM scratch on
     # real TPUs); callers discard them.
@@ -184,7 +216,7 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
         comm=True,
         grid=(n, n_i, n_j, n_k),
         out_shape=(
-            jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+            jax.ShapeDtypeStruct((out_rows, n_dim), out_dtype),
             jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
                                  jnp.float32),
             jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
@@ -193,6 +225,8 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
         in_specs=[
             pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
             pl.BlockSpec((tk, tn), lambda s, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda s, i, j, kk: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
@@ -211,5 +245,64 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False):
                             + m_loc * n_dim) * a.dtype.itemsize,
             transcendentals=0,
         ),
-    )(a, b)
+    )(a, b, w_recv)
     return out
+
+
+def gemm_rs_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
+                  configs=None, **kw):
+    """Autotuned gemm_rs with perf-model pruning (reference:
+    ``gemm_perf_model.py`` + ``comm_perf_model.py`` prune every sweep
+    before timing): configs whose modeled VMEM cannot lower, or whose
+    modeled roofline time is >2x the best candidate's, are vetoed
+    without a compile."""
+    from triton_dist_tpu.autotuner import autotune
+    from triton_dist_tpu.tools.perf_model import (
+        gemm_rs_vmem_bytes, gemm_time_model_s,
+    )
+
+    if configs is None:
+        configs = [
+            {"block_m": 1024, "block_n": 128, "block_k": 4096},
+            {"block_m": 512, "block_n": 128, "block_k": 4096},
+            {"block_m": 512, "block_n": 128, "block_k": 2048},
+            {"block_m": 256, "block_n": 256, "block_k": 1024},
+        ]
+
+    def _prune(cfg, a_, b_):
+        m, k_loc = a_.shape
+        n_dim = b_.shape[1]
+        n = mesh.size(axis)
+
+        def fits(c):
+            return gemm_rs_vmem_bytes(
+                c.get("block_m", 256), c.get("block_n", 256),
+                c.get("block_k", 512), m // n, k_loc, n_dim,
+                a_.dtype.itemsize) <= 14 * 1024 * 1024
+
+        def t_model(c):
+            return gemm_time_model_s(
+                m, k_loc, n_dim, c.get("block_m", 256),
+                c.get("block_n", 256), c.get("block_k", 512),
+                dtype_bytes=a_.dtype.itemsize)
+
+        if not fits(cfg):
+            return False
+        # Time baseline over the VMEM-FEASIBLE subset only: an
+        # infeasible config must not set a phantom best time that
+        # vetoes every runnable candidate.
+        feasible = [c for c in configs if fits(c)]
+        best = min(t_model(c) for c in feasible)
+        return t_model(cfg) <= 2.0 * best
+
+    @autotune("gemm_rs", configs,
+              key_fn=lambda a_, b_, **kk: {
+                  "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
+                  "dtype": str(a_.dtype), "world": mesh.size(axis)},
+              prune_fn=_prune)
+    def _run(a_, b_, block_m=256, block_n=256, block_k=512):
+        ctx = create_gemm_rs_context(mesh, axis, block_m, block_n,
+                                     block_k)
+        return gemm_rs(a_, b_, ctx, **kw)
+
+    return _run(a, b)

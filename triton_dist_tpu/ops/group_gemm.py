@@ -132,3 +132,37 @@ def grouped_swiglu(x, w_gate, w_up, w_down, group_sizes):
     return jax.lax.ragged_dot(h, w_down, group_sizes,
                               preferred_element_type=jnp.float32
                               ).astype(x.dtype)
+
+
+def grouped_gemm_tiles_tuned(x_sorted, w, tile_expert, *, configs=None):
+    """Autotuned grouped GEMM with perf-model pruning: VMEM-infeasible
+    block configs are vetoed before any compile (reference pattern:
+    ``gemm_perf_model.py`` pruning grouped sweeps)."""
+    from triton_dist_tpu.autotuner import autotune
+    from triton_dist_tpu.tools.perf_model import grouped_gemm_vmem_bytes
+
+    if configs is None:
+        configs = [
+            {"block_n": 256, "block_k": 512},
+            {"block_n": 512, "block_k": 1024},
+            {"block_n": 512, "block_k": 2048},
+            {"block_n": 1024, "block_k": 4096},
+        ]
+    block_m = x_sorted.shape[0] // max(tile_expert.shape[0], 1)
+
+    def _prune(cfg, x_, w_, te_):
+        return grouped_gemm_vmem_bytes(
+            block_m, cfg.get("block_n", 256), cfg.get("block_k", 512),
+            w_.shape[1], w_.shape[2],
+            x_.dtype.itemsize) <= 14 * 1024 * 1024
+
+    @autotune("grouped_gemm_tiles", configs,
+              key_fn=lambda x_, w_, te_, **kk: {
+                  "rows": x_.shape[0], "d": w_.shape[1], "f": w_.shape[2],
+                  "e": w_.shape[0], "dtype": str(x_.dtype)},
+              prune_fn=_prune)
+    def _run(x_, w_, te_, block_n=256, block_k=512):
+        return grouped_gemm_tiles(x_, w_, te_, block_n=block_n,
+                                  block_k=block_k)
+
+    return _run(x_sorted, w, tile_expert)

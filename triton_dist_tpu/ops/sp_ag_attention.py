@@ -209,8 +209,24 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
     # k <= me, so `k <= me` selects exactly the visible chunks.
     need = (k <= me) if causal else (k >= 0)
     if varlen:
-        need = jnp.logical_and(
-            need, jnp.logical_or(k == 0, span_need(src, me)))
+        own_need = span_need(src, me)
+        if outer_axis is not None and no > 1:
+            # Hierarchical varlen: at a mirror step (k = m·ni) I am the
+            # chunk's RELAYER and must accept it whenever ANY member of
+            # my inner group needs it — the needing rank set of a
+            # contiguous packed sequence is the contiguous range
+            # [src, r_max], so "group needs" collapses to the span test
+            # against the group's FIRST rank. My own compute on a
+            # group-only chunk is then fully sequence-masked (zero
+            # contribution via the -inf guards).
+            group_start = oo * ni
+            is_relay_step = jnp.logical_and(
+                k > 0, jax.lax.rem(k, ni) == 0)
+            recv_need = jnp.where(is_relay_step,
+                                  span_need(src, group_start), own_need)
+        else:
+            recv_need = own_need
+        need = jnp.logical_and(need, jnp.logical_or(k == 0, recv_need))
     n_kv = s_loc // tkv
     hd = q_ref.shape[-1]
     scale = 1.0 / (float(hd) ** 0.5)
@@ -266,7 +282,10 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
         # Mirror pushes: one copy of my chunk per other outer group, to
         # the rank with my inner index (the group's relayer) — each
         # chunk crosses the slow (DCN) axis exactly once
-        # (sp_ag_attention_inter_node.py's node-leader staging).
+        # (sp_ag_attention_inter_node.py's node-leader staging). With
+        # varlen, a group is skipped when no packed sequence spans from
+        # my chunk into it (tested against the group's first rank —
+        # the needing set is a contiguous rank range).
         for m in range(1, no):
             if causal:
                 peer_o = oo + m          # no wrap: only groups above
@@ -275,6 +294,8 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                 peer_o = jax.lax.rem(oo + m, no)
                 pred = jnp.bool_(True)
             dst = peer_o * ni + ii
+            if varlen:
+                pred = jnp.logical_and(pred, span_need(me, peer_o * ni))
 
             @pl.when(pred)
             def _():
@@ -294,7 +315,10 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
         dl.wait_arrivals(recv_sem.at[1, n - k - 1], v_ws.at[src], 1)
         # Relay: at step k = m*ni the chunk is my mirror's (same inner
         # index, m groups below) — I am its relayer: forward it to my
-        # inner peers, who are all above it in global order.
+        # inner peers, who are all above it in global order. With
+        # varlen, each forward is pruned to peers whose queries share a
+        # sequence with the chunk (the peer's own wait uses the same
+        # span predicate — no handshake).
         for m in range(1, no):
             @pl.when(k == m * ni)
             def _():
@@ -302,14 +326,19 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                     peer = jax.lax.rem(ii + off, ni)
                     dst = oo * ni + peer
                     s_idx = _REL0 + (m - 1) * (ni - 1) + off - 1
-                    dl.remote_put(k_ws.at[src], k_ws.at[src],
-                                  send_sem.at[0, s_idx],
-                                  recv_sem.at[0, slot_for(src, dst)],
-                                  peer, axis=inner_axis, ctx=ctx)
-                    dl.remote_put(v_ws.at[src], v_ws.at[src],
-                                  send_sem.at[1, s_idx],
-                                  recv_sem.at[1, slot_for(src, dst)],
-                                  peer, axis=inner_axis, ctx=ctx)
+                    fwd = (span_need(src, dst) if varlen
+                           else jnp.bool_(True))
+
+                    @pl.when(fwd)
+                    def _():
+                        dl.remote_put(k_ws.at[src], k_ws.at[src],
+                                      send_sem.at[0, s_idx],
+                                      recv_sem.at[0, slot_for(src, dst)],
+                                      peer, axis=inner_axis, ctx=ctx)
+                        dl.remote_put(v_ws.at[src], v_ws.at[src],
+                                      send_sem.at[1, s_idx],
+                                      recv_sem.at[1, slot_for(src, dst)],
+                                      peer, axis=inner_axis, ctx=ctx)
 
     @pl.when(k == 0)
     def _():
@@ -424,6 +453,9 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                 dl.wait_arrivals(send_sem.at[1, off - 1], v_ref, 1)
         for m in range(1, no):
             pred = (oo + m < no) if causal else jnp.bool_(True)
+            if varlen:
+                peer_o = (oo + m) if causal else jax.lax.rem(oo + m, no)
+                pred = jnp.logical_and(pred, span_need(me, peer_o * ni))
 
             @pl.when(pred)
             def _():
@@ -431,10 +463,19 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                 dl.wait_arrivals(send_sem.at[1, ni - 1 + m - 1], v_ref, 1)
         for m in range(1, no):
             pred = (m * ni <= me) if causal else jnp.bool_(True)
+            src0 = jax.lax.rem(me - m * ni + 2 * n, n)
+            if varlen:
+                # Relays only happened if the mirror accepted the chunk
+                # for the group (the relay-step wait's predicate).
+                pred = jnp.logical_and(pred, span_need(src0, oo * ni))
             for off in range(1, ni):
                 s_idx = _REL0 + (m - 1) * (ni - 1) + off - 1
+                p_off = pred
+                if varlen:
+                    dst = oo * ni + jax.lax.rem(ii + off, ni)
+                    p_off = jnp.logical_and(pred, span_need(src0, dst))
 
-                @pl.when(pred)
+                @pl.when(p_off)
                 def _():
                     dl.wait_arrivals(send_sem.at[0, s_idx], k_ref, 1)
                     dl.wait_arrivals(send_sem.at[1, s_idx], v_ref, 1)
@@ -567,19 +608,24 @@ def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
     shrinks by n_inner versus a flat full-mesh push, and mirror-hop
     latency hides under the inner-group chunks that are consumed first
     (the chunk order walks own group, then groups below).
+
+    ``cu_seqlens`` enables the varlen form on this schedule too
+    (beyond the reference, whose varlen is intra-node only —
+    ``sp_ag_attention_intra_node.py:113``): the span predicate is
+    threaded through all three send tiers — mirror pushes skip outer
+    groups no sequence reaches, the mirror accepts on behalf of its
+    whole inner group (the needing rank set of a contiguous packed
+    sequence is a contiguous range, so "group needs" is one span test
+    against the group's first rank), and relays prune per-peer.
     """
-    if cu_seqlens is not None:
-        # The mirror/relay forwarding decisions would each need the
-        # span predicate threaded through three send tiers; the varlen
-        # workload is the reference's intra-node form, so the 1D fused
-        # kernel (or the XLA ring form, any mesh) covers it.
-        raise NotImplementedError(
-            "varlen is supported by sp_ag_attention_fused (1D) and "
-            "sp_ag_attention (XLA ring); not the hierarchical schedule")
+    if cu_seqlens is not None and not causal:
+        raise ValueError("varlen (cu_seqlens) requires causal=True")
     ni = ctx.size(inner_axis)
     no = ctx.size(outer_axis)
     if ni * no == 1:
-        return _masked_attn(q, k, v, 0, causal=causal)
+        return _masked_attn(q, k, v, 0, causal=causal,
+                            cu_seqlens=cu_seqlens)
     return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=inner_axis,
                             outer_axis=outer_axis, causal=causal,
-                            block_q=block_q, block_kv=block_kv)
+                            block_q=block_q, block_kv=block_kv,
+                            cu_seqlens=cu_seqlens)

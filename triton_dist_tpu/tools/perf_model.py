@@ -66,6 +66,59 @@ def overlap_efficiency_bound(m: int, k: int, n: int, world: int, *,
     return min(1.0, t_gemm / (t_gemm + max(t_comm - t_gemm, 0.0)))
 
 
+def gemm_rs_vmem_bytes(block_m: int, block_n: int, block_k: int,
+                       m_loc: int, k_loc: int, n_dim: int,
+                       dtype_bytes: int = 2) -> int:
+    """Model of ops/gemm_rs.py's VMEM footprint for a block config:
+    double-buffered pipelined A (tm,tk) and B (tk,tn) tiles plus the
+    acc/tmp/out scratch triple (gemm_rs.py scratch_shapes)."""
+    tm = min(block_m, m_loc)
+    tn = min(block_n, n_dim)
+    tk = min(block_k, k_loc)
+    a_tiles = 2 * tm * tk * dtype_bytes
+    b_tiles = 2 * tk * tn * dtype_bytes
+    scratch = tm * tn * (4 + 4 + dtype_bytes)
+    return a_tiles + b_tiles + scratch
+
+
+def grouped_gemm_vmem_bytes(block_m: int, block_n: int, block_k: int,
+                            d_in: int, d_out: int,
+                            dtype_bytes: int = 2) -> int:
+    """Model of ops/group_gemm.grouped_gemm_tiles' footprint: pipelined
+    X row tile (tm, tk), per-expert W tile (tk, tn), f32 accumulator.
+    Mirrors the kernel's divisor snapping (tn/tk halve until they
+    divide the weight dims) so the modeled footprint is what actually
+    allocates."""
+    tn = min(block_n, d_out)
+    while tn > 1 and d_out % tn:
+        tn //= 2
+    tk = min(block_k, d_in)
+    while tk > 1 and d_in % tk:
+        tk //= 2
+    return (2 * block_m * tk * dtype_bytes + 2 * tk * tn * dtype_bytes
+            + block_m * tn * 4 + block_m * tn * dtype_bytes)
+
+
+def gemm_time_model_s(m: int, k: int, n: int, block_m: int, block_n: int,
+                      block_k: int, *, dtype_bytes: int = 2,
+                      chip: ChipSpec = V5P) -> float:
+    """Config-sensitive GEMM time estimate: roofline compute plus the
+    HBM traffic this BLOCKING actually generates in the (i, j, kk)
+    grid — B tiles re-fetched once per row-tile sweep (n_i) and A tiles
+    once per column-tile sweep (n_j). Used to rank/prune autotune
+    configs before any compile (reference: ``gemm_perf_model.py``
+    estimates per-config tensorcore time the same way)."""
+    tm = max(min(block_m, m), 1)
+    tn = max(min(block_n, n), 1)
+    n_i = -(-m // tm)
+    n_j = -(-n // tn)
+    flops = 2.0 * m * k * n
+    t_compute = flops / (chip.bf16_tflops * 1e12 * chip.mxu_util)
+    traffic = (n_j * m * k + n_i * k * n + m * n) * dtype_bytes
+    t_mem = traffic / (chip.hbm_gbps * 1e9)
+    return max(t_compute, t_mem)
+
+
 def ag_gemm_vmem_bytes(block_m: int, block_n: int, block_k: int,
                        m_loc: int, kdim: int, n_loc: int,
                        dtype_bytes: int = 2,
