@@ -340,3 +340,55 @@ def test_getmem_block_pull_shift(tp8_mesh, tp8_ctx):
     want = np.roll(np.asarray(x).reshape(8, 8, 128), -2, axis=0)
     np.testing.assert_array_equal(np.asarray(out),
                                   want.reshape(64, 128))
+
+
+def test_broadcastmem_in_kernel(tp8_mesh, tp8_ctx):
+    """In-kernel broadcast from a non-zero root: every rank ends with
+    the root's buffer (reference libshmem_device.broadcastmem)."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, *, ctx):
+        # No explicit barrier: the collective runs its own barrier_all
+        # (scratch semaphores are unsafe under skewed kernel entry).
+        dl.broadcastmem(out_ref, x_ref, 3, send_sem, recv_sem,
+                        axis="tp", ctx=ctx)
+
+    def run(x):
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    out = spmd(tp8_mesh, run, P("tp", None), P("tp", None))(x)
+    expected = jnp.tile(x[3 * 8:4 * 8], (8, 1))   # root 3's shard
+    assert_allclose(out, expected)
+
+
+def test_fcollect_in_kernel(tp8_mesh, tp8_ctx):
+    """In-kernel flat collect: every rank gathers all 8 shards into its
+    (n, rows, cols) buffer (reference libshmem_device.fcollect)."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, *, ctx):
+        dl.fcollect(out_ref, x_ref, send_sem, recv_sem, axis="tp",
+                    ctx=ctx)
+
+    def run(x):
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct((8,) + x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 4 * 128, dtype=jnp.float32).reshape(32, 128)
+    out = spmd(tp8_mesh, run, P("tp", None), P(None, None, None))(x)
+    expected = jnp.asarray(x).reshape(8, 4, 128)
+    assert_allclose(out, expected)

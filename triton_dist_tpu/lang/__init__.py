@@ -16,7 +16,19 @@ from triton_dist_tpu.lang.shmem_device import (  # noqa: F401
     remote_put,
     putmem_block,
     putmem_signal_block,
+    putmem_signal_nbi_block,
+    putmem_nbi_block,
+    putmem_warp,
+    putmem_wave,
+    putmem_wg,
     getmem_block,
+    getmem_nbi_block,
+    getmem_warp,
+    getmem_wave,
+    getmem_wg,
+    broadcastmem,
+    fcollect,
+    amo_add,
     signal_op,
     notify,
     wait,

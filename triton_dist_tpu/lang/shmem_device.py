@@ -144,6 +144,127 @@ def getmem_block(dst_ref, src_ref, peer, requester, send_sem, recv_sem, *,
 
 
 # ---------------------------------------------------------------------------
+# Granularity / nbi tiers of the put-get surface
+#
+# The reference's libshmem_device multiplies every transfer op by a
+# thread-granularity suffix (_block/_warp/_wave/_wg — which SIMT lanes
+# participate, ``libshmem_device.py:~120-320``) and an _nbi (non-
+# blocking) tier. A TPU core drives ONE DMA engine — there are no
+# sub-core lanes to scope a transfer to — so every granularity maps to
+# the same whole-core async DMA, and *all* puts here are already nbi
+# (completion is the semaphore, not the call). The aliases keep the
+# reference surface addressable one-to-one.
+# ---------------------------------------------------------------------------
+
+putmem_nbi_block = putmem_block
+putmem_warp = putmem_block
+putmem_wave = putmem_block
+putmem_wg = putmem_block
+getmem_nbi_block = getmem_block
+getmem_warp = getmem_block
+getmem_wave = getmem_block
+getmem_wg = getmem_block
+
+
+def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, peer, send_sem,
+                            recv_sem, *, axis: str, ctx=None,
+                            sig_inc: int = 1):
+    """Non-blocking put+signal: the signal is issued WITHOUT draining
+    the send side first, so it may overtake the bulk data in flight
+    (stronger caveat than :func:`putmem_signal_block`, same as the
+    reference's ``putmem_signal_nbi`` ordering). Consumers must wait
+    the DMA's own ``recv_sem`` before reading; ``sig_sem`` is
+    application-level sequencing only."""
+    copy = remote_put(src_ref, dst_ref, send_sem, recv_sem, peer,
+                      axis=axis, ctx=ctx)
+    notify(sig_sem, peer, axis=axis, ctx=ctx, inc=sig_inc)
+    return copy
+
+
+# ---------------------------------------------------------------------------
+# In-kernel team collectives (broadcast / fcollect)
+# ---------------------------------------------------------------------------
+
+def broadcastmem(dst_ref, src_ref, root: int, send_sem, recv_sem, *,
+                 axis: str, ctx=None, barrier: bool = True):
+    """In-kernel broadcast: the root pushes ``src_ref`` into every
+    peer's ``dst_ref``; non-roots block until arrival. Completes fully
+    before returning on every rank (reference
+    ``libshmem_device.broadcast[mem]``; ``root`` is a static int,
+    matching the reference's PE_root argument).
+
+    By default an internal :func:`barrier_all` precedes the puts: the
+    scratch recv semaphore is only safe once every target has entered
+    the kernel (the skewed-entry hazard — see :func:`barrier_tile`'s
+    caveat). Pass ``barrier=False`` ONLY if the caller already ran a
+    full barrier over ``axis`` in this kernel."""
+    me = rank(axis)
+    n = num_ranks(axis)
+    if barrier:
+        barrier_all(axis, ctx=ctx)
+
+    @pl.when(me == root)
+    def _():
+        pltpu.sync_copy(src_ref, dst_ref)
+        for off in range(1, n):
+            peer = jax.lax.rem(root + off, n)
+            remote_put(src_ref, dst_ref, send_sem, recv_sem, peer,
+                       axis=axis, ctx=ctx)
+        for _ in range(n - 1):
+            pltpu.make_async_copy(src_ref, src_ref, send_sem).wait()
+
+    @pl.when(me != root)
+    def _():
+        wait_arrivals(recv_sem, dst_ref, 1)
+
+
+def fcollect(dst_ref, src_ref, send_sem, recv_sem, *, axis: str,
+             ctx=None, barrier: bool = True):
+    """In-kernel all-gather ("flat collect"): every rank pushes its
+    ``src_ref`` into slot ``me`` of every peer's ``dst_ref``
+    ((n, *src.shape)); returns with all n slots valid on every rank
+    (reference ``libshmem_device.fcollect[mem]`` — the full-mesh push
+    form, the same schedule as ``ops/allgather.py`` mode
+    "full_mesh" but usable mid-kernel on arbitrary refs).
+
+    Like that schedule, a full :func:`barrier_all` precedes the puts by
+    default — full-mesh traffic on scratch semaphores is unsafe under
+    skewed kernel entry (only the collective-id-keyed barrier semaphore
+    tolerates skew). ``barrier=False`` only after the caller's own full
+    barrier over ``axis``."""
+    me = rank(axis)
+    n = num_ranks(axis)
+    if barrier:
+        barrier_all(axis, ctx=ctx)
+    pltpu.sync_copy(src_ref, dst_ref.at[me])
+    for off in range(1, n):
+        peer = jax.lax.rem(me + off, n)
+        remote_put(src_ref, dst_ref.at[me], send_sem, recv_sem, peer,
+                   axis=axis, ctx=ctx)
+    for _ in range(n - 1):
+        pltpu.make_async_copy(src_ref, src_ref, send_sem).wait()
+    wait_arrivals(recv_sem, dst_ref.at[0], n - 1)
+
+
+# ---------------------------------------------------------------------------
+# AMO (atomic memory operations)
+#
+# The reference exposes remote word atomics (atomic_fetch_add / set /
+# compare_swap, ``libshmem_device.py`` AMO constants). TPU has no
+# remote atomics on arbitrary HBM words; the hardware's atomic
+# primitive is the COUNTING SEMAPHORE, so add-style AMO protocols map
+# to remote semaphore increments (amo_add below == signal_op ADD) and
+# fetch/compare styles must be re-designed around counts
+# (docs/primitives.md). This is the documented semantic delta, not an
+# emulation.
+# ---------------------------------------------------------------------------
+
+def amo_add(sem, value: int, peer, *, axis: str, ctx=None):
+    """Remote add on a semaphore "word" (the TPU AMO analogue)."""
+    notify(sem, peer, axis=axis, ctx=ctx, inc=value)
+
+
+# ---------------------------------------------------------------------------
 # Memory ordering (fence / quiet)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,65 @@
+"""Tutorial 13: multi-host bring-up + EP-MoE serving.
+
+Two independent demos of round-4 capabilities:
+
+1. The Engine serving the Qwen3-MoE model in the EXPERT-PARALLEL
+   regime — it builds the EP dispatch context itself
+   (``Engine(..., moe_impl="ep")``; the hierarchical form takes
+   ``ep_axis=(outer, inner)`` on a 2-axis mesh).
+2. The multi-host launch contract: this same script re-launched under
+   ``scripts/launch.py`` runs as 2 coordinated processes
+   (``python scripts/launch.py --nproc 2 --devices-per-proc 4
+   tutorials/13_multihost_moe_serving.py``) — the localhost analogue of
+   a 2-host pod slice; see docs/build.md for the real-pod recipe.
+
+Run: python tutorials/13_multihost_moe_serving.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import bootstrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from triton_dist_tpu.utils.distributed import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+# Multi-host first (before any backend init), no-op single-host.
+initialize_distributed()
+
+jax = bootstrap()
+import jax.numpy as jnp  # noqa: E402
+import numpy as np       # noqa: E402
+
+import triton_dist_tpu as tdt                      # noqa: E402
+from triton_dist_tpu.models import (               # noqa: E402
+    Engine, ModelConfig, qwen_moe,
+)
+
+n_local = jax.local_device_count()
+dist_print(f"{jax.process_count()} process(es), "
+           f"{jax.device_count()} global devices")
+
+if jax.process_count() > 1:
+    # 2-host shape: DP across hosts (DCN), TP inside (ICI) — the
+    # hierarchical EP regime shards experts over BOTH axes and each
+    # token's dispatch hops ICI first, then crosses DCN once.
+    mesh = tdt.make_mesh(dp=jax.process_count(), tp=n_local,
+                         devices=jax.devices())
+    ep_axis = ("dp", "tp")
+else:
+    mesh = tdt.make_mesh(tp=min(8, n_local), devices=jax.devices()[:8])
+    ep_axis = "tp"
+
+cfg = ModelConfig.tiny_moe(vocab_size=128, num_experts=8)
+eng = Engine(cfg, mesh, mode="xla", max_len=48, model=qwen_moe,
+             moe_impl="ep", ep_axis=ep_axis, seed=0)
+prompts = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                             cfg.vocab_size)
+toks = np.asarray(eng.serve(prompts, gen_len=6))
+dist_print("EP-MoE served tokens:\n" + str(toks))
+assert toks.shape == (2, 6)
+dist_print("tutorial 13 OK")
