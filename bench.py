@@ -580,7 +580,9 @@ def battery(quiet=False, deadline=None):
         """Correctness of both exchange schemes + the decode-shape perf
         comparison the VERDICT asked for: fused gemm_ar vs the XLA dot
         (the n=1 psum oracle) at M=128 (reference
-        low_latency_gemm_allreduce_op's regime, gemm_allreduce.py:669)."""
+        low_latency_gemm_allreduce_op's regime, gemm_allreduce.py:669).
+        Timed with the SELF-SIMULATED exchange (sim_ranks=8): the full
+        push + per-slot reduce schedule runs, peers = self."""
         small = jax.random.normal(k0, (128, 4096), dt)
         want = np.asarray(small, np.float32) @ np.asarray(b4k, np.float32)
         steps = {}
@@ -588,7 +590,7 @@ def battery(quiet=False, deadline=None):
             ctx = ops.create_gemm_ar_context(
                 mctx, block_n=512, block_k=1024, variant=variant)
             f = sm(lambda x, w, c=ctx: ops.gemm_ar(x, w, c,
-                                                   force_kernel=True),
+                                                   sim_ranks=8),
                    (P(None, None), P(None, None)))
             out = np.asarray(f(small, b4k), np.float32)
             np.testing.assert_allclose(out, want, rtol=3e-2, atol=3.0)

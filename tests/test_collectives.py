@@ -100,6 +100,23 @@ def test_p2p_put_partial(tp8_mesh, tp8_ctx):
     assert_allclose(f(x), g(x))
 
 
+@pytest.mark.parametrize("inner,outer", [("tp", "dp"), ("dp", "tp")])
+def test_all_reduce_2d(dp2tp4_mesh, dp2tp4_ctx, inner, outer):
+    """Hierarchical RS->AR->AG AllReduce == flat psum over both axes
+    (the INTRA/INTER CommScope decomposition; DCN carries 1/n_inner)."""
+    from triton_dist_tpu.ops import all_reduce_2d
+
+    x = _rand((32, 64), seed=9)
+    f = spmd(dp2tp4_mesh,
+             lambda v: all_reduce_2d(v, ctx=dp2tp4_ctx, inner_axis=inner,
+                                     outer_axis=outer),
+             P(None, None), P(None, None))
+    g = spmd(dp2tp4_mesh,
+             lambda v: jax.lax.psum(v, (outer, inner)),
+             P(None, None), P(None, None))
+    assert_allclose(f(x), g(x), rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.parametrize("mode", ["interleaved", "phased"])
 @pytest.mark.parametrize("inner,outer", [("tp", "dp"), ("dp", "tp")])
 def test_all_gather_2d(dp2tp4_mesh, dp2tp4_ctx, mode, inner, outer):

@@ -217,3 +217,23 @@ def test_ag_gemm_pipelined_variant(tp8_mesh, tp8_ctx):
     g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", ["ll", "one_shot"])
+def test_gemm_ar_sim_ranks(variant):
+    """Self-simulated exchange for gemm_ar (both schemes): full push +
+    per-slot reduce schedule with peer slots runtime-weighted to zero —
+    the output must be the plain local GEMM."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((16, 64), 56)
+    b = _rand((64, 64), 57)
+    ctx = create_gemm_ar_context(ctx1, block_n=16, block_k=16,
+                                 variant=variant)
+    f = spmd(mesh1, lambda x, w: gemm_ar(x, w, ctx, sim_ranks=4),
+             (P(None, None), P(None, None)), P(None, None))
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)

@@ -62,3 +62,16 @@ def test_hf_tied_embeddings():
     params = params_from_hf_state_dict(sd, cfg, dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(params["lm_head"]),
                                np.asarray(params["embed"]))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    """Params round-trip through the orbax checkpointer with shardings
+    restored device-direct (models/checkpoint.py)."""
+    from triton_dist_tpu.models import checkpoint
+
+    cfg = ModelConfig.tiny()
+    params = dense.init_params(jax.random.PRNGKey(5), cfg)
+    path = checkpoint.save_params(str(tmp_path / "ckpt"), params)
+    back = checkpoint.restore_params(path, like=params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b)), params, back)

@@ -309,3 +309,25 @@ def test_sp_flash_decode_vs_dense(tp8_mesh, tp8_ctx):
     out = f(q, k, v, kv_len)
     expected = flash_decode_ref(q, k, v, kv_len)
     assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_sp_flash_decode_2d_multislice(dp2tp4_mesh, dp2tp4_ctx):
+    """Multi-slice split-KV decode: the cache shards over BOTH mesh
+    axes (outer-major) and the LSE combine rides (dp, tp) — the
+    hierarchical long-context decode regime (reference scales split-KV
+    1->32 GPUs across nodes; here ICI x DCN in one call)."""
+    b, h, kvh, hd, t = 2, 8, 4, 16, 64
+    q = _rand((b, h, hd), 13)
+    k = _rand((b, t, kvh, hd), 14)
+    v = _rand((b, t, kvh, hd), 15)
+    kv_len = jnp.array([60, 23], jnp.int32)
+
+    f = spmd(dp2tp4_mesh,
+             lambda a, b_, c, l: sp_flash_decode(
+                 a, b_, c, l, axis=("dp", "tp")),
+             (P(None, None, None), P(None, ("dp", "tp"), None, None),
+              P(None, ("dp", "tp"), None, None), P(None)),
+             P(None, None, None))
+    out = f(q, k, v, kv_len)
+    expected = flash_decode_ref(q, k, v, kv_len)
+    assert_allclose(out, expected, rtol=1e-4, atol=1e-4)

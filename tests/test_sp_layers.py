@@ -98,6 +98,50 @@ def test_sp_flash_decode_layer(tp8_mesh, tp8_ctx):
     assert_allclose(np.asarray(kc2)[:, :37], np.asarray(k_cache)[:, :37])
 
 
+def test_sp_flash_decode_layer_2d(dp2tp4_mesh, dp2tp4_ctx):
+    """The decode layer over a multi-slice (dp x tp) sequence-sharded
+    cache: owner-rank append + two-axis LSE combine must match the
+    1-axis layout on the same global cache."""
+    from triton_dist_tpu.layers import sp_flash_decode as sfd
+    from triton_dist_tpu.layers import tp_attn
+    from triton_dist_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny()
+    b, t = 2, 64
+    params = tp_attn.init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, cfg.hidden_size))
+    kvh, hd = cfg.num_key_value_heads, cfg.head_dim
+    k_cache = jax.random.normal(jax.random.PRNGKey(2), (b, t, kvh, hd))
+    v_cache = jax.random.normal(jax.random.PRNGKey(3), (b, t, kvh, hd))
+    cache_len = jnp.asarray(41, jnp.int32)
+
+    kv2 = P(None, ("dp", "tp"), None, None)
+    y2d, _ = spmd(dp2tp4_mesh,
+                  lambda p, xx, kc, vc, cl: sfd.fwd(
+                      p, xx, cfg, kc, vc, cl, axis=("dp", "tp")),
+                  (tp_attn.param_specs(None), P(None, None), kv2, kv2,
+                   P()),
+                  (P(None, None), (kv2, kv2)))(
+        params, x, k_cache, v_cache, cache_len)
+
+    kv1 = P(None, "tp", None, None)
+    mesh1d = tp8_mesh_from(dp2tp4_mesh)
+    y1d, _ = spmd(mesh1d,
+                  lambda p, xx, kc, vc, cl: sfd.fwd(
+                      p, xx, cfg, kc, vc, cl, axis="tp"),
+                  (tp_attn.param_specs(None), P(None, None), kv1, kv1,
+                   P()),
+                  (P(None, None), (kv1, kv1)))(
+        params, x, k_cache, v_cache, cache_len)
+    assert_allclose(y2d, y1d, rtol=1e-4, atol=1e-4)
+
+
+def tp8_mesh_from(mesh2d):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(mesh2d.devices).reshape(-1), ("tp",))
+
+
 @pytest.mark.parametrize("impl", ["xla", "pallas"])
 def test_pp_send_next(tp8_mesh, tp8_ctx, impl):
     x = _rand((64, 32), 6)

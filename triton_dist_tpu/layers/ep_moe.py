@@ -103,15 +103,13 @@ def fwd_decode(params, x, *, topk: int, axis: str = "ep",
     """
     topk_ids, topk_w = route(params["router"], x, topk,
                              norm_topk_prob=norm_topk_prob)
+    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+
     if isinstance(axis, (tuple, list)):
         # Hierarchical expert sharding (outer-major rank order, matching
         # EP2DContext and P((outer, inner)) param specs).
         axis = tuple(axis)
-        me = jnp.int32(0)
-        for nm in axis:
-            me = me * jax.lax.axis_size(nm) + jax.lax.axis_index(nm)
-    else:
-        me = jax.lax.axis_index(axis)
+    _, me = flat_axis_rank(axis)
     e_loc = params["w_gate"].shape[0]        # local expert shard
     ge = me * e_loc + jnp.arange(e_loc)      # my experts' global ids
     # (B, e_loc) combine weight mass routed to my experts.

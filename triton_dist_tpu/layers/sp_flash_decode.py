@@ -19,7 +19,7 @@ from triton_dist_tpu.ops.flash_decode import sp_flash_decode
 init = None  # uses tp_attn-style params passed by the caller
 
 
-def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis: str = "sp"):
+def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp"):
     """One decode step with a sequence-sharded cache.
 
     x: (B, d) replicated along ``axis``; caches (B, T_loc, KV, hd) —
@@ -27,13 +27,22 @@ def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis: str = "sp"):
     cache; cache_len: scalar global length. The new token's KV is
     appended on the owning rank only. Returns (y (B, d), caches).
 
+    ``axis`` may be an ``(outer, inner)`` tuple for multi-slice caches
+    (shards in outer-major order; the combine rides both axes — see
+    ``ops/flash_decode.sp_flash_decode``).
+
     CAPACITY CONTRACT: ``cache_len`` must be < n*T_loc. At full
     capacity no rank owns the append slot (owner == n) and the newest
     token's KV would be silently dropped — callers must size caches or
     guard the step count (as ``Engine.decode`` does for the TP cache).
     """
-    n = jax.lax.axis_size(axis)
-    me = jax.lax.axis_index(axis)
+    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+
+    if isinstance(axis, (tuple, list)):
+        axis = tuple(axis)
+    # Only `me` feeds the owner-rank append; the capacity contract
+    # (cache_len < n*T_loc) is the CALLER's guard (see docstring).
+    _, me = flat_axis_rank(axis)
     hd = cfg.head_dim
     h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
     b = x.shape[0]

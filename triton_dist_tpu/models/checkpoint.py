@@ -1,0 +1,53 @@
+"""Native checkpoint save/restore for model params (orbax-backed).
+
+The reference has no checkpointing of its own (inference library —
+weights always come from HF files, SURVEY.md §5 "Checkpoint/resume:
+none"); serving restarts re-read safetensors. Here params can
+round-trip through orbax so a sharded serving state restores directly
+to devices (sharding-aware, no host-side detour through torch), which
+matters once a pod slice holds the weights: restore places each shard
+on its owner.
+
+API:
+    save_params(path, params)
+    params = restore_params(path, like=abstract_or_concrete_pytree)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_params(path: str, params) -> str:
+    """Write the param pytree to ``path`` (an empty/new directory).
+    Sharded arrays are written per-shard by their owning processes."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    ckpt.save(path, params)
+    ckpt.wait_until_finished()
+    return path
+
+
+def restore_params(path: str, like=None):
+    """Restore a param pytree. ``like`` (optional) is a pytree of
+    arrays or ShapeDtypeStructs with shardings — restored arrays are
+    placed onto those shardings directly (device-direct multi-host
+    restore)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    if like is None:
+        return ckpt.restore(path)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, "sharding",
+                                                        None)),
+        like)
+    return ckpt.restore(path, abstract)

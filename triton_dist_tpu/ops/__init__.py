@@ -17,7 +17,7 @@ from triton_dist_tpu.ops.reduce_scatter import (  # noqa: F401
     reduce_scatter, reduce_scatter_ref,
 )
 from triton_dist_tpu.ops.allreduce import (  # noqa: F401
-    all_reduce, all_reduce_ref, AllReduceMethod,
+    all_reduce, all_reduce_2d, all_reduce_ref, AllReduceMethod,
 )
 from triton_dist_tpu.ops.p2p import p2p_put, ppermute_ref  # noqa: F401
 from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401

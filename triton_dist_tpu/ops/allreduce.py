@@ -216,3 +216,38 @@ def all_reduce(x, *, ctx: MeshContext, axis: str = "tp",
         ],
     )(x)
     return out
+
+
+def all_reduce_2d(x, *, ctx: MeshContext, inner_axis: str = "tp",
+                  outer_axis: str = "dp", force_kernel: bool = False,
+                  outer_method="one_shot"):
+    """Hierarchical (ICI x DCN) AllReduce: ReduceScatter on the fast
+    inner axis, AllReduce the 1/n_inner-sized shards across the slow
+    outer axis, then AllGather back on the inner axis — the classic
+    bandwidth-optimal decomposition (DCN carries 1/n_inner of the
+    payload; the CommScope INTRA/INTER split of the reference's
+    allreduce family, ``kernels/nvidia/allreduce.py``, re-expressed as
+    mesh-axis placement).
+
+    ``x``: per-shard array with dim0 divisible by the inner axis size.
+    Returns the sum over BOTH axes, replicated.
+    """
+    from triton_dist_tpu.ops.allgather import all_gather
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    ni = ctx.size(inner_axis)
+    no = ctx.size(outer_axis)
+    if ni * no == 1 and not force_kernel:
+        return x
+    part = x
+    if ni > 1 or force_kernel:
+        part = reduce_scatter(part, ctx=ctx, axis=inner_axis,
+                              force_kernel=force_kernel)
+    if no > 1 or force_kernel:
+        part = all_reduce(part, ctx=ctx, axis=outer_axis,
+                          method=outer_method,
+                          force_kernel=force_kernel)
+    if ni > 1 or force_kernel:
+        part = all_gather(part, ctx=ctx, axis=inner_axis,
+                          force_kernel=force_kernel)
+    return part

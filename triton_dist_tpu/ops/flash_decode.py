@@ -26,7 +26,7 @@ def flash_decode_ref(q, k_full, v_full, kv_len):
                 kv_len=kv_len)[:, 0]
 
 
-def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis: str = "sp",
+def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis="sp",
                     shard_offset=None):
     """Split-KV decode step.
 
@@ -35,9 +35,20 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis: str = "sp",
     of the cache; kv_len: (B,) total valid length (global);
     shard_offset: global position of this shard's first slot (defaults
     to rank * T_loc). Returns (B, H, hd).
+
+    ``axis`` may be a single mesh-axis name or an ``(outer, inner)``
+    tuple for MULTI-SLICE long-context decode (KV sharded over
+    ICI x DCN): shards are addressed in outer-major flat order and the
+    LSE combine's pmax/psum ride both axes — XLA reduces intra-slice
+    first, then one small DCN hop, the right decomposition for a
+    (B, H)-sized payload (the hierarchical analogue of the reference's
+    intra/inter-rank combine pair, ``flash_decode.py:393/482``).
     """
-    n = jax.lax.axis_size(axis)
-    me = jax.lax.axis_index(axis)
+    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+
+    if isinstance(axis, (tuple, list)):
+        axis = tuple(axis)
+    n, me = flat_axis_rank(axis)
     b, h, hd = q.shape
     t_loc, kvh = k_shard.shape[1], k_shard.shape[2]
     if shard_offset is None:

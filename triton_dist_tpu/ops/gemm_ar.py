@@ -89,31 +89,49 @@ def _ar_accumulate(part_v, a_ref, b_ref, j, kk, axis, ctx):
 
 
 def _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
-                  recv_sem_tile, axis, ctx):
+                  recv_sem_tile, axis, ctx, sim=False):
     """Land my finished partial tile and push it to every peer; the
-    transfers overlap the next tile's matmul."""
+    transfers overlap the next tile's matmul. ``sim``: self-targeted
+    pushes into the peers' slot indices on MY OWN gather buffer — same
+    count/size of transfers and signals, peer = self, wire = HBM."""
     my_slot = gather_hbm.at[me, :, pl.ds(j * tn, tn)]
     pltpu.sync_copy(part_v, my_slot)
     for peer_off in range(1, n):
-        peer = jax.lax.rem(me + peer_off, n)
-        dl.remote_put(my_slot, my_slot, send_sem.at[peer_off - 1],
-                      recv_sem_tile, peer, axis=axis, ctx=ctx)
+        if sim:
+            dl.remote_put(my_slot,
+                          gather_hbm.at[peer_off, :, pl.ds(j * tn, tn)],
+                          send_sem.at[peer_off - 1], recv_sem_tile, me,
+                          axis=axis, ctx=ctx)
+        else:
+            peer = jax.lax.rem(me + peer_off, n)
+            dl.remote_put(my_slot, my_slot, send_sem.at[peer_off - 1],
+                          recv_sem_tile, peer, axis=axis, ctx=ctx)
 
 
-def _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n):
+def _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n, me, w_ref,
+                 sim=False):
     """Sum the n gather slots of tile ``jj`` into the output (arrivals
-    must already be certified by the caller's semaphore wait)."""
+    must already be certified by the caller's semaphore wait). In sim
+    mode ONLY, peer slots fold with the runtime weight ``w_ref`` (0 —
+    a value the compiler cannot fold away) so the result stays the
+    verifiable local GEMM; the real path is a plain sum with zero
+    extra VPU work (``sim`` is a compile-time bool)."""
     acc = None
     for r in range(n):
         pltpu.sync_copy(gather_hbm.at[r, :, pl.ds(jj * tn, tn)], tmp_v)
-        acc = tmp_v[...] if acc is None else acc + tmp_v[...]
+        if sim:
+            term = tmp_v[...] * jnp.where(r == me, 1.0, w_ref[0, 0])
+        else:
+            term = tmp_v[...]
+        acc = term if acc is None else acc + term
     out_v[...] = acc.astype(out_v.dtype)
     pltpu.sync_copy(out_v, o_ref.at[:, pl.ds(jj * tn, tn)])
 
 
-def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
-                    send_sem, recv_sem, *, axis: str, ctx: MeshContext,
-                    m: int, tn: int, n_ranks: int):
+def _gemm_ar_kernel(a_ref, b_ref, w_ref, o_ref, gather_hbm, part_v,
+                    tmp_v, out_v, send_sem, recv_sem, *, axis: str,
+                    ctx: MeshContext, m: int, tn: int, n_ranks: int,
+                    sim: bool = False):
     j = pl.program_id(0)
     kk = pl.program_id(1)
     n_j = pl.num_programs(0)
@@ -126,7 +144,7 @@ def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
     @pl.when(kk == n_k - 1)
     def _():
         _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
-                      recv_sem, axis, ctx)
+                      recv_sem, axis, ctx, sim=sim)
 
     @pl.when(jnp.logical_and(j == n_j - 1, kk == n_k - 1))
     def _():
@@ -137,12 +155,14 @@ def _gemm_ar_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v, out_v,
         for t in range(n - 1):
             dl.wait_arrivals(send_sem.at[t], tile_ref, n_j)
         for jj in range(n_j):
-            _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n)
+            _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n,
+                         me, w_ref, sim=sim)
 
 
-def _gemm_ar_ll_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v,
-                       out_v, send_sem, recv_sem, *, axis: str,
-                       ctx: MeshContext, m: int, tn: int, n_ranks: int):
+def _gemm_ar_ll_kernel(a_ref, b_ref, w_ref, o_ref, gather_hbm, part_v,
+                       tmp_v, out_v, send_sem, recv_sem, *, axis: str,
+                       ctx: MeshContext, m: int, tn: int, n_ranks: int,
+                       sim: bool = False):
     """Low-latency variant: per-N-tile one-shot exchange with the n-way
     reduction pipelined ONE TILE BEHIND the pushes.
 
@@ -172,12 +192,13 @@ def _gemm_ar_ll_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v,
         """Wait tile jj's (n-1) arrivals, then sum-and-emit."""
         dl.wait_arrivals(recv_sem.at[jj],
                          gather_hbm.at[0, :, pl.ds(jj * tn, tn)], n - 1)
-        _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n)
+        _ar_sum_tile(gather_hbm, tmp_v, out_v, o_ref, jj, tn, n, me,
+                     w_ref, sim=sim)
 
     @pl.when(kk == n_k - 1)
     def _():
         _ar_push_tile(gather_hbm, part_v, me, j, tn, n, send_sem,
-                      recv_sem.at[j], axis, ctx)
+                      recv_sem.at[j], axis, ctx, sim=sim)
 
         # Lagged reduce: tile j-1's arrivals rode under tile j's matmul.
         @pl.when(j > 0)
@@ -193,17 +214,31 @@ def _gemm_ar_ll_kernel(a_ref, b_ref, o_ref, gather_hbm, part_v, tmp_v,
                 dl.wait_arrivals(send_sem.at[t], tile_ref, n_j)
 
 
-def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
+def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False,
+            sim_ranks: int = 0):
     """Overlapped per-shard (A @ B) all-reduced along ``ctx.axis``.
 
     ``a``: (M, K_loc); ``b``: (K_loc, N). Returns the fully-reduced
     (M, N) on every device. Designed for small M (decode).
+
+    ``sim_ranks > 1`` (requires a size-1 mesh axis): single-chip
+    overlap proxy — the full exchange schedule runs with self-targeted
+    pushes into the simulated peers' gather slots, and the reduce folds
+    them with a runtime zero weight so the (verifiable) result is the
+    plain local GEMM. What bench.py's decode-regime battery measures
+    on one chip.
     """
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m, k_loc = a.shape
     _, n_dim = b.shape
     out_dtype = ctx.out_dtype or a.dtype
+    sim = False
+    if sim_ranks and sim_ranks > 1:
+        if n != 1:
+            raise ValueError("sim_ranks requires a size-1 mesh axis "
+                             f"(got {n} ranks)")
+        n, sim = sim_ranks, True
     if n == 1 and not force_kernel:
         return jnp.dot(a, b, preferred_element_type=jnp.float32
                        ).astype(out_dtype)
@@ -217,14 +252,18 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
 
     if ctx.variant == "ll":
         kernel = functools.partial(_gemm_ar_ll_kernel, axis=ctx.axis,
-                                   ctx=mesh, m=m, tn=tn, n_ranks=n)
+                                   ctx=mesh, m=m, tn=tn, n_ranks=n,
+                                   sim=sim)
         # Per-tile arrival semaphores: tile j's reduce waits only its
         # own arrivals, so tiles pipeline independently.
         recv_shape = (n_j,)
     else:
         kernel = functools.partial(_gemm_ar_kernel, axis=ctx.axis,
-                                   ctx=mesh, m=m, tn=tn, n_ranks=n)
+                                   ctx=mesh, m=m, tn=tn, n_ranks=n,
+                                   sim=sim)
         recv_shape = ()
+    # Runtime fold weight for peer slots (see _ar_sum_tile).
+    w_recv = jnp.full((1, 1), 0.0 if sim else 1.0, jnp.float32)
     # Gather workspace is a second output (no HBM scratch on real TPUs).
     out, _gather_ws = core_call(
         kernel,
@@ -236,6 +275,8 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
             pl.BlockSpec((m, tk), lambda j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((tk, tn), lambda j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda j, kk: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
@@ -253,7 +294,7 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False):
                             + (n + 1) * m * n_dim) * a.dtype.itemsize,
             transcendentals=0,
         ),
-    )(a, b)
+    )(a, b, w_recv)
     return out
 
 

@@ -106,3 +106,21 @@ class MeshContext:
     def spec(self, *names) -> P:
         """PartitionSpec helper: ``ctx.spec("tp", None)`` etc."""
         return P(*names)
+
+
+def flat_axis_rank(axis):
+    """(total size, my flat rank) over one axis name or an
+    outer-major tuple of axis names — THE convention shared by
+    ``P((outer, inner))`` shardings, ``EP2DContext`` expert ownership,
+    and multi-slice cache layouts. Must be called inside shard_map.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(axis, (tuple, list)):
+        n, me = 1, jnp.int32(0)
+        for nm in tuple(axis):
+            sz = jax.lax.axis_size(nm)
+            n *= sz
+            me = me * sz + jax.lax.axis_index(nm)
+        return n, me
+    return jax.lax.axis_size(axis), jax.lax.axis_index(axis)
