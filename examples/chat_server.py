@@ -1,0 +1,98 @@
+"""Minimal serving loop — the reference's megakernel ``model_server.py``
+/ chat-demo analogue (``mega_triton_kernel/test/models``).
+
+Reads one prompt of space-separated token ids per line on stdin, greedy-
+decodes, prints the generated ids. With ``--hf-dir`` it loads a real
+local HF checkpoint (config.json + safetensors) through
+``models.hf_loader.load_hf_checkpoint`` and serves THAT model (dense or
+MoE — the Engine picks the MoE contract from the config); otherwise a
+tiny randomly-initialized dense model. ``--megakernel`` swaps the layer
+engine for the persistent-kernel runtime.
+
+Run: printf '1 2 3\n9 8 7\n' | python examples/chat_server.py --gen-len 8
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tp", type=int, default=4)
+    ap.add_argument("--gen-len", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--hf-dir", default=None,
+                    help="local HF checkpoint directory")
+    ap.add_argument("--megakernel", action="store_true")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.tp}")
+    import jax
+    if os.environ.get("TDT_REAL_TPU") != "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import triton_dist_tpu as tdt
+    from triton_dist_tpu.models import Engine, ModelConfig, qwen_moe
+
+    if args.hf_dir and args.megakernel:
+        sys.exit("--megakernel serves the built-in tiny model only; "
+                 "drop one of --hf-dir/--megakernel")
+    mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
+    mk = None
+    if args.hf_dir:
+        from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(args.hf_dir, dtype=jnp.float32)
+        model_kw = ({"model": qwen_moe} if cfg.is_moe else {})
+        eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
+                     params=params, **model_kw)
+    elif args.megakernel:
+        from jax.sharding import Mesh
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        cfg = ModelConfig.tiny(vocab_size=128)
+        mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
+        # One engine for the whole session: construction/jit are
+        # prompt-length independent (prefill_chain is length-agnostic).
+        mk = MegaKernelEngine(cfg, mesh1d, batch=args.tp,
+                              max_len=args.max_len, tile_w=16, t_tile=16)
+        eng = None
+    else:
+        cfg = ModelConfig.tiny(vocab_size=128)
+        eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len)
+
+    print(f"serving {cfg.model_name} (vocab {cfg.vocab_size}); one "
+          "prompt of space-separated token ids per line:", flush=True)
+    for line in sys.stdin:
+        ids = [int(t) % cfg.vocab_size for t in line.split()]
+        if not ids:
+            continue
+        if len(ids) + args.gen_len > args.max_len:
+            print(f"-> [skipped: prompt {len(ids)} + gen {args.gen_len} "
+                  f"exceeds --max-len {args.max_len}]", flush=True)
+            continue
+        # Token-sharded prefill needs B*S divisible by tp; serving
+        # B=tp copies of the prompt satisfies it for ANY length (the
+        # rows are identical; row 0 is the answer).
+        prompt = jnp.asarray(np.tile(np.array([ids], np.int32),
+                                     (args.tp, 1)))
+        if args.megakernel:
+            seed = mk.prefill_chain(prompt)
+            toks = np.asarray(mk.generate(seed, steps=args.gen_len,
+                                          start_pos=len(ids) - 1))
+        else:
+            toks = np.asarray(eng.serve(prompt, gen_len=args.gen_len))
+        print("->", " ".join(str(t) for t in toks[0].tolist()),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
