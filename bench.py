@@ -323,7 +323,22 @@ def main():
         results.sort(key=lambda e: e[0])
         return results
 
-    sweep = _sweep("ag_gemm", configs, make_fused_step, a, b)
+    sim_fallback_reason = None
+    try:
+        sweep = _sweep("ag_gemm", configs, make_fused_step, a, b)
+    except AssertionError as e:
+        if not sim:
+            raise
+        # The self-sim ring has only ever lowered in interpret mode; if
+        # real Mosaic rejects every sim config, fall back to the
+        # rounds-1..3 rankless pipeline metric rather than zeroing the
+        # round — and RECORD WHY (detail.sim_fallback_reason), so a
+        # genuine Mosaic rejection is distinguishable from a transient
+        # outage in the round record.
+        sim = 0
+        sim_fallback_reason = str(e)[:600]
+        sweep = _sweep("ag_gemm", configs,
+                       lambda cfg: make_fused_step(cfg, 0), a, b)
     _, best_cfg, fused_step = sweep[0]
 
     # Correctness gate before persisting or timing: a fast wrong kernel
@@ -345,13 +360,14 @@ def main():
         jax.random.normal(jax.random.PRNGKey(3), (k_dim, n_dim), dtype),
         NamedSharding(mesh, P("tp", None)))
 
-    def make_rs_step(cfg):
+    def make_rs_step(cfg, sim_ranks=None):
         ctx = create_gemm_rs_context(mctx, **cfg)
 
         def rs_step(x, w):
+            s = sim if sim_ranks is None else sim_ranks
             return jax.shard_map(
-                lambda xs, ws: gemm_rs(xs, ws, ctx, sim_ranks=sim,
-                                       force_kernel=(n == 1 and not sim)),
+                lambda xs, ws: gemm_rs(xs, ws, ctx, sim_ranks=s,
+                                       force_kernel=(n == 1 and not s)),
                 mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
                 out_specs=P("tp", None), check_vma=False)(x, w)
         return rs_step
@@ -362,7 +378,17 @@ def main():
     rs_configs = list(GEMM_RS_CONFIGS)
     if rs_cached is not None and rs_cached not in rs_configs:
         rs_configs.append(rs_cached)
-    rs_sweep = _sweep("gemm_rs", rs_configs, make_rs_step, a_rs, b_rs)
+    rs_sim_used = bool(sim)
+    try:
+        rs_sweep = _sweep("gemm_rs", rs_configs, make_rs_step, a_rs, b_rs)
+    except AssertionError as e:
+        if not sim:
+            raise
+        rs_sim_used = False    # same fallback policy as ag_gemm above
+        if sim_fallback_reason is None:
+            sim_fallback_reason = f"gemm_rs: {str(e)[:600]}"
+        rs_sweep = _sweep("gemm_rs", rs_configs,
+                          lambda cfg: make_rs_step(cfg, 0), a_rs, b_rs)
     rs_best_cfg, rs_fused = rs_sweep[0][1], rs_sweep[0][2]
     got_rs = np.asarray(jax.jit(rs_fused)(a_rs, b_rs), np.float32)
     want_rs = (np.asarray(a_rs, np.float32)
@@ -434,14 +460,17 @@ def main():
     flops = 2 * m_full * k_dim * n_dim / max(n, 1)
     t_rankless = times.get("fused_rankless")
     result = {
-        "metric": ("ag_gemm_overlap_efficiency" if n > 1
-                   else "ag_gemm_overlap_efficiency_selfsim_ring"),
+        "metric": ("ag_gemm_overlap_efficiency" if n > 1 else
+                   "ag_gemm_overlap_efficiency_selfsim_ring" if sim else
+                   "ag_gemm_kernel_efficiency_single_chip"),
         "value": round(float(eff), 4),
         "unit": "ratio_vs_compute_only_gemm",
         "vs_baseline": round(float(eff) / 0.90, 4),
         "detail": {
             "devices": n,
             "sim_ranks": (SIM_RANKS if sim else None),
+            "gemm_rs_sim": rs_sim_used,
+            "sim_fallback_reason": sim_fallback_reason,
             "rankless_kernel_efficiency": (
                 round(float(t_compute / t_rankless), 4)
                 if t_rankless else None),
