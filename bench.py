@@ -323,22 +323,25 @@ def main():
         results.sort(key=lambda e: e[0])
         return results
 
-    sim_fallback_reason = None
-    try:
-        sweep = _sweep("ag_gemm", configs, make_fused_step, a, b)
-    except AssertionError as e:
-        if not sim:
-            raise
-        # The self-sim ring has only ever lowered in interpret mode; if
-        # real Mosaic rejects every sim config, fall back to the
-        # rounds-1..3 rankless pipeline metric rather than zeroing the
-        # round — and RECORD WHY (detail.sim_fallback_reason), so a
-        # genuine Mosaic rejection is distinguishable from a transient
-        # outage in the round record.
-        sim = 0
-        sim_fallback_reason = str(e)[:600]
-        sweep = _sweep("ag_gemm", configs,
-                       lambda cfg: make_fused_step(cfg, 0), a, b)
+    def _sweep_with_sim_fallback(name, cfgs, make_step, *operands,
+                                 sim_on):
+        """One fallback policy for every sim-capable sweep: if EVERY
+        sim config fails (the self-sim ring has only ever lowered in
+        interpret mode), re-sweep rankless rather than zeroing the
+        round, and RECORD WHY — a genuine Mosaic rejection stays
+        distinguishable from a transient outage in the round record.
+        Returns (sweep, sim_used, reason)."""
+        try:
+            return _sweep(name, cfgs, make_step, *operands), sim_on, None
+        except AssertionError as e:
+            if not sim_on:
+                raise
+            return (_sweep(name, cfgs, lambda c: make_step(c, 0),
+                           *operands),
+                    0, f"{name}: {str(e)[:600]}")
+
+    sweep, sim, sim_fallback_reason = _sweep_with_sim_fallback(
+        "ag_gemm", configs, make_fused_step, a, b, sim_on=sim)
     _, best_cfg, fused_step = sweep[0]
 
     # Correctness gate before persisting or timing: a fast wrong kernel
@@ -378,17 +381,12 @@ def main():
     rs_configs = list(GEMM_RS_CONFIGS)
     if rs_cached is not None and rs_cached not in rs_configs:
         rs_configs.append(rs_cached)
-    rs_sim_used = bool(sim)
-    try:
-        rs_sweep = _sweep("gemm_rs", rs_configs, make_rs_step, a_rs, b_rs)
-    except AssertionError as e:
-        if not sim:
-            raise
-        rs_sim_used = False    # same fallback policy as ag_gemm above
-        if sim_fallback_reason is None:
-            sim_fallback_reason = f"gemm_rs: {str(e)[:600]}"
-        rs_sweep = _sweep("gemm_rs", rs_configs,
-                          lambda cfg: make_rs_step(cfg, 0), a_rs, b_rs)
+    rs_sweep, rs_sim_used, rs_reason = _sweep_with_sim_fallback(
+        "gemm_rs", rs_configs, make_rs_step, a_rs, b_rs, sim_on=sim)
+    if rs_reason is not None:
+        # Only reachable when the ag sweep kept sim (else sim_on=0
+        # re-raises), so the two reasons never coexist.
+        sim_fallback_reason = rs_reason
     rs_best_cfg, rs_fused = rs_sweep[0][1], rs_sweep[0][2]
     got_rs = np.asarray(jax.jit(rs_fused)(a_rs, b_rs), np.float32)
     want_rs = (np.asarray(a_rs, np.float32)
@@ -469,7 +467,7 @@ def main():
         "detail": {
             "devices": n,
             "sim_ranks": (SIM_RANKS if sim else None),
-            "gemm_rs_sim": rs_sim_used,
+            "gemm_rs_sim": bool(rs_sim_used),
             "sim_fallback_reason": sim_fallback_reason,
             "rankless_kernel_efficiency": (
                 round(float(t_compute / t_rankless), 4)

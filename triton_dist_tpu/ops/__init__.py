@@ -22,6 +22,7 @@ from triton_dist_tpu.ops.allreduce import (  # noqa: F401
 from triton_dist_tpu.ops.p2p import p2p_put, ppermute_ref  # noqa: F401
 from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401
     AGGemmContext, create_ag_gemm_context, ag_gemm, ag_gemm_ref,
+    ag_gemm_tuned,
 )
 from triton_dist_tpu.ops.gemm_rs import (  # noqa: F401
     GemmRSContext, create_gemm_rs_context, gemm_rs, gemm_rs_ref,

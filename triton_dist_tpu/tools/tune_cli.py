@@ -1,0 +1,75 @@
+"""Offline tuning CLI — the reference's ``tools/tune/tune_gemm.py``
+analogue: sweep the fused-GEMM config spaces on the ATTACHED backend
+and persist winners into the tune cache, so serving jobs hit tuned
+configs on first use.
+
+Run (real chip):  TDT_REAL_TPU=1 python -m triton_dist_tpu.tools.tune_cli \
+    --op ag_gemm --m 2048 --k 4096 --n 4096
+"""
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", default="ag_gemm",
+                    choices=["ag_gemm", "gemm_rs", "gemm_ar"])
+    ap.add_argument("--m", type=int, default=2048)
+    ap.add_argument("--k", type=int, default=4096)
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--tp", type=int, default=None,
+                    help="mesh size (default: all attached devices)")
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    import jax
+    if os.environ.get("TDT_REAL_TPU") != "1":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import triton_dist_tpu as tdt
+    from triton_dist_tpu import ops
+
+    ndev = args.tp or len(jax.devices())
+    mesh = tdt.make_mesh(tp=ndev, devices=jax.devices()[:ndev])
+    mctx = tdt.MeshContext.from_mesh(mesh)
+    dt = jnp.dtype(args.dtype)
+    ka, kb = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+
+    if args.op == "ag_gemm":
+        a = jax.device_put(jax.random.normal(ka, (args.m, args.k), dt),
+                           NamedSharding(mesh, P("tp", None)))
+        b = jax.device_put(jax.random.normal(kb, (args.k, args.n), dt),
+                           NamedSharding(mesh, P(None, "tp")))
+        fn = jax.jit(jax.shard_map(
+            lambda xs, ws: ops.ag_gemm_tuned(xs, ws, mctx),
+            mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=P(None, "tp"), check_vma=False))
+    else:
+        a = jax.device_put(jax.random.normal(ka, (args.m, args.k), dt),
+                           NamedSharding(mesh, P(None, "tp")))
+        b = jax.device_put(jax.random.normal(kb, (args.k, args.n), dt),
+                           NamedSharding(mesh, P("tp", None)))
+        tuned = (ops.gemm_rs_tuned if args.op == "gemm_rs"
+                 else ops.gemm_ar_tuned)
+        out_spec = (P("tp", None) if args.op == "gemm_rs"
+                    else P(None, None))
+        fn = jax.jit(jax.shard_map(
+            lambda xs, ws: tuned(xs, ws, mctx),
+            mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=out_spec, check_vma=False))
+
+    jax.block_until_ready(fn(a, b))   # the sweep runs on first call
+    from triton_dist_tpu import tune
+
+    print(f"tuned {args.op} m={args.m} k={args.k} n={args.n} "
+          f"world={ndev}; cache at {tune.cache_path()}")
+
+
+if __name__ == "__main__":
+    main()
