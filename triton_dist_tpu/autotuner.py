@@ -66,6 +66,15 @@ def autotune(op_name: str, configs: Sequence[Dict[str, Any]],
                            "configs", prefix=False)
             if not candidates:
                 return fn(*args, **kwargs)
+            # Under tracing (jit/shard_map) nothing can be TIMED — a
+            # tracer has no wall clock. Use the cache (miss → first
+            # pruned candidate, deterministic everywhere) and leave
+            # sweeping to the offline paths: tune_spmd / tune_cli /
+            # bench.py, which time concrete jitted steps.
+            import jax
+
+            if any(isinstance(a, jax.core.Tracer) for a in args):
+                return fn(*args, **kwargs, **candidates[0])
             best_cfg, best_t = None, float("inf")
             for cfg in candidates:
                 try:
@@ -83,3 +92,43 @@ def autotune(op_name: str, configs: Sequence[Dict[str, Any]],
             return fn(*args, **kwargs, **best_cfg)
         return wrapper
     return deco
+
+
+def tune_spmd(op_name: str, configs: Sequence[Dict[str, Any]],
+              make_step: Callable[[Dict[str, Any]], Callable],
+              operands: Sequence[Any], key_attrs: Dict[str, Any],
+              prune_fn: Optional[Callable] = None,
+              reps: int = 3) -> Optional[Dict[str, Any]]:
+    """OFFLINE config sweep for SPMD ops (the path that can actually
+    time): ``make_step(cfg)`` returns a jitted callable over concrete
+    arrays (typically ``jax.jit(jax.shard_map(op-with-cfg))``); each
+    candidate is compiled and timed eagerly, the winner persists in
+    the tune cache under ``key_attrs``, and subsequent in-trace calls
+    of the op's ``*_tuned`` wrapper hit that cache. Configs that fail
+    to compile are skipped (the reference autotuner's deterministic
+    failure-skip policy). Returns the winning config (None if nothing
+    compiled)."""
+    import time as _time
+
+    import numpy as _np
+
+    key = tune.make_key(op_name, **key_attrs)
+    candidates = [c for c in configs
+                  if prune_fn is None or prune_fn(c, *operands)]
+    best_cfg, best_t = None, float("inf")
+    for cfg in candidates:
+        try:
+            step = make_step(cfg)
+            _np.asarray(step(*operands))          # compile + correctness
+            t = float("inf")
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                _np.asarray(step(*operands))
+                t = min(t, _time.perf_counter() - t0)
+        except Exception:
+            continue
+        if t < best_t:
+            best_cfg, best_t = cfg, t
+    if best_cfg is not None:
+        tune.store_autotune_data(key, best_cfg, best_t)
+    return best_cfg
