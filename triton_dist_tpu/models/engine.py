@@ -145,20 +145,43 @@ class Engine:
         self._host_len = length + 1
         return out
 
-    def serve(self, input_ids, gen_len: int = 32):
-        """Greedy generation (reference ``Engine.serve`` decode loop,
-        ``engine.py:113``). input_ids: (B, S) → (B, gen_len) tokens."""
+    def serve(self, input_ids, gen_len: int = 32, *,
+              temperature: float = 0.0, top_k: int = 0,
+              seed: int = 0):
+        """Token generation (reference ``Engine.serve`` decode loop,
+        ``engine.py:113`` — greedy there; sampling is capability-plus).
+
+        input_ids: (B, S) → (B, gen_len) tokens. ``temperature`` 0
+        (default) is greedy argmax; > 0 samples from the softmax at
+        that temperature, optionally truncated to the ``top_k``
+        highest-probability tokens. Sampling is deterministic per
+        ``seed`` (a fold of jax PRNG keys, one per step).
+        """
         input_ids = jnp.asarray(input_ids)
         b, s = input_ids.shape
         if s + gen_len > self.max_len:
             raise ValueError(
                 f"sequence {s}+{gen_len} exceeds max_len={self.max_len}")
+
+        if top_k < 0 or top_k > self.cfg.vocab_size:
+            raise ValueError(f"top_k={top_k} outside [0, vocab="
+                             f"{self.cfg.vocab_size}]")
+
+        def pick(logits, step):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lg = logits.astype(jnp.float32) / temperature
+            if top_k > 0:
+                # O(V log k) threshold, not a full vocab sort per token.
+                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            return jax.random.categorical(key, lg, axis=-1
+                                          ).astype(jnp.int32)
+
         logits, cache = self.prefill(input_ids)
-        out = []
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        out.append(tok)
-        for _ in range(gen_len - 1):
-            logits, cache = self.decode(tok, cache)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            out.append(tok)
+        out = [pick(logits, 0)]
+        for i in range(gen_len - 1):
+            logits, cache = self.decode(out[-1], cache)
+            out.append(pick(logits, i + 1))
         return jnp.stack(out, axis=1)
