@@ -415,6 +415,7 @@ def main():
         from triton_dist_tpu.ops.sp_ag_attention import _masked_attn
 
         s_len, h_n, kvh_n, hd_n = 2048, 16, 8, 128
+        s_last = s_len // SIM_RANKS
         qa = jax.device_put(
             jax.random.normal(jax.random.PRNGKey(4), (s_len, h_n, hd_n),
                               dtype) * 0.3,
@@ -426,24 +427,55 @@ def main():
                 NamedSharding(mesh, P(None, None, None)))
             for i in range(2))
 
-        def attn_fused(q_, kv_):
-            return jax.shard_map(
-                lambda qq, kk, vv: sp_ag_attention_fused(
-                    qq, kk, vv, ctx=mctx, axis="tp", force_kernel=True),
-                mesh=mesh, in_specs=(P(None, None, None),) * 3,
-                out_specs=P(None, None, None), check_vma=False)(q_, *kv_)
+        # Self-sim ring (only while the headline also measures sim —
+        # a demoted `sim` keeps the whole record on one footing): play
+        # the last of SIM_RANKS ranks, all chunk arrivals riding real
+        # self-put DMAs. Oracle computes the SAME slice (last-rank
+        # queries over the full KV), so the ratio compares identical
+        # work, overlap machinery included. With sim demoted, fall back
+        # to the rankless kernel vs the full dense oracle (rounds 1-3).
+        if sim:
+            def attn_fused(q_, kv_):
+                return jax.shard_map(
+                    lambda qq, kk, vv: sp_ag_attention_fused(
+                        qq, kk, vv, ctx=mctx, axis="tp",
+                        sim_ranks=SIM_RANKS),
+                    mesh=mesh, in_specs=(P(None, None, None),) * 3,
+                    out_specs=P(None, None, None),
+                    check_vma=False)(q_, *kv_)
 
-        def attn_xla(q_, kv_):
-            return _masked_attn(q_, kv_[0], kv_[1], 0).astype(q_.dtype)
+            def attn_xla(q_, kv_):
+                return _masked_attn(q_[-s_last:], kv_[0], kv_[1],
+                                    s_len - s_last).astype(q_.dtype)
+        else:
+            def attn_fused(q_, kv_):
+                return jax.shard_map(
+                    lambda qq, kk, vv: sp_ag_attention_fused(
+                        qq, kk, vv, ctx=mctx, axis="tp",
+                        force_kernel=True),
+                    mesh=mesh, in_specs=(P(None, None, None),) * 3,
+                    out_specs=P(None, None, None),
+                    check_vma=False)(q_, *kv_)
+
+            def attn_xla(q_, kv_):
+                return _masked_attn(q_, kv_[0], kv_[1], 0
+                                    ).astype(q_.dtype)
 
         # Correctness gate before timing (same policy as ag_gemm above:
-        # a fast wrong kernel is worthless).
-        np.testing.assert_allclose(
-            np.asarray(attn_fused(qa, kv_a), np.float32),
-            np.asarray(attn_xla(qa, kv_a), np.float32),
-            rtol=3e-2, atol=3e-2)
-        group["attn_fused"] = (attn_fused, qa, kv_a)
-        group["attn_xla"] = (attn_xla, qa, kv_a)
+        # a fast wrong kernel is worthless). Sim lowering failures are
+        # recorded and the attn metric skipped, not fatal.
+        try:
+            np.testing.assert_allclose(
+                np.asarray(attn_fused(qa, kv_a), np.float32),
+                np.asarray(attn_xla(qa, kv_a), np.float32),
+                rtol=3e-2, atol=3e-2)
+            group["attn_fused"] = (attn_fused, qa, kv_a)
+            group["attn_xla"] = (attn_xla, qa, kv_a)
+        except AssertionError:
+            raise    # numerics wrong: must surface, not skip
+        except Exception as e:
+            if sim_fallback_reason is None:
+                sim_fallback_reason = f"sp_attn: {str(e)[:600]}"
 
     # Final numbers: every chain interleaved in ONE measurement group —
     # numerator and denominator see the same tunnel/chip conditions.

@@ -331,3 +331,32 @@ def test_sp_flash_decode_2d_multislice(dp2tp4_mesh, dp2tp4_ctx):
     out = f(q, k, v, kv_len)
     expected = flash_decode_ref(q, k, v, kv_len)
     assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("gqa", [False, True], ids=["mha", "gqa"])
+def test_sp_ag_attention_fused_sim_ranks(gqa):
+    """Single-chip self-sim ring (the bench proxy): playing the LAST of
+    sim_ranks ranks — all chunk arrivals via self-puts of true data —
+    must equal dense causal attention of the last query slice over the
+    full KV."""
+    from jax.sharding import Mesh
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+    from triton_dist_tpu.ops.sp_ag_attention import _masked_attn
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    s, h, hd = 64, 4, 16
+    kvh = 2 if gqa else h
+    q = _rand((s, h, hd), 60) * 0.5
+    k = _rand((s, kvh, hd), 61) * 0.5
+    v = _rand((s, kvh, hd), 62) * 0.5
+    n_sim = 4
+    out = spmd(mesh1,
+               lambda a, b, c: sp_ag_attention_fused(
+                   a, b, c, ctx=ctx1, axis="tp", block_q=4, block_kv=8,
+                   sim_ranks=n_sim),
+               (P(None, None, None),) * 3, P(None, None, None))(q, k, v)
+    s_loc = s // n_sim
+    want = _masked_attn(q[-s_loc:], k, v, (n_sim - 1) * s_loc)
+    assert_allclose(out, want, rtol=1e-4, atol=1e-4)

@@ -177,14 +177,23 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                        outer_axis: Optional[str], ctx: MeshContext,
                        n_inner: int, n_outer: int, s_loc: int, kvh: int,
                        rep: int, tq: int, tkv: int, causal: bool,
-                       varlen: bool):
+                       varlen: bool, sim: bool = False):
     i = pl.program_id(0)   # query tile (outer: arrival waits only at i=0)
     k = pl.program_id(1)   # chunk step; src = (me - k) mod n
     n_i = pl.num_programs(0)
     ni, no = n_inner, n_outer
     n = ni * no
-    ii = dl.rank(inner_axis)
-    oo = dl.rank(outer_axis) if outer_axis is not None else 0
+    if sim:
+        # Single-chip overlap proxy: play the LAST rank (the one that
+        # consumes every chunk under causal masking). The other ranks'
+        # pushes become self-puts sourcing the TRUE chunk data from the
+        # full input — same arrival waits, slots, and per-chunk traffic;
+        # wire = HBM (what bench.py measures for the SP family).
+        ii = jnp.int32(ni - 1)
+        oo = jnp.int32(0)
+    else:
+        ii = dl.rank(inner_axis)
+        oo = dl.rank(outer_axis) if outer_axis is not None else 0
     me = oo * ni + ii  # global rank, outer-major (canonical mesh order)
     src = jax.lax.rem(me - k + n, n)
 
@@ -248,65 +257,82 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
 
     first = jnp.logical_and(i == 0, k == 0)
 
-    @pl.when(first)
-    def _():
-        # Peers must be in-kernel before any remote traffic (all-peer
-        # puts ride both axes, so both axes barrier).
-        dl.barrier_all(inner_axis, ctx=ctx)
-        if outer_axis is not None and no > 1:
-            dl.barrier_all(outer_axis, ctx=ctx)
-        # Push my KV chunk to every inner peer that will read it
-        # (causal prunes to higher ranks — the reference's AG push with
-        # the same pruning, sp_ag_attention_intra_node.py:116).
-        for off in range(1, ni):
-            if causal:
-                peer = ii + off          # no wrap: only peers above me
-                pred = peer < ni
-            else:
-                peer = jax.lax.rem(ii + off, ni)
-                pred = jnp.bool_(True)
-            dst = oo * ni + peer
-            if varlen:
-                pred = jnp.logical_and(pred, span_need(me, dst))
+    if sim:
+        @pl.when(first)
+        def _():
+            # Sim: the n-1 lower ranks' pushes toward me, as self-puts
+            # of the true chunk rows out of the full input (peer = my
+            # real rank on the size-1 axis).
+            self_rank = dl.rank(inner_axis)
+            for c in range(n - 1):
+                dl.remote_put(k_ref.at[:, pl.ds(c * s_loc, s_loc)],
+                              k_ws.at[c], send_sem.at[0, c],
+                              recv_sem.at[0, slot_for(c, me)],
+                              self_rank, axis=inner_axis, ctx=ctx)
+                dl.remote_put(v_ref.at[:, pl.ds(c * s_loc, s_loc)],
+                              v_ws.at[c], send_sem.at[1, c],
+                              recv_sem.at[1, slot_for(c, me)],
+                              self_rank, axis=inner_axis, ctx=ctx)
+    else:
+        @pl.when(first)
+        def _():
+            # Peers must be in-kernel before any remote traffic
+            # (all-peer puts ride both axes, so both axes barrier).
+            dl.barrier_all(inner_axis, ctx=ctx)
+            if outer_axis is not None and no > 1:
+                dl.barrier_all(outer_axis, ctx=ctx)
+            # Push my KV chunk to every inner peer that will read it
+            # (causal prunes to higher ranks — the reference's AG push
+            # with the same pruning, sp_ag_attention_intra_node.py:116).
+            for off in range(1, ni):
+                if causal:
+                    peer = ii + off      # no wrap: only peers above me
+                    pred = peer < ni
+                else:
+                    peer = jax.lax.rem(ii + off, ni)
+                    pred = jnp.bool_(True)
+                dst = oo * ni + peer
+                if varlen:
+                    pred = jnp.logical_and(pred, span_need(me, dst))
 
-            @pl.when(pred)
-            def _():
-                dl.remote_put(k_ref, k_ws.at[me],
-                              send_sem.at[0, off - 1],
-                              recv_sem.at[0, slot_for(me, dst)], peer,
-                              axis=inner_axis, ctx=ctx)
-                dl.remote_put(v_ref, v_ws.at[me],
-                              send_sem.at[1, off - 1],
-                              recv_sem.at[1, slot_for(me, dst)], peer,
-                              axis=inner_axis, ctx=ctx)
-        # Mirror pushes: one copy of my chunk per other outer group, to
-        # the rank with my inner index (the group's relayer) — each
-        # chunk crosses the slow (DCN) axis exactly once
-        # (sp_ag_attention_inter_node.py's node-leader staging). With
-        # varlen, a group is skipped when no packed sequence spans from
-        # my chunk into it (tested against the group's first rank —
-        # the needing set is a contiguous rank range).
-        for m in range(1, no):
-            if causal:
-                peer_o = oo + m          # no wrap: only groups above
-                pred = peer_o < no
-            else:
-                peer_o = jax.lax.rem(oo + m, no)
-                pred = jnp.bool_(True)
-            dst = peer_o * ni + ii
-            if varlen:
-                pred = jnp.logical_and(pred, span_need(me, peer_o * ni))
+                @pl.when(pred)
+                def _():
+                    dl.remote_put(k_ref, k_ws.at[me],
+                                  send_sem.at[0, off - 1],
+                                  recv_sem.at[0, slot_for(me, dst)],
+                                  peer, axis=inner_axis, ctx=ctx)
+                    dl.remote_put(v_ref, v_ws.at[me],
+                                  send_sem.at[1, off - 1],
+                                  recv_sem.at[1, slot_for(me, dst)],
+                                  peer, axis=inner_axis, ctx=ctx)
+            # Mirror pushes: one copy of my chunk per other outer group, to
+            # the rank with my inner index (the group's relayer) — each
+            # chunk crosses the slow (DCN) axis exactly once
+            # (sp_ag_attention_inter_node.py's node-leader staging). With
+            # varlen, a group is skipped when no packed sequence spans from
+            # my chunk into it (tested against the group's first rank —
+            # the needing set is a contiguous rank range).
+            for m in range(1, no):
+                if causal:
+                    peer_o = oo + m          # no wrap: only groups above
+                    pred = peer_o < no
+                else:
+                    peer_o = jax.lax.rem(oo + m, no)
+                    pred = jnp.bool_(True)
+                dst = peer_o * ni + ii
+                if varlen:
+                    pred = jnp.logical_and(pred, span_need(me, peer_o * ni))
 
-            @pl.when(pred)
-            def _():
-                dl.remote_put(k_ref, k_ws.at[me],
-                              send_sem.at[0, ni - 1 + m - 1],
-                              recv_sem.at[0, slot_for(me, dst)], peer_o,
-                              axis=outer_axis, ctx=ctx)
-                dl.remote_put(v_ref, v_ws.at[me],
-                              send_sem.at[1, ni - 1 + m - 1],
-                              recv_sem.at[1, slot_for(me, dst)], peer_o,
-                              axis=outer_axis, ctx=ctx)
+                @pl.when(pred)
+                def _():
+                    dl.remote_put(k_ref, k_ws.at[me],
+                                  send_sem.at[0, ni - 1 + m - 1],
+                                  recv_sem.at[0, slot_for(me, dst)], peer_o,
+                                  axis=outer_axis, ctx=ctx)
+                    dl.remote_put(v_ref, v_ws.at[me],
+                                  send_sem.at[1, ni - 1 + m - 1],
+                                  recv_sem.at[1, slot_for(me, dst)], peer_o,
+                                  axis=outer_axis, ctx=ctx)
 
     @pl.when(jnp.logical_and(i == 0, jnp.logical_and(k > 0, need)))
     def _():
@@ -354,14 +380,16 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
         ride separate semaphores so the two copies overlap."""
         g, kvt = t // n_kv, t % n_kv
 
+        own_off = (n - 1) * s_loc if sim else 0  # sim input holds FULL S
+
         @pl.when(k == 0)
         def _():
             pltpu.make_async_copy(
-                k_ref.at[g, pl.ds(kvt * tkv, tkv)], k_panel.at[buf],
-                k_sem).start()
+                k_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)],
+                k_panel.at[buf], k_sem).start()
             pltpu.make_async_copy(
-                v_ref.at[g, pl.ds(kvt * tkv, tkv)], v_panel.at[buf],
-                v_sem).start()
+                v_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)],
+                v_panel.at[buf], v_sem).start()
 
         @pl.when(k > 0)
         def _():
@@ -438,6 +466,19 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
 
     last = jnp.logical_and(i == n_i - 1, k == n - 1)
 
+    if sim:
+        @pl.when(jnp.logical_and(last, n > 1))
+        def _():
+            # Drain the n-1 self-put send semaphores — K and V against
+            # refs of THEIR OWN dtype/size (the wait decrements by the
+            # ref's byte count).
+            for c in range(n - 1):
+                dl.wait_arrivals(send_sem.at[0, c],
+                                 k_ref.at[:, pl.ds(c * s_loc, s_loc)], 1)
+                dl.wait_arrivals(send_sem.at[1, c],
+                                 v_ref.at[:, pl.ds(c * s_loc, s_loc)], 1)
+        return
+
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
         # Drain send semaphores (same predicates as the sends).
@@ -482,14 +523,32 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
 
 
 def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
-                     block_q, block_kv, cu_seqlens=None):
-    """Shared host-side setup for the 1D and hierarchical fused forms."""
-    ni = ctx.size(inner_axis)
-    no = ctx.size(outer_axis) if outer_axis is not None else 1
+                     block_q, block_kv, cu_seqlens=None,
+                     sim_ranks: int = 0):
+    """Shared host-side setup for the 1D and hierarchical fused forms.
+
+    ``sim_ranks > 1`` (1-device axis): q/k/v hold the FULL sequence;
+    the kernel plays the last of ``sim_ranks`` simulated ranks, with
+    the other ranks' chunk pushes as self-puts (see the kernel) and
+    returns that rank's (S/sim_ranks, H, hd) output slice.
+    """
+    sim = bool(sim_ranks and sim_ranks > 1)
+    if sim:
+        if ctx.size(inner_axis) != 1 or outer_axis is not None:
+            raise ValueError("sim_ranks needs a size-1 1D mesh axis")
+        ni, no = sim_ranks, 1
+    else:
+        ni = ctx.size(inner_axis)
+        no = ctx.size(outer_axis) if outer_axis is not None else 1
     n = ni * no
     s_loc, h, hd = q.shape
     kvh = k.shape[1]
     rep = h // kvh
+    if sim:
+        if s_loc % sim_ranks:
+            raise ValueError(f"S={s_loc} not divisible by "
+                             f"sim_ranks={sim_ranks}")
+        s_loc //= sim_ranks
 
     varlen = cu_seqlens is not None
     if varlen:
@@ -516,7 +575,12 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
     kernel = functools.partial(
         _sp_ag_attn_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
         ctx=ctx, n_inner=ni, n_outer=no, s_loc=s_loc,
-        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal, varlen=varlen)
+        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal, varlen=varlen,
+        sim=sim)
+
+    # Sim: query tiles come from the last simulated rank's slice of the
+    # FULL q (the kernel's output covers only that slice).
+    q_off = (n - 1) * n_qt if sim else 0
 
     o, _, _ = core_call(
         kernel,
@@ -528,7 +592,7 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
             jax.ShapeDtypeStruct((n, kvh, s_loc, hd), v.dtype),  # v_ws
         ),
         in_specs=[
-            pl.BlockSpec((h, tq, hd), lambda i, kk: (0, i, 0),
+            pl.BlockSpec((h, tq, hd), lambda i, kk: (0, q_off + i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -565,7 +629,8 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
 def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
                           causal: bool = True, block_q: int = 256,
                           block_kv: int = 1024, cu_seqlens=None,
-                          force_kernel: bool = False):
+                          force_kernel: bool = False,
+                          sim_ranks: int = 0):
     """Kernel-level KV-allgather attention (call inside shard_map).
 
     q: (S_loc, H, hd); k/v: (S_loc, KVH, hd), sequence-sharded along
@@ -585,6 +650,25 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
     if cu_seqlens is not None and not causal:
         raise ValueError("varlen (cu_seqlens) requires causal=True")
     n = ctx.size(axis)
+    if sim_ranks and sim_ranks > 1:
+        # Single-chip overlap proxy (bench.py): play the LAST of
+        # sim_ranks simulated ranks — the one that consumes every chunk
+        # under causal masking — with the other ranks' pushes as
+        # self-puts. Returns that rank's (S/sim_ranks, H, hd) slice;
+        # oracle: _masked_attn(q_last, k_full, v_full, offset).
+        if not causal:
+            raise ValueError("sim_ranks requires causal=True (the "
+                             "simulated last rank must need all chunks)")
+        if cu_seqlens is not None:
+            # Varlen span pruning would skip receiver waits for chunks
+            # the sim's unconditional self-puts already signaled —
+            # semaphore residue at kernel exit. The sim is a perf
+            # proxy; measure it on the dense-causal form.
+            raise ValueError("sim_ranks does not support cu_seqlens")
+        return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=axis,
+                                outer_axis=None, causal=causal,
+                                block_q=block_q, block_kv=block_kv,
+                                sim_ranks=sim_ranks)
     if n == 1 and not force_kernel:
         return _masked_attn(q, k, v, 0, causal=causal,
                             cu_seqlens=cu_seqlens)
