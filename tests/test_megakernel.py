@@ -263,3 +263,64 @@ def test_megakernel_paged_vs_dense(tp2_mesh):
         l2d = np.asarray(dense_eng.decode_step(tok, S + i))
         np.testing.assert_allclose(l2p, l2d, rtol=2e-3, atol=2e-3)
         tok = jnp.argmax(jnp.asarray(l2d), -1).astype(jnp.int32)
+
+
+def test_megakernel_moe_decode_vs_layers(tp2_mesh):
+    """MoE megakernel: in-kernel router + all-expert swiglu + weighted
+    combine must match the layer oracle (tp_moe.fwd_ar — the same
+    all-expert small-batch math)."""
+    from triton_dist_tpu.layers import tp_moe
+    from triton_dist_tpu.models import qwen_moe
+
+    mcfg = ModelConfig.tiny_moe(vocab_size=64, hidden_size=32,
+                                num_hidden_layers=2,
+                                num_attention_heads=4,
+                                num_key_value_heads=2, head_dim=8,
+                                num_experts=4, num_experts_per_tok=2,
+                                moe_intermediate_size=32)
+    mesh = tp2_mesh
+    mb = ModelBuilder(mcfg, mesh, batch=B, max_len=MAXLEN, tile_w=16,
+                      t_tile=16)
+    assert mb.moe and (mb.task_types == int(TaskType.MOE_WEIGHTS)).sum()
+    params = qwen_moe.init_params(jax.random.PRNGKey(3), mcfg)
+    specs = qwen_moe.param_specs(mcfg, moe_impl="tp")
+
+    cache_shape = (mcfg.num_hidden_layers, B, MAXLEN,
+                   mcfg.num_key_value_heads, mcfg.head_dim)
+    k_cache = jax.random.normal(jax.random.PRNGKey(4), cache_shape) * 0.3
+    v_cache = jax.random.normal(jax.random.PRNGKey(5), cache_shape) * 0.3
+    tokens = jnp.asarray([9, 41], jnp.int32)
+    pos = jnp.asarray(5, jnp.int32)
+    kvspec = P(None, None, None, "tp", None)
+
+    pack = spmd(mesh, mb.pack_arena, (specs,), P("tp", None))
+    arena = pack(params)
+    step = spmd(mesh, mb.step_fn(),
+                (P("tp", None), kvspec, kvspec, P(None), P()),
+                (P(None, "tp"), P("tp", None), kvspec, kvspec))
+    logits, _, _, _ = step(arena, k_cache, v_cache, tokens, pos)
+
+    def oracle(p, tok, kc, vc):
+        h = p["embed"][tok]
+        new_k, new_v = kc, vc
+        for li, lp in enumerate(p["layers"]):
+            t = rms_norm(h, lp["ln_attn"], mcfg.rms_norm_eps)
+            ao, (lk, lv) = tp_attn.fwd_decode(
+                lp["attn"], t, mcfg, new_k[li], new_v[li], pos,
+                mode="xla")
+            new_k = new_k.at[li].set(lk)
+            new_v = new_v.at[li].set(lv)
+            h = h + ao
+            t = rms_norm(h, lp["ln_mlp"], mcfg.rms_norm_eps)
+            h = h + tp_moe.fwd_ar(lp["moe"], t,
+                                  topk=mcfg.num_experts_per_tok,
+                                  num_experts=mcfg.num_experts,
+                                  norm_topk_prob=mcfg.norm_topk_prob)
+        h = rms_norm(h, p["ln_f"], mcfg.rms_norm_eps)
+        logits_loc = h @ p["lm_head"].T
+        return jax.lax.all_gather(logits_loc, "tp", axis=1, tiled=True)
+
+    of = spmd(mesh, oracle, (specs, P(None), kvspec, kvspec),
+              P(None, None))
+    want = of(params, tokens, k_cache, v_cache)
+    assert_allclose(logits, want, rtol=2e-3, atol=2e-3)

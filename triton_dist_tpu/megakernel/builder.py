@@ -104,6 +104,27 @@ class ModelBuilder:
         self.hq_tiles = _cdiv(self.h_loc * hd, self.w)
         self.kv_tiles = _cdiv(self.kv_loc * hd, self.w)
         self.ff_tiles = _cdiv(cfg.intermediate_size // n, self.w)
+        # MoE (qwen_moe): per-expert ffn dim sharded over tp (the TP
+        # regime); decode computes EVERY expert and weight-combines —
+        # fully static task graph, the same small-batch trade as
+        # ep_moe.fwd_decode. Router logits must fit one lane tile.
+        self.moe = cfg.is_moe
+        if self.moe:
+            if cfg.num_experts > self.w:
+                raise ValueError(
+                    f"megakernel MoE needs num_experts={cfg.num_experts}"
+                    f" <= tile width {self.w} (router logits tile)")
+            if cfg.moe_intermediate_size % n:
+                raise ValueError(
+                    f"moe_intermediate_size={cfg.moe_intermediate_size} "
+                    f"not divisible by tp={n}")
+            if cfg.num_experts_per_tok > cfg.num_experts:
+                raise ValueError(
+                    f"num_experts_per_tok={cfg.num_experts_per_tok} > "
+                    f"num_experts={cfg.num_experts} (the static top-k "
+                    "loop would pick zero-probability padded columns)")
+            self.ffe_tiles = _cdiv(cfg.moe_intermediate_size // n,
+                                   self.w)
 
         self._cursor = 0
         self._offsets: Dict[str, int] = {}
@@ -162,9 +183,16 @@ class ModelBuilder:
             walloc(f"l{li}.wk", d_t, kv_t)
             walloc(f"l{li}.wv", d_t, kv_t)
             walloc(f"l{li}.wo", hq_t, d_t)
-            walloc(f"l{li}.w_gate", d_t, ff_t)
-            walloc(f"l{li}.w_up", d_t, ff_t)
-            walloc(f"l{li}.w_down", ff_t, d_t)
+            if self.moe:
+                walloc(f"l{li}.router", d_t, 1)
+                for e in range(cfg.num_experts):
+                    walloc(f"l{li}.e{e}.w_gate", d_t, self.ffe_tiles)
+                    walloc(f"l{li}.e{e}.w_up", d_t, self.ffe_tiles)
+                    walloc(f"l{li}.e{e}.w_down", self.ffe_tiles, d_t)
+            else:
+                walloc(f"l{li}.w_gate", d_t, ff_t)
+                walloc(f"l{li}.w_up", d_t, ff_t)
+                walloc(f"l{li}.w_down", ff_t, d_t)
             vecalloc(f"l{li}.ln_attn", d_t)
             vecalloc(f"l{li}.ln_mlp", d_t)
             vecalloc(f"l{li}.q_norm", 1)
@@ -209,9 +237,10 @@ class ModelBuilder:
             opart = self._alloc_act(f"l{li}.opart", d_t)
             x1 = self._alloc_act(f"l{li}.x1", d_t)
             t1 = self._alloc_act(f"l{li}.t1", d_t)
-            gx = self._alloc_act(f"l{li}.g", ff_t)
-            ux = self._alloc_act(f"l{li}.u", ff_t)
-            hx = self._alloc_act(f"l{li}.h", ff_t)
+            if not self.moe:
+                gx = self._alloc_act(f"l{li}.g", ff_t)
+                ux = self._alloc_act(f"l{li}.u", ff_t)
+                hx = self._alloc_act(f"l{li}.h", ff_t)
             mpart = self._alloc_act(f"l{li}.mpart", d_t)
             x2 = self._alloc_act(f"l{li}.x2", d_t)
 
@@ -255,16 +284,56 @@ class ModelBuilder:
                   (x1, o[f"l{li}.ln_mlp"], t1, d_t),
                   reads=[(x1, d_t * b), (o[f"l{li}.ln_mlp"], d_t)],
                   writes=[(t1, d_t * b)], layer=li)
-            self._linear(t1, o[f"l{li}.w_gate"], gx, d_t, ff_t, layer=li,
-                         in_rows=d_t * b, w_rows=d_t * ff_t * w)
-            self._linear(t1, o[f"l{li}.w_up"], ux, d_t, ff_t, layer=li,
-                         in_rows=d_t * b, w_rows=d_t * ff_t * w)
-            g.add(TaskType.SILU_MUL, (gx, ux, hx, ff_t),
-                  reads=[(gx, ff_t * b), (ux, ff_t * b)],
-                  writes=[(hx, ff_t * b)], layer=li)
-            self._linear(hx, o[f"l{li}.w_down"], mpart, ff_t, d_t,
-                         layer=li, in_rows=ff_t * b,
-                         w_rows=ff_t * d_t * w)
+            if self.moe:
+                # MoE FFN: router → combine weights → every expert's
+                # swiglu (ffn-sharded over tp) → weighted accumulate
+                # into mpart (partial; summed by the allreduce below).
+                E, ffe_t = cfg.num_experts, self.ffe_tiles
+                rl = self._alloc_act(f"l{li}.rl", 1)
+                wbe = self._alloc_act(f"l{li}.wbe", 1)
+                self._linear(t1, o[f"l{li}.router"], rl, d_t, 1,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * w)
+                g.add(TaskType.MOE_WEIGHTS, (rl, wbe, E),
+                      reads=[(rl, b)], writes=[(wbe, b)], layer=li)
+                for e in range(E):
+                    ge = self._alloc_act(f"l{li}.e{e}.g", ffe_t)
+                    ue = self._alloc_act(f"l{li}.e{e}.u", ffe_t)
+                    he = self._alloc_act(f"l{li}.e{e}.h", ffe_t)
+                    pe = self._alloc_act(f"l{li}.e{e}.part", d_t)
+                    self._linear(t1, o[f"l{li}.e{e}.w_gate"], ge, d_t,
+                                 ffe_t, layer=li, in_rows=d_t * b,
+                                 w_rows=d_t * ffe_t * w)
+                    self._linear(t1, o[f"l{li}.e{e}.w_up"], ue, d_t,
+                                 ffe_t, layer=li, in_rows=d_t * b,
+                                 w_rows=d_t * ffe_t * w)
+                    g.add(TaskType.SILU_MUL, (ge, ue, he, ffe_t),
+                          reads=[(ge, ffe_t * b), (ue, ffe_t * b)],
+                          writes=[(he, ffe_t * b)], layer=li)
+                    self._linear(he, o[f"l{li}.e{e}.w_down"], pe, ffe_t,
+                                 d_t, layer=li, in_rows=ffe_t * b,
+                                 w_rows=ffe_t * d_t * w)
+                    # init on e==0 writes; later experts accumulate —
+                    # the shared (mpart, wbe) read/write regions chain
+                    # the experts' combines in order.
+                    g.add(TaskType.WEIGHTED_ADD,
+                          (mpart, pe, wbe, e, d_t, 1 if e == 0 else 0),
+                          reads=[(pe, d_t * b), (wbe, b),
+                                 (mpart, d_t * b)],
+                          writes=[(mpart, d_t * b)], layer=li)
+            else:
+                self._linear(t1, o[f"l{li}.w_gate"], gx, d_t, ff_t,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * ff_t * w)
+                self._linear(t1, o[f"l{li}.w_up"], ux, d_t, ff_t,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * ff_t * w)
+                g.add(TaskType.SILU_MUL, (gx, ux, hx, ff_t),
+                      reads=[(gx, ff_t * b), (ux, ff_t * b)],
+                      writes=[(hx, ff_t * b)], layer=li)
+                self._linear(hx, o[f"l{li}.w_down"], mpart, ff_t, d_t,
+                             layer=li, in_rows=ff_t * b,
+                             w_rows=ff_t * d_t * w)
             g.add(TaskType.ALLREDUCE, (mpart, d_t),
                   reads=[(mpart, d_t * b)],
                   writes=[(mpart, d_t * b),
@@ -346,6 +415,8 @@ class ModelBuilder:
             return 2 * max(self.seq // 8, 1)
         if t.task_type == TaskType.ALLREDUCE:
             return 2 * int(t.args[1])
+        if t.task_type == TaskType.WEIGHTED_ADD:
+            return int(t.args[4])          # tiles copied + fused mul-add
         return 1
 
     # ---------------- arena packing ------------------------------------
@@ -377,9 +448,23 @@ class ModelBuilder:
             parts.append(self._tile_weight(lp["attn"]["wk"], d_t, kv_t))
             parts.append(self._tile_weight(lp["attn"]["wv"], d_t, kv_t))
             parts.append(self._tile_weight(lp["attn"]["wo"], hq_t, d_t))
-            parts.append(self._tile_weight(lp["mlp"]["w_gate"], d_t, ff_t))
-            parts.append(self._tile_weight(lp["mlp"]["w_up"], d_t, ff_t))
-            parts.append(self._tile_weight(lp["mlp"]["w_down"], ff_t, d_t))
+            if self.moe:
+                mp = lp["moe"]
+                parts.append(self._tile_weight(mp["router"], d_t, 1))
+                for e in range(cfg.num_experts):
+                    parts.append(self._tile_weight(
+                        mp["w_gate"][e], d_t, self.ffe_tiles))
+                    parts.append(self._tile_weight(
+                        mp["w_up"][e], d_t, self.ffe_tiles))
+                    parts.append(self._tile_weight(
+                        mp["w_down"][e], self.ffe_tiles, d_t))
+            else:
+                parts.append(self._tile_weight(lp["mlp"]["w_gate"],
+                                               d_t, ff_t))
+                parts.append(self._tile_weight(lp["mlp"]["w_up"],
+                                               d_t, ff_t))
+                parts.append(self._tile_weight(lp["mlp"]["w_down"],
+                                               ff_t, d_t))
             parts.append(self._pad_vec(lp["ln_attn"], d_t))
             parts.append(self._pad_vec(lp["ln_mlp"], d_t))
             parts.append(self._pad_vec(lp["attn"]["q_norm"], 1))
@@ -412,7 +497,9 @@ class ModelBuilder:
             n_ranks=self.n, axis=self.axis, mesh=self.mctx,
             ar_ws_off=self.ar_ws_off, ar_max_tiles=self.ar_max_tiles,
             seq=self.seq, paged=self.paged, page=self.page,
-            p_max=self.p_max)
+            p_max=self.p_max,
+            moe_topk=(self.cfg.num_experts_per_tok if self.moe else 0),
+            moe_norm=self.cfg.norm_topk_prob)
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
                 wait_edges_s, sig_edges_s, len_s, tok_s, tbl_s,
@@ -452,6 +539,8 @@ class ModelBuilder:
             lambda: None,   # NOOP (queue padding)
             lambda: K.write_kv_prefill_body(cfg, args, refs, len_s),
             lambda: K.attn_prefill_body(cfg, args, refs, len_s),
+            lambda: K.moe_weights_body(cfg, args, refs),
+            lambda: K.weighted_add_body(cfg, args, refs),
         ]
         jax.lax.switch(ttype, branches)
 

@@ -51,9 +51,19 @@ class MegaKernelEngine:
                                     num_cores=num_cores,
                                     strategy=strategy, paged=paged,
                                     page=page)
-        specs = dense.param_specs(cfg, axis)
-        if params is None:
-            params = dense.init_params(jax.random.PRNGKey(seed), cfg)
+        if cfg.is_moe:
+            # MoE megakernel runs the TP expert regime (every expert's
+            # ffn dim sharded over tp; routing in-kernel).
+            from triton_dist_tpu.models import qwen_moe
+
+            specs = qwen_moe.param_specs(cfg, moe_impl="tp", axis=axis)
+            if params is None:
+                params = qwen_moe.init_params(jax.random.PRNGKey(seed),
+                                              cfg)
+        else:
+            specs = dense.param_specs(cfg, axis)
+            if params is None:
+                params = dense.init_params(jax.random.PRNGKey(seed), cfg)
         placed = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, specs)

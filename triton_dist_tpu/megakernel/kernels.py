@@ -48,6 +48,10 @@ class KernelConfig:
     paged: bool = False
     page: int = 0           # page length (builder: t_tile | page, seq | page)
     p_max: int = 0          # pages per sequence (max_len // page)
+    # MoE (qwen_moe): static routing hyperparams for the MOE_WEIGHTS
+    # task (top-k is a static python loop in the body).
+    moe_topk: int = 0
+    moe_norm: bool = True
 
 
 def _act(arena, off, tiles_b):
@@ -149,6 +153,65 @@ def silu_mul_body(cfg, args, refs):
         g = va[...].astype(jnp.float32)
         vc[...] = jax.nn.silu(g) * vb[...].astype(jnp.float32)
         pltpu.sync_copy(vc, arena.at[pl.ds(out_off + j * b, b)])
+        return 0
+
+    jax.lax.fori_loop(0, tiles, step, 0)
+
+
+def moe_weights_body(cfg, args, refs):
+    """Router epilogue: softmax over the first ``n_experts`` columns of
+    the router-logits tile, keep the top-``cfg.moe_topk`` per row
+    (static iterative argmax extraction — no in-kernel sort), optional
+    renormalization; writes the (B, W) combine-weight tile (reference:
+    the megakernel's routing happens host-side; in-kernel routing keeps
+    the whole MoE decode step one launch)."""
+    arena, va, vc = refs["arena"], refs["va"], refs["vc"]
+    rl_off, wout_off, e_n = args[0], args[1], args[2]
+    b = cfg.batch
+
+    pltpu.sync_copy(arena.at[pl.ds(rl_off, b)], va)
+    lg = va[...].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+    lg = jnp.where(col < e_n, lg, -jnp.inf)
+    p = jax.nn.softmax(lg, axis=-1)
+    p = jnp.where(col < e_n, p, 0.0)
+    mask = jnp.zeros(p.shape, jnp.bool_)
+    work = p
+    for _ in range(cfg.moe_topk):
+        amax = jnp.argmax(work, axis=-1)
+        pick = col == amax[:, None]
+        mask = jnp.logical_or(mask, pick)
+        work = jnp.where(pick, -jnp.inf, work)
+    wbe = jnp.where(mask, p, 0.0)
+    if cfg.moe_norm:
+        wbe = wbe / jnp.maximum(jnp.sum(wbe, axis=-1, keepdims=True),
+                                1e-30)
+    vc[...] = wbe
+    pltpu.sync_copy(vc, arena.at[pl.ds(wout_off, b)])
+
+
+def weighted_add_body(cfg, args, refs):
+    """acc[+]= part * wbe[:, e] — the per-expert combine of the MoE
+    FFN block (``init`` selects write vs accumulate; the expert-e
+    column is selected maskwise, no dynamic gather)."""
+    arena, va, vb, vc = (refs["arena"], refs["va"], refs["vb"],
+                         refs["vc"])
+    acc_off, part_off, wbe_off = args[0], args[1], args[2]
+    e_idx, tiles, init = args[3], args[4], args[5]
+    b = cfg.batch
+
+    pltpu.sync_copy(arena.at[pl.ds(wbe_off, b)], va)
+    col = jax.lax.broadcasted_iota(jnp.int32, va.shape, 1)
+    wcol = jnp.sum(jnp.where(col == e_idx,
+                             va[...].astype(jnp.float32), 0.0),
+                   axis=1, keepdims=True)                   # (B, 1)
+
+    def step(j, _):
+        pltpu.sync_copy(arena.at[pl.ds(part_off + j * b, b)], vb)
+        pltpu.sync_copy(arena.at[pl.ds(acc_off + j * b, b)], vc)
+        term = vb[...].astype(jnp.float32) * wcol
+        vc[...] = jnp.where(init == 1, term, vc[...] + term)
+        pltpu.sync_copy(vc, arena.at[pl.ds(acc_off + j * b, b)])
         return 0
 
     jax.lax.fori_loop(0, tiles, step, 0)

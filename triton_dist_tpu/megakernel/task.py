@@ -25,6 +25,8 @@ class TaskType(enum.IntEnum):
     NOOP = 8           # queue padding slot (multi-core schedules)
     WRITE_KV_PREFILL = 9   # args like WRITE_KV; rows are (b, s) pairs
     ATTN_PREFILL = 10      # args like ATTN_DECODE; causal over new rows
+    MOE_WEIGHTS = 11       # args: rl_off, wout_off, n_experts
+    WEIGHTED_ADD = 12      # args: acc_off, part_off, wbe_off, e, tiles, init
 
 
 @dataclasses.dataclass
