@@ -47,7 +47,7 @@ class ModelBuilder:
                  tile_w: Optional[int] = None, t_tile: Optional[int] = None,
                  num_cores: int = 1, strategy: str = "round_robin",
                  seq: int = 1, paged: bool = False,
-                 page: Optional[int] = None):
+                 page: Optional[int] = None, profile: bool = False):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -65,6 +65,11 @@ class ModelBuilder:
         self.max_len = max_len
         self.num_cores = num_cores
         self.strategy = strategy
+        # profile=True: the step emits a 4th output — one (task_type,
+        # arg0) row per executed queue slot — feeding core_activity()
+        # (the reference megakernel's SM-activity metric,
+        # model_builder.py:164-190) and the Perfetto exporter.
+        self.profile = profile
         # seq > 1: batched prefill — ``batch`` counts ROWS (B*S, b-major)
         # and the attention/cache tasks use the causal prefill bodies.
         self.seq = seq
@@ -503,9 +508,14 @@ class ModelBuilder:
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
                 wait_edges_s, sig_edges_s, len_s, tok_s, tbl_s,
-                arena_in, kc_in, vc_in, arena, k_cache, v_cache, va, vb,
-                vc, vw, acc, vhd, vkt, vsq, edge_sem, send_sem,
-                recv_sem):
+                arena_in, kc_in, vc_in, arena, k_cache, v_cache, *tail):
+        if self.profile:
+            prof_ref = tail[0]
+            tail = tail[1:]
+        else:
+            prof_ref = None
+        (va, vb, vc, vw, acc, vhd, vkt, vsq, edge_sem, send_sem,
+         recv_sem) = tail
         cfg = self.kernel_config()
         q = pl.program_id(0)
         c = pl.program_id(1)
@@ -543,6 +553,11 @@ class ModelBuilder:
             lambda: K.weighted_add_body(cfg, args, refs),
         ]
         jax.lax.switch(ttype, branches)
+        if prof_ref is not None:
+            # tag = task_type + 1: the Perfetto exporter treats a
+            # (0, 0) row as an unused slot, and RMSNORM is type 0.
+            prof_ref[...] = jnp.stack(
+                [ttype + 1, args[0]]).astype(jnp.int32).reshape(1, 2)
 
         # Mark completion: signal each outgoing cross-core edge. (A
         # true CORE_PARALLEL execution additionally needs the signal
@@ -560,7 +575,9 @@ class ModelBuilder:
     def step_fn(self):
         """Per-shard decode step:
         (arena, k_cache, v_cache, token_ids (B,), cache_len)
-        → (logits (B, vocab_loc), arena, k_cache, v_cache).
+        → (logits (B, vocab_loc), arena, k_cache, v_cache)
+        [+ prof (qlen·cores, 2) as a 5th element when ``profile=True``:
+        one (task_type+1, arg0) row per queue slot].
         Embedding, the transformer stack, and the vocab-sharded LM head
         all run inside the kernel. Call inside shard_map; donate arena +
         caches at jit level."""
@@ -583,11 +600,19 @@ class ModelBuilder:
                 block_table = jnp.zeros((1,), jnp.int32)
             tbl_arr = jnp.asarray(block_table, jnp.int32).reshape(-1)
 
+            C = self.num_cores
+            out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+            if self.profile:
+                # One (task_type, arg0) row per executed queue slot,
+                # written via the regular output pipeline.
+                out_specs.append(pl.BlockSpec(
+                    (1, 2), lambda q, c, *_: (q * C + c, 0),
+                    memory_space=pltpu.VMEM))
             grid_spec = pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=9,
                 grid=(self.qlen, self.num_cores),
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
-                out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+                out_specs=out_specs,
                 scratch_shapes=[
                     pltpu.VMEM((b, w), jnp.float32),       # va
                     pltpu.VMEM((b, w), jnp.float32),       # vb
@@ -620,14 +645,18 @@ class ModelBuilder:
             # that variant, so it is not wired up here rather than
             # pretending coverage we cannot have; the
             # schedule's sig_cores mapping is ready for it.
-            arena, k_cache, v_cache = core_call(
+            out_shape = [
+                jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+                jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+            ]
+            if self.profile:
+                out_shape.append(jax.ShapeDtypeStruct(
+                    (self.qlen * self.num_cores, 2), jnp.int32))
+            outs = core_call(
                 self._kernel,
                 grid_spec=grid_spec,
-                out_shape=(
-                    jax.ShapeDtypeStruct(arena.shape, arena.dtype),
-                    jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
-                    jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
-                ),
+                out_shape=tuple(out_shape),
                 input_output_aliases={9: 0, 10: 1, 11: 2},
                 # A rankless megakernel traces no barrier: Mosaic
                 # rejects a collective_id without one.
@@ -636,12 +665,27 @@ class ModelBuilder:
                                      has_side_effects=True)),
             )(types, args, wait_tab, sig_tab, wait_edges, sig_edges,
               len_arr, tok_arr, tbl_arr, arena, k_cache, v_cache)
+            if self.profile:
+                arena, k_cache, v_cache, prof = outs
+            else:
+                arena, k_cache, v_cache = outs
+                prof = None
 
             lt = self.vloc_tiles
             out_rows = jax.lax.dynamic_slice(
                 arena, (self.logits_off, 0), (lt * b, w))
             logits = out_rows.reshape(lt, b, w).transpose(1, 0, 2
                                                           ).reshape(b, lt * w)
+            if self.profile:
+                return (logits[:, :self.vocab_loc], arena, k_cache,
+                        v_cache, prof)
             return (logits[:, :self.vocab_loc], arena, k_cache, v_cache)
 
         return step
+
+    def core_activity(self, prof) -> "np.ndarray":
+        """Per-core busy fraction from a profile output: share of queue
+        slots that executed a real task (non-NOOP) — the reference
+        megakernel's SM-activity metric (model_builder.py:164-190)."""
+        t = np.asarray(prof)[:, 0].reshape(self.qlen, self.num_cores)
+        return (t != int(TaskType.NOOP) + 1).mean(axis=0)
