@@ -404,3 +404,93 @@ def test_megakernel_moe_paged_compose(tp2_mesh):
     ld2 = dense_e.decode_step(tok, 16)
     assert_allclose(np.asarray(lp2, np.float32),
                     np.asarray(ld2, np.float32), rtol=2e-3, atol=2e-3)
+
+
+def test_megakernel_hybrid_gdn_decode_vs_layers(tp2_mesh):
+    """Hybrid (qwen_next) decode in the megakernel: GDN layers advance
+    their recurrent state via the GDN_DECODE task, softmax layers use
+    the KV cache — logits and new states must match the qwen_next
+    layer decode_step."""
+    from triton_dist_tpu.models import qwen_next
+    from triton_dist_tpu.models.kv_cache import KVCache
+
+    hcfg = ModelConfig.tiny_next(vocab_size=64, hidden_size=32,
+                                 num_hidden_layers=4,
+                                 num_attention_heads=4,
+                                 num_key_value_heads=2, head_dim=8,
+                                 gdn_num_heads=8, gdn_head_dim_k=8,
+                                 gdn_head_dim_v=8, full_attn_interval=2)
+    mesh = tp2_mesh
+    mb = ModelBuilder(hcfg, mesh, batch=B, max_len=MAXLEN, tile_w=16,
+                      t_tile=16)
+    assert mb.hybrid and (mb.task_types == int(TaskType.GDN_DECODE)
+                          ).sum() == 2  # layers 0, 2
+    params = qwen_next.init_params(jax.random.PRNGKey(7), hcfg)
+    specs = qwen_next.param_specs(hcfg)
+
+    n_attn, n_gdn = 2, 2
+    cache_shape = (n_attn, B, MAXLEN, hcfg.num_key_value_heads,
+                   hcfg.head_dim)
+    k_cache = jax.random.normal(jax.random.PRNGKey(8), cache_shape) * 0.3
+    v_cache = jax.random.normal(jax.random.PRNGKey(9), cache_shape) * 0.3
+    states0 = jax.random.normal(
+        jax.random.PRNGKey(10),
+        (n_gdn, B, hcfg.gdn_num_heads, hcfg.gdn_head_dim_k,
+         hcfg.gdn_head_dim_v)) * 0.2
+    tokens = jnp.asarray([5, 23], jnp.int32)
+    pos = jnp.asarray(5, jnp.int32)
+    kvspec = P(None, None, None, "tp", None)
+    stspec = P(None, None, "tp", None, None)
+
+    pack = spmd(mesh, mb.pack_arena, (specs,), P("tp", None))
+    arena = pack(params)
+    step = spmd(mesh, mb.step_fn(),
+                (P("tp", None), kvspec, kvspec, P(None), P(), P(None),
+                 stspec),
+                (P(None, "tp"), P("tp", None), kvspec, kvspec, stspec))
+    logits, _, _, _, states2 = step(
+        arena, k_cache, v_cache, tokens, pos, jnp.zeros((1,), jnp.int32),
+        states0)
+
+    def oracle(p, tok, kc, vc, st):
+        cache = qwen_next.HybridCache(
+            kv=KVCache(k=kc, v=vc, length=pos), states=st)
+        lg, cache2 = qwen_next.decode_step(p, tok, cache, hcfg)
+        return lg, cache2.states
+
+    of = spmd(mesh, oracle,
+              (specs, P(None), kvspec, kvspec, stspec),
+              (P(None, None), stspec))
+    want_logits, want_states = of(params, tokens, k_cache, v_cache,
+                                  states0)
+    assert_allclose(logits, want_logits, rtol=2e-3, atol=2e-3)
+    assert_allclose(np.asarray(states2), np.asarray(want_states),
+                    rtol=2e-3, atol=2e-3)
+
+
+def test_megakernel_hybrid_engine_matches_layer_engine(tp2_mesh):
+    """MegaKernelEngine with a hybrid config (prefill_chain + generate)
+    produces the same greedy tokens as the layer-path Engine serving
+    qwen_next on identical params."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models import Engine, qwen_next
+
+    hcfg = ModelConfig.tiny_next(vocab_size=64, hidden_size=32,
+                                 num_hidden_layers=4,
+                                 num_attention_heads=4,
+                                 num_key_value_heads=2, head_dim=8,
+                                 gdn_num_heads=8, gdn_head_dim_k=8,
+                                 gdn_head_dim_v=8, full_attn_interval=2)
+    params = qwen_next.init_params(jax.random.PRNGKey(12), hcfg)
+    mk = MegaKernelEngine(hcfg, tp2_mesh, batch=2, max_len=32,
+                          tile_w=16, t_tile=16, params=params)
+    prompts = jnp.asarray(
+        np.random.RandomState(5).randint(0, hcfg.vocab_size, (2, 8)),
+        jnp.int32)
+    seed_tok = mk.prefill_chain(prompts)
+    mk_toks = np.asarray(mk.generate(seed_tok, steps=5, start_pos=7))
+
+    eng = Engine(hcfg, tp2_mesh, mode="xla", max_len=32,
+                 model=qwen_next, params=params)
+    eng_toks = np.asarray(eng.serve(prompts, gen_len=5))
+    np.testing.assert_array_equal(mk_toks, eng_toks)

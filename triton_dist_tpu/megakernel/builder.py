@@ -109,6 +109,36 @@ class ModelBuilder:
         self.hq_tiles = _cdiv(self.h_loc * hd, self.w)
         self.kv_tiles = _cdiv(self.kv_loc * hd, self.w)
         self.ff_tiles = _cdiv(cfg.intermediate_size // n, self.w)
+        # Hybrid (qwen_next): GDN layers carry a recurrent state
+        # buffer instead of KV rows; decode-only in the megakernel
+        # (prefill via MegaKernelEngine.prefill_chain / the layer
+        # engine). Head slices must sit inside lane tiles.
+        self.hybrid = cfg.is_hybrid
+        if self.hybrid:
+            if self.seq > 1:
+                raise ValueError("hybrid megakernel is decode-only "
+                                 "(seq == 1); prefill via prefill_chain")
+            if cfg.is_moe:
+                raise NotImplementedError(
+                    "hybrid+MoE megakernel not wired; the layer Engine "
+                    "serves qwen_next MoE")
+            if cfg.gdn_num_heads % n:
+                raise ValueError(f"gdn_num_heads={cfg.gdn_num_heads} "
+                                 f"not divisible by tp={n}")
+            self.gdn_h_loc = cfg.gdn_num_heads // n
+            if (self.w % cfg.gdn_head_dim_k or self.w % cfg.gdn_head_dim_v
+                    or self.gdn_h_loc > self.w):
+                raise ValueError(
+                    "GDN head dims must divide the tile width and local "
+                    f"heads fit one tile (w={self.w}, "
+                    f"dk={cfg.gdn_head_dim_k}, dv={cfg.gdn_head_dim_v}, "
+                    f"h_loc={self.gdn_h_loc})")
+            self.gq_tiles = _cdiv(self.gdn_h_loc * cfg.gdn_head_dim_k,
+                                  self.w)
+            self.gv_tiles = _cdiv(self.gdn_h_loc * cfg.gdn_head_dim_v,
+                                  self.w)
+            from triton_dist_tpu.models.qwen_next import _layer_kinds
+            self.layer_kinds, _, self.n_gdn = _layer_kinds(cfg)
         # MoE (qwen_moe): per-expert ffn dim sharded over tp (the TP
         # regime); decode computes EVERY expert and weight-combines —
         # fully static task graph, the same small-batch trade as
@@ -184,10 +214,20 @@ class ModelBuilder:
         self.vloc_tiles = _cdiv(self.vocab_loc, w)
         L = cfg.num_hidden_layers
         for li in range(L):
-            walloc(f"l{li}.wq", d_t, hq_t)
-            walloc(f"l{li}.wk", d_t, kv_t)
-            walloc(f"l{li}.wv", d_t, kv_t)
-            walloc(f"l{li}.wo", hq_t, d_t)
+            if self.hybrid and self.layer_kinds[li][0] == "gdn":
+                gq_t, gv_t = self.gq_tiles, self.gv_tiles
+                walloc(f"l{li}.gwq", d_t, gq_t)
+                walloc(f"l{li}.gwk", d_t, gq_t)
+                walloc(f"l{li}.gwv", d_t, gv_t)
+                walloc(f"l{li}.gwg", d_t, 1)
+                walloc(f"l{li}.gwb", d_t, 1)
+                vecalloc(f"l{li}.g_bias", 1)
+                walloc(f"l{li}.gwo", gv_t, d_t)
+            else:
+                walloc(f"l{li}.wq", d_t, hq_t)
+                walloc(f"l{li}.wk", d_t, kv_t)
+                walloc(f"l{li}.wv", d_t, kv_t)
+                walloc(f"l{li}.wo", hq_t, d_t)
             if self.moe:
                 walloc(f"l{li}.router", d_t, 1)
                 for e in range(cfg.num_experts):
@@ -200,8 +240,9 @@ class ModelBuilder:
                 walloc(f"l{li}.w_down", ff_t, d_t)
             vecalloc(f"l{li}.ln_attn", d_t)
             vecalloc(f"l{li}.ln_mlp", d_t)
-            vecalloc(f"l{li}.q_norm", 1)
-            vecalloc(f"l{li}.k_norm", 1)
+            if not (self.hybrid and self.layer_kinds[li][0] == "gdn"):
+                vecalloc(f"l{li}.q_norm", 1)
+                vecalloc(f"l{li}.k_norm", 1)
         vecalloc("ln_f", d_t)
         # Embedding table vocab-sharded like lm_head: vocab/n entries
         # per rank; the gather task zero-fills off-shard tokens and an
@@ -235,10 +276,11 @@ class ModelBuilder:
         o = self._offsets
         for li in range(L):
             t0 = self._alloc_act(f"l{li}.t0", d_t)
-            q = self._alloc_act(f"l{li}.q", hq_t)
-            kx = self._alloc_act(f"l{li}.k", kv_t)
-            vx = self._alloc_act(f"l{li}.v", kv_t)
-            attn = self._alloc_act(f"l{li}.attn", hq_t)
+            if not (self.hybrid and self.layer_kinds[li][0] == "gdn"):
+                q = self._alloc_act(f"l{li}.q", hq_t)
+                kx = self._alloc_act(f"l{li}.k", kv_t)
+                vx = self._alloc_act(f"l{li}.v", kv_t)
+                attn = self._alloc_act(f"l{li}.attn", hq_t)
             opart = self._alloc_act(f"l{li}.opart", d_t)
             x1 = self._alloc_act(f"l{li}.x1", d_t)
             t1 = self._alloc_act(f"l{li}.t1", d_t)
@@ -253,30 +295,70 @@ class ModelBuilder:
                   (x_off, o[f"l{li}.ln_attn"], t0, d_t),
                   reads=[(x_off, d_t * b), (o[f"l{li}.ln_attn"], d_t)],
                   writes=[(t0, d_t * b)], layer=li)
-            self._linear(t0, o[f"l{li}.wq"], q, d_t, hq_t, layer=li,
-                         in_rows=d_t * b, w_rows=d_t * hq_t * w)
-            self._linear(t0, o[f"l{li}.wk"], kx, d_t, kv_t, layer=li,
-                         in_rows=d_t * b, w_rows=d_t * kv_t * w)
-            self._linear(t0, o[f"l{li}.wv"], vx, d_t, kv_t, layer=li,
-                         in_rows=d_t * b, w_rows=d_t * kv_t * w)
-            g.add(TaskType.WRITE_KV if self.seq == 1
-                  else TaskType.WRITE_KV_PREFILL,
-                  (kx, vx, li, o[f"l{li}.k_norm"]),
-                  reads=[(kx, kv_t * b), (vx, kv_t * b),
-                         (o[f"l{li}.k_norm"], 1)],
-                  writes=[], layer=li)
-            # ATTN reads the cache written by WRITE_KV — encode the
-            # ordering as an artificial region keyed off the task above.
-            attn_task = g.add(TaskType.ATTN_DECODE if self.seq == 1
-                              else TaskType.ATTN_PREFILL,
-                              (q, attn, li, o[f"l{li}.q_norm"]),
-                              reads=[(q, hq_t * b),
-                                     (o[f"l{li}.q_norm"], 1)],
-                              writes=[(attn, hq_t * b)], layer=li)
-            attn_task.deps.append(g.tasks[-2].task_id)  # after WRITE_KV
-            self._linear(attn, o[f"l{li}.wo"], opart, hq_t, d_t,
-                         layer=li, in_rows=hq_t * b,
-                         w_rows=hq_t * d_t * w)
+            if self.hybrid and self.layer_kinds[li][0] == "gdn":
+                # GDN mixer: q/k/v/g/beta projections then the
+                # recurrent delta-rule step (state in the states
+                # buffer; ordinal = position among GDN layers).
+                gq_t, gv_t = self.gq_tiles, self.gv_tiles
+                ordinal = self.layer_kinds[li][1]
+                gq = self._alloc_act(f"l{li}.gq", gq_t)
+                gk = self._alloc_act(f"l{li}.gk", gq_t)
+                gv = self._alloc_act(f"l{li}.gv", gv_t)
+                graw = self._alloc_act(f"l{li}.graw", 1)
+                braw = self._alloc_act(f"l{li}.braw", 1)
+                go = self._alloc_act(f"l{li}.go", gv_t)
+                self._linear(t0, o[f"l{li}.gwq"], gq, d_t, gq_t,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * gq_t * w)
+                self._linear(t0, o[f"l{li}.gwk"], gk, d_t, gq_t,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * gq_t * w)
+                self._linear(t0, o[f"l{li}.gwv"], gv, d_t, gv_t,
+                             layer=li, in_rows=d_t * b,
+                             w_rows=d_t * gv_t * w)
+                self._linear(t0, o[f"l{li}.gwg"], graw, d_t, 1,
+                             layer=li, in_rows=d_t * b, w_rows=d_t * w)
+                self._linear(t0, o[f"l{li}.gwb"], braw, d_t, 1,
+                             layer=li, in_rows=d_t * b, w_rows=d_t * w)
+                g.add(TaskType.GDN_DECODE,
+                      (gq, gk, gv, graw, braw, o[f"l{li}.g_bias"], go,
+                       ordinal),
+                      reads=[(gq, gq_t * b), (gk, gq_t * b),
+                             (gv, gv_t * b), (graw, b), (braw, b),
+                             (o[f"l{li}.g_bias"], 1), (go, gv_t * b)],
+                      writes=[(go, gv_t * b)], layer=li)
+                self._linear(go, o[f"l{li}.gwo"], opart, gv_t, d_t,
+                             layer=li, in_rows=gv_t * b,
+                             w_rows=gv_t * d_t * w)
+            else:
+                self._linear(t0, o[f"l{li}.wq"], q, d_t, hq_t, layer=li,
+                             in_rows=d_t * b, w_rows=d_t * hq_t * w)
+                self._linear(t0, o[f"l{li}.wk"], kx, d_t, kv_t, layer=li,
+                             in_rows=d_t * b, w_rows=d_t * kv_t * w)
+                self._linear(t0, o[f"l{li}.wv"], vx, d_t, kv_t, layer=li,
+                             in_rows=d_t * b, w_rows=d_t * kv_t * w)
+                kv_layer = (self.layer_kinds[li][1] if self.hybrid
+                            else li)
+                g.add(TaskType.WRITE_KV if self.seq == 1
+                      else TaskType.WRITE_KV_PREFILL,
+                      (kx, vx, kv_layer, o[f"l{li}.k_norm"]),
+                      reads=[(kx, kv_t * b), (vx, kv_t * b),
+                             (o[f"l{li}.k_norm"], 1)],
+                      writes=[], layer=li)
+                # ATTN reads the cache written by WRITE_KV — encode the
+                # ordering as an artificial region keyed off the task
+                # above.
+                attn_task = g.add(TaskType.ATTN_DECODE if self.seq == 1
+                                  else TaskType.ATTN_PREFILL,
+                                  (q, attn, kv_layer,
+                                   o[f"l{li}.q_norm"]),
+                                  reads=[(q, hq_t * b),
+                                         (o[f"l{li}.q_norm"], 1)],
+                                  writes=[(attn, hq_t * b)], layer=li)
+                attn_task.deps.append(g.tasks[-2].task_id)  # after W_KV
+                self._linear(attn, o[f"l{li}.wo"], opart, hq_t, d_t,
+                             layer=li, in_rows=hq_t * b,
+                             w_rows=hq_t * d_t * w)
             g.add(TaskType.ALLREDUCE, (opart, d_t),
                   reads=[(opart, d_t * b)],
                   writes=[(opart, d_t * b),
@@ -422,6 +504,9 @@ class ModelBuilder:
             return 2 * int(t.args[1])
         if t.task_type == TaskType.WEIGHTED_ADD:
             return int(t.args[4])          # tiles copied + fused mul-add
+        if t.task_type == TaskType.GDN_DECODE:
+            # The body loops every (batch, local-head) pair.
+            return 2 * self.batch * self.gdn_h_loc
         return 1
 
     # ---------------- arena packing ------------------------------------
@@ -449,10 +534,29 @@ class ModelBuilder:
         parts = []
         for li in range(cfg.num_hidden_layers):
             lp = params["layers"][li]
-            parts.append(self._tile_weight(lp["attn"]["wq"], d_t, hq_t))
-            parts.append(self._tile_weight(lp["attn"]["wk"], d_t, kv_t))
-            parts.append(self._tile_weight(lp["attn"]["wv"], d_t, kv_t))
-            parts.append(self._tile_weight(lp["attn"]["wo"], hq_t, d_t))
+            mixer_key = "mixer" if self.hybrid else "attn"
+            mx = lp[mixer_key]
+            if self.hybrid and self.layer_kinds[li][0] == "gdn":
+                gq_t, gv_t = self.gq_tiles, self.gv_tiles
+                me = jax.lax.axis_index(self.axis)
+                h_loc = self.gdn_h_loc
+                # Column-parallel gdn projections: local shards already
+                # hold this rank's head columns; g_bias needs slicing
+                # (replicated param, like the embedding below).
+                parts.append(self._tile_weight(mx["wq"], d_t, gq_t))
+                parts.append(self._tile_weight(mx["wk"], d_t, gq_t))
+                parts.append(self._tile_weight(mx["wv"], d_t, gv_t))
+                parts.append(self._tile_weight(mx["wg"], d_t, 1))
+                parts.append(self._tile_weight(mx["wb"], d_t, 1))
+                bias = jax.lax.dynamic_slice_in_dim(
+                    mx["g_bias"], me * h_loc, h_loc, 0)
+                parts.append(self._pad_vec(bias, 1))
+                parts.append(self._tile_weight(mx["wo"], gv_t, d_t))
+            else:
+                parts.append(self._tile_weight(mx["wq"], d_t, hq_t))
+                parts.append(self._tile_weight(mx["wk"], d_t, kv_t))
+                parts.append(self._tile_weight(mx["wv"], d_t, kv_t))
+                parts.append(self._tile_weight(mx["wo"], hq_t, d_t))
             if self.moe:
                 mp = lp["moe"]
                 parts.append(self._tile_weight(mp["router"], d_t, 1))
@@ -472,8 +576,9 @@ class ModelBuilder:
                                                ff_t, d_t))
             parts.append(self._pad_vec(lp["ln_attn"], d_t))
             parts.append(self._pad_vec(lp["ln_mlp"], d_t))
-            parts.append(self._pad_vec(lp["attn"]["q_norm"], 1))
-            parts.append(self._pad_vec(lp["attn"]["k_norm"], 1))
+            if not (self.hybrid and self.layer_kinds[li][0] == "gdn"):
+                parts.append(self._pad_vec(mx["q_norm"], 1))
+                parts.append(self._pad_vec(mx["k_norm"], 1))
         parts.append(self._pad_vec(params["ln_f"], d_t))
         # Embedding table shard: this rank's vocab/n rows, laid out as
         # (vocab_loc * d_tiles, w). Params keep embed replicated
@@ -504,18 +609,34 @@ class ModelBuilder:
             seq=self.seq, paged=self.paged, page=self.page,
             p_max=self.p_max,
             moe_topk=(self.cfg.num_experts_per_tok if self.moe else 0),
-            moe_norm=self.cfg.norm_topk_prob)
+            moe_norm=self.cfg.norm_topk_prob,
+            gdn_h_loc=(self.gdn_h_loc if self.hybrid else 0),
+            gdn_dk=self.cfg.gdn_head_dim_k,
+            gdn_dv=self.cfg.gdn_head_dim_v)
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
                 wait_edges_s, sig_edges_s, len_s, tok_s, tbl_s,
-                arena_in, kc_in, vc_in, arena, k_cache, v_cache, *tail):
+                arena_in, kc_in, vc_in, *tail):
+        if self.hybrid:
+            states_in, tail = tail[0], tail[1:]
+        arena, k_cache, v_cache = tail[:3]
+        tail = tail[3:]
+        if self.hybrid:
+            states, tail = tail[0], tail[1:]
+        else:
+            states = None
         if self.profile:
-            prof_ref = tail[0]
-            tail = tail[1:]
+            prof_ref, tail = tail[0], tail[1:]
         else:
             prof_ref = None
-        (va, vb, vc, vw, acc, vhd, vkt, vsq, edge_sem, send_sem,
-         recv_sem) = tail
+        (va, vb, vc, vw, acc, vhd, vkt, vsq) = tail[:8]
+        tail = tail[8:]
+        if self.hybrid:
+            vrow, vrow2, vS = tail[:3]
+            tail = tail[3:]
+        else:
+            vrow = vrow2 = vS = None
+        edge_sem, send_sem, recv_sem = tail
         cfg = self.kernel_config()
         q = pl.program_id(0)
         c = pl.program_id(1)
@@ -524,7 +645,8 @@ class ModelBuilder:
         refs = {"arena": arena, "k_cache": k_cache, "v_cache": v_cache,
                 "va": va, "vb": vb, "vc": vc, "vw": vw, "acc": acc,
                 "vhd": vhd, "vkt": vkt, "vsq": vsq, "send_sem": send_sem,
-                "recv_sem": recv_sem, "tbl_s": tbl_s}
+                "recv_sem": recv_sem, "tbl_s": tbl_s, "states": states,
+                "vrow": vrow, "vrow2": vrow2, "vS": vS}
 
         # Scoreboard waits: block until every cross-core predecessor's
         # edge semaphore has been signalled (reference
@@ -551,6 +673,8 @@ class ModelBuilder:
             lambda: K.attn_prefill_body(cfg, args, refs, len_s),
             lambda: K.moe_weights_body(cfg, args, refs),
             lambda: K.weighted_add_body(cfg, args, refs),
+            (lambda: K.gdn_decode_body(cfg, args, refs))
+            if self.hybrid else (lambda: None),
         ]
         jax.lax.switch(ttype, branches)
         if prof_ref is not None:
@@ -591,7 +715,10 @@ class ModelBuilder:
         sig_edges = jnp.asarray(self.sig_edges)
 
         def step(arena, k_cache, v_cache, token_ids, cache_len,
-                 block_table=None):
+                 block_table=None, states=None):
+            if self.hybrid and states is None:
+                raise ValueError("hybrid megakernel step needs the GDN "
+                                 "states buffer")
             len_arr = jnp.asarray([cache_len], jnp.int32)
             tok_arr = jnp.asarray(token_ids, jnp.int32)
             if block_table is None:
@@ -601,7 +728,8 @@ class ModelBuilder:
             tbl_arr = jnp.asarray(block_table, jnp.int32).reshape(-1)
 
             C = self.num_cores
-            out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+            n_big = 4 if self.hybrid else 3
+            out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_big
             if self.profile:
                 # One (task_type, arg0) row per executed queue slot,
                 # written via the regular output pipeline.
@@ -611,7 +739,7 @@ class ModelBuilder:
             grid_spec = pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=9,
                 grid=(self.qlen, self.num_cores),
-                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_big,
                 out_specs=out_specs,
                 scratch_shapes=[
                     pltpu.VMEM((b, w), jnp.float32),       # va
@@ -624,6 +752,13 @@ class ModelBuilder:
                                jnp.float32),                # vkt
                     pltpu.VMEM((self.seq, self.cfg.head_dim),
                                jnp.float32),                # vsq
+                ] + ([
+                    pltpu.VMEM((1, w), jnp.float32),        # vrow
+                    pltpu.VMEM((1, w), jnp.float32),        # vrow2
+                    pltpu.VMEM((self.cfg.gdn_head_dim_k,
+                                self.cfg.gdn_head_dim_v),
+                               jnp.float32),                # vS
+                ] if self.hybrid else []) + [
                     pltpu.SemaphoreType.REGULAR(
                         (max(self.n_edges, 1),)),           # scoreboard
                     pltpu.SemaphoreType.DMA((max(self.n - 1, 1),)),
@@ -650,36 +785,48 @@ class ModelBuilder:
                 jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                 jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
             ]
+            if self.hybrid:
+                out_shape.append(jax.ShapeDtypeStruct(
+                    states.shape, states.dtype))
             if self.profile:
                 out_shape.append(jax.ShapeDtypeStruct(
                     (self.qlen * self.num_cores, 2), jnp.int32))
-            outs = core_call(
+            outs_fn = core_call(
                 self._kernel,
                 grid_spec=grid_spec,
                 out_shape=tuple(out_shape),
-                input_output_aliases={9: 0, 10: 1, 11: 2},
+                input_output_aliases=(
+                    {9: 0, 10: 1, 11: 2, 12: 3} if self.hybrid
+                    else {9: 0, 10: 1, 11: 2}),
                 # A rankless megakernel traces no barrier: Mosaic
                 # rejects a collective_id without one.
                 compiler_params=(comm_compiler_params() if self.n > 1
                                  else pltpu.CompilerParams(
                                      has_side_effects=True)),
-            )(types, args, wait_tab, sig_tab, wait_edges, sig_edges,
-              len_arr, tok_arr, tbl_arr, arena, k_cache, v_cache)
-            if self.profile:
-                arena, k_cache, v_cache, prof = outs
-            else:
-                arena, k_cache, v_cache = outs
-                prof = None
+            )
+            operands = [types, args, wait_tab, sig_tab, wait_edges,
+                        sig_edges, len_arr, tok_arr, tbl_arr, arena,
+                        k_cache, v_cache]
+            if self.hybrid:
+                operands.append(states)
+            outs = list(outs_fn(*operands))
+            arena, k_cache, v_cache = outs[:3]
+            outs = outs[3:]
+            if self.hybrid:
+                states, outs = outs[0], outs[1:]
+            prof = outs[0] if self.profile else None
 
             lt = self.vloc_tiles
             out_rows = jax.lax.dynamic_slice(
                 arena, (self.logits_off, 0), (lt * b, w))
             logits = out_rows.reshape(lt, b, w).transpose(1, 0, 2
                                                           ).reshape(b, lt * w)
+            ret = [logits[:, :self.vocab_loc], arena, k_cache, v_cache]
+            if self.hybrid:
+                ret.append(states)
             if self.profile:
-                return (logits[:, :self.vocab_loc], arena, k_cache,
-                        v_cache, prof)
-            return (logits[:, :self.vocab_loc], arena, k_cache, v_cache)
+                ret.append(prof)
+            return tuple(ret)
 
         return step
 

@@ -51,7 +51,17 @@ class MegaKernelEngine:
                                     num_cores=num_cores,
                                     strategy=strategy, paged=paged,
                                     page=page)
-        if cfg.is_moe:
+        if cfg.is_hybrid:
+            # Hybrid (qwen_next): GDN layers keep a recurrent-state
+            # buffer; prefill runs via prefill_chain (decode-only
+            # builder).
+            from triton_dist_tpu.models import qwen_next
+
+            specs = qwen_next.param_specs(cfg, axis)
+            if params is None:
+                params = qwen_next.init_params(jax.random.PRNGKey(seed),
+                                               cfg)
+        elif cfg.is_moe:
             # MoE megakernel runs the TP expert regime (every expert's
             # ffn dim sharded over tp; routing in-kernel).
             from triton_dist_tpu.models import qwen_moe
@@ -76,6 +86,12 @@ class MegaKernelEngine:
         # bigger (prefill) footprint sizes the buffer.
         self.prefill_builder = None
         pack_builder = self.builder
+        if cfg.is_hybrid and prefill_seq > 1:
+            raise ValueError(
+                "hybrid (GDN) megakernel is decode-only: batched "
+                "prefill (prefill_seq > 1) is unsupported — ingest "
+                "prompts with prefill_chain(), or serve prefill via "
+                "the layer Engine")
         if prefill_seq > 1:
             self.prefill_builder = ModelBuilder(
                 cfg, mesh, batch=batch * prefill_seq, max_len=max_len,
@@ -100,21 +116,46 @@ class MegaKernelEngine:
         self.params = placed if keep_params else None
 
         step = self.builder.step_fn()
-        self._step = jax.jit(jax.shard_map(
-            step, mesh=mesh,
-            in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
-                      tblspec),
-            out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
-            check_vma=False), donate_argnums=(0, 1, 2))
+        if cfg.is_hybrid:
+            stspec = P(None, None, axis, None, None)
+            self._step = jax.jit(jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
+                          tblspec, stspec),
+                out_specs=(P(None, axis), P(axis, None), kvspec, kvspec,
+                           stspec),
+                check_vma=False), donate_argnums=(0, 1, 2, 6))
+        else:
+            self._step = jax.jit(jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
+                          tblspec),
+                out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
+                check_vma=False), donate_argnums=(0, 1, 2))
 
         n = mesh.shape[axis]
         kv = cfg.num_key_value_heads
+        # Hybrid: KV rows exist only for the full-attention layers
+        # (ordinal-indexed), plus the GDN recurrent-state buffer.
+        self.states = None
+        if cfg.is_hybrid:
+            from triton_dist_tpu.models.qwen_next import _layer_kinds
+
+            _, n_attn, n_gdn = _layer_kinds(cfg)
+            kv_layers = max(n_attn, 1)
+            self.states = jax.device_put(
+                jnp.zeros((max(n_gdn, 1), batch, cfg.gdn_num_heads,
+                           cfg.gdn_head_dim_k, cfg.gdn_head_dim_v),
+                          jnp.float32),
+                NamedSharding(mesh, P(None, None, axis, None, None)))
+        else:
+            kv_layers = cfg.num_hidden_layers
         if paged:
             # Page pools + identity block table (a serving layer swaps
             # in its own allocator's table per call).
             p_max = self.builder.p_max
             self.num_pages = num_pages or batch * p_max
-            shape = (cfg.num_hidden_layers, self.num_pages,
+            shape = (kv_layers, self.num_pages,
                      self.builder.page, kv, cfg.head_dim)
             self.block_table = jnp.arange(batch * p_max, dtype=jnp.int32)
             if self.num_pages < batch * p_max:
@@ -124,7 +165,7 @@ class MegaKernelEngine:
                     "per (batch, page index))")
         else:
             self.block_table = jnp.zeros((1,), jnp.int32)
-            shape = (cfg.num_hidden_layers, batch, max_len, kv,
+            shape = (kv_layers, batch, max_len, kv,
                      cfg.head_dim)
         self.k_cache = jax.device_put(
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
@@ -136,10 +177,18 @@ class MegaKernelEngine:
         transformer stack, and the LM head all run inside the
         megakernel; the vocab-sharded logits are stitched by the
         out_specs."""
-        logits, self._arena, self.k_cache, self.v_cache = self._step(
-            self._arena, self.k_cache, self.v_cache,
-            jnp.asarray(token_ids, jnp.int32),
-            jnp.asarray(cache_len, jnp.int32), self.block_table)
+        if self.states is not None:
+            (logits, self._arena, self.k_cache, self.v_cache,
+             self.states) = self._step(
+                self._arena, self.k_cache, self.v_cache,
+                jnp.asarray(token_ids, jnp.int32),
+                jnp.asarray(cache_len, jnp.int32), self.block_table,
+                self.states)
+        else:
+            logits, self._arena, self.k_cache, self.v_cache = self._step(
+                self._arena, self.k_cache, self.v_cache,
+                jnp.asarray(token_ids, jnp.int32),
+                jnp.asarray(cache_len, jnp.int32), self.block_table)
         return logits
 
     def prefill_chain(self, prompt_ids):

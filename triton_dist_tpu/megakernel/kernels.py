@@ -52,6 +52,10 @@ class KernelConfig:
     # task (top-k is a static python loop in the body).
     moe_topk: int = 0
     moe_norm: bool = True
+    # Hybrid (qwen_next) GDN geometry (0 = no GDN layers).
+    gdn_h_loc: int = 0
+    gdn_dk: int = 0
+    gdn_dv: int = 0
 
 
 def _act(arena, off, tiles_b):
@@ -646,3 +650,97 @@ def attn_prefill_body(cfg, args, refs, len_s):
 
     q_tiles = pl.cdiv(cfg.h_loc * hd, w)
     jax.lax.fori_loop(0, q_tiles, per_qtile, 0)
+
+
+def gdn_decode_body(cfg, args, refs):
+    """Gated-delta-rule decode step for one GDN layer, all (batch,
+    local-head) pairs: S ← exp(g)·S + β·k(v − Sᵀk)ᵀ; o = Sᵀq
+    (``ops/gdn.gdn_decode_step`` math, normalize_qk on). Head slices
+    live inside lane tiles (w % dk == 0, w % dv == 0 — builder
+    contract); per-(b, h) scalars are extracted with masked reduces
+    (no dynamic vector indexing). Row DMAs are grouped per lane tile —
+    each q/k row loads once per batch entry, each v/output row once
+    per v-tile — and the recurrent state rides the ``states`` buffer,
+    the hybrid family's KV-cache analogue."""
+    arena, states = refs["arena"], refs["states"]
+    va, vb = refs["va"], refs["vb"]
+    vrow, vrow2, vS = refs["vrow"], refs["vrow2"], refs["vS"]
+    q_off, k_off, v_off = args[0], args[1], args[2]
+    graw_off, braw_off, gbias_off = args[3], args[4], args[5]
+    out_off, gl = args[6], args[7]
+    b, w = cfg.batch, cfg.w
+    h_loc, dk, dv = cfg.gdn_h_loc, cfg.gdn_dk, cfg.gdn_dv
+
+    pltpu.sync_copy(arena.at[pl.ds(graw_off, b)], va)     # g raw (b, w)
+    pltpu.sync_copy(arena.at[pl.ds(braw_off, b)], vb)     # beta raw
+    pltpu.sync_copy(arena.at[pl.ds(gbias_off, 1)], vrow)  # bias (1, w)
+    g_all = -jax.nn.softplus(va[...].astype(jnp.float32)
+                             + vrow[...].astype(jnp.float32))
+    beta_all = jax.nn.sigmoid(vb[...].astype(jnp.float32))
+    rows_i = jax.lax.broadcasted_iota(jnp.int32, (b, w), 0)
+    cols_i = jax.lax.broadcasted_iota(jnp.int32, (b, w), 1)
+
+    # Static DMA plan: heads grouped by their q-tile; within a group,
+    # v/output rows reload only when the v-tile changes (heads are
+    # ascending, so v-tiles are nondecreasing). g_all/beta_all live in
+    # registers, freeing va/vb as the q/k row buffers.
+    gq_tiles = -(-(h_loc * dk) // w)
+
+    def bstep(bb, _):
+        qrow = va.at[0:1]
+        krow = vb.at[0:1]
+        cur_jv = [None]
+
+        def flush_out():
+            if cur_jv[0] is not None:
+                pltpu.sync_copy(
+                    vrow2, arena.at[pl.ds(out_off + cur_jv[0] * b + bb,
+                                          1)])
+
+        for jq in range(gq_tiles):
+            heads = [hh for hh in range(h_loc) if (hh * dk) // w == jq]
+            if not heads:
+                continue
+            pltpu.sync_copy(arena.at[pl.ds(q_off + jq * b + bb, 1)],
+                            qrow)
+            pltpu.sync_copy(arena.at[pl.ds(k_off + jq * b + bb, 1)],
+                            krow)
+            for h in heads:
+                cq = (h * dk) % w
+                jv, cv = (h * dv) // w, (h * dv) % w
+                if jv != cur_jv[0]:
+                    flush_out()
+                    pltpu.sync_copy(
+                        arena.at[pl.ds(v_off + jv * b + bb, 1)], vrow)
+                    pltpu.sync_copy(
+                        arena.at[pl.ds(out_off + jv * b + bb, 1)],
+                        vrow2)
+                    cur_jv[0] = jv
+                sel = jnp.logical_and(rows_i == bb, cols_i == h)
+                g_s = jnp.exp(jnp.sum(jnp.where(sel, g_all, 0.0)))
+                b_s = jnp.sum(jnp.where(sel, beta_all, 0.0))
+                q = qrow[0:1, cq:cq + dk].astype(jnp.float32)
+                k = krow[0:1, cq:cq + dk].astype(jnp.float32)
+                q = q / jnp.maximum(
+                    jnp.sqrt(jnp.sum(q * q, axis=1, keepdims=True)),
+                    1e-6)
+                k = k / jnp.maximum(
+                    jnp.sqrt(jnp.sum(k * k, axis=1, keepdims=True)),
+                    1e-6)
+                v = vrow[0:1, cv:cv + dv].astype(jnp.float32)
+
+                pltpu.sync_copy(states.at[gl, bb, h], vS)
+                S = vS[...] * g_s
+                pred = jnp.dot(k, S,
+                               preferred_element_type=jnp.float32)
+                delta = (v - pred) * b_s
+                S = S + jnp.dot(k.reshape(dk, 1), delta,
+                                preferred_element_type=jnp.float32)
+                o = jnp.dot(q, S, preferred_element_type=jnp.float32)
+                vS[...] = S
+                pltpu.sync_copy(vS, states.at[gl, bb, h])
+                vrow2[0:1, cv:cv + dv] = o.astype(vrow2.dtype)
+        flush_out()
+        return 0
+
+    jax.lax.fori_loop(0, b, bstep, 0)

@@ -27,6 +27,7 @@ class TaskType(enum.IntEnum):
     ATTN_PREFILL = 10      # args like ATTN_DECODE; causal over new rows
     MOE_WEIGHTS = 11       # args: rl_off, wout_off, n_experts
     WEIGHTED_ADD = 12      # args: acc_off, part_off, wbe_off, e, tiles, init
+    GDN_DECODE = 13        # args: q,k,v,graw,braw,gbias,out offs, gdn_idx
 
 
 @dataclasses.dataclass
