@@ -977,6 +977,42 @@ def battery(quiet=False, deadline=None):
             assert np.isfinite(np.asarray(l2, np.float32)).all()
         return go
 
+    def _run_megakernel_family(make_cfg):
+        """Shared silicon gate for the non-dense megakernel families:
+        engine + prefill_chain + greedy steps, with the FINAL LOGITS
+        checked for finiteness (greedy int tokens are always finite —
+        they cannot catch a NaN lowering)."""
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+        from triton_dist_tpu.models.config import ModelConfig
+
+        eng = MegaKernelEngine(make_cfg(ModelConfig), mesh, batch=4,
+                               max_len=128)
+        seed = eng.prefill_chain(jnp.ones((4, 8), jnp.int32))
+        tok = seed
+        for i in range(4):
+            logits = eng.decode_step(tok, 7 + i)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        lg = np.asarray(logits, np.float32)
+        assert lg.shape[0] == 4 and np.isfinite(lg).all()
+
+    def run_megakernel_moe():
+        """MOE_WEIGHTS/WEIGHTED_ADD task bodies on real Mosaic (they
+        have interpret-mode coverage; this is their silicon gate)."""
+        _run_megakernel_family(lambda MC: MC.tiny_moe(
+            vocab_size=4096, hidden_size=1024, num_hidden_layers=2,
+            num_attention_heads=8, num_key_value_heads=4, head_dim=128,
+            num_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=512))
+
+    def run_megakernel_hybrid():
+        """GDN_DECODE task body on real Mosaic (recurrent state buffer
+        threading + per-head delta-rule update)."""
+        _run_megakernel_family(lambda MC: MC.tiny_next(
+            vocab_size=4096, hidden_size=1024, num_hidden_layers=2,
+            num_attention_heads=8, num_key_value_heads=4, head_dim=128,
+            gdn_num_heads=8, gdn_head_dim_k=128, gdn_head_dim_v=128,
+            full_attn_interval=2))
+
     entries = [
         ("gemm_ar", run_gemm_ar),
         ("allreduce_one_shot", run_allreduce("one_shot")),
@@ -1000,6 +1036,8 @@ def battery(quiet=False, deadline=None):
         ("engine_decode_throughput", run_decode_perf),
         ("megakernel_prefill_decode", run_megakernel(False)),
         ("megakernel_paged", run_megakernel(True)),
+        ("megakernel_moe", run_megakernel_moe),
+        ("megakernel_hybrid_gdn", run_megakernel_hybrid),
     ]
     results = []
     for name, fn in entries:
