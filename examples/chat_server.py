@@ -28,6 +28,10 @@ def main():
     ap.add_argument("--hf-dir", default=None,
                     help="local HF checkpoint directory")
     ap.add_argument("--megakernel", action="store_true")
+    ap.add_argument("--mk-model", default="dense",
+                    choices=["dense", "moe", "hybrid"],
+                    help="--megakernel only: which family the one-"
+                         "kernel runtime serves")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -58,7 +62,14 @@ def main():
         from jax.sharding import Mesh
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
-        cfg = ModelConfig.tiny(vocab_size=128)
+        if args.mk_model == "moe":
+            cfg = ModelConfig.tiny_moe(vocab_size=128, num_experts=8)
+        elif args.mk_model == "hybrid":
+            cfg = ModelConfig.tiny_next(vocab_size=128,
+                                        num_key_value_heads=4,
+                                        full_attn_interval=2)
+        else:
+            cfg = ModelConfig.tiny(vocab_size=128)
         mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
         # One engine for the whole session: construction/jit are
         # prompt-length independent (prefill_chain is length-agnostic).
@@ -85,6 +96,9 @@ def main():
         prompt = jnp.asarray(np.tile(np.array([ids], np.int32),
                                      (args.tp, 1)))
         if args.megakernel:
+            # Fresh recurrent state per prompt (hybrid family): stale
+            # KV is masked by cache_len, stale GDN state is not.
+            mk.reset_states()
             seed = mk.prefill_chain(prompt)
             toks = np.asarray(mk.generate(seed, steps=args.gen_len,
                                           start_pos=len(ids) - 1))

@@ -494,3 +494,34 @@ def test_megakernel_hybrid_engine_matches_layer_engine(tp2_mesh):
                  model=qwen_next, params=params)
     eng_toks = np.asarray(eng.serve(prompts, gen_len=5))
     np.testing.assert_array_equal(mk_toks, eng_toks)
+
+
+def test_megakernel_hybrid_reset_states(tp2_mesh):
+    """Reusing a hybrid engine for a second independent prompt must
+    reproduce the fresh-engine tokens after reset_states() (stale
+    recurrent state has no position mask, unlike KV rows)."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models import qwen_next
+
+    hcfg = ModelConfig.tiny_next(vocab_size=64, hidden_size=32,
+                                 num_hidden_layers=2,
+                                 num_attention_heads=4,
+                                 num_key_value_heads=2, head_dim=8,
+                                 gdn_num_heads=4, gdn_head_dim_k=8,
+                                 gdn_head_dim_v=8, full_attn_interval=2)
+    params = qwen_next.init_params(jax.random.PRNGKey(30), hcfg)
+    eng = MegaKernelEngine(hcfg, tp2_mesh, batch=2, max_len=32,
+                           tile_w=16, t_tile=16, params=params)
+    p1 = jnp.asarray([[3, 9, 27, 17], [5, 25, 61, 41]], jnp.int32)
+    p2 = jnp.asarray([[8, 16, 32, 60], [7, 49, 23, 11]], jnp.int32)
+    eng.generate(eng.prefill_chain(p1), steps=3, start_pos=3)
+
+    eng.reset_states()
+    t2_reused = np.asarray(
+        eng.generate(eng.prefill_chain(p2), steps=3, start_pos=3))
+
+    fresh = MegaKernelEngine(hcfg, tp2_mesh, batch=2, max_len=32,
+                             tile_w=16, t_tile=16, params=params)
+    t2_fresh = np.asarray(
+        fresh.generate(fresh.prefill_chain(p2), steps=3, start_pos=3))
+    np.testing.assert_array_equal(t2_reused, t2_fresh)

@@ -172,6 +172,15 @@ class MegaKernelEngine:
         self.v_cache = jax.device_put(
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
 
+    def reset_states(self):
+        """Zero the GDN recurrent states (hybrid family) — REQUIRED
+        between independent prompts on a reused engine: unlike stale KV
+        rows (masked beyond cache_len), the recurrent state has no
+        position mask, so a previous prompt's S would contaminate the
+        next. No-op for dense/MoE engines."""
+        if self.states is not None:
+            self.states = jax.tree.map(jnp.zeros_like, self.states)
+
     def decode_step(self, token_ids, cache_len) -> jax.Array:
         """token_ids: (B,) → logits (B, vocab). Embedding, the whole
         transformer stack, and the LM head all run inside the

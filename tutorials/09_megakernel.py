@@ -25,3 +25,35 @@ eng = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
 print("tasks per step:", len(eng.builder.task_types))
 print("generated:",
       np.asarray(eng.generate(jnp.zeros((2,), jnp.int32), steps=6)))
+
+# --- MoE family: in-kernel routing + all-expert weighted combine ---------
+mcfg = ModelConfig.tiny_moe(vocab_size=64, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            num_key_value_heads=2, head_dim=8,
+                            num_experts=4, num_experts_per_tok=2,
+                            moe_intermediate_size=32)
+moe_eng = MegaKernelEngine(mcfg, mesh, batch=2, max_len=32, tile_w=16,
+                           t_tile=16)
+print("MoE tasks per step:", len(moe_eng.builder.task_types))
+print("MoE generated:",
+      np.asarray(moe_eng.generate(jnp.zeros((2,), jnp.int32), steps=4)))
+
+# --- Hybrid GDN family: recurrent state instead of KV rows ---------------
+hcfg = ModelConfig.tiny_next(vocab_size=64, hidden_size=32,
+                             num_hidden_layers=4, num_attention_heads=4,
+                             num_key_value_heads=2, head_dim=8,
+                             gdn_num_heads=8, gdn_head_dim_k=8,
+                             gdn_head_dim_v=8, full_attn_interval=2)
+gdn_eng = MegaKernelEngine(hcfg, mesh, batch=2, max_len=32, tile_w=16,
+                           t_tile=16)
+print("hybrid generated:",
+      np.asarray(gdn_eng.generate(jnp.zeros((2,), jnp.int32), steps=4)))
+
+# --- Per-slot task profiling (the SM-activity analogue) ------------------
+from triton_dist_tpu.megakernel import ModelBuilder
+
+prof_mb = ModelBuilder(cfg, mesh, batch=2, max_len=32, tile_w=16,
+                       t_tile=16, num_cores=2, strategy="cost_lpt",
+                       profile=True)
+print("profiled queue:", prof_mb.qlen, "slots x 2 cores "
+      "(run step_fn for the per-slot log + core_activity)")
