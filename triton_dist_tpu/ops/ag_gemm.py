@@ -107,6 +107,28 @@ def _drain_sends(send_sem, chunk_ref, n: int):
         dl.wait_arrivals(send_sem.at[s], chunk_ref, 1)
 
 
+def _certify_and_forward(k, me, n, right, chunk_of, sim_src_of, send_sem,
+                         recv_sem, *, axis, ctx):
+    """Shared ring-step boundary: certify chunk k+1's arrival (slot k),
+    then forward it right on slot k+1 while the caller computes on it
+    (sim mode sources the forward from the full-A ref instead — the
+    self-ring's wire). Used by both kernel variants' early waits."""
+    nxt = jax.lax.rem(me - (k + 1) + n, n)
+    dl.wait_arrivals(recv_sem.at[k], chunk_of(nxt), 1)
+
+    @pl.when(k + 1 < n - 1)
+    def _():
+        if sim_src_of is not None:
+            nxt2 = jax.lax.rem(me - (k + 2) + 2 * n, n)
+            dl.remote_put(sim_src_of(nxt2), chunk_of(nxt2),
+                          send_sem.at[k + 1], recv_sem.at[k + 1], me,
+                          axis=axis, ctx=ctx)
+        else:
+            dl.remote_put(chunk_of(nxt), chunk_of(nxt), send_sem.at[k + 1],
+                          recv_sem.at[k + 1], right, axis=axis, ctx=ctx)
+    return nxt
+
+
 def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                     recv_sem, panel_sem, local_sem, *, axis: str,
                     ctx: MeshContext, m_loc: int, tm: int, tk: int,
@@ -124,14 +146,54 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
     n = n_ranks
     c = jax.lax.rem(me - k + n, n)
     right = jax.lax.rem(me + 1, n)
+    lin = (i * n_j + j) * n_k + kk          # body index within chunk k
+    chunk_len = n_i * n_j * n_k
+    # Cross-chunk prefetch mode: with two panel buffers, the chunk-(k+1)
+    # arrival wait, ring forward, and first-panel staging all run near
+    # the end of chunk k — the ring-step boundary exposes neither the
+    # arrival latency nor a cold panel load. Needs >= 2 bodies per
+    # chunk (the wait must precede the boundary body). The staging body
+    # is the second-to-last EXCEPT when each panel is a single body
+    # (n_j*n_k == 1): there the second-to-last body still computes from
+    # the buffer the next chunk's panel would land in, so staging moves
+    # to the last body (panel p and p+2 share a buffer; p's compute
+    # must have finished).
+    cross = n_buf > 1 and chunk_len >= 2
+    boundary_lin = chunk_len - 2 if n_j * n_k >= 2 else chunk_len - 1
 
     chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
 
-    first = jnp.logical_and(
-        k == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+    def start_panel_copy(ii, buf):
+        """Start panel ii of chunk c into a_panel[buf]. My own chunk
+        (k == 0) reads straight from the input; received chunks read
+        from the workspace — arrival certified by the chunk-start wait
+        (non-cross mode, above) or by the previous chunk's boundary
+        body (cross mode, the ``lin == boundary_lin`` block below)."""
+        @pl.when(k == 0)
+        def _():
+            off = (me * m_loc if sim else 0)
+            pltpu.make_async_copy(a_ref.at[pl.ds(off + ii * tm, tm)],
+                                  a_panel.at[buf], panel_sem).start()
+
+        @pl.when(k > 0)
+        def _():
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(c * m_loc + ii * tm, tm)],
+                a_panel.at[buf], panel_sem).start()
+
+    def wait_panel(buf):
+        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
+                              panel_sem).wait()
+
+    first = jnp.logical_and(k == 0, lin == 0)
 
     @pl.when(first)
     def _():
+        if cross:
+            # Panel 0 of my own chunk reads the local input — no peer
+            # dependency, so its HBM->VMEM copy hides under the entry
+            # barrier's neighbour round-trip.
+            start_panel_copy(0, 0)
         _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         # Peers must be in-kernel before any remote traffic.
         dl.barrier_tile(axis, ctx=ctx)
@@ -159,44 +221,30 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
     chunk_start = jnp.logical_and(
         i == 0, jnp.logical_and(j == 0, kk == 0))
 
-    @pl.when(jnp.logical_and(k > 0, chunk_start))
-    def _():
-        # Chunk c arrives from the left neighbour's step-(k-1) put.
-        dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(c), 1)
-
-        # Forward it right (steps 1..n-2) while we compute on it.
-        @pl.when(k < n - 1)
+    if not cross:
+        @pl.when(jnp.logical_and(k > 0, chunk_start))
         def _():
-            if sim:
-                nxt = jax.lax.rem(me - (k + 1) + n, n)
-                dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
-                              chunk_of(nxt), send_sem.at[k],
-                              recv_sem.at[k], me, axis=axis, ctx=ctx)
-            else:
-                dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
-                              recv_sem.at[k], right, axis=axis, ctx=ctx)
+            # Chunk c arrives from the left neighbour's step-(k-1) put.
+            dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(c), 1)
 
-    def start_panel_copy(ii, buf):
-        """Start panel ii of chunk c into a_panel[buf]. My own chunk
-        (k == 0) reads straight from the input; received chunks read
-        from the workspace (arrival already certified above)."""
-        @pl.when(k == 0)
-        def _():
-            off = (me * m_loc if sim else 0)
-            pltpu.make_async_copy(a_ref.at[pl.ds(off + ii * tm, tm)],
-                                  a_panel.at[buf], panel_sem).start()
+            # Forward it right (steps 1..n-2) while we compute on it.
+            @pl.when(k < n - 1)
+            def _():
+                if sim:
+                    nxt = jax.lax.rem(me - (k + 1) + n, n)
+                    dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
+                                  chunk_of(nxt), send_sem.at[k],
+                                  recv_sem.at[k], me, axis=axis, ctx=ctx)
+                else:
+                    dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
+                                  recv_sem.at[k], right, axis=axis,
+                                  ctx=ctx)
 
-        @pl.when(k > 0)
-        def _():
-            pltpu.make_async_copy(
-                a_ws.at[pl.ds(c * m_loc + ii * tm, tm)],
-                a_panel.at[buf], panel_sem).start()
-
-    def wait_panel(buf):
-        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
-                              panel_sem).wait()
-
-    buf = jax.lax.rem(i, n_buf) if n_buf > 1 else 0
+    # Global panel index: consecutive panels alternate buffers even
+    # across ring-chunk boundaries (an i-based index collides when n_i
+    # is odd — chunk k's last panel and chunk k+1's first would share).
+    p_glob = k * n_i + i
+    buf = jax.lax.rem(p_glob, n_buf) if n_buf > 1 else 0
 
     @pl.when(jnp.logical_and(j == 0, kk == 0))
     def _():
@@ -207,17 +255,31 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
             start_panel_copy(i, 0)
             wait_panel(0)
         else:
-            # Double-buffered: panel i was prefetched during panel i-1;
-            # only the first panel of each chunk is a cold, blocking
-            # load. One copy is in flight at a time (single sem).
-            @pl.when(i == 0)
-            def _():
-                start_panel_copy(i, buf)
+            # Every panel was prefetched during its predecessor (the
+            # first via the pre-barrier copy, chunk-crossing ones at
+            # the boundary body below) — the wait is warm.
             wait_panel(buf)
 
             @pl.when(i + 1 < n_i)
             def _():
-                start_panel_copy(i + 1, jax.lax.rem(i + 1, n_buf))
+                start_panel_copy(i + 1, jax.lax.rem(p_glob + 1, n_buf))
+
+    if cross and n > 1:
+        @pl.when(jnp.logical_and(k < n - 1, lin == boundary_lin))
+        def _():
+            # Certify chunk k+1's arrival one body before its first
+            # panel is needed, forward it right, and stage its first
+            # panel — the ring-step boundary costs nothing when the
+            # transfer beat the compute (the steady state).
+            sim_src = ((lambda r: a_ref.at[pl.ds(r * m_loc, m_loc)])
+                       if sim else None)
+            nxt = _certify_and_forward(k, me, n, right, chunk_of, sim_src,
+                                       send_sem, recv_sem, axis=axis,
+                                       ctx=ctx)
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(nxt * m_loc, tm)],
+                a_panel.at[jax.lax.rem((k + 1) * n_i, n_buf)],
+                panel_sem).start()
 
     @pl.when(kk == 0)
     def _():
@@ -310,20 +372,9 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
     # prefetches chunk k+1's first A block.
     @pl.when(jnp.logical_and(k < n - 1, lin == chunk_len - 2))
     def _():
-        nxt = jax.lax.rem(me - (k + 1) + n, n)
-        dl.wait_arrivals(recv_sem.at[k], chunk_of(nxt), 1)
-
-        @pl.when(k + 1 < n - 1)
-        def _():
-            if sim:
-                nxt2 = jax.lax.rem(me - (k + 2) + 2 * n, n)
-                dl.remote_put(sim_chunk(nxt2), chunk_of(nxt2),
-                              send_sem.at[k + 1], recv_sem.at[k + 1], me,
-                              axis=axis, ctx=ctx)
-            else:
-                dl.remote_put(chunk_of(nxt), chunk_of(nxt),
-                              send_sem.at[k + 1], recv_sem.at[k + 1],
-                              right, axis=axis, ctx=ctx)
+        _certify_and_forward(k, me, n, right, chunk_of,
+                             sim_chunk if sim else None,
+                             send_sem, recv_sem, axis=axis, ctx=ctx)
 
     @pl.when(kk == 0)
     def _():
@@ -476,11 +527,15 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         c = jax.lax.rem(me - k + n, n)
         return (c * n_i + i, j)
 
-    # Double-buffer the A panel when two fit the budget: panel i+1
-    # prefetches while panel i computes, hiding the HBM→VMEM staging
-    # everywhere except the first panel of each ring chunk.
+    # Double-buffer the A panel when two fit the budget: panel p+1
+    # prefetches while panel p computes — including ACROSS ring-chunk
+    # boundaries (the next chunk's arrival wait, ring forward, and
+    # first-panel staging run near the end of the current chunk), so no
+    # panel load is ever cold after the first. Needs >= 2 bodies per
+    # chunk for the boundary body to precede the chunk it feeds.
     panel_bytes = tm * kdim * a.dtype.itemsize
-    n_buf = 2 if (n_i > 1 and 2 * panel_bytes <= panel_budget) else 1
+    n_buf = 2 if (n * n_i > 1 and n_i * n_j * n_k >= 2
+                  and 2 * panel_bytes <= panel_budget) else 1
 
     kernel = functools.partial(
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
