@@ -458,6 +458,294 @@ def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
     return out, a_full
 
 
+def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize):
+    """Shared tile-size policy for the panel-staging kernels: clamp tm
+    to the VMEM panel budget, check divisibility, pick the panel buffer
+    count (2 when a double-buffered pair fits and there are >= 2 bodies
+    per ring chunk — the cross-chunk prefetch precondition)."""
+    tm = min(ctx.block_m, m_loc)
+    tn = min(ctx.block_n, n_loc)
+    tk = min(ctx.block_k, kdim)
+    # The A panel is (tm, K) in VMEM; clamp tm so it stays within a
+    # ~9 MB budget for any K (block_k bounds only the B tiles; the rest
+    # of the ~16 MB VMEM holds double-buffered B, the accumulator, and
+    # the output tile).
+    panel_budget = 9 * 1024 * 1024
+    while tm > 8 and tm * kdim * itemsize > panel_budget:
+        tm //= 2
+    while tm > 1 and m_loc % tm:
+        tm //= 2
+    if m_loc % tm or n_loc % tn or kdim % tk:
+        raise ValueError(
+            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
+            f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
+    n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
+    panel_bytes = tm * kdim * itemsize
+    n_buf = 2 if (n_i * n_j * n_k >= 2
+                  and 2 * panel_bytes <= panel_budget) else 1
+    return tm, tn, tk, n_i, n_j, n_k, n_buf
+
+
+def _ag_gemm_2d_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, isend,
+                       irecv, osend, orecv, panel_sem, local_sem, *,
+                       inner_axis: str, outer_axis: str, ctx: MeshContext,
+                       m_loc: int, tm: int, n_in: int, n_o: int,
+                       n_buf: int, write_ag: bool,
+                       straggler_rank: int = -1,
+                       straggler_delay_iters: int = 0):
+    """Hierarchical (outer x inner) fused AllGather+GEMM.
+
+    The grid's outermost dimension flattens (super-step s, inner ring
+    step t): at super-step s the inner ring distributes outer-column
+    ``col = (o - s) % n_o``'s chunks through the MXU while that
+    column's seed chunk crosses the slow outer link toward super-step
+    s+1 — the interleaved relay of :func:`ops.allgather.all_gather_2d`
+    (reference inter-node AG+GEMM, ``allgather_gemm.py`` via
+    ``allgather.py:454``), fused into the GEMM the way the 1D kernel
+    fuses its ring. One DCN hop hides behind n_in chunks of compute.
+    """
+    q = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    o = dl.rank(outer_axis)
+    ii = dl.rank(inner_axis)
+    nq = n_o * n_in
+    s = jax.lax.div(q, n_in)
+    t = jax.lax.rem(q, n_in)
+    col = jax.lax.rem(o - s + n_o, n_o)
+    src = jax.lax.rem(ii - t + n_in, n_in)
+    cidx = col * n_in + src            # global chunk index of this step
+    my_idx = o * n_in + ii
+    i_right = jax.lax.rem(ii + 1, n_in)
+    o_right = jax.lax.rem(o + 1, n_o)
+    lin = (i * n_j + j) * n_k + kk
+    chunk_len = n_i * n_j * n_k
+    cross = n_buf > 1 and chunk_len >= 2
+    boundary_lin = chunk_len - 2 if n_j * n_k >= 2 else chunk_len - 1
+
+    chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
+
+    def certify_and_relay(qn):
+        """Certify arrival of the chunk computed at step ``qn`` >= 1,
+        then relay it (inner forward, or seed put + outer hop at a
+        super-step boundary). Returns the chunk's global index."""
+        s2 = jax.lax.div(qn, n_in)
+        t2 = jax.lax.rem(qn, n_in)
+        col2 = jax.lax.rem(o - s2 + n_o, n_o)
+        seed = col2 * n_in + ii
+        c2 = col2 * n_in + jax.lax.rem(ii - t2 + n_in, n_in)
+
+        if n_in > 1:
+            @pl.when(t2 > 0)
+            def _():
+                # Inner-ring arrival from the left; forward right while
+                # the MXU works on it (transfer u carries the chunk for
+                # ring step u+1).
+                u = s2 * (n_in - 1) + t2 - 1
+                dl.wait_arrivals(irecv.at[u], chunk_of(c2), 1)
+
+                @pl.when(t2 < n_in - 1)
+                def _():
+                    dl.remote_put(chunk_of(c2), chunk_of(c2),
+                                  isend.at[u + 1], irecv.at[u + 1],
+                                  i_right, axis=inner_axis, ctx=ctx)
+
+        @pl.when(t2 == 0)
+        def _():
+            # Super-step boundary: column col2's seed arrived over the
+            # outer link during super-step s2-1. Kick the inner ring
+            # with it and relay it onward over the outer ring.
+            dl.wait_arrivals(orecv.at[s2 - 1], chunk_of(seed), 1)
+            if n_in > 1:
+                dl.remote_put(chunk_of(seed), chunk_of(seed),
+                              isend.at[s2 * (n_in - 1)],
+                              irecv.at[s2 * (n_in - 1)], i_right,
+                              axis=inner_axis, ctx=ctx)
+
+            @pl.when(s2 < n_o - 1)
+            def _():
+                dl.remote_put(chunk_of(seed), chunk_of(seed),
+                              osend.at[s2], orecv.at[s2], o_right,
+                              axis=outer_axis, ctx=ctx)
+        return c2
+
+    def start_panel_copy(ci, row, buf):
+        """Stage row-panel ``row`` of global chunk ``ci`` (step q's own
+        chunk): step 0 reads the local input, later steps the ws."""
+        @pl.when(q == 0)
+        def _():
+            pltpu.make_async_copy(a_ref.at[pl.ds(row * tm, tm)],
+                                  a_panel.at[buf], panel_sem).start()
+
+        @pl.when(q > 0)
+        def _():
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(ci * m_loc + row * tm, tm)],
+                a_panel.at[buf], panel_sem).start()
+
+    def wait_panel(buf):
+        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
+                              panel_sem).wait()
+
+    first = jnp.logical_and(q == 0, lin == 0)
+
+    @pl.when(first)
+    def _():
+        if cross:
+            start_panel_copy(my_idx, 0, 0)   # local input, pre-barrier
+        # Straggler injection uses the FLAT rank over (outer, inner),
+        # so any device in the 2D mesh can be delayed.
+        _straggler_spin(acc_v, o * n_in + ii, straggler_rank,
+                        straggler_delay_iters)
+        dl.barrier_tile(inner_axis, ctx=ctx)
+        dl.barrier_tile(outer_axis, ctx=ctx)
+        if write_ag:
+            pltpu.make_async_copy(a_ref, chunk_of(my_idx),
+                                  local_sem).start()
+        if n_in > 1:
+            # Inner seed put for super-step 0 (my own chunk).
+            dl.remote_put(a_ref, chunk_of(my_idx), isend.at[0],
+                          irecv.at[0], i_right, axis=inner_axis, ctx=ctx)
+        # Outer hop 0: my chunk seeds the right group's super-step 1.
+        dl.remote_put(a_ref, chunk_of(my_idx), osend.at[0], orecv.at[0],
+                      o_right, axis=outer_axis, ctx=ctx)
+
+    if not cross:
+        @pl.when(jnp.logical_and(q > 0, lin == 0))
+        def _():
+            certify_and_relay(q)
+
+    p_glob = q * n_i + i
+    buf = jax.lax.rem(p_glob, n_buf) if n_buf > 1 else 0
+
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _():
+        if n_buf == 1:
+            start_panel_copy(cidx, i, 0)
+            wait_panel(0)
+        else:
+            wait_panel(buf)
+
+            @pl.when(i + 1 < n_i)
+            def _():
+                start_panel_copy(cidx, i + 1,
+                                 jax.lax.rem(p_glob + 1, n_buf))
+
+    if cross:
+        @pl.when(jnp.logical_and(q < nq - 1, lin == boundary_lin))
+        def _():
+            c2 = certify_and_relay(q + 1)
+            pltpu.make_async_copy(
+                a_ws.at[pl.ds(c2 * m_loc, tm)],
+                a_panel.at[jax.lax.rem((q + 1) * n_i, n_buf)],
+                panel_sem).start()
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_panel[buf, :, pl.ds(kk * b_ref.shape[0],
+                                                b_ref.shape[0])],
+                          b_ref[...], preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+
+    last = jnp.logical_and(q == nq - 1, lin == chunk_len - 1)
+
+    @pl.when(last)
+    def _():
+        # Drain every send slot (one put each per rank: seeds at
+        # s*(n_in-1), forwards in between; outer hops 0..n_o-2).
+        if n_in > 1:
+            for u in range(n_o * (n_in - 1)):
+                dl.wait_arrivals(isend.at[u], chunk_of(0), 1)
+        for h in range(n_o - 1):
+            dl.wait_arrivals(osend.at[h], chunk_of(0), 1)
+        if write_ag:
+            dl.wait_arrivals(local_sem, a_ref, 1)
+
+
+def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
+    """Host wrapper for the hierarchical kernel (``ctx.axis`` is an
+    ``(outer, inner)`` tuple — e.g. ("dp", "tp") for dcn x ici).
+
+    ``ctx.variant`` is ignored: only the panel kernel has a 2D form
+    (the pipelined variant's aliased-workspace pipeline has no
+    hierarchical schedule). Straggler injection IS honoured, keyed by
+    flat rank over (outer, inner)."""
+    outer_axis, inner_axis = ctx.axis
+    mesh = ctx.mesh
+    n_o = mesh.size(outer_axis)
+    n_in = mesh.size(inner_axis)
+    n = n_o * n_in
+    m_loc, kdim = a.shape
+    _, n_loc = b.shape
+    out_dtype = ctx.out_dtype or a.dtype
+    if n_o == 1:
+        inner_ctx = dataclasses.replace(ctx, axis=inner_axis)
+        return ag_gemm(a, b, inner_ctx, return_ag=return_ag)
+
+    tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
+        ctx, m_loc, n_loc, kdim, a.dtype.itemsize)
+    m_full = n * m_loc
+
+    def c_index(q, i, j, kk):
+        o = jax.lax.axis_index(outer_axis)
+        ii = jax.lax.axis_index(inner_axis)
+        s = jax.lax.div(q, n_in)
+        t = jax.lax.rem(q, n_in)
+        col = jax.lax.rem(o - s + n_o, n_o)
+        src = jax.lax.rem(ii - t + n_in, n_in)
+        return ((col * n_in + src) * n_i + i, j)
+
+    kernel = functools.partial(
+        _ag_gemm_2d_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
+        ctx=ctx.mesh, m_loc=m_loc, tm=tm, n_in=n_in, n_o=n_o,
+        n_buf=n_buf, write_ag=return_ag,
+        straggler_rank=ctx.straggler_rank,
+        straggler_delay_iters=ctx.straggler_delay_iters)
+
+    out, a_full = core_call(
+        kernel,
+        comm=True,
+        grid=(n_o * n_in, n_i, n_j, n_k),
+        out_shape=(jax.ShapeDtypeStruct((m_full, n_loc), out_dtype),
+                   jax.ShapeDtypeStruct((m_full, kdim), a.dtype)),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # a (manual RDMA)
+            pl.BlockSpec((tk, tn), lambda q, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_buf, tm, kdim), a.dtype),              # panel
+            pltpu.VMEM((tm, tn), jnp.float32),                   # acc
+            pltpu.SemaphoreType.DMA((max(n_o * (n_in - 1), 1),)),  # isend
+            pltpu.SemaphoreType.DMA((max(n_o * (n_in - 1), 1),)),  # irecv
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),           # osend
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),           # orecv
+            pltpu.SemaphoreType.DMA(()),                         # panel
+            pltpu.SemaphoreType.DMA(()),                         # local
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_full * kdim * n_loc,
+            bytes_accessed=(m_full * kdim + kdim * n_loc * n * n_i
+                            + m_full * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a, b)
+    return (out, a_full) if return_ag else out
+
+
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
             force_kernel: bool = False, sim_ranks: int = 0):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
@@ -475,7 +763,18 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     semaphore waits, staging, and per-step compute:comm ratio to the
     real multi-chip kernel; only the wire is HBM instead of ICI. This is
     what bench.py measures when one chip is available.
+
+    ``ctx.axis`` may be an ``(outer, inner)`` tuple for the
+    hierarchical dcn x ici form (reference inter-node AG+GEMM): the
+    gather then spans both axes with outer hops relayed under inner
+    rings (see :func:`_ag_gemm_2d_kernel`).
     """
+    if isinstance(ctx.axis, (tuple, list)):
+        if sim_ranks or force_kernel:
+            raise ValueError("sim_ranks/force_kernel apply to the "
+                             "single-axis form only")
+        return _ag_gemm_2d(a, b, dataclasses.replace(
+            ctx, axis=tuple(ctx.axis)), return_ag=return_ag)
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m_loc, kdim = a.shape
@@ -497,23 +796,10 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_ag else c
 
-    tm = min(ctx.block_m, m_loc)
-    tn = min(ctx.block_n, n_loc)
-    tk = min(ctx.block_k, kdim)
-    # The A panel is (tm, K) in VMEM; clamp tm so it stays within a
-    # ~9 MB budget for any K (block_k bounds only the B tiles; the rest
-    # of the ~16 MB VMEM holds double-buffered B, the accumulator, and
-    # the output tile).
-    panel_budget = 9 * 1024 * 1024
-    while tm > 8 and tm * kdim * a.dtype.itemsize > panel_budget:
-        tm //= 2
-    while tm > 1 and m_loc % tm:
-        tm //= 2
-    if m_loc % tm or n_loc % tn or kdim % tk:
-        raise ValueError(
-            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
-            f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
-    n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
+    tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
+        ctx, m_loc, n_loc, kdim, a.dtype.itemsize)
+    if n * n_i == 1:
+        n_buf = 1     # a single panel total — nothing to double-buffer
     m_full = n * m_loc
 
     if ctx.variant == "pipelined" and n_i * n_j * n_k >= 2:
@@ -526,16 +812,6 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         me = jax.lax.axis_index(ctx.axis)
         c = jax.lax.rem(me - k + n, n)
         return (c * n_i + i, j)
-
-    # Double-buffer the A panel when two fit the budget: panel p+1
-    # prefetches while panel p computes — including ACROSS ring-chunk
-    # boundaries (the next chunk's arrival wait, ring forward, and
-    # first-panel staging run near the end of the current chunk), so no
-    # panel load is ever cold after the first. Needs >= 2 bodies per
-    # chunk for the boundary body to precede the chunk it feeds.
-    panel_bytes = tm * kdim * a.dtype.itemsize
-    n_buf = 2 if (n * n_i > 1 and n_i * n_j * n_k >= 2
-                  and 2 * panel_bytes <= panel_budget) else 1
 
     kernel = functools.partial(
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,

@@ -57,6 +57,19 @@ def gemm_rs_ref(a, b, *, axis: str = "tp", **_):
                                 tiled=True).astype(a.dtype)
 
 
+def _rs_blocks(ctx: GemmRSContext, m_loc, n_dim, k_loc):
+    """Shared tile-size clamp + divisibility check for both gemm_rs
+    kernel paths."""
+    tm = min(ctx.block_m, m_loc)
+    tn = min(ctx.block_n, n_dim)
+    tk = min(ctx.block_k, k_loc)
+    if m_loc % tm or n_dim % tn or k_loc % tk:
+        raise ValueError(
+            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
+            f"divide (M_loc={m_loc}, N={n_dim}, K_loc={k_loc})")
+    return tm, tn, tk, m_loc // tm, n_dim // tn, k_loc // tk
+
+
 def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
                     acc_v, tmp_v, out_v, send_sem, recv_sem, *,
                     axis: str, ctx: MeshContext, m_loc: int, tm: int,
@@ -154,6 +167,208 @@ def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
             dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
 
 
+def _gemm_rs_2d_kernel(a_ref, b_ref, o_ref, recv_hbm, send_hbm, opart,
+                       osend_hbm, acc_v, tmp_v, out_v, isend, irecv,
+                       osend, orecv, *, inner_axis: str, outer_axis: str,
+                       ctx: MeshContext, tm: int, tn: int,
+                       n_in: int, n_o: int):
+    """Hierarchical (outer x inner) fused GEMM+ReduceScatter.
+
+    Super-step t ring-reduces — through the producer GEMM, exactly like
+    the 1D kernel — the chunks destined for outer group
+    ``o_dst = (o + n_o - 1 - t) % n_o``; the finished group-sum crosses
+    the slow outer link ONCE to its destination rank, where it is folded
+    during the final super-step (my own group, scheduled last so every
+    inbound outer transfer hides under n_in chunks of compute).
+    Reference: inter-node ``gemm_reduce_scatter.py`` (SURVEY §2.5).
+    """
+    q = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    o = dl.rank(outer_axis)
+    ii = dl.rank(inner_axis)
+    t = jax.lax.div(q, n_in)          # super-step (destination group)
+    s = jax.lax.rem(q, n_in)          # inner ring step
+    o_dst = jax.lax.rem(o + n_o - 1 - t, n_o)
+    # (the A rows multiplied this step — inner chunk (ii - s - 1) % n_in
+    # of group o_dst — are selected host-side by the a_index BlockSpec)
+    i_right = jax.lax.rem(ii + 1, n_in)
+    u = t * (n_in - 1) + s - 1        # inner transfer slot (s >= 1)
+    last_super = t == n_o - 1         # o_dst == o
+
+    first = jnp.logical_and(q == 0, jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_tile(inner_axis, ctx=ctx)
+        # Outer puts target rank (o + n_o - 1 - t) — up to n_o-1 hops
+        # away — so a neighbour-pair barrier is NOT enough: every outer
+        # peer must be in-kernel before the first group-sum ships.
+        if n_o > 2:
+            dl.barrier_all(outer_axis, ctx=ctx)
+        else:
+            dl.barrier_tile(outer_axis, ctx=ctx)
+
+    chunk_start = jnp.logical_and(
+        i == 0, jnp.logical_and(j == 0, kk == 0))
+
+    if n_in > 1:
+        @pl.when(jnp.logical_and(s > 0, chunk_start))
+        def _():
+            # Running sum for this step's chunk arrives from inner-left.
+            dl.wait_arrivals(irecv.at[u], recv_hbm.at[u], 1)
+
+    @pl.when(jnp.logical_and(last_super,
+                             jnp.logical_and(s == n_in - 1, chunk_start)))
+    def _():
+        # My own chunk's group-sums from every other outer group landed
+        # over the outer link during earlier super-steps.
+        for h in range(n_o - 1):
+            dl.wait_arrivals(orecv.at[h], opart.at[h], 1)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_ref[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        if n_in > 1:
+            @pl.when(s > 0)
+            def _():
+                pltpu.sync_copy(
+                    recv_hbm.at[u, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
+                    tmp_v)
+                acc_v[...] = acc_v[...] + tmp_v[...]
+
+        @pl.when(s < n_in - 1)
+        def _():
+            pltpu.sync_copy(acc_v, send_hbm.at[t * (n_in - 1) + s,
+                                               pl.ds(i * tm, tm),
+                                               pl.ds(j * tn, tn)])
+
+            @pl.when(jnp.logical_and(i == n_i - 1, j == n_j - 1))
+            def _():
+                dl.remote_put(send_hbm.at[t * (n_in - 1) + s],
+                              recv_hbm.at[t * (n_in - 1) + s],
+                              isend.at[t * (n_in - 1) + s],
+                              irecv.at[t * (n_in - 1) + s], i_right,
+                              axis=inner_axis, ctx=ctx)
+
+        @pl.when(jnp.logical_and(jnp.logical_not(last_super),
+                                 s == n_in - 1))
+        def _():
+            # Group-sum complete -> stage and ship it over the outer
+            # link to rank (o_dst, ii). The sender's super-step t is a
+            # unique slot at the receiver: t == (o - o_dst - 1) % n_o.
+            pltpu.sync_copy(acc_v, osend_hbm.at[t, pl.ds(i * tm, tm),
+                                                pl.ds(j * tn, tn)])
+
+            @pl.when(jnp.logical_and(i == n_i - 1, j == n_j - 1))
+            def _():
+                dl.remote_put(osend_hbm.at[t], opart.at[t], osend.at[t],
+                              orecv.at[t], o_dst, axis=outer_axis,
+                              ctx=ctx)
+
+        @pl.when(jnp.logical_and(last_super, s == n_in - 1))
+        def _():
+            # Fold the n_o-1 inbound group-sums and emit my tile.
+            for h in range(n_o - 1):
+                pltpu.sync_copy(
+                    opart.at[h, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
+                    tmp_v)
+                acc_v[...] = acc_v[...] + tmp_v[...]
+            out_v[...] = acc_v[...].astype(out_v.dtype)
+            pltpu.sync_copy(out_v, o_ref.at[pl.ds(i * tm, tm),
+                                            pl.ds(j * tn, tn)])
+
+    last = jnp.logical_and(q == n_o * n_in - 1, jnp.logical_and(
+        i == n_i - 1, jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(last)
+    def _():
+        if n_in > 1:
+            for w in range(n_o * (n_in - 1)):
+                dl.wait_arrivals(isend.at[w], recv_hbm.at[0], 1)
+        for h in range(n_o - 1):
+            dl.wait_arrivals(osend.at[h], opart.at[0], 1)
+
+
+def _gemm_rs_2d(a, b, ctx: GemmRSContext):
+    """Host wrapper: ``ctx.axis`` is an ``(outer, inner)`` tuple."""
+    outer_axis, inner_axis = ctx.axis
+    mesh = ctx.mesh
+    n_o = mesh.size(outer_axis)
+    n_in = mesh.size(inner_axis)
+    n = n_o * n_in
+    m_full, k_loc = a.shape
+    _, n_dim = b.shape
+    out_dtype = ctx.out_dtype or a.dtype
+    if n_o == 1:
+        return gemm_rs(a, b, dataclasses.replace(ctx, axis=inner_axis))
+    if m_full % n:
+        raise ValueError(f"M={m_full} not divisible by mesh size {n}")
+    m_loc = m_full // n
+    tm, tn, tk, n_i, n_j, n_k = _rs_blocks(ctx, m_loc, n_dim, k_loc)
+
+    def a_index(q, i, j, kk):
+        o = jax.lax.axis_index(outer_axis)
+        ii = jax.lax.axis_index(inner_axis)
+        t = jax.lax.div(q, n_in)
+        s = jax.lax.rem(q, n_in)
+        o_dst = jax.lax.rem(o + n_o - 1 - t, n_o)
+        c = jax.lax.rem(ii - s - 1 + n_in, n_in)
+        return ((o_dst * n_in + c) * n_i + i, kk)
+
+    kernel = functools.partial(
+        _gemm_rs_2d_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
+        ctx=mesh, tm=tm, tn=tn, n_in=n_in, n_o=n_o)
+
+    n_islots = max(n_o * (n_in - 1), 1)
+    out, *_ = core_call(
+        kernel,
+        comm=True,
+        grid=(n_o * n_in, n_i, n_j, n_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+            jax.ShapeDtypeStruct((n_islots, m_loc, n_dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_islots, m_loc, n_dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_o - 1, m_loc, n_dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_o - 1, m_loc, n_dim), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tn), lambda q, i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                        for _ in range(5)),
+        scratch_shapes=[
+            pltpu.VMEM((tm, tn), jnp.float32),               # acc_v
+            pltpu.VMEM((tm, tn), jnp.float32),               # tmp_v
+            pltpu.VMEM((tm, tn), out_dtype),                 # out_v
+            pltpu.SemaphoreType.DMA((n_islots,)),            # isend
+            pltpu.SemaphoreType.DMA((n_islots,)),            # irecv
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),     # osend
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1),)),     # orecv
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_full * k_loc * n_dim,
+            bytes_accessed=(m_full * k_loc + k_loc * n_dim * n * n_i
+                            + m_loc * n_dim) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+    )(a, b)
+    return out
+
+
 def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
             sim_ranks: int = 0):
     """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``.
@@ -167,7 +382,18 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
     and traffic; the output is the FULL (M, N) local GEMM (received
     partials are runtime-weighted to zero so every chunk stays
     verifiable). What bench.py measures on one chip.
+
+    ``ctx.axis`` may be an ``(outer, inner)`` tuple for the
+    hierarchical dcn x ici form (reference inter-node GEMM+RS): inner
+    rings reduce per-group sums which cross the outer link once each
+    (see :func:`_gemm_rs_2d_kernel`).
     """
+    if isinstance(ctx.axis, (tuple, list)):
+        if sim_ranks or force_kernel:
+            raise ValueError("sim_ranks/force_kernel apply to the "
+                             "single-axis form only")
+        return _gemm_rs_2d(a, b, dataclasses.replace(
+            ctx, axis=tuple(ctx.axis)))
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m_full, k_loc = a.shape
@@ -187,14 +413,7 @@ def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
     if m_full % n:
         raise ValueError(f"M={m_full} not divisible by axis size {n}")
     m_loc = m_full // n
-    tm = min(ctx.block_m, m_loc)
-    tn = min(ctx.block_n, n_dim)
-    tk = min(ctx.block_k, k_loc)
-    if m_loc % tm or n_dim % tn or k_loc % tk:
-        raise ValueError(
-            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
-            f"divide (M_loc={m_loc}, N={n_dim}, K_loc={k_loc})")
-    n_i, n_j, n_k = m_loc // tm, n_dim // tn, k_loc // tk
+    tm, tn, tk, n_i, n_j, n_k = _rs_blocks(ctx, m_loc, n_dim, k_loc)
 
     def a_index(s, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)
