@@ -168,3 +168,41 @@ def test_pipeline_forward_relay(tp8_mesh, tp8_ctx):
     out = f(x)
     expected = x + sum(range(1, 9))
     assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_sp_flash_decode_layer_fused_matches_xla(tp8_mesh, tp8_ctx):
+    """fused=True (one-kernel head-major decode) must match the XLA
+    composition layer path on the same logical cache."""
+    params = tp_attn.init(jax.random.PRNGKey(2), CFG)
+    b, t_loc = 2, 8
+    kvh, hd = CFG.num_key_value_heads, CFG.head_dim
+    x = _rand((b, CFG.hidden_size), 3)
+    k_cache = _rand((b, 8 * t_loc, kvh, hd), 4)
+    v_cache = _rand((b, 8 * t_loc, kvh, hd), 5)
+    cache_len = jnp.asarray(37, jnp.int32)
+
+    f = spmd(tp8_mesh,
+             lambda p, xx, kc, vc: sp_flash_decode.fwd(
+                 p, xx, CFG, kc, vc, cache_len, axis="tp"),
+             (ulysses_sp.param_specs(), P(None, None),
+              P(None, "tp", None, None), P(None, "tp", None, None)),
+             (P(None, None), (P(None, "tp", None, None),
+                              P(None, "tp", None, None))))
+    y_ref, (kc_ref, _) = f(params, x, k_cache, v_cache)
+
+    # Same caches in head-major layout through the fused kernel.
+    k_hm = jnp.transpose(k_cache, (0, 2, 1, 3))
+    v_hm = jnp.transpose(v_cache, (0, 2, 1, 3))
+    g = spmd(tp8_mesh,
+             lambda p, xx, kc, vc: sp_flash_decode.fwd(
+                 p, xx, CFG, kc, vc, cache_len, axis="tp", fused=True,
+                 ctx=tp8_ctx, page=8),
+             (ulysses_sp.param_specs(), P(None, None),
+              P(None, None, "tp", None), P(None, None, "tp", None)),
+             (P(None, None), (P(None, None, "tp", None),
+                              P(None, None, "tp", None))))
+    y_fused, (kc_hm2, _) = g(params, x, k_hm, v_hm)
+    assert_allclose(y_fused, y_ref, rtol=2e-4, atol=2e-4)
+    # Same cache content in the other layout after the append.
+    assert_allclose(jnp.transpose(kc_hm2, (0, 2, 1, 3)), kc_ref,
+                    rtol=1e-6, atol=1e-6)

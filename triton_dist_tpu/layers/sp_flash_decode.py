@@ -19,7 +19,8 @@ from triton_dist_tpu.ops.flash_decode import sp_flash_decode
 init = None  # uses tp_attn-style params passed by the caller
 
 
-def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp"):
+def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp",
+        fused: bool = False, ctx=None, page: int = 128):
     """One decode step with a sequence-sharded cache.
 
     x: (B, d) replicated along ``axis``; caches (B, T_loc, KV, hd) —
@@ -35,6 +36,13 @@ def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp"):
     capacity no rank owns the append slot (owner == n) and the newest
     token's KV would be silently dropped — callers must size caches or
     guard the step count (as ``Engine.decode`` does for the TP cache).
+
+    ``fused=True``: caches are HEAD-MAJOR (B, KV, T_loc, hd) and the
+    attention step runs as ONE Pallas kernel (online softmax + in-kernel
+    RDMA partial exchange, :func:`ops.sp_flash_decode_fused`) instead of
+    the pmax+2psum XLA composition. ``page`` tiles T_loc through VMEM
+    (min(page, T_loc) is used; T_loc must divide evenly). ``ctx`` (a
+    MeshContext) is required for tuple ``axis`` under ``fused``.
     """
     from triton_dist_tpu.parallel.mesh import flat_axis_rank
 
@@ -46,7 +54,7 @@ def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp"):
     hd = cfg.head_dim
     h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
     b = x.shape[0]
-    t_loc = k_cache.shape[1]
+    t_loc = k_cache.shape[2] if fused else k_cache.shape[1]
 
     q = jnp.dot(x, params["wq"]).reshape(b, 1, h, hd)
     k = jnp.dot(x, params["wk"]).reshape(b, 1, kvh, hd)
@@ -59,20 +67,33 @@ def fwd(params, x, cfg, k_cache, v_cache, cache_len, *, axis="sp"):
     owner = cache_len // t_loc
     local_slot = cache_len - owner * t_loc
     is_owner = owner == me
-    upd_k = jnp.where(is_owner, k.astype(k_cache.dtype),
-                      jax.lax.dynamic_slice(
-                          k_cache, (0, local_slot, 0, 0),
-                          (b, 1, kvh, hd)))
-    upd_v = jnp.where(is_owner, v.astype(v_cache.dtype),
-                      jax.lax.dynamic_slice(
-                          v_cache, (0, local_slot, 0, 0),
-                          (b, 1, kvh, hd)))
-    k_cache = jax.lax.dynamic_update_slice(k_cache, upd_k,
-                                           (0, local_slot, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, upd_v,
-                                           (0, local_slot, 0, 0))
-
     kv_len = jnp.full((b,), cache_len + 1, jnp.int32)
-    o = sp_flash_decode(q[:, 0], k_cache, v_cache, kv_len, axis=axis)
+
+    def append(cache, new, idx, sizes):
+        """Owner-gated append at ``idx`` (non-owners rewrite the
+        existing slice — a no-op that keeps the SPMD step uniform)."""
+        upd = jnp.where(is_owner, new.astype(cache.dtype),
+                        jax.lax.dynamic_slice(cache, idx, sizes))
+        return jax.lax.dynamic_update_slice(cache, upd, idx)
+
+    if fused:
+        # Head-major caches: the new token is a (B, KV, 1, hd) slice.
+        idx, sizes = (0, 0, local_slot, 0), (b, kvh, 1, hd)
+        k_cache = append(k_cache, jnp.transpose(k, (0, 2, 1, 3)), idx,
+                         sizes)
+        v_cache = append(v_cache, jnp.transpose(v, (0, 2, 1, 3)), idx,
+                         sizes)
+        from triton_dist_tpu.ops.paged_flash_decode import (
+            sp_flash_decode_fused,
+        )
+
+        o = sp_flash_decode_fused(q[:, 0], k_cache, v_cache, kv_len,
+                                  ctx=ctx, axis=axis,
+                                  page=min(page, t_loc))
+    else:
+        idx, sizes = (0, local_slot, 0, 0), (b, 1, kvh, hd)
+        k_cache = append(k_cache, k, idx, sizes)
+        v_cache = append(v_cache, v, idx, sizes)
+        o = sp_flash_decode(q[:, 0], k_cache, v_cache, kv_len, axis=axis)
     y = jnp.dot(o.reshape(b, h * hd), params["wo"]).astype(x.dtype)
     return y, (k_cache, v_cache)

@@ -64,17 +64,59 @@ def page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int):
     return m_new, l_new, acc_new
 
 
-def _decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
-                   part_gather, kpage, vpage, m_l, acc_s, part_stage,
-                   gather_v, psem, send_sem, recv_sem, *, axis: str,
-                   ctx: MeshContext, n_ranks: int, page: int, p_max: int,
-                   kvh: int, rep: int, hd: int, shard_len: int):
+def _lse_reduce(parts, hd: int):
+    """Log-sum-exp combine of flash partials: parts (r, B, H, 2+hd)
+    [acc | m | l] → one combined partial (B, H, 2+hd). Associative —
+    the hierarchical (inner-then-outer) exchange reduces in two stages
+    (reference intra/inter-rank combine pair, flash_decode.py:393/482).
+    """
+    m_r = parts[:, :, :, hd:hd + 1]
+    l_r = parts[:, :, :, hd + 1:hd + 2]
+    acc_r = parts[:, :, :, :hd]
+    m_g = jnp.max(m_r, axis=0, keepdims=True)
+    m_g_safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
+    corr = jnp.where(jnp.isfinite(m_r), jnp.exp(m_r - m_g_safe), 0.0)
+    l_tot = jnp.sum(l_r * corr, axis=0)
+    acc_tot = jnp.sum(acc_r * corr, axis=0)
+    return jnp.concatenate([acc_tot, m_g[0], l_tot], axis=-1)
+
+
+def _decode_kernel(*refs, axes, ctx: MeshContext, page: int, p_max: int,
+                   kvh: int, rep: int, hd: int, shard_len: int,
+                   paged: bool, sim: bool):
+    """``axes``: list of (axis_name, n_ax) exchange stages, innermost
+    first (1 entry = flat; 2 = hierarchical outer x inner, where the
+    flat shard order is outer-major). ``paged=False`` reads a dense
+    head-major (B, KV, T_loc, hd) cache with pages carved from T_loc.
+    ``sim=True``: self-targeted puts at full schedule/traffic (every
+    gather slot receives my own partial; the LSE-combine of n identical
+    partials is exact) — the single-chip bench proxy."""
+    if paged:
+        (table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+         part_gather) = refs[:7]
+        scratch = refs[7:]
+    else:
+        table_ref = None
+        len_ref, q_ref, kp_ref, vp_ref, o_ref, part_gather = refs[:6]
+        scratch = refs[6:]
+    (kpage, vpage, m_l, acc_s, part_stage, gather_v, psem, send_sem,
+     recv_sem) = scratch
+
     b = pl.program_id(0)
     p = pl.program_id(1)
     n_b = pl.num_programs(0)
-    n = n_ranks
-    me = dl.rank(axis) if n > 1 else 0
+    n = 1
+    for _, n_ax in axes:
+        n *= n_ax
     h = kvh * rep
+    # Flat rank over the exchange axes (outer-major for 2 stages;
+    # ``axes`` lists innermost first).
+    if sim or n == 1:
+        me = 0
+    elif len(axes) == 2:
+        me = dl.rank(axes[1][0]) * axes[0][1] + dl.rank(axes[0][0])
+    else:
+        me = dl.rank(axes[0][0])
     off = me * shard_len          # my shard's global position offset
 
     # Page p of batch b lives at pool slot table[b, p]. Pages past this
@@ -85,11 +127,17 @@ def _decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
     par = jax.lax.rem(lin, 2)
 
     def load(b2, p2, buf):
-        pid = table_ref[b2, p2]
-        pltpu.make_async_copy(kp_ref.at[pid], kpage.at[buf],
-                              psem.at[buf]).start()
-        pltpu.make_async_copy(vp_ref.at[pid], vpage.at[buf],
-                              psem.at[buf]).start()
+        if paged:
+            pid = table_ref[b2, p2]
+            ksrc = kp_ref.at[pid]
+            vsrc = vp_ref.at[pid]
+        else:
+            # Dense head-major cache: page p2 is a T_loc slice — the
+            # (KV, page, hd) block feeds page_attend with no transpose.
+            ksrc = kp_ref.at[b2, :, pl.ds(p2 * page, page)]
+            vsrc = vp_ref.at[b2, :, pl.ds(p2 * page, page)]
+        pltpu.make_async_copy(ksrc, kpage.at[buf], psem.at[buf]).start()
+        pltpu.make_async_copy(vsrc, vpage.at[buf], psem.at[buf]).start()
 
     @pl.when(jnp.logical_and(active, lin == 0))
     def _():
@@ -143,58 +191,61 @@ def _decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
 
         @pl.when(b == n_b - 1)
         def _():
-            if n > 1:
-                dl.barrier_all(axis, ctx=ctx)
-                for offp in range(1, n):
-                    peer = jax.lax.rem(me + offp, n)
-                    dl.remote_put(part_stage, part_gather.at[me],
-                                  send_sem.at[offp - 1],
-                                  recv_sem, peer, axis=axis, ctx=ctx)
-                # My own partial straight into the reduce staging; the
-                # peers' land in HBM and are staged after the waits.
-                dl.wait_arrivals(recv_sem, part_stage, n - 1)
-                for offp in range(n - 1):
-                    dl.wait_arrivals(send_sem.at[offp], part_stage, 1)
-                pltpu.make_async_copy(part_gather, gather_v,
+            # Exchange + LSE-reduce, one stage per axis (innermost
+            # first: intra-slice partials merge before a single small
+            # DCN hop per outer peer — reference intra/inter-rank
+            # combine kernels, flash_decode.py:393-482).
+            sem_base = 0
+            for ax, n_ax in axes:
+                if n_ax == 1:
+                    continue
+                me_ax = 0 if sim else dl.rank(ax)
+                dl.barrier_all(ax, ctx=ctx)
+                for offp in range(1, n_ax):
+                    if sim:
+                        # Self-puts: every slot receives my partial.
+                        dl.remote_put(part_stage, part_gather.at[offp],
+                                      send_sem.at[sem_base + offp - 1],
+                                      recv_sem, me_ax, axis=ax, ctx=ctx)
+                    else:
+                        peer = jax.lax.rem(me_ax + offp, n_ax)
+                        dl.remote_put(part_stage, part_gather.at[me_ax],
+                                      send_sem.at[sem_base + offp - 1],
+                                      recv_sem, peer, axis=ax, ctx=ctx)
+                dl.wait_arrivals(recv_sem, part_stage, n_ax - 1)
+                for offp in range(n_ax - 1):
+                    dl.wait_arrivals(send_sem.at[sem_base + offp],
+                                     part_stage, 1)
+                sem_base += n_ax - 1
+                pltpu.make_async_copy(part_gather.at[pl.ds(0, n_ax)],
+                                      gather_v.at[pl.ds(0, n_ax)],
                                       psem.at[0]).start()
-                pltpu.make_async_copy(gather_v, gather_v,
+                pltpu.make_async_copy(gather_v.at[pl.ds(0, n_ax)],
+                                      gather_v.at[pl.ds(0, n_ax)],
                                       psem.at[0]).wait()
-            gather_v[me] = part_stage[...]
+                gather_v[0 if sim else me_ax] = part_stage[...]
+                # Stage's combined partial becomes the next stage's
+                # (or the final divide's) input.
+                part_stage[...] = _lse_reduce(
+                    gather_v[pl.ds(0, n_ax)], hd)
 
-            # Log-sum-exp combine across ranks (reference combine
-            # kernels, flash_decode.py:393-482), then the final divide.
-            m_r = gather_v[:, :, :, hd:hd + 1]         # (n, B, H, 1)
-            l_r = gather_v[:, :, :, hd + 1:hd + 2]
-            acc_r = gather_v[:, :, :, :hd]             # (n, B, H, hd)
-            m_g = jnp.max(m_r, axis=0, keepdims=True)  # (1, B, H, 1)
-            m_g_safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
-            corr = jnp.where(jnp.isfinite(m_r),
-                             jnp.exp(m_r - m_g_safe), 0.0)
-            l_tot = jnp.sum(l_r * corr, axis=0)        # (B, H, 1)
-            acc_tot = jnp.sum(acc_r * corr, axis=0)    # (B, H, hd)
-            out = acc_tot / jnp.maximum(l_tot, 1e-30)
+            out = (part_stage[:, :, :hd]
+                   / jnp.maximum(part_stage[:, :, hd + 1:hd + 2], 1e-30))
             o_ref[...] = out.astype(o_ref.dtype)
 
 
-def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
-                       ctx: MeshContext = None, axis: str = "sp"):
-    """Distributed paged-KV GQA decode step (call inside shard_map).
-
-    q: (B, H, hd) replicated along ``axis``;
-    k_pages/v_pages: (num_pages, KV, page, hd) — this rank's page pool
-    (head-major pages);
-    block_table: (B, P_max) int32 page ids into the local pool (rank r's
-    pages hold the global positions [r·P_max·page, (r+1)·P_max·page));
-    kv_len: (B,) int32 *global* valid lengths (ragged per batch).
-    Lengths beyond the total pool capacity (n·P_max·page) are an error
-    — positions past capacity would be silently dropped otherwise, so
-    concrete inputs are validated here.
-    Returns (B, H, hd).
-    """
-    b, h, hd = q.shape
-    _, kvh, page, _ = k_pages.shape
-    p_max = block_table.shape[1]
-    rep = h // kvh
+def _normalize_axes(axis, ctx, sim_ranks):
+    """→ (axes innermost-first [(name, n)], total n, sim flag)."""
+    if sim_ranks and sim_ranks > 1:
+        return [(axis if isinstance(axis, str) else axis[-1],
+                 sim_ranks)], sim_ranks, True
+    if isinstance(axis, (tuple, list)):
+        outer, inner = axis
+        n_o = ctx.size(outer) if ctx is not None else (
+            jax.lax.axis_size(outer))
+        n_in = ctx.size(inner) if ctx is not None else (
+            jax.lax.axis_size(inner))
+        return [(inner, n_in), (outer, n_o)], n_o * n_in, False
     if ctx is not None:
         n = ctx.size(axis)
     else:
@@ -206,19 +257,46 @@ def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
             n = jax.lax.axis_size(axis)
         except (NameError, KeyError):
             n = 1
+    return [(axis, n)], n, False
+
+
+def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
+                 page, p_max, paged, sim_ranks=0):
+    """Shared host plumbing for the paged and dense decode kernels."""
+    b, h, hd = q.shape
+    kvh = k_arr.shape[1]
+    rep = h // kvh
+    axes, n, sim = _normalize_axes(axis, ctx, sim_ranks)
     shard_len = p_max * page
     if not isinstance(kv_len, jax.core.Tracer):
         import numpy as _np
 
-        if int(_np.max(_np.asarray(kv_len))) > n * shard_len:
+        cap = shard_len if sim else n * shard_len
+        if int(_np.max(_np.asarray(kv_len))) > cap:
+            layout = (f"sim: local pool only, {p_max} pages x {page}"
+                      if sim else f"{n} ranks x {p_max} pages x {page}")
             raise ValueError(
                 f"kv_len max {int(_np.max(_np.asarray(kv_len)))} exceeds "
-                f"pool capacity {n * shard_len} ({n} ranks x {p_max} "
-                f"pages x {page})")
+                f"pool capacity {cap} ({layout})")
 
     kernel = functools.partial(
-        _decode_kernel, axis=axis, ctx=ctx, n_ranks=n, page=page,
-        p_max=p_max, kvh=kvh, rep=rep, hd=hd, shard_len=shard_len)
+        _decode_kernel, axes=axes, ctx=ctx, page=page, p_max=p_max,
+        kvh=kvh, rep=rep, hd=hd, shard_len=shard_len, paged=paged,
+        sim=sim)
+
+    n_sem = max(sum(n_ax - 1 for _, n_ax in axes), 1)
+    n_slots = max(max(n_ax for _, n_ax in axes), 1)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),     # kv_len
+        pl.BlockSpec((1, b, h, hd), lambda bb, pp: (0, 0, 0, 0),
+                     memory_space=pltpu.VMEM),     # q (whole)
+        pl.BlockSpec(memory_space=pl.ANY),         # k pool / cache
+        pl.BlockSpec(memory_space=pl.ANY),         # v pool / cache
+    ]
+    operands = [kv_len.astype(jnp.int32), q[None], k_arr, v_arr]
+    if paged:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, block_table.astype(jnp.int32))
 
     out, _ = core_call(
         kernel,
@@ -226,38 +304,86 @@ def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
         grid=(b, p_max),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, hd), q.dtype),
-            jax.ShapeDtypeStruct((max(n, 1), b, h, 2 + hd), jnp.float32),
+            jax.ShapeDtypeStruct((n_slots, b, h, 2 + hd), jnp.float32),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),     # block_table
-            pl.BlockSpec(memory_space=pltpu.SMEM),     # kv_len
-            pl.BlockSpec((1, b, h, hd), lambda bb, pp: (0, 0, 0, 0),
-                         memory_space=pltpu.VMEM),     # q (whole)
-            pl.BlockSpec(memory_space=pl.ANY),         # k page pool
-            pl.BlockSpec(memory_space=pl.ANY),         # v page pool
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((b, h, hd), lambda bb, pp: (0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.HBM),      # partial gather
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, kvh, page, hd), k_pages.dtype),  # kpage x2
-            pltpu.VMEM((2, kvh, page, hd), v_pages.dtype),  # vpage x2
+            pltpu.VMEM((2, kvh, page, hd), k_arr.dtype),  # kpage x2
+            pltpu.VMEM((2, kvh, page, hd), v_arr.dtype),  # vpage x2
             pltpu.VMEM((h, 2), jnp.float32),              # m | l
             pltpu.VMEM((h, hd), jnp.float32),             # acc
             pltpu.VMEM((b, h, 2 + hd), jnp.float32),      # part_stage
-            pltpu.VMEM((max(n, 1), b, h, 2 + hd), jnp.float32),
+            pltpu.VMEM((n_slots, b, h, 2 + hd), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),                # page loads
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),    # sends
+            pltpu.SemaphoreType.DMA((n_sem,)),            # sends
             pltpu.SemaphoreType.DMA(()),                  # recv
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * hd * shard_len,
             bytes_accessed=2 * b * shard_len * kvh * hd
-            * k_pages.dtype.itemsize,
+            * k_arr.dtype.itemsize,
             transcendentals=b * h * shard_len,
         ),
-    )(block_table.astype(jnp.int32), kv_len.astype(jnp.int32), q[None],
-      k_pages, v_pages)
+    )(*operands)
     return out
+
+
+def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
+                       ctx: MeshContext = None, axis="sp"):
+    """Distributed paged-KV GQA decode step (call inside shard_map).
+
+    q: (B, H, hd) replicated along ``axis``;
+    k_pages/v_pages: (num_pages, KV, page, hd) — this rank's page pool
+    (head-major pages);
+    block_table: (B, P_max) int32 page ids into the local pool (rank r's
+    pages hold the global positions [r·P_max·page, (r+1)·P_max·page));
+    kv_len: (B,) int32 *global* valid lengths (ragged per batch).
+    Lengths beyond the total pool capacity (n·P_max·page) are an error
+    — positions past capacity would be silently dropped otherwise, so
+    concrete inputs are validated here.
+    ``axis`` may be an ``(outer, inner)`` tuple for MULTI-SLICE decode:
+    shards in outer-major flat order; the in-kernel partial exchange
+    runs inner-axis first, so only one already-combined partial per
+    outer peer crosses the slow link.
+    Returns (B, H, hd).
+    """
+    _, kvh, page, _ = k_pages.shape
+    p_max = block_table.shape[1]
+    return _decode_call(q, k_pages, v_pages, block_table, kv_len,
+                        ctx=ctx, axis=axis, page=page, p_max=p_max,
+                        paged=True)
+
+
+def sp_flash_decode_fused(q, k_cache, v_cache, kv_len, *,
+                          ctx: MeshContext = None, axis="sp",
+                          page: int = 128, sim_ranks: int = 0):
+    """Fused distributed split-KV decode over a DENSE head-major cache
+    — one kernel per decode step (online softmax + in-kernel RDMA
+    partial exchange), replacing the pmax+2×psum XLA composition of
+    :func:`~triton_dist_tpu.ops.flash_decode.sp_flash_decode`.
+
+    q: (B, H, hd) replicated along ``axis``;
+    k_cache/v_cache: (B, KV, T_loc, hd) — this rank's contiguous
+    HEAD-MAJOR slice of the global cache (rank r holds global positions
+    [r·T_loc, (r+1)·T_loc), outer-major flat order for tuple ``axis``);
+    kv_len: (B,) int32 global valid lengths. ``page`` tiles T_loc
+    through VMEM (T_loc % page == 0 required).
+
+    ``sim_ranks > 1`` (single-chip bench proxy): full exchange schedule
+    with self-targeted puts — every gather slot carries this rank's own
+    partial, whose LSE-combine is exactly the local result.
+
+    Reference: persistent split-KV kernels + combine,
+    ``flash_decode.py:587-1095`` (the 1→32-GPU scaling headline).
+    """
+    t_loc = k_cache.shape[2]
+    if t_loc % page:
+        raise ValueError(f"T_loc={t_loc} not divisible by page={page}")
+    return _decode_call(q, k_cache, v_cache, None, kv_len, ctx=ctx,
+                        axis=axis, page=page, p_max=t_loc // page,
+                        paged=False, sim_ranks=sim_ranks)
