@@ -403,3 +403,62 @@ def test_tp_moe_layer_fused_epilogue(tp8_mesh, tp8_ctx):
             P("tp", None))(params, tokens)
 
     assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-4)
+
+
+def test_ep_dropfree_recv_capacity_envelope(tp8_mesh, tp8_ctx):
+    """Splits-sized drop-free mode: a static receive envelope far below
+    n*T*K. Memory is proportional to the envelope (asserted on the
+    receive buffer shape), and with the envelope >= the actual skewed
+    receives the result is exactly the unclamped one."""
+    T, d, E, K = 16, 32, 16, 2
+    R = 3 * T * K  # 96 rows vs worst case n*T*K = 256
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K, axis="tp",
+                            recv_capacity=R)
+    tokens = _rand((8 * T, d), 60)
+    # Uniform routing: each rank receives ~T*K rows — well under R.
+    ids = jax.random.randint(jax.random.PRNGKey(61), (8 * T, K), 0, E)
+    w = jax.nn.softmax(_rand((8 * T, K), 62), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        assert recv.shape[0] == R        # memory ∝ envelope
+        return ep_combine(recv, state, w_, ctx), state.num_dropped[None]
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)),
+             (P("tp", None), P("tp")))
+    out, dropped = f(tokens, ids, w)
+    assert int(np.sum(np.asarray(dropped))) == 0
+    expected = tokens * jnp.sum(w, axis=-1, keepdims=True)
+    assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_ep_dropfree_recv_capacity_overflow_cut(tp8_mesh, tp8_ctx):
+    """Adversarial skew overflowing the envelope: every assignment on
+    every rank routes to rank 0's experts (8*T*K = 256 receives there),
+    with an envelope of 80. The cut is deterministic (tail sources
+    first), counted, and the combine still returns the exact weighted
+    sum over the assignments that DID travel."""
+    T, d, E, K = 16, 32, 16, 2
+    R = 80
+    ctx = create_ep_context(tp8_ctx, num_experts=E, topk=K, axis="tp",
+                            recv_capacity=R)
+    tokens = _rand((8 * T, d), 63)
+    ids = jax.random.randint(jax.random.PRNGKey(64), (8 * T, K), 0, 2)
+    w = jax.nn.softmax(_rand((8 * T, K), 65), axis=-1)
+
+    def run(tok, ids_, w_):
+        recv, rexp, state = ep_dispatch(tok, ids_, ctx)
+        out = ep_combine(recv, state, w_, ctx)
+        return out, state.num_dropped[None], state.valid
+
+    f = spmd(tp8_mesh, run,
+             (P("tp", None), P("tp", None), P("tp", None)),
+             (P("tp", None), P("tp"), P("tp", None)))
+    out, dropped, valid = f(tokens, ids, w)
+    total_dropped = int(np.sum(np.asarray(dropped)))
+    assert total_dropped == 8 * 8 * T * K // 8 - R * 1  # 256 - 80 = 176
+    # Identity experts: surviving assignments contribute w * token.
+    expected = tokens * jnp.sum(
+        jnp.where(valid, w, 0.0), axis=-1, keepdims=True)
+    assert_allclose(out, expected, rtol=1e-5, atol=1e-5)

@@ -53,6 +53,12 @@ class EPContext:
     # None (default): drop-free ragged dispatch sized from exact splits.
     # int C: capped mode, max C tokens per (src rank, dst rank) pair.
     capacity: Optional[int] = None
+    # Drop-free mode's TOTAL receive-row envelope (default n·T·K, the
+    # provable worst case). A smaller static envelope shrinks the
+    # receive buffer and grouped-GEMM row space to ~actual-splits
+    # scale; sends are deterministically clamped to fit, with cut
+    # assignments counted in state.num_dropped.
+    recv_capacity: Optional[int] = None
     impl: str = "pallas"  # "pallas" | "xla" transport (capped mode)
     # On-wire quantization (reference low-latency a2a v2's optional fp8
     # online quant): tokens travel as wire_dtype with per-token scales.
@@ -65,27 +71,31 @@ class EPContext:
 
 def create_ep_context(mesh: MeshContext, *, num_experts: int, topk: int,
                       capacity: Optional[int] = None, axis: str = "ep",
-                      impl: str = "pallas",
-                      wire_dtype=None) -> EPContext:
+                      impl: str = "pallas", wire_dtype=None,
+                      recv_capacity: Optional[int] = None) -> EPContext:
     """Build the EP dispatch/combine context.
 
-    MEMORY SCALING of the drop-free default (``capacity=None``): the
-    receive buffer and grouped-GEMM row space are statically sized at
-    the worst case ``n_ranks * T * topk`` rows per rank (XLA needs
-    static shapes; the reference sizes transfers from the exchanged
-    splits at runtime instead). At production scale this is multi-GB —
-    e.g. 64-rank EP, T=4096, topk=10, d=2048 bf16 ≈ 10 GB — so large
-    meshes should pass an explicit ``capacity`` (max tokens per
-    (src, dst) rank pair, with counted drops) or keep per-rank T small.
-    The hierarchical 2D path (``ep_dispatch_2d``) reduces the factor to
-    the ICI group size for the intra-slice hop.
+    MEMORY SCALING of the drop-free default (``capacity=None``): with
+    ``recv_capacity=None`` the receive buffer and grouped-GEMM row
+    space are statically sized at the worst case ``n_ranks * T * topk``
+    rows per rank — provably drop-free, but multi-GB at production
+    scale (64-rank EP, T=4096, topk=10, d=2048 bf16 ≈ 10 GB). Pass
+    ``recv_capacity=R`` to bound the receive rows at a static envelope
+    sized for the EXPECTED load (e.g. a few × T·topk): the exact splits
+    are still exchanged first and only real tokens travel — the
+    reference's splits-sized transfers under XLA static shapes
+    (``ep_a2a.py`` splits exchange; ``low_latency_all_to_all_v2.py:628``)
+    — and in the rare step whose receives exceed R, the overflow is
+    deterministically cut and counted (``state.num_dropped``), never
+    corrupted. The legacy per-pair ``capacity`` mode and the
+    hierarchical 2D path remain as alternatives.
     """
     if num_experts % mesh.size(axis):
         raise ValueError(
             f"num_experts={num_experts} not divisible by ep={mesh.size(axis)}")
     return EPContext(mesh=mesh, axis=axis, num_experts=num_experts,
                      topk=topk, capacity=capacity, impl=impl,
-                     wire_dtype=wire_dtype)
+                     wire_dtype=wire_dtype, recv_capacity=recv_capacity)
 
 
 @dataclasses.dataclass
@@ -115,20 +125,17 @@ jax.tree_util.register_pytree_node(
 class RaggedDispatchState:
     """Routing metadata for the drop-free (dynamic splits) mode.
 
-    perm: (T*K,) stable sort of assignments by destination rank (the
-    send order); counts_mat: (n, n) exact global splits, C[s, d] =
-    number of (token, k) assignments source s routed to destination d
-    — the TPU-resident form of the reference's exchanged splits cumsum.
-    num_dropped is always 0 (kept for API parity with DispatchState).
+    exchange: the hop's :class:`ExchangeState` (sort permutation +
+    traveled/original splits matrices — the TPU-resident form of the
+    reference's exchanged splits cumsum). num_dropped is structurally 0
+    unless a ``recv_capacity`` envelope cut assignments.
     """
-    perm: jax.Array
-    counts_mat: jax.Array
-    valid: jax.Array        # (T, K) all-True
+    exchange: "ExchangeState"
+    valid: jax.Array        # (T, K) sent status per assignment
     num_dropped: jax.Array = None
 
     def tree_flatten(self):
-        return (self.perm, self.counts_mat, self.valid,
-                self.num_dropped), None
+        return (self.exchange, self.valid, self.num_dropped), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -145,7 +152,8 @@ def _excl_cumsum(x):
         [jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
 
 
-def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis):
+def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis,
+                local_out_off=None):
     """Ragged all-to-all with packed-by-source-rank output layout.
 
     On TPU this is one ``ragged-all-to-all`` HLO — only the real rows
@@ -153,10 +161,12 @@ def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis):
     (the 8-device CPU test mesh, the driver's dryrun) the same
     semantics are emulated with a dense tiled all-to-all padded to the
     worst case per pair; numerics are identical, only the wire padding
-    differs. ``out_off`` must describe the packed-by-source layout
-    (offset of my chunk in dst's buffer = packed prefix of earlier
-    sources), which is what both callers construct — the emulation
-    produces exactly that layout directly.
+    differs. ``out_off`` follows the HLO's destination-indexed
+    semantics (where MY chunk lands on each peer); the emulation
+    instead needs ``local_out_off`` — the source-indexed offsets where
+    each peer's chunk lands in MY buffer (defaults to the packed
+    prefix of ``recv_sz``; the return hop under a clamped envelope
+    passes its non-packed original segment offsets).
     """
     if jax.default_backend() == "tpu":
         return jax.lax.ragged_all_to_all(
@@ -175,21 +185,33 @@ def _ragged_a2a(operand, out, in_off, send_sz, out_off, recv_sz, axis):
     buf = buf.at[dst, jnp.where(v_send, pos, s_rows)].set(
         operand, mode="drop")
     recv = all_to_all_ref(buf, axis=axis)        # (n, s_rows, ...)
-    roff = _excl_cumsum(recv_sz)
+    if local_out_off is None:
+        local_out_off = _excl_cumsum(recv_sz)
     p = jnp.arange(s_rows)[None, :]
-    tgt = jnp.where(p < recv_sz[:, None], roff[:, None] + p, r_rows)
+    tgt = jnp.where(p < recv_sz[:, None], local_out_off[:, None] + p,
+                    r_rows)
     return out.at[tgt.reshape(-1)].set(
         recv.reshape((n * s_rows,) + operand.shape[1:]), mode="drop")
 
 
 @dataclasses.dataclass
 class ExchangeState:
-    """One ragged exchange hop: sort permutation + global counts."""
+    """One ragged exchange hop: sort permutation + global counts.
+
+    ``counts_mat`` holds the counts that actually TRAVELED (clamped to
+    the receive envelope when ``recv_rows`` was given);
+    ``orig_counts_mat`` the pre-clamp counts — the return hop needs it
+    to scatter rows back to each source's ORIGINAL sorted-segment
+    offsets (non-packed when rows were cut); ``sent_sorted`` marks
+    which of my sorted rows traveled."""
     perm: jax.Array        # (N,) stable sort of rows by destination
-    counts_mat: jax.Array  # (n, n) C[s, d] = rows s sent to d
+    counts_mat: jax.Array  # (n, n) C[s, d] = rows s sent to d (clamped)
+    orig_counts_mat: jax.Array  # (n, n) pre-clamp counts
+    sent_sorted: jax.Array  # (N,) bool per sorted row
 
     def tree_flatten(self):
-        return (self.perm, self.counts_mat), None
+        return (self.perm, self.counts_mat, self.orig_counts_mat,
+                self.sent_sorted), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -201,30 +223,64 @@ jax.tree_util.register_pytree_node(
     ExchangeState.tree_unflatten)
 
 
-def ragged_exchange(arrays, dst, axis: str, fills=None):
+def ragged_exchange(arrays, dst, axis: str, fills=None,
+                    recv_rows: Optional[int] = None):
     """Drop-free exchange of rows by destination index along ``axis``.
 
     arrays: tuple of (N, ...) row-aligned payloads; dst: (N,) int32
     destination (within the axis), or -1 for rows that must not travel
     (they sort to the tail and are excluded from the counts). Returns
-    (recv_arrays, state): each recv array is (n·N, ...) with valid rows
+    (recv_arrays, state): each recv array is (R, ...) with valid rows
     packed at the front in source-rank order; invalid tail rows hold
     ``fills[i]``. This is the generic hop both the flat and the
     hierarchical (ICI×DCN) EP dispatch build on.
+
+    ``recv_rows`` (default n·N, the provable worst case) statically
+    sizes the receive buffer R — the reference's splits-sized transfer
+    expressed under XLA static shapes: the exact counts are exchanged
+    FIRST (one tiny all_gather), then every rank deterministically
+    clamps its sends so each destination's packed receives fit the
+    envelope (tail sources cut first). Rows cut by the clamp do not
+    travel, come back as ``fill`` from :func:`ragged_return`, and are
+    reported via ``state.sent_sorted``; with the default envelope the
+    clamp is the identity and the hop is drop-free by construction.
     """
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     n_rows = dst.shape[0]
+    if recv_rows is None:
+        recv_rows = n * n_rows
     key = jnp.where(dst < 0, n, dst)
     perm = jnp.argsort(key, stable=True)
-    send_counts = jnp.bincount(key[perm], length=n).astype(jnp.int32)
-    counts_mat = jax.lax.all_gather(send_counts, axis)      # (n, n)
+    key_sorted = key[perm]
+    orig_counts = jnp.bincount(key_sorted, length=n).astype(jnp.int32)
+    counts_mat = jax.lax.all_gather(orig_counts, axis)      # (n, n)
+    orig_mat = counts_mat
+    in_off = _excl_cumsum(orig_counts)
 
-    in_off = _excl_cumsum(send_counts)
+    if recv_rows < n * n_rows:
+        # Clamp sends to the envelope: receives pack by source order, so
+        # destination d accepts from source s at most the room left
+        # after sources 0..s-1 — identical arithmetic on every rank.
+        prefix = jnp.concatenate(
+            [jnp.zeros((1, n), counts_mat.dtype),
+             jnp.cumsum(counts_mat, axis=0)[:-1]], axis=0)   # (n, n)
+        counts_mat = jnp.clip(
+            jnp.minimum(counts_mat, recv_rows - prefix), 0)
+    send_counts = counts_mat[rank]
+
     out_off = jnp.sum(
         jnp.where(jnp.arange(n)[:, None] < rank, counts_mat, 0), axis=0)
     recv_sz = counts_mat[:, rank]
     total = jnp.sum(recv_sz)
+
+    # Which sorted rows actually travel (position within their segment
+    # below the clamped count; dst == -1 rows never do).
+    j = jnp.arange(n_rows)
+    seg = jnp.clip(jnp.searchsorted(in_off, j, side="right") - 1, 0,
+                   n - 1)
+    sent_sorted = jnp.logical_and(key_sorted < n,
+                                  (j - in_off[seg]) < send_counts[seg])
 
     if fills is None:
         fills = tuple(0 for _ in arrays)
@@ -234,52 +290,61 @@ def ragged_exchange(arrays, dst, axis: str, fills=None):
         a = arr[perm]
         if squeeze:
             a = a[:, None]
-        out = jnp.full((n * n_rows,) + a.shape[1:], fill, a.dtype)
+        out = jnp.full((recv_rows,) + a.shape[1:], fill, a.dtype)
         r = _ragged_a2a(a, out, in_off, send_counts, out_off, recv_sz,
                         axis)
         r = jnp.where(
-            (jnp.arange(n * n_rows) < total).reshape(
+            (jnp.arange(recv_rows) < total).reshape(
                 (-1,) + (1,) * (r.ndim - 1)),
             r, jnp.asarray(fill, r.dtype))
         recv.append(r[:, 0] if squeeze else r)
-    return tuple(recv), ExchangeState(perm=perm, counts_mat=counts_mat)
+    return tuple(recv), ExchangeState(perm=perm, counts_mat=counts_mat,
+                                      orig_counts_mat=orig_mat,
+                                      sent_sorted=sent_sorted)
 
 
 def ragged_return(array, state: ExchangeState, axis: str, *,
                   out_rows: int, fill=0):
     """Reverse a :func:`ragged_exchange` hop: rows travel back to their
     source and are unsorted to the original row order. Rows that never
-    traveled (dst was -1) come back as ``fill``."""
+    traveled (dst was -1, or cut by the receive envelope) come back as
+    ``fill``."""
     n = jax.lax.axis_size(axis)
     rank = jax.lax.axis_index(axis)
     counts_mat = state.counts_mat
 
     recv_sz = counts_mat[:, rank]
     in_off = _excl_cumsum(recv_sz)
+    # Returning rows land at each source's ORIGINAL sorted-segment
+    # offsets (their pre-clamp prefix over destinations before me) —
+    # under a clamped envelope each segment's traveled prefix comes
+    # back in place and the cut tail stays ``fill``.
     out_off = jnp.sum(
-        jnp.where(jnp.arange(n)[None, :] < rank, counts_mat, 0), axis=1)
+        jnp.where(jnp.arange(n)[None, :] < rank, state.orig_counts_mat,
+                  0), axis=1)
     send_back = counts_mat[rank, :]
 
     squeeze = array.ndim == 1
     a = array[:, None] if squeeze else array
     out = jnp.full((out_rows,) + a.shape[1:], fill, a.dtype)
-    back = _ragged_a2a(a, out, in_off, recv_sz, out_off, send_back, axis)
-    # Valid rows occupy the sorted prefix; unsort. Tail (untraveled)
-    # rows keep their scatter source — mask them to fill afterwards.
-    n_valid = jnp.sum(send_back)
+    back = _ragged_a2a(a, out, in_off, recv_sz, out_off, send_back, axis,
+                       local_out_off=_excl_cumsum(
+                           state.orig_counts_mat[rank]))
+    mask = state.sent_sorted.reshape((-1,) + (1,) * (back.ndim - 1))
     unsorted = jnp.full_like(back, fill).at[state.perm].set(
-        jnp.where((jnp.arange(out_rows) < n_valid).reshape(
-            (-1,) + (1,) * (back.ndim - 1)),
-            back, jnp.asarray(fill, back.dtype)))
+        jnp.where(mask, back, jnp.asarray(fill, back.dtype)))
     return unsorted[:, 0] if squeeze else unsorted
 
 
 def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
-    """Exact-splits dispatch: zero drops by construction.
+    """Exact-splits dispatch: zero drops by construction (default), or
+    splits-sized under a static receive envelope.
 
-    One :func:`ragged_exchange` hop keyed by destination rank. The
-    receive buffer is statically sized to n·T·K rows — the provable
-    worst case (every assignment in the job routed to this rank). Only
+    One :func:`ragged_exchange` hop keyed by destination rank. With
+    ``ctx.recv_capacity=None`` the receive buffer is statically sized
+    to n·T·K rows — the provable worst case — and nothing can drop;
+    with a smaller envelope only that many rows are ever received
+    (memory ∝ envelope, not world size), overflow cut + counted. Only
     ``sum(recv_sizes)`` rows actually travel or hold data; the valid
     region is the packed prefix (sources land in rank order)."""
     t, d = tokens.shape
@@ -295,16 +360,19 @@ def _ep_dispatch_dropfree(tokens, topk_ids, ctx: EPContext):
 
         q, scale = quantize_rows(rep_tok, ctx.wire_dtype)
         (rq, rs, recv_exp), st = ragged_exchange(
-            (q, scale, local_exp), dst_rank, ctx.axis, fills=(0, 0, -1))
+            (q, scale, local_exp), dst_rank, ctx.axis, fills=(0, 0, -1),
+            recv_rows=ctx.recv_capacity)
         recv_tok = (rq.astype(jnp.float32) * rs).astype(tokens.dtype)
     else:
         (recv_tok, recv_exp), st = ragged_exchange(
-            (rep_tok, local_exp), dst_rank, ctx.axis, fills=(0, -1))
+            (rep_tok, local_exp), dst_rank, ctx.axis, fills=(0, -1),
+            recv_rows=ctx.recv_capacity)
 
+    valid = jnp.zeros((t * k,), bool).at[st.perm].set(
+        st.sent_sorted).reshape(t, k)
     state = RaggedDispatchState(
-        perm=st.perm, counts_mat=st.counts_mat,
-        valid=jnp.ones((t, k), bool),
-        num_dropped=jnp.zeros((), jnp.int32))
+        exchange=st, valid=valid,
+        num_dropped=jnp.sum(~valid).astype(jnp.int32))
     return recv_tok, recv_exp, state
 
 
@@ -315,7 +383,7 @@ def _ep_combine_dropfree(expert_out, state: RaggedDispatchState,
     t, k = topk_weights.shape
     tk = t * k
     d = expert_out.shape[-1]
-    st = ExchangeState(perm=state.perm, counts_mat=state.counts_mat)
+    st = state.exchange
 
     if ctx.wire_dtype is not None:
         from triton_dist_tpu.ops.low_latency import quantize_rows
