@@ -721,6 +721,47 @@ def battery(quiet=False, deadline=None):
         np.testing.assert_allclose(out, np.asarray(x, np.float32),
                                    rtol=0.05, atol=0.05)
 
+    def run_ll_a2a_steps():
+        """Decode-loop amortization: S=8 a2a steps fused into ONE
+        kernel invocation (one entry barrier + launch, slot-parity
+        wire buffers, credit flow control) vs 8 chained single-step
+        calls in one jit. The per-step delta is the per-invocation
+        overhead the persistent form eliminates."""
+        from triton_dist_tpu.ops import ll_a2a, ll_a2a_steps
+
+        S, c, d = 8, 128, 4096
+        xs = jax.random.normal(k0, (S, 1, c, d), dt)
+
+        multi = sm(lambda v: ll_a2a_steps(v, ctx=mctx, axis="tp",
+                                          force_kernel=True),
+                   (P(None, None, None, None),),
+                   P(None, None, None, None))
+
+        def chained(v):
+            outs = []
+            for s in range(S):
+                outs.append(ll_a2a(v[s], ctx=mctx, axis="tp", step=s,
+                                   force_kernel=True))
+            return jnp.stack(outs)
+
+        single = sm(chained, (P(None, None, None, None),),
+                    P(None, None, None, None))
+        got = np.asarray(multi(xs), np.float32)
+        want = np.asarray(single(xs), np.float32)
+        np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+        times = _timed_chain_group(
+            {"fused_steps": (lambda a, b_: multi(a), xs, xs),
+             "chained": (lambda a, b_: single(a), xs, xs)},
+            repeats=3, hi=24, lo=4)
+        return {"steps_fused_ms_per_step": round(
+                    times["fused_steps"] * 1e3 / S, 4),
+                "steps_chained_ms_per_step": round(
+                    times["chained"] * 1e3 / S, 4),
+                "per_step_overhead_saved_ms": round(
+                    (times["chained"] - times["fused_steps"]) * 1e3 / S,
+                    4)}
+
     def run_moe_rs():
         y = jax.random.normal(k0, (2048, 8, 2048), dt)
         w = jax.nn.softmax(
@@ -1075,6 +1116,7 @@ def battery(quiet=False, deadline=None):
         ("ulysses_qkv_gemm_a2a", run_ulysses),
         ("paged_flash_decode", run_paged_decode),
         ("fused_sp_decode", run_fused_decode),
+        ("ll_a2a_steps", run_ll_a2a_steps),
         ("hybrid_gdn_engine", run_hybrid_gdn),
         ("engine_decode_throughput", run_decode_perf),
         ("megakernel_prefill_decode", run_megakernel(False)),

@@ -383,3 +383,27 @@ def test_gemm_rs_2d_four_outer_groups():
              (P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
              P(("dcn", "ici"), None))
     assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)
+
+
+def test_ag_gemm_pipelined_persistent_ws(tp8_mesh, tp8_ctx):
+    """Persistent-workspace threading: call 2 reuses call 1's gather
+    buffer (no zero-fill) and must produce identical results."""
+    a1 = _rand((256, 32), 19)
+    a2 = _rand((256, 32), 20)
+    b = _rand((32, 64), 21)
+    ctx = create_ag_gemm_context(tp8_ctx, block_m=16, block_n=8,
+                                 variant="pipelined")
+
+    def two_calls(x1, x2, w):
+        o1, ws = ag_gemm(x1, w, ctx, return_ag=True)
+        o2, ws = ag_gemm(x2, w, ctx, return_ag=True, ws=ws)
+        return o1, o2
+
+    f = spmd(tp8_mesh, two_calls,
+             (P("tp", None), P("tp", None), P(None, "tp")),
+             (P(None, "tp"), P(None, "tp")))
+    o1, o2 = f(a1, a2, b)
+    g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    assert_allclose(o1, g(a1, b), rtol=1e-4, atol=1e-4)
+    assert_allclose(o2, g(a2, b), rtol=1e-4, atol=1e-4)

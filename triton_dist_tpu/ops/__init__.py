@@ -60,7 +60,7 @@ from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
     o_a2a_gemm, group_qkv_columns, group_o_rows, ulysses_attn_fused,
 )
 from triton_dist_tpu.ops.low_latency import (  # noqa: F401
-    fast_allgather, ll_a2a,
+    fast_allgather, ll_a2a, ll_a2a_steps,
 )
 from triton_dist_tpu.ops.moe_reduce import (  # noqa: F401
     moe_reduce_rs, moe_reduce_rs_ref, moe_reduce_ar, moe_reduce_ar_ref,

@@ -395,17 +395,23 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
 
 
 def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
-                out_dtype, tm, tn, tk, n_i, n_j, n_k, sim=False):
+                out_dtype, tm, tn, tk, n_i, n_j, n_k, sim=False,
+                ws=None):
     mesh = ctx.mesh
     m_full = n * m_loc
     me = jax.lax.axis_index(ctx.axis)
     # Pre-place the local chunk so chunk k=0's pipeline reads are valid
     # from the first body. In sim mode the "local chunk" is slice `me`
     # (= 0) of the full input; the rest arrives via the self-ring.
+    # With a caller-threaded persistent workspace (``ws``) the
+    # (n-1)/n-of-the-buffer zero-fill disappears — only the local chunk
+    # is (re)written, in place via the input/output alias (reference
+    # ctx-owned symmetric tensors, allgather_gemm.py:449-511).
     local = (jax.lax.dynamic_slice(a, (me * m_loc, 0), (m_loc, kdim))
              if sim else a)
-    a_ws_init = jax.lax.dynamic_update_slice(
-        jnp.zeros((m_full, kdim), a.dtype), local, (me * m_loc, 0))
+    base = jnp.zeros((m_full, kdim), a.dtype) if ws is None else ws
+    a_ws_init = jax.lax.dynamic_update_slice(base, local,
+                                             (me * m_loc, 0))
 
     def a_index(k, i, j, kk):
         me_ = jax.lax.axis_index(ctx.axis)
@@ -747,7 +753,7 @@ def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
 
 
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
-            force_kernel: bool = False, sim_ranks: int = 0):
+            force_kernel: bool = False, sim_ranks: int = 0, ws=None):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
 
     ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;
@@ -768,11 +774,21 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     hierarchical dcn x ici form (reference inter-node AG+GEMM): the
     gather then spans both axes with outer hops relayed under inner
     rings (see :func:`_ag_gemm_2d_kernel`).
+
+    ``ws`` (pipelined variant): a caller-threaded persistent gather
+    workspace — pass the previous call's ``return_ag`` array (seeded by
+    ``shmem.symm_tensor``) to skip the per-call workspace zero-fill:
+    ``out, ws = ag_gemm(a, b, ctx, return_ag=True, ws=ws)``. The
+    reference's context-owned symmetric tensors
+    (``allgather_gemm.py:449-511``) as functional threading.
     """
     if isinstance(ctx.axis, (tuple, list)):
         if sim_ranks or force_kernel:
             raise ValueError("sim_ranks/force_kernel apply to the "
                              "single-axis form only")
+        if ws is not None:
+            raise ValueError("ws (persistent workspace) is not "
+                             "supported on the hierarchical path")
         return _ag_gemm_2d(a, b, dataclasses.replace(
             ctx, axis=tuple(ctx.axis)), return_ag=return_ag)
     mesh = ctx.mesh
@@ -802,10 +818,17 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         n_buf = 1     # a single panel total — nothing to double-buffer
     m_full = n * m_loc
 
+    if ws is not None and not (ctx.variant == "pipelined"
+                               and n_i * n_j * n_k >= 2):
+        raise ValueError(
+            "ws (persistent workspace) applies to the pipelined "
+            "variant only (with >= 2 grid bodies — this grid falls "
+            "back to the panel kernel, whose workspace is an output "
+            "with no init cost to amortize)")
     if ctx.variant == "pipelined" and n_i * n_j * n_k >= 2:
         out, a_full = _ag_gemm_v2(a, b, ctx, n, m_loc, kdim, n_loc,
                                   out_dtype, tm, tn, tk, n_i, n_j, n_k,
-                                  sim=sim)
+                                  sim=sim, ws=ws)
         return (out, a_full) if return_ag else out
 
     def c_index(k, i, j, kk):

@@ -210,26 +210,24 @@ def wire_roundtrip(x, wire_dtype):
     return (q.astype(jnp.float32) * scale).astype(x.dtype)
 
 
-def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
-                   recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
-                   slot: int, wire_dtype):
-    """Quantize → put (payload + scales) → wait slot arrivals →
-    dequantize. Buffers are indexed [side] (0 = outgoing, 1 = inbound
-    — an arrival must never overwrite an outgoing chunk that hasn't
-    left yet); only the SEMAPHORES carry the step-slot parity. In this
-    allocation model (fresh XLA output buffers per call + full drain +
-    entry barrier) parity is defense-in-depth rather than load-bearing;
-    it becomes load-bearing for a persistent-symmetric-heap variant
-    that relaxes the trailing drain. Each peer's put fires the moment
-    its chunk is staged, so quantization of later chunks overlaps wire
-    time of earlier ones."""
-    n = n_ranks
+def _wire_exchange(x_src, out_dst, qout, sout, qin, sin, qx, sx, qv,
+                   send_sem, recv_sem, *, axis: str, ctx: MeshContext,
+                   n: int, wire_dtype):
+    """THE wire protocol, shared by the single-step and multi-step
+    kernels: stage+quantize each destination chunk (each peer's put
+    fires the moment its chunk is staged, so quantization of later
+    chunks overlaps wire time of earlier ones), paired payload/scale
+    puts, 2(n-1) arrival waits, dequantize into the output, drain
+    sends.
+
+    x_src(r)/out_dst(r): refs of the chunk for/from rank r;
+    qout/sout: (n, ...) outgoing staging; qin/sin: (n, ...) inbound
+    slots (the caller picks the parity slice); send_sem: (2(n-1),)
+    slice; recv_sem: one slot."""
     me = dl.rank(axis)
 
-    dl.barrier_all(axis, ctx=ctx)
-
     def stage(dst_rank):
-        pltpu.sync_copy(x_ref.at[dst_rank], qv)
+        pltpu.sync_copy(x_src(dst_rank), qv)
         q, scale = quantize_rows(qv[...], wire_dtype)
         qx[...] = q
         # Scales ride lane-aligned (col 0 is the value): HBM slices on
@@ -237,44 +235,165 @@ def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
         # keeps width 1 — its buffers starve past ~64 KB and it has no
         # tiling constraint.
         sx[...] = jnp.broadcast_to(scale, sx.shape)
-        pltpu.sync_copy(qx, qbuf.at[0, dst_rank])
-        pltpu.sync_copy(sx, sbuf.at[0, dst_rank])
+        pltpu.sync_copy(qx, qout.at[dst_rank])
+        pltpu.sync_copy(sx, sout.at[dst_rank])
 
     copies = []
     for off in range(1, n):
         peer = jax.lax.rem(me + off, n)
         stage(peer)
         copies.append(dl.remote_put(
-            qbuf.at[0, peer], qbuf.at[1, me],
-            send_sem.at[slot, 2 * (off - 1)], recv_sem.at[slot], peer,
-            axis=axis, ctx=ctx))
+            qout.at[peer], qin.at[me], send_sem.at[2 * (off - 1)],
+            recv_sem, peer, axis=axis, ctx=ctx))
         copies.append(dl.remote_put(
-            sbuf.at[0, peer], sbuf.at[1, me],
-            send_sem.at[slot, 2 * (off - 1) + 1], recv_sem.at[slot],
-            peer, axis=axis, ctx=ctx))
+            sout.at[peer], sin.at[me], send_sem.at[2 * (off - 1) + 1],
+            recv_sem, peer, axis=axis, ctx=ctx))
 
     # My own chunk, staged last (it has no wire to catch), crosses to
     # the inbound side locally.
     stage(me)
-    pltpu.sync_copy(qbuf.at[0, me], qbuf.at[1, me])
-    pltpu.sync_copy(sbuf.at[0, me], sbuf.at[1, me])
+    pltpu.sync_copy(qout.at[me], qin.at[me])
+    pltpu.sync_copy(sout.at[me], sin.at[me])
 
-    # 2(n-1) slot-parity arrivals (payload + scale per peer); DMA
-    # semaphores count transfer units, so the waits are order-free.
+    # 2(n-1) slot arrivals (payload + scale per peer); DMA semaphores
+    # count transfer units, so the waits are order-free.
     for _ in range(n - 1):
-        dl.wait_arrivals(recv_sem.at[slot], qbuf.at[0, 0], 1)
-        dl.wait_arrivals(recv_sem.at[slot], sbuf.at[0, 0], 1)
+        dl.wait_arrivals(recv_sem, qin.at[0], 1)
+        dl.wait_arrivals(recv_sem, sin.at[0], 1)
 
     # Dequantize the inbound side into the output.
     for r in range(n):
-        pltpu.sync_copy(qbuf.at[1, r], qx)
-        pltpu.sync_copy(sbuf.at[1, r], sx)
+        pltpu.sync_copy(qin.at[r], qx)
+        pltpu.sync_copy(sin.at[r], sx)
         qv[...] = (qx[...].astype(jnp.float32) * sx[:, :1]
                    ).astype(qv.dtype)
-        pltpu.sync_copy(qv, out_ref.at[r])
+        pltpu.sync_copy(qv, out_dst(r))
 
     for copy in copies:
         copy.wait_send()
+
+
+def _ll_a2a_kernel(x_ref, out_ref, qbuf, sbuf, qx, sx, qv, send_sem,
+                   recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
+                   slot: int, wire_dtype):
+    """One exchange. Buffers are indexed [side] (0 = outgoing, 1 =
+    inbound — an arrival must never overwrite an outgoing chunk that
+    hasn't left yet); only the SEMAPHORES carry the step-slot parity.
+    In this allocation model (fresh XLA output buffers per call + full
+    drain + entry barrier) parity is defense-in-depth; the multi-step
+    :func:`_ll_a2a_steps_kernel` is where it is load-bearing."""
+    dl.barrier_all(axis, ctx=ctx)
+    _wire_exchange(lambda r: x_ref.at[r], lambda r: out_ref.at[r],
+                   qbuf.at[0], sbuf.at[0], qbuf.at[1], sbuf.at[1],
+                   qx, sx, qv, send_sem.at[slot], recv_sem.at[slot],
+                   axis=axis, ctx=ctx, n=n_ranks, wire_dtype=wire_dtype)
+
+
+def _ll_a2a_steps_kernel(x_ref, out_ref, qin, sin, qout, sout, qx, sx,
+                         qv, send_sem, recv_sem, credit_sem, *,
+                         axis: str, ctx: MeshContext, n_ranks: int,
+                         n_steps: int, wire_dtype):
+    """Multi-step A2A loop in ONE kernel invocation: slot parity is
+    LOAD-BEARING and a credit protocol replaces per-step barriers.
+
+    Why in-kernel: scratch/DMA semaphores are physical registers
+    allocated per kernel — across *invocations* a fast peer's signal
+    can land while this device still runs a different kernel whose
+    allocation aliases the same register, so cross-call credit
+    protocols are unsound on TPU and every invocation needs its entry
+    rendezvous (docs/primitives.md rule 2). Inside one invocation the
+    registers are live for the whole loop, so steps amortize ONE entry
+    barrier over S steps:
+
+    - step s uses inbound slot parity ``p = s % 2`` (buffers AND
+      semaphores);
+    - before writing peers' parity-p slots at step s >= 2, wait n-1
+      CREDITS on ``credit_sem[p]`` — each granted by a peer at the end
+      of its step s-2 after consuming that slot (the flow control the
+      reference's double-buffered signal slots imply,
+      ``low_latency_all_to_all_v2.py:156,360``);
+    - after consuming step s, grant credits for parity p — except in
+      the last two steps, so every semaphore drains by kernel exit.
+    """
+    s = pl.program_id(0)
+    n = n_ranks
+    me = dl.rank(axis)
+    p = jax.lax.rem(s, 2)
+
+    @pl.when(s == 0)
+    def _():
+        dl.barrier_all(axis, ctx=ctx)
+
+    # Flow control: peers' parity-p inbound slots are free once each
+    # peer granted its step-(s-2) credit.
+    @pl.when(s >= 2)
+    def _():
+        dl.wait(credit_sem.at[p], n - 1)
+
+    _wire_exchange(lambda r: x_ref.at[s, r], lambda r: out_ref.at[s, r],
+                   qout, sout, qin.at[p], sin.at[p], qx, sx, qv,
+                   send_sem.at[p], recv_sem.at[p],
+                   axis=axis, ctx=ctx, n=n, wire_dtype=wire_dtype)
+
+    # Grant parity-p credits for step s+2 (skip the final two steps so
+    # the credit semaphores drain before kernel exit).
+    @pl.when(s < n_steps - 2)
+    def _():
+        for off in range(1, n):
+            peer = jax.lax.rem(me + off, n)
+            dl.notify(credit_sem.at[p], peer, axis=axis, ctx=ctx)
+
+
+def ll_a2a_steps(xs, *, ctx: MeshContext, axis: str = "ep",
+                 wire_dtype=jnp.int8, force_kernel: bool = False):
+    """S back-to-back low-latency A2A steps in ONE kernel invocation —
+    the persistent-workspace decode loop: one entry barrier total,
+    slot-parity wire buffers reused across steps, credit-based flow
+    control instead of per-step rendezvous (see the kernel docstring).
+
+    xs: (S, n, C, d); returns (S, n, C, d), step s matching
+    ``ll_a2a(xs[s], step=s)`` bit-for-bit. S >= 2 (a single step has
+    nothing to amortize — call :func:`ll_a2a`).
+    """
+    n = ctx.size(axis)
+    n_steps, nx, c, d = xs.shape
+    if n_steps < 2:
+        raise ValueError("ll_a2a_steps needs S >= 2; use ll_a2a")
+    if nx != n:
+        raise ValueError(f"dim 1 {nx} != axis size {n}")
+    if n == 1 and not force_kernel:
+        return jax.vmap(lambda x: wire_roundtrip(x, wire_dtype))(xs)
+    # force_kernel with n == 1 runs the full multi-step kernel (stage,
+    # parity slots, credits degenerate to no peers) — the single-chip
+    # lowering check the battery uses.
+    scale_w = 1 if use_interpret() else 128
+    kernel = functools.partial(
+        _ll_a2a_steps_kernel, axis=axis, ctx=ctx, n_ranks=n,
+        n_steps=n_steps, wire_dtype=wire_dtype)
+    out, *_ = core_call(
+        kernel,
+        comm=True,
+        grid=(n_steps,),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_steps, n, c, d), xs.dtype),
+            jax.ShapeDtypeStruct((2, n, c, d), wire_dtype),    # qin
+            jax.ShapeDtypeStruct((2, n, c, scale_w), jnp.float32),
+            jax.ShapeDtypeStruct((n, c, d), wire_dtype),       # qout
+            jax.ShapeDtypeStruct((n, c, scale_w), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=tuple(pl.BlockSpec(memory_space=pltpu.HBM)
+                        for _ in range(5)),
+        scratch_shapes=[
+            pltpu.VMEM((c, d), wire_dtype),         # qx
+            pltpu.VMEM((c, scale_w), jnp.float32),  # sx
+            pltpu.VMEM((c, d), xs.dtype),           # qv
+            pltpu.SemaphoreType.DMA((2, max(2 * (n - 1), 1))),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),      # credits
+        ],
+    )(xs)
+    return out
 
 
 def ll_a2a(x, *, ctx: MeshContext, axis: str = "ep", step=0,

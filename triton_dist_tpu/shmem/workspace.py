@@ -15,6 +15,16 @@ same Ref in the same kernel on the peer core). So:
   output (``input_output_aliases``) if it must persist across calls.
 - per-peer views need no API: a kernel addresses peer buffers directly in
   ``make_async_remote_copy(device_id=...)``.
+
+PERSISTENT CONTEXTS (reference ctx-owned symmetric tensors,
+``allgather_gemm.py:449-511``): thread the workspace functionally —
+seed with ``symm_tensor``, pass it back in each call
+(``ag_gemm(..., return_ag=True, ws=ws)``); the kernel's input/output
+alias makes the update in place, so steady-state calls skip the
+workspace init entirely. The per-invocation entry barrier itself is
+irreducible on TPU (``docs/primitives.md`` rule 3 — semaphore register
+aliasing across kernels); to amortize IT, fuse the loop into one
+kernel (``ops/low_latency.ll_a2a_steps``, the megakernel).
 """
 
 from __future__ import annotations
