@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: csrc test race ci bench-all
+.PHONY: csrc test quick race apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -10,13 +10,23 @@ csrc:
 test: csrc
 	$(PY) -m pytest tests/ -x -q
 
+# Sub-2-minute smoke tier for iteration (primitives, collectives,
+# low-latency family, tools; the full battery stays the merge gate).
+quick: csrc
+	$(PY) -m pytest tests/test_shmem.py tests/test_tools.py \
+	    tests/test_low_latency.py tests/test_collectives.py -x -q
+
 # The whole battery under the vector-clock race detector — the
 # deliberate signal-protocol checker (SURVEY.md section 5).
 race: csrc
 	TRITON_DIST_TPU_DETECT_RACES=1 $(PY) -m pytest \
 	    tests/test_shmem.py tests/test_collectives.py -x -q
 
-ci: test race
+# docs/api.md is generated; fail CI when it drifts from the source.
+apicheck:
+	$(PY) -m triton_dist_tpu.tools.gen_api --check
+
+ci: test race apicheck
 
 # Hardware battery: every fused op once on the real chip (needs a TPU).
 bench-all:

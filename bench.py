@@ -333,12 +333,16 @@ def main():
         Returns (sweep, sim_used, reason)."""
         try:
             return _sweep(name, cfgs, make_step, *operands), sim_on, None
-        except AssertionError as e:
+        except Exception as e:
+            # Any sim-mode failure demotes to the rankless proxy — not
+            # only the sweep's final AssertionError but also failures
+            # escaping step CONSTRUCTION outside the per-config loop
+            # (ADVICE r4). Non-sim failures still propagate.
             if not sim_on:
                 raise
             return (_sweep(name, cfgs, lambda c: make_step(c, 0),
                            *operands),
-                    0, f"{name}: {str(e)[:600]}")
+                    0, f"{name}: {type(e).__name__}: {str(e)[:600]}")
 
     sweep, sim, sim_fallback_reason = _sweep_with_sim_fallback(
         "ag_gemm", configs, make_fused_step, a, b, sim_on=sim)
@@ -497,6 +501,11 @@ def main():
         "unit": "ratio_vs_compute_only_gemm",
         "vs_baseline": round(float(eff) / 0.90, 4),
         "detail": {
+            # Wall-clock stamp: a stale replay of this record (backend
+            # down at round end) stays attributable to WHEN it was
+            # actually measured — a mid-round measurement is fresh
+            # evidence, not round-1 leftovers.
+            "measured_at_unix": int(time.time()),
             "devices": n,
             "sim_ranks": (SIM_RANKS if sim else None),
             "gemm_rs_sim": bool(rs_sim_used),

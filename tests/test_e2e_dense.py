@@ -72,3 +72,13 @@ def test_serve_sampling(tp8_mesh, ids):
     k1 = np.asarray(eng.serve(ids, gen_len=4, temperature=0.8,
                               top_k=1, seed=9))
     np.testing.assert_array_equal(k1, greedy)    # top-1 == argmax
+
+
+def test_engine_rejects_moe_impl_on_dense_model(tp8_mesh):
+    """Engine(moe_impl=...) with a non-MoE model raises a clear error
+    instead of a TypeError inside param_specs (ADVICE r4)."""
+    import pytest
+    from triton_dist_tpu.models import Engine, ModelConfig
+
+    with pytest.raises(ValueError, match="not a MoE model"):
+        Engine(ModelConfig.tiny(), tp8_mesh, moe_impl="ep")

@@ -75,3 +75,27 @@ def test_checkpoint_roundtrip(tmp_path):
     back = checkpoint.restore_params(path, like=params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b)), params, back)
+
+
+def test_from_hf_config_requires_core_fields():
+    """Core architecture fields must raise when absent — a malformed
+    config.json must not silently build a default-shaped model
+    (ADVICE r4)."""
+    import pytest
+    from triton_dist_tpu.models.config import ModelConfig
+
+    good = {"vocab_size": 128, "hidden_size": 32,
+            "num_hidden_layers": 2, "num_attention_heads": 4}
+    assert ModelConfig.from_hf_config(good).hidden_size == 32
+    for missing in good:
+        bad = {k: v for k, v in good.items() if k != missing}
+        with pytest.raises(KeyError):
+            ModelConfig.from_hf_config(bad)
+
+
+def test_from_hf_config_gdn_key_heads_split():
+    cfg = {"vocab_size": 128, "hidden_size": 32,
+           "num_hidden_layers": 2, "num_attention_heads": 4,
+           "linear_num_value_heads": 8, "linear_num_key_heads": 4}
+    mc = ModelConfig.from_hf_config(cfg)
+    assert mc.gdn_num_heads == 8 and mc.gdn_num_key_heads == 4

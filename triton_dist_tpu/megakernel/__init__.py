@@ -20,4 +20,6 @@ Execution model mapping:
 from triton_dist_tpu.megakernel.task import TaskType, Task  # noqa: F401
 from triton_dist_tpu.megakernel.graph import Graph  # noqa: F401
 from triton_dist_tpu.megakernel.scheduler import schedule, prune_deps  # noqa: F401
-from triton_dist_tpu.megakernel.builder import ModelBuilder  # noqa: F401
+from triton_dist_tpu.megakernel.builder import (  # noqa: F401
+    ModelBuilder, calibrate_cost_table,
+)

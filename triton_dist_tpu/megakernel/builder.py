@@ -39,6 +39,47 @@ def _cdiv(a, b):
     return -(-a // b)
 
 
+def calibrate_cost_table(observations) -> dict:
+    """Profile-feedback calibration: solve per-task-type unit times
+    from wall-clock observations of whole megakernel steps.
+
+    observations: list of (unit_counts, wall_seconds) where
+    ``unit_counts`` is :meth:`ModelBuilder.task_unit_counts` for that
+    build — at least as many observations as distinct task types, from
+    builds that vary the type mix (layer count, batch, seq). Solves the
+    least-squares system ``counts @ x = wall`` (x >= 0) and returns a
+    ``cost_table`` {task_type: weight} normalized so the smallest
+    positive weight is 1.0 — feed it back into
+    ``ModelBuilder(cost_table=...)`` to re-schedule ``cost_lpt`` from
+    measured times (reference ``enable_runtime_scheduler``,
+    ``model_builder.py:521-524``, answered at schedule time).
+
+    Raises ``ValueError`` when the observation mix is rank-deficient
+    (e.g. proportional count vectors): the minimum-norm solution would
+    be weights proportional to counts — garbage the schedule (and a
+    Perfetto export labeled "calibrated") would then trust. Vary the
+    shapes until every type's unit time is identifiable.
+    """
+    types = sorted({k for counts, _ in observations for k in counts})
+    a = np.array([[counts.get(k, 0) for k in types]
+                  for counts, _ in observations], np.float64)
+    b = np.array([w for _, w in observations], np.float64)
+    rank = np.linalg.matrix_rank(a)
+    if rank < len(types):
+        raise ValueError(
+            f"calibrate_cost_table: observation matrix rank {rank} < "
+            f"{len(types)} task types — per-type unit times are not "
+            "identifiable; add observations with different type mixes "
+            "(vary layer count / batch / seq)")
+    x, *_ = np.linalg.lstsq(a, b, rcond=None)
+    x = np.clip(x, 0.0, None)
+    pos = x[x > 0]
+    if pos.size == 0:
+        return {k: 1.0 for k in types}
+    x = x / pos.min()
+    return {k: float(v) for k, v in zip(types, x)}
+
+
 class ModelBuilder:
     """Builds the Qwen3 dense decode step as a megakernel."""
 
@@ -47,7 +88,8 @@ class ModelBuilder:
                  tile_w: Optional[int] = None, t_tile: Optional[int] = None,
                  num_cores: int = 1, strategy: str = "round_robin",
                  seq: int = 1, paged: bool = False,
-                 page: Optional[int] = None, profile: bool = False):
+                 page: Optional[int] = None, profile: bool = False,
+                 cost_table: Optional[dict] = None):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -70,6 +112,14 @@ class ModelBuilder:
         # (the reference megakernel's SM-activity metric,
         # model_builder.py:164-190) and the Perfetto exporter.
         self.profile = profile
+        # cost_table: measured per-unit weights {int(TaskType): float}
+        # multiplying the static unit estimates — the profile-feedback
+        # loop (calibrate_cost_table) re-schedules cost_lpt from
+        # MEASURED task times, the static-TPU answer to the reference's
+        # runtime scheduler (model_builder.py:521-524: no cross-core
+        # atomic queue head exists here, so balance moves to schedule
+        # time but from silicon numbers).
+        self.cost_table = dict(cost_table) if cost_table else None
         # seq > 1: batched prefill — ``batch`` counts ROWS (B*S, b-major)
         # and the attention/cache tasks use the causal prefill bodies.
         self.seq = seq
@@ -464,6 +514,11 @@ class ModelBuilder:
         self.task_types = np.array(
             [g.tasks[t].task_type if t >= 0 else int(TaskType.NOOP)
              for t in qc], np.int32).reshape(queue.shape)
+        # Static work units per queue slot — the progress-counter →
+        # time model's design row (slot_durations()).
+        self.slot_units = np.array(
+            [self._task_units(g.tasks[t]) if t >= 0 else 0
+             for t in qc], np.int64).reshape(queue.shape)
         self.task_args = np.array(
             [g.tasks[t].encoded_args() if t >= 0 else noop_args
              for t in qc], np.int32).reshape(*queue.shape, ARGS_MAX)
@@ -490,7 +545,25 @@ class ModelBuilder:
         self.sig_cores = np.array(scores_ or [0], np.int32)
 
     def _task_cost(self, t) -> int:
-        """Static cost estimate feeding the cost_lpt strategy."""
+        """Cost estimate feeding the cost_lpt strategy: static work
+        units, optionally reweighted by a measured ``cost_table``."""
+        units = self._task_units(t)
+        if self.cost_table is None:
+            return units
+        w = self.cost_table.get(int(t.task_type), 1.0)
+        return max(int(round(units * w)), 0)
+
+    def task_unit_counts(self) -> dict:
+        """Total static work units per task type over the whole graph —
+        the design matrix row for :func:`calibrate_cost_table`."""
+        counts = {}
+        for t in self.graph.tasks:
+            k = int(t.task_type)
+            counts[k] = counts.get(k, 0) + self._task_units(t)
+        return counts
+
+    def _task_units(self, t) -> int:
+        """Static work-unit estimate per task (pre-reweighting)."""
         if t.task_type == TaskType.LINEAR:
             return int(t.args[3])          # k_tiles MXU passes
         if t.task_type == TaskType.ATTN_DECODE:
@@ -829,6 +902,29 @@ class ModelBuilder:
             return tuple(ret)
 
         return step
+
+    def prof_tracks(self, prof):
+        """Reshape a step's profile output ((qlen·num_cores, 2) rows,
+        slot-major) into per-core tracks (num_cores, qlen, 2) — the
+        exporter's buffer layout, aligned with
+        :meth:`slot_durations`."""
+        p = np.asarray(prof).reshape(self.qlen, self.num_cores, 2)
+        return np.transpose(p, (1, 0, 2))
+
+    def slot_durations(self, cost_table: dict, unit_s: float):
+        """Calibrated progress-counter→time model: per-queue-slot
+        durations in seconds, ``units * weight[task_type] * unit_s``
+        with weights from a MEASURED :func:`calibrate_cost_table` and
+        ``unit_s`` the fit's base unit time. Feed to
+        ``profiler.export_to_perfetto_trace(prof_tracks(prof),
+        slot_durations=...)`` — the export then carries spans labeled
+        ``calibrated`` (model times), never passing reconstructed order
+        off as measurement. Returns (num_cores, qlen), matching
+        :meth:`prof_tracks`."""
+        w = np.array([cost_table.get(int(t), 1.0)
+                      for t in self.task_types.reshape(-1)],
+                     np.float64).reshape(self.task_types.shape)
+        return (self.slot_units * w * unit_s).T
 
     def core_activity(self, prof) -> "np.ndarray":
         """Per-core busy fraction from a profile output: share of queue

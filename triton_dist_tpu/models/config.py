@@ -33,7 +33,12 @@ class ModelConfig:
     # layers with a full-attention layer every `full_attn_interval`;
     # 0 GDN heads = pure full attention). The reference ships the GDN
     # kernel (``kernels/nvidia/gdn.py``) for this family.
-    gdn_num_heads: int = 0
+    gdn_num_heads: int = 0          # value heads (HF linear_num_value_heads)
+    # Key heads may differ from value heads in real Qwen3-Next configs
+    # (HF linear_num_key_heads); 0 means "same as gdn_num_heads". The
+    # in-framework GDN family uses equal counts; a future HF hybrid
+    # mapper needs the split (ADVICE r4).
+    gdn_num_key_heads: int = 0
     gdn_head_dim_k: int = 128
     gdn_head_dim_v: int = 128
     full_attn_interval: int = 4
@@ -138,13 +143,25 @@ class ModelConfig:
             get = lambda k, d=None: hf_cfg.get(k, d)
         else:
             get = lambda k, d=None: getattr(hf_cfg, k, d)
-        d = get("hidden_size", 4096)
-        heads = get("num_attention_heads", 32)
+
+        def req(k):
+            # Core architecture fields stay REQUIRED: silently
+            # defaulting them would build a default-shaped model from a
+            # malformed or wrong-schema config.json (ADVICE r4).
+            v = get(k)
+            if v is None:
+                raise KeyError(
+                    f"HF config missing required field {k!r} — is this "
+                    "a supported config.json?")
+            return v
+
+        d = req("hidden_size")
+        heads = req("num_attention_heads")
         return cls(
-            vocab_size=get("vocab_size", 151936),
+            vocab_size=req("vocab_size"),
             hidden_size=d,
             intermediate_size=get("intermediate_size", 4 * d),
-            num_hidden_layers=get("num_hidden_layers", 32),
+            num_hidden_layers=req("num_hidden_layers"),
             num_attention_heads=heads,
             num_key_value_heads=get("num_key_value_heads", heads),
             head_dim=get("head_dim") or d // heads,
@@ -158,6 +175,7 @@ class ModelConfig:
             moe_intermediate_size=get("moe_intermediate_size", 768) or 768,
             norm_topk_prob=get("norm_topk_prob", True),
             gdn_num_heads=get("linear_num_value_heads", 0) or 0,
+            gdn_num_key_heads=get("linear_num_key_heads", 0) or 0,
             gdn_head_dim_k=get("linear_key_head_dim", 128) or 128,
             gdn_head_dim_v=get("linear_value_head_dim", 128) or 128,
             full_attn_interval=get("full_attention_interval", 4) or 4,

@@ -66,6 +66,13 @@ class Engine:
             model.param_specs).parameters
         if moe_impl is None and takes_moe:
             moe_impl = "tp"
+        if moe_impl is not None and not takes_moe:
+            # Without this the call below dies in a confusing TypeError
+            # inside param_specs (ADVICE r4).
+            raise ValueError(
+                f"moe_impl={moe_impl!r} given, but model "
+                f"{getattr(model, '__name__', model)!r} is not a MoE "
+                "model (its param_specs takes no moe_impl)")
 
         model_kwargs = {}
         if moe_impl is not None:

@@ -136,6 +136,16 @@ def load_hf_checkpoint(path: str, dtype=jnp.bfloat16):
 
     with open(os.path.join(path, "config.json")) as f:
         cfg = config_from_hf(json.load(f))
+    if cfg.is_hybrid:
+        # Fail BEFORE reading shards (tens of GB for 80B-class
+        # checkpoints): a dense/MoE mapper would die with an opaque
+        # KeyError on the GDN projection keys (ADVICE r4).
+        raise NotImplementedError(
+            "load_hf_checkpoint has no weight mapper for hybrid "
+            "(Qwen3-Next / GDN) checkpoints yet — the in-framework "
+            "hybrid family initializes via models.qwen_next.init_params; "
+            "a hybrid mapper needs the separate gdn_num_key_heads / "
+            "gdn_num_heads projection split now carried by ModelConfig")
     state: Dict = {}
     shards = sorted(_glob.glob(os.path.join(path, "*.safetensors")))
     if not shards:

@@ -17,29 +17,56 @@ import numpy as np
 
 def export_to_perfetto_trace(slot_buffers, path: str,
                              tag_names: Optional[Dict[int, str]] = None,
-                             device_names: Optional[Sequence[str]] = None
-                             ) -> str:
+                             device_names: Optional[Sequence[str]] = None,
+                             slot_durations=None) -> str:
     """slot_buffers: (n_devices, capacity, 2) int32 array (or a list of
-    per-device (capacity, 2) arrays). Writes chrome-trace JSON."""
+    per-device (capacity, 2) arrays). Writes chrome-trace JSON.
+
+    TIMING HONESTY: every event is labeled with how its time was
+    obtained. Without ``slot_durations`` (default) events are
+    unit-spaced instants in PROGRAM ORDER — ``timing:
+    "reconstructed"``, no duration claim (wall time lives in xprof).
+    With ``slot_durations`` ((n_devices, capacity) seconds per slot —
+    e.g. ``ModelBuilder.slot_durations`` fed by a MEASURED
+    ``calibrate_cost_table``) events become spans at the model's
+    cumulative times — ``timing: "calibrated"``, good to the cost
+    model's least-squares fit, not a per-span measurement.
+    """
     buffers = np.asarray(slot_buffers)
     if buffers.ndim == 2:
         buffers = buffers[None]
+    durs = None
+    if slot_durations is not None:
+        durs = np.asarray(slot_durations, np.float64)
+        if durs.ndim == 1:
+            durs = durs[None]
     tag_names = tag_names or {}
-    events = []
+    timing = "calibrated" if durs is not None else "reconstructed"
+    events = [{
+        "name": f"timing_model: {timing}",
+        "ph": "M", "pid": 0, "tid": 0,
+        "args": {"timing": timing},
+    }]
     for dev, buf in enumerate(buffers):
         name = (device_names[dev] if device_names else f"device{dev}")
+        t_cum = 0.0
         for t, (tag, value) in enumerate(buf):
             if tag == 0 and value == 0 and t > 0:
                 continue  # unused slot
-            events.append({
+            ev = {
                 "name": tag_names.get(int(tag), f"tag{int(tag)}"),
-                "ph": "i",  # instant event
-                "ts": t,     # program order (unitless)
                 "pid": 0,
                 "tid": dev,
-                "s": "t",
-                "args": {"value": int(value), "device": name},
-            })
+                "args": {"value": int(value), "device": name,
+                         "timing": timing},
+            }
+            if durs is not None:
+                d_us = float(durs[dev, t]) * 1e6
+                ev.update({"ph": "X", "ts": t_cum, "dur": d_us})
+                t_cum += d_us
+            else:
+                ev.update({"ph": "i", "ts": t, "s": "t"})
+            events.append(ev)
     trace = {"traceEvents": events,
              "displayTimeUnit": "ns"}
     with open(path, "w") as f:
