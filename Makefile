@@ -7,8 +7,10 @@ PY ?= python
 csrc:
 	$(MAKE) -C csrc
 
+# PYTEST_ARGS lets CI deselect files covered by dedicated jobs
+# (e.g. --ignore=tests/test_multihost.py).
 test: csrc
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -x -q $(PYTEST_ARGS)
 
 # Sub-2-minute smoke tier for iteration (primitives, collectives,
 # low-latency family, tools; the full battery stays the merge gate).

@@ -28,3 +28,20 @@ f = spmd(mesh, lambda a, b_, c, l: sp_flash_decode(a, b_, c, l, axis="tp"),
 out = np.asarray(f(q, k, v, kv_len))
 want = np.asarray(flash_decode_ref(q, k, v, kv_len))
 print("split-KV flash decode max err:", np.abs(out - want).max())
+
+# ---- fused form: one Pallas kernel per decode step ------------------
+# The same split-KV step with a HEAD-MAJOR (B, KV, T_loc, hd) cache:
+# online softmax + in-kernel RDMA partial exchange replace the
+# pmax+2psum XLA collectives (reference flash_decode.py:587-1095).
+from triton_dist_tpu.ops import sp_flash_decode_fused  # noqa: E402
+
+ctx = tdt.MeshContext.from_mesh(mesh)
+k_hm = jnp.transpose(k, (0, 2, 1, 3))
+v_hm = jnp.transpose(v, (0, 2, 1, 3))
+g = spmd(mesh,
+         lambda a, kc, vc, l: sp_flash_decode_fused(
+             a, kc, vc, l, ctx=ctx, axis="tp", page=8),
+         (P(None, None, None), P(None, None, "tp", None),
+          P(None, None, "tp", None), P(None)), P(None, None, None))
+out_f = np.asarray(g(q, k_hm, v_hm, kv_len))
+print("fused one-kernel decode max err:", np.abs(out_f - want).max())
