@@ -53,6 +53,11 @@ AG_GEMM_CONFIGS = (
     {"block_m": 512, "block_n": 128, "block_k": 4096},
     {"block_m": 1024, "block_n": 128, "block_k": 2048},
     {"block_m": 256, "block_n": 512, "block_k": 1024},
+    # Double-buffered panels (block_m <= 512 fits two (tm, K) panels in
+    # the VMEM budget): the cross-chunk prefetch path — no cold panel
+    # load or arrival stall at ring boundaries (r5 kernel change).
+    {"block_m": 512, "block_n": 256, "block_k": 4096},
+    {"block_m": 256, "block_n": 128, "block_k": 4096},
     {"variant": "pipelined", "block_m": 256, "block_n": 256,
      "block_k": 1024},
     {"variant": "pipelined", "block_m": 128, "block_n": 512,

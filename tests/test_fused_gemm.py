@@ -407,3 +407,25 @@ def test_ag_gemm_pipelined_persistent_ws(tp8_mesh, tp8_ctx):
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(o1, g(a1, b), rtol=1e-4, atol=1e-4)
     assert_allclose(o2, g(a2, b), rtol=1e-4, atol=1e-4)
+
+
+def test_gemm_ar_2d(dp2tp4_mesh, dp2tp4_ctx):
+    """Hierarchical GEMM+AR: fused inner-axis kernel + one outer
+    exchange vs the two-axis psum oracle."""
+    m, k, n_dim = 16, 128, 64
+    a = _rand((m, k), 22)
+    b = _rand((k, n_dim), 23)
+    ctx = create_gemm_ar_context(dp2tp4_ctx, axis=("dp", "tp"),
+                                 block_n=32)
+
+    def oracle(x, w):
+        p = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        return jax.lax.psum(p, ("dp", "tp")).astype(x.dtype)
+
+    f = spmd(dp2tp4_mesh, lambda x, w: gemm_ar(x, w, ctx),
+             (P(None, ("dp", "tp")), P(("dp", "tp"), None)),
+             P(None, None))
+    g = spmd(dp2tp4_mesh, oracle,
+             (P(None, ("dp", "tp")), P(("dp", "tp"), None)),
+             P(None, None))
+    assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)

@@ -28,7 +28,8 @@ class MegaKernelEngine:
                  seed: int = 0, tile_w=None, t_tile=None,
                  keep_params: bool = False, prefill_seq: int = 0,
                  num_cores: int = 1, strategy: str = "round_robin",
-                 paged: bool = False, page=None, num_pages=None):
+                 paged: bool = False, page=None, num_pages=None,
+                 cost_table=None):
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -50,7 +51,7 @@ class MegaKernelEngine:
                                     tile_w=tile_w, t_tile=t_tile,
                                     num_cores=num_cores,
                                     strategy=strategy, paged=paged,
-                                    page=page)
+                                    page=page, cost_table=cost_table)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
             # buffer; prefill runs via prefill_chain (decode-only
@@ -97,7 +98,7 @@ class MegaKernelEngine:
                 cfg, mesh, batch=batch * prefill_seq, max_len=max_len,
                 axis=axis, tile_w=tile_w, t_tile=t_tile,
                 seq=prefill_seq, num_cores=num_cores, strategy=strategy,
-                paged=paged, page=page)
+                paged=paged, page=page, cost_table=cost_table)
             self.prefill_seq = prefill_seq
             pack_builder = self.prefill_builder
             pstep = self.prefill_builder.step_fn()

@@ -227,7 +227,25 @@ def gemm_ar(a, b, ctx: GemmARContext, *, force_kernel: bool = False,
     them with a runtime zero weight so the (verifiable) result is the
     plain local GEMM. What bench.py's decode-regime battery measures
     on one chip.
+
+    ``ctx.axis`` may be an ``(outer, inner)`` tuple: the fused
+    GEMM+AR runs on the inner (ICI) axis and the inner-reduced result
+    crosses the outer (DCN) axis with one :func:`ops.allreduce
+    .all_reduce` exchange — inner traffic fused under the MXU, exactly
+    one outer exchange of the final (M, N) payload (reference
+    inter-node GEMM+AR composition).
     """
+    if isinstance(ctx.axis, (tuple, list)):
+        if sim_ranks or force_kernel:
+            raise ValueError("sim_ranks/force_kernel apply to the "
+                             "single-axis form only")
+        from triton_dist_tpu.ops.allreduce import all_reduce
+
+        outer_axis, inner_axis = ctx.axis
+        inner = gemm_ar(a, b, dataclasses.replace(ctx, axis=inner_axis))
+        if ctx.mesh.size(outer_axis) == 1:
+            return inner
+        return all_reduce(inner, ctx=ctx.mesh, axis=outer_axis)
     mesh = ctx.mesh
     n = mesh.size(ctx.axis)
     m, k_loc = a.shape
