@@ -82,3 +82,91 @@ def test_engine_rejects_moe_impl_on_dense_model(tp8_mesh):
 
     with pytest.raises(ValueError, match="not a MoE model"):
         Engine(ModelConfig.tiny(), tp8_mesh, moe_impl="ep")
+
+
+def test_dense_attention_bias_seed_oss_shape(tp8_mesh, tp8_ctx):
+    """Seed-OSS-class dense models (attention biases, NO per-head q/k
+    norm — reference serves ByteDance-Seed/Seed-OSS-36B-Instruct
+    through the same DenseLLM, models/__init__.py:42): fused modes must
+    match the XLA path with biases active."""
+    import dataclasses
+
+    from triton_dist_tpu.models import ModelConfig, Engine
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), attention_bias=True,
+                              qk_norm=False,
+                              model_name="seed-oss-tiny")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0,
+                             cfg.vocab_size)
+
+    # Nonzero biases so the test actually exercises them.
+    from triton_dist_tpu.models import dense as dense_mod
+    params = dense_mod.init_params(jax.random.PRNGKey(1), cfg)
+    for lyr in params["layers"]:
+        assert "bq" in lyr["attn"] and "q_norm" not in lyr["attn"]
+        lyr["attn"]["bq"] = jnp.full_like(lyr["attn"]["bq"], 0.05)
+        lyr["attn"]["bo"] = jnp.full_like(lyr["attn"]["bo"], -0.03)
+
+    # Biases must be load-bearing: the per-shard forward with nonzero
+    # bq/bo differs from the zero-bias forward at the LOGITS level.
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.utils.testing import spmd
+    specs = dense_mod.param_specs(cfg)
+    params0 = dense_mod.init_params(jax.random.PRNGKey(1), cfg)
+    f = spmd(tp8_mesh,
+             lambda p, i: dense_mod.prefill(p, i, cfg, max_len=16)[0],
+             (specs, P(None, None)), P(None, None))
+    lg_b = np.asarray(f(params, ids))
+    lg_0 = np.asarray(f(params0, ids))
+    assert np.abs(lg_b - lg_0).max() > 1e-4
+
+    outs = {}
+    for mode in ("xla", "fused"):
+        eng = Engine(cfg, tp8_mesh, mode=mode, params=params)
+        outs[mode] = np.asarray(eng.serve(ids, gen_len=4))
+    np.testing.assert_array_equal(outs["xla"], outs["fused"])
+
+
+def test_hf_loader_maps_bias_checkpoint():
+    """State-dict mapping for a bias-carrying, norm-free checkpoint."""
+    import numpy as _np
+    from triton_dist_tpu.models.hf_loader import params_from_hf_state_dict
+    from triton_dist_tpu.models import ModelConfig
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        ModelConfig.tiny(vocab_size=32, hidden_size=16,
+                         intermediate_size=32, num_hidden_layers=1,
+                         num_attention_heads=2, num_key_value_heads=2,
+                         head_dim=8),
+        attention_bias=True, qk_norm=False)
+    d, hq, hkv = 16, 16, 16
+    state = {}
+    p = "model.layers.0."
+    rng = _np.random.default_rng(0)
+    for k, shape in [
+            (p + "self_attn.q_proj.weight", (hq, d)),
+            (p + "self_attn.k_proj.weight", (hkv, d)),
+            (p + "self_attn.v_proj.weight", (hkv, d)),
+            (p + "self_attn.o_proj.weight", (d, hq)),
+            (p + "self_attn.q_proj.bias", (hq,)),
+            (p + "self_attn.k_proj.bias", (hkv,)),
+            (p + "self_attn.v_proj.bias", (hkv,)),
+            (p + "mlp.gate_proj.weight", (32, d)),
+            (p + "mlp.up_proj.weight", (32, d)),
+            (p + "mlp.down_proj.weight", (d, 32)),
+            (p + "input_layernorm.weight", (d,)),
+            (p + "post_attention_layernorm.weight", (d,)),
+            ("model.embed_tokens.weight", (32, d)),
+            ("model.norm.weight", (d,)),
+            ("lm_head.weight", (32, d)),
+    ]:
+        state[k] = rng.standard_normal(shape).astype(_np.float32)
+    params = params_from_hf_state_dict(state, cfg)
+    attn = params["layers"][0]["attn"]
+    assert "bq" in attn and "bo" in attn and "q_norm" not in attn
+    np.testing.assert_allclose(
+        np.asarray(attn["bq"], np.float32),
+        state[p + "self_attn.q_proj.bias"], rtol=1e-2, atol=1e-2)
+    # o_proj.bias absent -> zeros fallback.
+    assert np.all(np.asarray(attn["bo"], np.float32) == 0.0)

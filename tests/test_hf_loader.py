@@ -99,3 +99,55 @@ def test_from_hf_config_gdn_key_heads_split():
            "linear_num_value_heads": 8, "linear_num_key_heads": 4}
     mc = ModelConfig.from_hf_config(cfg)
     assert mc.gdn_num_heads == 8 and mc.gdn_num_key_heads == 4
+
+
+def test_moe_mapper_bias_checkpoint_matches_init_tree():
+    """The MoE mapper shares _attn_from_hf with the dense mapper: a
+    bias-carrying, norm-free (qwen2_moe-style) MoE state dict must land
+    on exactly the tree `qwen_moe.init_params` builds for that config."""
+    import dataclasses
+    from triton_dist_tpu.models.hf_loader import (
+        moe_params_from_hf_state_dict)
+    from triton_dist_tpu.models import qwen_moe
+
+    cfg = dataclasses.replace(ModelConfig.tiny_moe(),
+                              attention_bias=True, qk_norm=False)
+    rng = np.random.RandomState(2)
+    d, ff, hd = cfg.hidden_size, cfg.moe_intermediate_size, cfg.head_dim
+    h, kvh = cfg.num_attention_heads, cfg.num_key_value_heads
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+    sd = {}
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.q_proj.weight"] = w(h * hd, d)
+        sd[p + "self_attn.k_proj.weight"] = w(kvh * hd, d)
+        sd[p + "self_attn.v_proj.weight"] = w(kvh * hd, d)
+        sd[p + "self_attn.o_proj.weight"] = w(d, h * hd)
+        sd[p + "self_attn.q_proj.bias"] = w(h * hd)
+        sd[p + "self_attn.k_proj.bias"] = w(kvh * hd)
+        sd[p + "self_attn.v_proj.bias"] = w(kvh * hd)
+        sd[p + "mlp.gate.weight"] = w(cfg.num_experts, d)
+        for e in range(cfg.num_experts):
+            q = f"{p}mlp.experts.{e}."
+            sd[q + "gate_proj.weight"] = w(ff, d)
+            sd[q + "up_proj.weight"] = w(ff, d)
+            sd[q + "down_proj.weight"] = w(d, ff)
+        sd[p + "input_layernorm.weight"] = w(d)
+        sd[p + "post_attention_layernorm.weight"] = w(d)
+    sd["model.embed_tokens.weight"] = w(cfg.vocab_size, d)
+    sd["model.norm.weight"] = w(d)
+    sd["lm_head.weight"] = w(cfg.vocab_size, d)
+
+    params = moe_params_from_hf_state_dict(sd, cfg, dtype=jnp.float32)
+    ref = qwen_moe.init_params(jax.random.PRNGKey(0), cfg)
+    jax.tree.map(lambda a, b: (_ for _ in ()).throw(
+        AssertionError(f"{a.shape} != {b.shape}"))
+        if a.shape != b.shape else None, params, ref)
+    attn = params["layers"][0]["attn"]
+    assert "q_norm" not in attn
+    np.testing.assert_allclose(
+        np.asarray(attn["bq"]),
+        sd["model.layers.0.self_attn.q_proj.bias"])
+    # o_proj.bias absent in qwen2_moe checkpoints -> zeros.
+    assert np.all(np.asarray(attn["bo"]) == 0.0)

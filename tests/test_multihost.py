@@ -20,7 +20,7 @@ def test_two_process_launch():
         [sys.executable, os.path.join(ROOT, "scripts", "launch.py"),
          "--nproc", "2", "--devices-per-proc", "4",
          os.path.join(HERE, "multihost_worker.py")],
-        capture_output=True, text=True, timeout=420,
+        capture_output=True, text=True, timeout=900,
         env={k: v for k, v in os.environ.items()
              if k not in ("JAX_PLATFORMS", "XLA_FLAGS")})
     ok = [l for l in r.stdout.splitlines() if l.startswith("RESULT_OK")]

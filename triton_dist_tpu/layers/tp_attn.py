@@ -29,7 +29,7 @@ def init(key, cfg, dtype=jnp.float32) -> Dict:
     d = cfg.hidden_size
     hd = cfg.head_dim
     scale = d ** -0.5
-    return {
+    p = {
         "wq": jax.random.normal(kq, (d, cfg.num_attention_heads * hd),
                                 dtype) * scale,
         "wk": jax.random.normal(kk, (d, cfg.num_key_value_heads * hd),
@@ -39,20 +39,40 @@ def init(key, cfg, dtype=jnp.float32) -> Dict:
         "wo": jax.random.normal(
             ko, (cfg.num_attention_heads * hd, d), dtype
         ) * ((cfg.num_attention_heads * hd) ** -0.5),
-        "q_norm": jnp.ones((hd,), dtype),
-        "k_norm": jnp.ones((hd,), dtype),
     }
+    if getattr(cfg, "qk_norm", True):
+        p["q_norm"] = jnp.ones((hd,), dtype)
+        p["k_norm"] = jnp.ones((hd,), dtype)
+    if getattr(cfg, "attention_bias", False):
+        # Seed-OSS / Qwen2-style projection biases (the reference
+        # shards q_proj.bias etc. the same way, layer init path).
+        p["bq"] = jnp.zeros((cfg.num_attention_heads * hd,), dtype)
+        p["bk"] = jnp.zeros((cfg.num_key_value_heads * hd,), dtype)
+        p["bv"] = jnp.zeros((cfg.num_key_value_heads * hd,), dtype)
+        p["bo"] = jnp.zeros((d,), dtype)
+    return p
 
 
-def param_specs(axis: str = "tp") -> Dict:
-    return {
+def param_specs(axis: str = "tp", cfg=None) -> Dict:
+    """``cfg=None`` keeps the legacy Qwen3 layout (q/k norms, no
+    biases); pass a config to match :func:`init`'s conditional keys."""
+    s = {
         "wq": P(None, axis),
         "wk": P(None, axis),
         "wv": P(None, axis),
         "wo": P(axis, None),
-        "q_norm": P(None),
-        "k_norm": P(None),
     }
+    if cfg is None or getattr(cfg, "qk_norm", True):
+        s["q_norm"] = P(None)
+        s["k_norm"] = P(None)
+    if cfg is not None and getattr(cfg, "attention_bias", False):
+        s["bq"] = P(axis)
+        s["bk"] = P(axis)
+        s["bv"] = P(axis)
+        # Row-parallel o-proj: the bias adds ONCE after the reduce, so
+        # it stays replicated.
+        s["bo"] = P(None)
+    return s
 
 
 def _head_split(cfg, n: int):
@@ -87,13 +107,25 @@ def _project_qkv(params, x, *, mode, axis, ag_ctx):
         v = jnp.dot(x, params["wv"])
     else:
         raise ValueError(f"unknown TP_Attn mode {mode!r}")
+    if "bq" in params:
+        # Column-parallel biases: each shard owns its output columns.
+        q = q + params["bq"]
+        k = k + params["bk"]
+        v = v + params["bv"]
     return q, k, v
+
+
+def _o_bias(params, y):
+    """Row-parallel output bias — applied AFTER the cross-shard reduce
+    (a per-shard add would count it n times)."""
+    return y + params["bo"] if "bo" in params else y
 
 
 def _norm_rope(q, k, params, cfg, positions):
     """q: (B, S, H_loc, hd); k: (B, S, KV_loc, hd)."""
-    q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)
-    k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
+    if "q_norm" in params:       # Qwen3 per-head norm; absent for
+        q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)  # Seed-OSS
+        k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
     inv_freq = rope_freqs(cfg.head_dim, cfg.rope_theta)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
@@ -171,6 +203,7 @@ def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
         y = gemm_rs(o, params["wo"], rs_ctx)
     else:  # fused_ar
         y = gemm_ar(o, params["wo"], ar_ctx)
+    y = _o_bias(params, y)
     return (y, (k, v)) if kv_out else y
 
 
@@ -188,9 +221,14 @@ def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
     h_loc, kv_loc = _head_split(cfg, n)
     b = x.shape[0]
 
-    q = jnp.dot(x, params["wq"]).reshape(b, 1, h_loc, hd)
-    k = jnp.dot(x, params["wk"]).reshape(b, 1, kv_loc, hd)
-    v = jnp.dot(x, params["wv"]).reshape(b, 1, kv_loc, hd)
+    q = jnp.dot(x, params["wq"])
+    k = jnp.dot(x, params["wk"])
+    v = jnp.dot(x, params["wv"])
+    if "bq" in params:
+        q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+    q = q.reshape(b, 1, h_loc, hd)
+    k = k.reshape(b, 1, kv_loc, hd)
+    v = v.reshape(b, 1, kv_loc, hd)
     positions = jnp.full((b, 1), cache_len, dtype=jnp.int32)
     q, k = _norm_rope(q, k, params, cfg, positions)
 
@@ -209,4 +247,4 @@ def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
             axis).astype(x.dtype)
     else:  # fused / fused_ar decode both use gemm_ar (small M)
         y = gemm_ar(o, params["wo"], ar_ctx)
-    return y, (k_cache, v_cache)
+    return _o_bias(params, y), (k_cache, v_cache)

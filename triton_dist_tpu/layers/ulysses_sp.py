@@ -24,7 +24,17 @@ from triton_dist_tpu.ops.ulysses import pre_attn_a2a, post_attn_a2a
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
-init = tp_attn.init  # same weight shapes; heads stay *unsharded*
+def init(key, cfg, dtype=jnp.float32):
+    """Same weight shapes as tp_attn; heads stay *unsharded*. The
+    Ulysses fwd applies no projection biases and assumes the q/k norm,
+    so bias-carrying / norm-free (Seed-OSS-class) configs are rejected
+    rather than silently mis-served."""
+    if getattr(cfg, "attention_bias", False) or not getattr(
+            cfg, "qk_norm", True):
+        raise NotImplementedError(
+            "ulysses_sp covers the Qwen3 layer shape (no attention "
+            "biases, per-head q/k norm)")
+    return tp_attn.init(key, cfg, dtype)
 
 
 def param_specs() -> Dict:

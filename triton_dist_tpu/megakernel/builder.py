@@ -98,6 +98,13 @@ class ModelBuilder:
         static load-balanced analogue of the reference's
         ``enable_runtime_scheduler`` (TPU cores share no atomic queue
         head, so balancing happens at schedule time from task costs)."""
+        if getattr(cfg, "attention_bias", False) or not getattr(
+                cfg, "qk_norm", True):
+            raise NotImplementedError(
+                "megakernel task set covers the Qwen3 layer shape "
+                "(no attention biases, per-head q/k norm); serve "
+                "bias-carrying / norm-free checkpoints (Seed-OSS) "
+                "through the layer Engine")
         self.cfg = cfg
         self.mesh = mesh
         self.mctx = MeshContext.from_mesh(mesh)

@@ -33,6 +33,11 @@ class ModelConfig:
     # layers with a full-attention layer every `full_attn_interval`;
     # 0 GDN heads = pure full attention). The reference ships the GDN
     # kernel (``kernels/nvidia/gdn.py``) for this family.
+    # Attention projection biases (Seed-OSS / Qwen2-style checkpoints;
+    # Qwen3 family is bias-free) and the Qwen3 per-head q/k RMS norm
+    # (absent in Seed-OSS/llama-style models).
+    attention_bias: bool = False
+    qk_norm: bool = True
     gdn_num_heads: int = 0          # value heads (HF linear_num_value_heads)
     # Key heads may differ from value heads in real Qwen3-Next configs
     # (HF linear_num_key_heads); 0 means "same as gdn_num_heads". The
@@ -165,6 +170,20 @@ class ModelConfig:
             num_attention_heads=heads,
             num_key_value_heads=get("num_key_value_heads", heads),
             head_dim=get("head_dim") or d // heads,
+            # Qwen2-family configs omit the key but hardcode q/k/v
+            # biases in the HF implementation — default from the model
+            # type so those checkpoints don't silently drop biases.
+            attention_bias=bool(get(
+                "attention_bias",
+                str(get("model_type", "")).startswith("qwen2"))),
+            # The per-head q/k RMS norm is a Qwen3-family trait; bias-
+            # carrying llama-style checkpoints (Seed-OSS, the whole
+            # Qwen2 family incl. qwen2_moe/qwen2_vl) have no
+            # q_norm/k_norm weights.
+            qk_norm=not (
+                str(get("model_type", "")).startswith("qwen2")
+                or get("model_type", "qwen3") in (
+                    "seed_oss", "llama", "mistral")),
             rms_norm_eps=get("rms_norm_eps", 1e-6),
             rope_theta=get("rope_theta", 1_000_000.0),
             max_position_embeddings=get("max_position_embeddings", 40960),

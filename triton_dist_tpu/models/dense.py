@@ -84,7 +84,7 @@ def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
 
 def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
     layer_spec = {
-        "attn": tp_attn.param_specs(axis),
+        "attn": tp_attn.param_specs(axis, cfg),
         "mlp": tp_mlp.param_specs(axis),
         "ln_attn": P(None),
         "ln_mlp": P(None),

@@ -27,6 +27,32 @@ def _to_np(t):
     return np.asarray(t)
 
 
+def _attn_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
+                  dtype) -> Dict:
+    """Attention sub-dict for one layer, matching ``tp_attn.init``'s
+    conditional keys (q/k norm when ``cfg.qk_norm``; Seed-OSS /
+    Qwen2-style projection biases when ``cfg.attention_bias``)."""
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+    gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
+    attn = {
+        "wq": gT(prefix + "self_attn.q_proj.weight"),
+        "wk": gT(prefix + "self_attn.k_proj.weight"),
+        "wv": gT(prefix + "self_attn.v_proj.weight"),
+        "wo": gT(prefix + "self_attn.o_proj.weight"),
+    }
+    if cfg.qk_norm:
+        attn["q_norm"] = g(prefix + "self_attn.q_norm.weight")
+        attn["k_norm"] = g(prefix + "self_attn.k_norm.weight")
+    if cfg.attention_bias:
+        attn["bq"] = g(prefix + "self_attn.q_proj.bias")
+        attn["bk"] = g(prefix + "self_attn.k_proj.bias")
+        attn["bv"] = g(prefix + "self_attn.v_proj.bias")
+        bo_key = prefix + "self_attn.o_proj.bias"
+        attn["bo"] = (g(bo_key) if bo_key in state else
+                      jnp.zeros((cfg.hidden_size,), dtype))
+    return attn
+
+
 def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
                               dtype=jnp.bfloat16) -> Dict:
     """Map a Qwen3 HF state dict to the DenseLLM param pytree.
@@ -39,14 +65,7 @@ def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
     for i in range(cfg.num_hidden_layers):
         p = f"model.layers.{i}."
         layers.append({
-            "attn": {
-                "wq": gT(p + "self_attn.q_proj.weight"),
-                "wk": gT(p + "self_attn.k_proj.weight"),
-                "wv": gT(p + "self_attn.v_proj.weight"),
-                "wo": gT(p + "self_attn.o_proj.weight"),
-                "q_norm": g(p + "self_attn.q_norm.weight"),
-                "k_norm": g(p + "self_attn.k_norm.weight"),
-            },
+            "attn": _attn_from_hf(state, cfg, p, dtype),
             "mlp": {
                 "w_gate": gT(p + "mlp.gate_proj.weight"),
                 "w_up": gT(p + "mlp.up_proj.weight"),
@@ -85,14 +104,7 @@ def moe_params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
     for i in range(cfg.num_hidden_layers):
         p = f"model.layers.{i}."
         layers.append({
-            "attn": {
-                "wq": gT(p + "self_attn.q_proj.weight"),
-                "wk": gT(p + "self_attn.k_proj.weight"),
-                "wv": gT(p + "self_attn.v_proj.weight"),
-                "wo": gT(p + "self_attn.o_proj.weight"),
-                "q_norm": g(p + "self_attn.q_norm.weight"),
-                "k_norm": g(p + "self_attn.k_norm.weight"),
-            },
+            "attn": _attn_from_hf(state, cfg, p, dtype),
             "moe": {
                 "router": gT(p + "mlp.gate.weight"),
                 "w_gate": stack_T(p + "mlp.", "gate_proj"),

@@ -55,7 +55,7 @@ def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
     moe_specs = (tp_moe.param_specs(axis) if moe_impl == "tp"
                  else ep_moe.param_specs(ep_axis))
     layer_spec = {
-        "attn": tp_attn.param_specs(axis),
+        "attn": tp_attn.param_specs(axis, cfg),
         "moe": moe_specs,
         "ln_attn": P(None),
         "ln_mlp": P(None),

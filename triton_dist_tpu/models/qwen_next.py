@@ -110,7 +110,7 @@ def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
 def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
     layers = []
     for li in range(cfg.num_hidden_layers):
-        mixer = (tp_attn.param_specs(axis)
+        mixer = (tp_attn.param_specs(axis, cfg)
                  if cfg.layer_is_full_attn(li)
                  else gdn_attn.param_specs(axis))
         layers.append({

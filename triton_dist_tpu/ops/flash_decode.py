@@ -9,6 +9,13 @@ TPU redesign: the KV cache is *sequence*-sharded along ``axis``; each
 rank computes a flash partial (m, l, acc) over its shard, then a single
 log-sum-exp combine runs as three tiny collectives (pmax + two psums) —
 the analogue of the reference's intra/inter-rank combine kernels.
+
+This module is the pure-XLA composition (simple, any cache layout).
+The ONE-KERNEL form — online softmax + in-kernel RDMA partial
+exchange, no XLA collectives per step — is
+:func:`~triton_dist_tpu.ops.paged_flash_decode.sp_flash_decode_fused`
+(dense head-major caches) / :func:`...paged_flash_decode
+.paged_flash_decode` (paged pools).
 """
 
 from __future__ import annotations
