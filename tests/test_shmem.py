@@ -392,3 +392,119 @@ def test_fcollect_in_kernel(tp8_mesh, tp8_ctx):
     out = spmd(tp8_mesh, run, P("tp", None), P(None, None, None))(x)
     expected = jnp.asarray(x).reshape(8, 4, 128)
     assert_allclose(out, expected)
+
+
+# The reference libshmem_device surface (language/extra/
+# libshmem_device.py public defs, enumerated from the source). Every
+# name must resolve on lang.shmem_device — as a real implementation, a
+# documented granularity-collapse alias, or a documented-impossible
+# stub that raises NotImplementedError with the TPU redesign pointer.
+_REFERENCE_LIBSHMEM_SURFACE = [
+    "barrier", "barrier_all", "barrier_all_block", "barrier_all_vec",
+    "barrier_all_warp", "barrier_all_wave", "barrier_all_wg",
+    "barrier_block", "barrier_warp",
+    "broadcast", "broadcast_block", "broadcast_warp",
+    "broadcastmem", "broadcastmem_block", "broadcastmem_warp",
+    "fcollect", "fcollect_block", "fcollect_warp",
+    "fcollectmem", "fcollectmem_block", "fcollectmem_warp",
+    "fence",
+    "getmem", "getmem_block", "getmem_nbi", "getmem_nbi_block",
+    "getmem_nbi_warp", "getmem_nbi_wave", "getmem_nbi_wg",
+    "getmem_warp", "getmem_wave", "getmem_wg",
+    "int_p", "my_pe", "n_pes",
+    "putmem", "putmem_block", "putmem_nbi", "putmem_nbi_block",
+    "putmem_nbi_warp", "putmem_nbi_wave", "putmem_nbi_wg",
+    "putmem_rma", "putmem_rma_block", "putmem_rma_nbi",
+    "putmem_rma_nbi_block", "putmem_rma_nbi_warp", "putmem_rma_warp",
+    "putmem_signal", "putmem_signal_block", "putmem_signal_nbi",
+    "putmem_signal_nbi_block", "putmem_signal_nbi_warp",
+    "putmem_signal_nbi_wave", "putmem_signal_nbi_wg",
+    "putmem_signal_rma", "putmem_signal_rma_block",
+    "putmem_signal_rma_nbi", "putmem_signal_rma_nbi_block",
+    "putmem_signal_rma_nbi_warp", "putmem_signal_rma_warp",
+    "putmem_signal_warp", "putmem_signal_wave", "putmem_signal_wg",
+    "putmem_warp", "putmem_wave", "putmem_wg",
+    "quiet", "quiet_pe",
+    "remote_mc_ptr", "remote_ptr", "set_rocshmem_ctx",
+    "signal_op", "signal_wait_until",
+    "sync_all", "sync_all_block", "sync_all_warp",
+    "team_my_pe", "team_n_pes", "team_sync_block", "team_sync_warp",
+    "team_translate_pe",
+    "uint64_wait_until_equals", "ulong_put_signal",
+]
+
+_DOCUMENTED_IMPOSSIBLE = {"remote_ptr", "remote_mc_ptr",
+                          "set_rocshmem_ctx"}
+
+
+def test_libshmem_surface_parity():
+    from triton_dist_tpu.lang import shmem_device
+
+    for name in _REFERENCE_LIBSHMEM_SURFACE:
+        fn = getattr(shmem_device, name, None)
+        assert callable(fn), f"missing libshmem surface name: {name}"
+        assert name in shmem_device.__all__, f"{name} not exported"
+    # The impossible trio must raise with a redesign pointer, not exist
+    # as silent no-ops.
+    with pytest.raises(NotImplementedError):
+        shmem_device.remote_ptr(None, 0)
+    with pytest.raises(NotImplementedError):
+        shmem_device.remote_mc_ptr(None, None)
+    with pytest.raises(NotImplementedError):
+        shmem_device.set_rocshmem_ctx(None)
+    # __all__ itself must resolve (catches stale export lists).
+    for name in shmem_device.__all__:
+        assert hasattr(shmem_device, name), f"__all__ lists {name}"
+
+
+def test_team_barrier_in_kernel(dp2tp4_mesh, dp2tp4_ctx):
+    """barrier(team) over the tp team: all four tp peers of each dp
+    group must pass it; completion proves the team-scoped signal/wait
+    count is balanced."""
+    from triton_dist_tpu.lang import team_axis
+
+    tp = team_axis(dp2tp4_ctx, "tp")
+
+    def kernel(out_ref, v):
+        dl.barrier(tp)
+        v[...] = jnp.ones_like(v)
+        pltpu.sync_copy(v, out_ref)
+
+    def run():
+        return core_call(
+            kernel, comm=True,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+        )()
+
+    out = spmd(dp2tp4_mesh, run, (), P(("dp", "tp"), None))()
+    assert_allclose(out, jnp.ones((64, 128)))
+
+
+def test_int_p_single_word(tp8_mesh, tp8_ctx):
+    """int_p ships one word to the right neighbour's slot."""
+
+    def kernel(out_ref, staging, send_sem, recv_sem, *, ctx):
+        me = dl.rank("tp")
+        n = dl.num_ranks("tp")
+        right = jax.lax.rem(me + 1, n)
+        dl.barrier_tile("tp", ctx=ctx)
+        copy = dl.int_p(out_ref, 7, staging, right, send_sem, recv_sem,
+                        axis="tp", ctx=ctx)
+        copy.wait()
+
+    def run():
+        return core_call(
+            functools.partial(kernel, ctx=tp8_ctx),
+            comm=True,
+            out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.VMEM((1, 128), jnp.int32),
+                            pltpu.SemaphoreType.DMA(()),
+                            pltpu.SemaphoreType.DMA(())],
+        )()
+
+    out = spmd(tp8_mesh, run, (), P("tp", None))()
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.full((8, 128), 7, np.int32))

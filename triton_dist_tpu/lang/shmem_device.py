@@ -30,6 +30,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -37,6 +38,47 @@ from triton_dist_tpu.parallel.mesh import logical_device_id
 
 SIGNAL_SET = "set"   # reference: SignalOp::SET (DistributedAttrDefs.td:36)
 SIGNAL_ADD = "add"   # reference: SignalOp::ADD
+
+# The full public surface (tests/test_shmem.py asserts this covers the
+# reference's ~80-name libshmem_device API one-to-one).
+__all__ = [
+    "SIGNAL_SET", "SIGNAL_ADD",
+    "rank", "num_ranks", "my_pe", "n_pes",
+    "remote_put",
+    "putmem", "putmem_block", "putmem_warp", "putmem_wave", "putmem_wg",
+    "putmem_nbi", "putmem_nbi_block", "putmem_nbi_warp",
+    "putmem_nbi_wave", "putmem_nbi_wg",
+    "putmem_rma", "putmem_rma_block", "putmem_rma_warp",
+    "putmem_rma_nbi", "putmem_rma_nbi_block", "putmem_rma_nbi_warp",
+    "putmem_signal", "putmem_signal_block", "putmem_signal_warp",
+    "putmem_signal_wave", "putmem_signal_wg",
+    "putmem_signal_nbi", "putmem_signal_nbi_block",
+    "putmem_signal_nbi_warp", "putmem_signal_nbi_wave",
+    "putmem_signal_nbi_wg",
+    "putmem_signal_rma", "putmem_signal_rma_block",
+    "putmem_signal_rma_warp", "putmem_signal_rma_nbi",
+    "putmem_signal_rma_nbi_block", "putmem_signal_rma_nbi_warp",
+    "ulong_put_signal", "int_p",
+    "getmem", "getmem_block", "getmem_warp", "getmem_wave", "getmem_wg",
+    "getmem_nbi", "getmem_nbi_block", "getmem_nbi_warp",
+    "getmem_nbi_wave", "getmem_nbi_wg",
+    "broadcast", "broadcast_block", "broadcast_warp",
+    "broadcastmem", "broadcastmem_block", "broadcastmem_warp",
+    "fcollect", "fcollect_block", "fcollect_warp",
+    "fcollectmem", "fcollectmem_block", "fcollectmem_warp",
+    "amo_add", "fence", "quiet", "quiet_pe",
+    "notify", "signal_op", "wait", "signal_wait_until",
+    "uint64_wait_until_equals", "wait_arrivals", "consume_token",
+    "barrier", "barrier_block", "barrier_warp",
+    "barrier_all", "barrier_all_block", "barrier_all_vec",
+    "barrier_all_warp", "barrier_all_wave", "barrier_all_wg",
+    "barrier_tile",
+    "sync_all", "sync_all_block", "sync_all_warp",
+    "team_sync_block", "team_sync_warp",
+    "team_my_pe", "team_n_pes", "team_translate_pe",
+    "local_copy", "local_copy_async",
+    "remote_ptr", "remote_mc_ptr", "set_rocshmem_ctx",
+]
 
 
 # ---------------------------------------------------------------------------
@@ -112,6 +154,11 @@ def putmem_signal_block(dst_ref, src_ref, sig_sem, peer, send_sem, recv_sem,
     ``sig_sem`` purely for application-level sequencing (tile counters
     etc.). The fused ops in this package follow that discipline.
 
+    The returned handle's send side is ALREADY drained — do not pass it
+    to :func:`fence`/:func:`quiet` again. TPU semaphore waits consume
+    counts (unlike NVSHMEM quiet, which is idempotent), so a second
+    drain blocks forever.
+
     Reference: ``libshmem_device.putmem_signal_block`` / ``_nbi``.
     """
     copy = remote_put(src_ref, dst_ref, send_sem, recv_sem, peer, axis=axis,
@@ -156,14 +203,35 @@ def getmem_block(dst_ref, src_ref, peer, requester, send_sem, recv_sem, *,
 # reference surface addressable one-to-one.
 # ---------------------------------------------------------------------------
 
+putmem = putmem_block
+putmem_nbi = putmem_block
 putmem_nbi_block = putmem_block
+putmem_nbi_warp = putmem_block
+putmem_nbi_wave = putmem_block
+putmem_nbi_wg = putmem_block
 putmem_warp = putmem_block
 putmem_wave = putmem_block
 putmem_wg = putmem_block
+getmem = getmem_block
+getmem_nbi = getmem_block
 getmem_nbi_block = getmem_block
+getmem_nbi_warp = getmem_block
+getmem_nbi_wave = getmem_block
+getmem_nbi_wg = getmem_block
 getmem_warp = getmem_block
 getmem_wave = getmem_block
 getmem_wg = getmem_block
+
+# The reference's _rma tier pins transfers to the proxy/RMA engine
+# (IBGDA vs P2P copy, ``libshmem_device.py`` putmem_rma*). TPU exposes
+# exactly one remote-DMA path — the ICI/DCN DMA engine — so the RMA
+# tier IS the normal put.
+putmem_rma = putmem_block
+putmem_rma_block = putmem_block
+putmem_rma_warp = putmem_block
+putmem_rma_nbi = putmem_block
+putmem_rma_nbi_block = putmem_block
+putmem_rma_nbi_warp = putmem_block
 
 
 def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, peer, send_sem,
@@ -179,6 +247,52 @@ def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, peer, send_sem,
                       axis=axis, ctx=ctx)
     notify(sig_sem, peer, axis=axis, ctx=ctx, inc=sig_inc)
     return copy
+
+
+# put+signal granularity/rma tiers (same collapse as the puts above).
+putmem_signal = putmem_signal_block
+putmem_signal_warp = putmem_signal_block
+putmem_signal_wave = putmem_signal_block
+putmem_signal_wg = putmem_signal_block
+putmem_signal_rma = putmem_signal_block
+putmem_signal_rma_block = putmem_signal_block
+putmem_signal_rma_warp = putmem_signal_block
+putmem_signal_nbi = putmem_signal_nbi_block
+putmem_signal_nbi_warp = putmem_signal_nbi_block
+putmem_signal_nbi_wave = putmem_signal_nbi_block
+putmem_signal_nbi_wg = putmem_signal_nbi_block
+putmem_signal_rma_nbi = putmem_signal_nbi_block
+putmem_signal_rma_nbi_block = putmem_signal_nbi_block
+putmem_signal_rma_nbi_warp = putmem_signal_nbi_block
+def ulong_put_signal(dst_ref, value, staging_ref, sig_sem, peer,
+                     send_sem, recv_sem, *, axis: str, ctx=None,
+                     sig_inc: int = 1):
+    """Word-sized put of an immediate + remote signal (reference
+    ``libshmem_device.ulong_put_signal(ptr, value, sig, ...)``).
+
+    Like :func:`int_p`, TPU DMA sources from memory: the immediate is
+    staged through the caller's 1-element ``staging_ref`` and shipped
+    as a normal put+signal (same ordering caveats as
+    :func:`putmem_signal_block`)."""
+    staging_ref[...] = jnp.full_like(staging_ref[...], value)
+    return putmem_signal_block(dst_ref, staging_ref, sig_sem, peer,
+                               send_sem, recv_sem, axis=axis, ctx=ctx,
+                               sig_inc=sig_inc)
+
+
+def int_p(dst_ref, value, staging_ref, peer, send_sem, recv_sem, *,
+          axis: str, ctx=None):
+    """Single-word put of an immediate (reference
+    ``libshmem_device.int_p(ptr, value, pe)``).
+
+    TPU DMA sources from memory, not immediates, so the caller provides
+    a 1-element ``staging_ref`` (SMEM/VMEM scratch); the value is
+    stored there and shipped with the normal remote DMA. Arrival is the
+    destination's ``recv_sem`` — there is no raced flag-word store.
+    """
+    staging_ref[...] = jnp.full_like(staging_ref[...], value)
+    return remote_put(staging_ref, dst_ref, send_sem, recv_sem, peer,
+                      axis=axis, ctx=ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +360,23 @@ def fcollect(dst_ref, src_ref, send_sem, recv_sem, *, axis: str,
     wait_arrivals(recv_sem, dst_ref.at[0], n - 1)
 
 
+# Typed-value and granularity tiers of broadcast/fcollect: Pallas refs
+# are typed (there is no separate bytes-vs-elements form), and one DMA
+# engine per core collapses the thread tiers — so the reference's
+# broadcast/broadcastmem x {,_block,_warp} six-way split is one
+# function each.
+broadcast = broadcastmem
+broadcast_block = broadcastmem
+broadcast_warp = broadcastmem
+broadcastmem_block = broadcastmem
+broadcastmem_warp = broadcastmem
+fcollect_block = fcollect
+fcollect_warp = fcollect
+fcollectmem = fcollect
+fcollectmem_block = fcollect
+fcollectmem_warp = fcollect
+
+
 # ---------------------------------------------------------------------------
 # AMO (atomic memory operations)
 #
@@ -293,9 +424,22 @@ def quiet(*copies):
     TPU only the *receiver* can certify arrival (its ``recv_sem``).
     Do not follow quiet with a raced flag signal — consumers must wait
     the DMA's own recv semaphore before reading the destination.
+
+    NOT idempotent (also unlike NVSHMEM): each handle's send side can
+    be drained exactly once — by quiet/fence, ``copy.wait()``, or a
+    put+signal helper's internal drain — a second wait consumes counts
+    that never come.
     """
     for c in copies:
         c.wait_send()
+
+
+def quiet_pe(peer, *copies):
+    """Per-PE quiet (reference ``libshmem_device.quiet_pe``): TPU DMA
+    handles are already per-transfer, so draining the handles aimed at
+    ``peer`` IS the per-PE form — the caller passes exactly those."""
+    del peer
+    quiet(*copies)
 
 
 # ---------------------------------------------------------------------------
@@ -354,6 +498,13 @@ def signal_wait_until(sem, cmp: str, value: int):
     if cmp not in ("eq", "ge"):
         raise NotImplementedError(f"cmp {cmp!r} not expressible on TPU")
     pltpu.semaphore_wait(sem, value)
+
+
+def uint64_wait_until_equals(sem, value: int):
+    """Reference ``libshmem_device.uint64_wait_until_equals(ptr, val)``
+    — the word is a counting semaphore here (see
+    :func:`signal_wait_until` for the count-protocol mapping)."""
+    signal_wait_until(sem, "eq", value)
 
 
 def wait_arrivals(sem, ref, count: int = 1):
@@ -419,6 +570,69 @@ def barrier_tile(axis: str, *, ctx=None, sem=None):
     wait(sem, 2)
 
 
+def barrier(team):
+    """Barrier over a :class:`~triton_dist_tpu.lang.teams.Team`
+    (reference ``libshmem_device.barrier(team)`` :126): every team PE
+    signals every other and waits for the full team count on the
+    collective-id-keyed barrier semaphore.
+
+    NVSHMEM's ``barrier`` implies quiet (outstanding puts complete);
+    here put completion is certified per-DMA by the receiver's
+    ``recv_sem`` — this barrier orders *kernel progress* only, which
+    makes it the same operation as :func:`sync_all` scoped to a team
+    (the delta :func:`quiet` documents).
+    """
+    sem = pltpu.get_barrier_semaphore()
+    n = team.n_pes()
+    for pe in range(n):
+        pltpu.semaphore_signal(
+            sem, inc=1,
+            device_id=team.device_id(pe),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(sem, n)
+
+
+# Granularity tiers (one core drives the kernel — see the put tiers).
+barrier_block = barrier
+barrier_warp = barrier
+barrier_all_block = barrier_all
+barrier_all_vec = barrier_all
+barrier_all_warp = barrier_all
+barrier_all_wave = barrier_all
+barrier_all_wg = barrier_all
+
+# NVSHMEM splits barrier_all (quiet + sync) from sync_all (sync only).
+# On TPU put completion is the receiver's recv_sem, never a sender-side
+# global drain, so the split collapses: barrier_all IS sync-only, and
+# sync_all is the same function (documented in barrier()/quiet()).
+sync_all = barrier_all
+sync_all_block = barrier_all
+sync_all_warp = barrier_all
+
+# Team sync tiers: barrier(team) is already sync-only (see above).
+team_sync_block = barrier
+team_sync_warp = barrier
+
+
+# ---------------------------------------------------------------------------
+# Team queries — function forms of lang.teams.Team's methods, matching
+# the reference's flat-function surface (``team_my_pe`` :69,
+# ``team_n_pes`` :74, ``team_translate_pe`` :475).
+# ---------------------------------------------------------------------------
+
+def team_my_pe(team):
+    return team.my_pe()
+
+
+def team_n_pes(team) -> int:
+    return team.n_pes()
+
+
+def team_translate_pe(src_team, pe, dest_team):
+    return src_team.translate_pe(pe, dest_team)
+
+
 # ---------------------------------------------------------------------------
 # Local copies (HBM<->VMEM staging helpers)
 # ---------------------------------------------------------------------------
@@ -433,3 +647,43 @@ def local_copy_async(src_ref, dst_ref, sem, *, start: bool = True):
     if start:
         copy.start()
     return copy
+
+
+# ---------------------------------------------------------------------------
+# Documented platform impossibilities.
+#
+# These reference symbols expose raw device pointers or vendor-runtime
+# state; Pallas has no device-pointer type — remote addressing is the
+# DMA descriptor's ``device_id`` — so they cannot exist on TPU. They
+# raise (rather than being absent) so reference-surface callers get the
+# redesign pointer instead of an AttributeError.
+# ---------------------------------------------------------------------------
+
+def remote_ptr(local_ref, peer):
+    """Reference ``libshmem_device.remote_ptr(ptr, pe)``: translate a
+    symmetric address to a peer's raw pointer for direct ld/st. No TPU
+    analogue — remote memory is reached only through DMA descriptors
+    (:func:`remote_put`) and semaphore signals (:func:`notify`)."""
+    raise NotImplementedError(
+        "TPU has no raw remote pointers; address peers via remote_put/"
+        "notify device_id (docs/primitives.md)")
+
+
+def remote_mc_ptr(team, local_ref):
+    """Reference ``libshmem_device.remote_mc_ptr`` (NVLS multicast
+    pointer): no ICI analogue — multimem stores do not exist; one-shot
+    multicast is expressed as the full-mesh push schedule
+    (:func:`fcollect`, ``ops/allreduce.py`` one-shot)."""
+    raise NotImplementedError(
+        "no ICI multicast pointer; use the full-mesh push schedules "
+        "(fcollect / ops.allreduce one-shot)")
+
+
+def set_rocshmem_ctx(ctx):
+    """Reference ``libshmem_device.set_rocshmem_ctx`` (ROCSHMEM device
+    context registration): vendor-runtime state with no TPU counterpart
+    — Mosaic kernels carry their communication identity in
+    ``collective_id`` CompilerParams (``lang/pallas_helpers.py``)."""
+    raise NotImplementedError(
+        "no device SHMEM context on TPU; collective identity is the "
+        "kernel's collective_id (lang/pallas_helpers.core_call)")
