@@ -47,6 +47,41 @@ def make_moe():
     print("wrote", out)
 
 
+def make_next():
+    """Tiny Qwen3-Next (hybrid GDN + gated attention + shared-expert
+    MoE): 4 layers, 3 linear : 1 full, every head count divisible by
+    the 8-device test mesh."""
+    from transformers import Qwen3NextConfig, Qwen3NextForCausalLM
+
+    cfg = Qwen3NextConfig(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=8, head_dim=8,
+        max_position_embeddings=128, rope_theta=1e4,
+        partial_rotary_factor=0.25,
+        linear_num_key_heads=8, linear_num_value_heads=16,
+        linear_key_head_dim=4, linear_value_head_dim=4,
+        linear_conv_kernel_dim=4,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=16,
+        shared_expert_intermediate_size=16, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        tie_word_embeddings=False)
+    torch.manual_seed(2)
+    model = Qwen3NextForCausalLM(cfg).float().eval()
+    # Default-initialized RMSNorm weights are exactly zero
+    # (zero-centered convention) and A_log/dt_bias are constants —
+    # perturb everything so the parity test is numerically
+    # load-bearing for every parameter.
+    g = torch.Generator().manual_seed(3)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(torch.randn(p.shape, generator=g) * 0.05)
+    out = os.path.join(HERE, "qwen3_next_tiny")
+    model.save_pretrained(out, safe_serialization=True)
+    print("wrote", out)
+
+
 if __name__ == "__main__":
     make_dense()
     make_moe()
+    make_next()

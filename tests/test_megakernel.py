@@ -454,7 +454,9 @@ def test_megakernel_hybrid_gdn_decode_vs_layers(tp2_mesh):
 
     def oracle(p, tok, kc, vc, st):
         cache = qwen_next.HybridCache(
-            kv=KVCache(k=kc, v=vc, length=pos), states=st)
+            kv=KVCache(k=kc, v=vc, length=pos), states=st,
+            conv=jnp.zeros((st.shape[0], st.shape[1], 0, 0),
+                           jnp.float32))
         lg, cache2 = qwen_next.decode_step(p, tok, cache, hcfg)
         return lg, cache2.states
 

@@ -1,0 +1,336 @@
+"""HF-faithful Qwen3-Next parity (layer-by-layer vs transformers).
+
+The reference serves Qwen3-Next through its GDN kernel + megakernel
+(``kernels/nvidia/gdn.py``); checkpoint compatibility means matching
+the EXACT HF cell — conv, z-gate, A_log/dt_bias decay, GQA repeat,
+gated RMSNorm — not just the delta-rule core. Every test here builds
+the real ``transformers.models.qwen3_next`` torch module with random
+weights, maps its state dict through the loader's de-interleave, and
+matches activations on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.utils.testing import spmd
+
+torch = pytest.importorskip("torch")
+
+from transformers.models.qwen3_next.configuration_qwen3_next import (  # noqa: E402
+    Qwen3NextConfig,
+)
+
+B, S = 2, 16
+D, HK, HV, DK, DV, CONV = 32, 8, 16, 4, 4, 4
+
+
+def _hf_config(**kw):
+    base = dict(
+        vocab_size=64, hidden_size=D, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=8, head_dim=8,
+        linear_num_key_heads=HK, linear_num_value_heads=HV,
+        linear_key_head_dim=DK, linear_value_head_dim=DV,
+        linear_conv_kernel_dim=CONV,
+        partial_rotary_factor=0.25, rope_theta=1e4,
+        num_experts=0, rms_norm_eps=1e-6, hidden_act="silu")
+    base.update(kw)
+    return Qwen3NextConfig(**base)
+
+
+def _cfg():
+    return ModelConfig.from_hf_config(_hf_config().to_dict())
+
+
+def _randomize(module, seed):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in module.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.2)
+    return module
+
+
+def test_from_hf_config_qwen3_next_fields():
+    cfg = _cfg()
+    assert cfg.is_hybrid and cfg.gdn_conv_kernel == CONV
+    assert cfg.gdn_num_kh == HK and cfg.gdn_num_heads == HV
+    assert cfg.attn_gate and cfg.partial_rotary_factor == 0.25
+    # 2 layers, both linear (the serialized layer_types) — no
+    # full-attention layer in range.
+    assert not any(cfg.layer_is_full_attn(i) for i in range(2))
+    # A 3:1 hybrid schedule round-trips through layer_types.
+    cfg8 = ModelConfig.from_hf_config(
+        _hf_config(num_hidden_layers=8).to_dict())
+    assert cfg8.full_attn_interval == 4
+    assert [cfg8.layer_is_full_attn(i) for i in range(8)] == [
+        False, False, False, True, False, False, False, True]
+
+
+def test_gdn_cell_prefill_matches_transformers(tp8_mesh):
+    from transformers.models.qwen3_next.modeling_qwen3_next import (
+        Qwen3NextGatedDeltaNet)
+    from triton_dist_tpu.layers import gdn_attn
+    from triton_dist_tpu.models.hf_loader import gdn_attn_from_hf
+
+    layer = _randomize(
+        Qwen3NextGatedDeltaNet(_hf_config(), layer_idx=0).float().eval(),
+        seed=0)
+    hidden = torch.randn(B, S, D, generator=torch.Generator()
+                         .manual_seed(1))
+    with torch.no_grad():
+        want = layer(hidden).numpy()
+
+    cfg = _cfg()
+    params = gdn_attn_from_hf(
+        {k: v for k, v in layer.state_dict().items()}, cfg, "",
+        jnp.float32)
+    x = jnp.asarray(hidden.numpy().reshape(B * S, D))
+
+    out = spmd(
+        tp8_mesh,
+        lambda p, xx: gdn_attn.fwd_prefill_hf(p, xx, cfg, batch=B)[0],
+        (gdn_attn.param_specs_hf(), P("tp", None)),
+        P("tp", None))(params, x)
+    np.testing.assert_allclose(np.asarray(out).reshape(B, S, D), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gdn_cell_decode_matches_transformers(tp8_mesh):
+    """Prefill S tokens, then 3 recurrent decode steps (conv state +
+    delta-rule state handoff) must reproduce the torch layer run on
+    the full S+3 sequence."""
+    from transformers.models.qwen3_next.modeling_qwen3_next import (
+        Qwen3NextGatedDeltaNet)
+    from triton_dist_tpu.layers import gdn_attn
+    from triton_dist_tpu.models.hf_loader import gdn_attn_from_hf
+
+    extra = 3
+    layer = _randomize(
+        Qwen3NextGatedDeltaNet(_hf_config(), layer_idx=0).float().eval(),
+        seed=2)
+    hidden = torch.randn(B, S + extra, D, generator=torch.Generator()
+                         .manual_seed(3))
+    with torch.no_grad():
+        want = layer(hidden).numpy()
+
+    cfg = _cfg()
+    params = gdn_attn_from_hf(
+        {k: v for k, v in layer.state_dict().items()}, cfg, "",
+        jnp.float32)
+    x_prefill = jnp.asarray(
+        hidden.numpy()[:, :S].reshape(B * S, D))
+
+    def prefill(p, xx):
+        out, (state, conv) = gdn_attn.fwd_prefill_hf(p, xx, cfg,
+                                                     batch=B)
+        return out, state, conv
+
+    out_p, state, conv = spmd(
+        tp8_mesh, prefill,
+        (gdn_attn.param_specs_hf(), P("tp", None)),
+        (P("tp", None), P(None, "tp", None, None),
+         P(None, "tp", None)))(params, x_prefill)
+    np.testing.assert_allclose(np.asarray(out_p).reshape(B, S, D),
+                               want[:, :S], rtol=2e-4, atol=2e-4)
+
+    def decode(p, xx, st, cv):
+        out, st2, cv2 = gdn_attn.fwd_decode_hf(p, xx, cfg, st, cv)
+        return out, st2, cv2
+
+    dec = spmd(
+        tp8_mesh, decode,
+        (gdn_attn.param_specs_hf(), P(None, None),
+         P(None, "tp", None, None), P(None, "tp", None)),
+        (P(None, None), P(None, "tp", None, None), P(None, "tp", None)))
+    for t in range(extra):
+        xt = jnp.asarray(hidden.numpy()[:, S + t])
+        out_d, state, conv = dec(params, xt, state, conv)
+        np.testing.assert_allclose(np.asarray(out_d), want[:, S + t],
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"decode step {t}")
+
+
+def test_gated_attention_matches_transformers(tp8_mesh):
+    """Full-attention layer parity: per-head output gate + partial
+    RoPE + q/k head-dim norms, vs the eager torch forward."""
+    from transformers.models.qwen3_next.modeling_qwen3_next import (
+        Qwen3NextAttention, Qwen3NextRotaryEmbedding)
+    from triton_dist_tpu.layers import tp_attn
+    from triton_dist_tpu.models.hf_loader import _attn_from_hf
+
+    hf_cfg = _hf_config()
+    hf_cfg._attn_implementation = "eager"
+    layer = _randomize(
+        Qwen3NextAttention(hf_cfg, layer_idx=0).float().eval(), seed=4)
+    hidden = torch.randn(B, S, D, generator=torch.Generator()
+                         .manual_seed(5))
+    rot = Qwen3NextRotaryEmbedding(hf_cfg)
+    pos = torch.arange(S)[None].expand(B, S)
+    # Eager attention applies ONLY the passed mask — build the causal
+    # one explicitly.
+    causal = torch.triu(torch.full((S, S), float("-inf")), diagonal=1)
+    causal = causal[None, None].expand(B, 1, S, S)
+    with torch.no_grad():
+        cos_sin = rot(hidden, pos)
+        want = layer(hidden, cos_sin, attention_mask=causal)[0].numpy()
+
+    cfg = _cfg()
+    state = {f"self_attn.{k}": v for k, v in layer.state_dict().items()}
+    params = _attn_from_hf(state, cfg, "", jnp.float32)
+    assert "wqg" in params
+    x = jnp.asarray(hidden.numpy().reshape(B * S, D))
+
+    out = spmd(
+        tp8_mesh,
+        lambda p, xx: tp_attn.fwd_prefill(p, xx, cfg, batch=B,
+                                          kv_out=False),
+        (tp_attn.param_specs("tp", cfg), P("tp", None)),
+        P("tp", None))(params, x)
+    np.testing.assert_allclose(np.asarray(out).reshape(B, S, D), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_shared_expert_matches_transformers(tp8_mesh):
+    """Sparse MoE block with the always-on sigmoid-gated shared
+    expert, vs the torch block (routed combine + shared add)."""
+    from transformers.models.qwen3_next.modeling_qwen3_next import (
+        Qwen3NextSparseMoeBlock)
+    from triton_dist_tpu.layers import tp_moe
+    from triton_dist_tpu.models.hf_loader import _moe_from_hf
+
+    hf_cfg = _hf_config(num_experts=4, num_experts_per_tok=2,
+                        moe_intermediate_size=16,
+                        shared_expert_intermediate_size=16,
+                        norm_topk_prob=True)
+    block = _randomize(Qwen3NextSparseMoeBlock(hf_cfg).float().eval(),
+                       seed=6)
+    hidden = torch.randn(B, S, D, generator=torch.Generator()
+                         .manual_seed(7))
+    with torch.no_grad():
+        want = block(hidden)[0].numpy()
+
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict())
+    assert cfg.shared_expert_intermediate_size == 16
+    params = _moe_from_hf(
+        {k: v for k, v in block.state_dict().items()}, cfg, "",
+        jnp.float32)
+    assert "shared_gate" in params
+    x = jnp.asarray(hidden.numpy().reshape(B * S, D))
+
+    out = spmd(
+        tp8_mesh,
+        lambda p, xx: tp_moe.fwd(p, xx, topk=2, num_experts=4),
+        (tp_moe.param_specs("tp", cfg), P("tp", None)),
+        P("tp", None))(params, x)
+    np.testing.assert_allclose(np.asarray(out).reshape(B, S, D), want,
+                               rtol=2e-4, atol=2e-4)
+
+    # Replicated decode regime agrees with the same oracle.
+    out_ar = spmd(
+        tp8_mesh,
+        lambda p, xx: tp_moe.fwd_ar(p, xx, topk=2, num_experts=4),
+        (tp_moe.param_specs("tp", cfg), P(None, None)),
+        P(None, None))(params, x)
+    np.testing.assert_allclose(np.asarray(out_ar).reshape(B, S, D),
+                               want, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Full-model parity against the committed real-format checkpoint
+# ---------------------------------------------------------------------------
+
+import os  # noqa: E402
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "qwen3_next_tiny")
+
+
+def _torch_logits(ids):
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(FIXTURE).float().eval()
+    with torch.no_grad():
+        return model(torch.from_numpy(np.asarray(ids))).logits.numpy()
+
+
+def test_hybrid_checkpoint_logits_parity(tp8_mesh):
+    """load_hf_checkpoint on a REAL-format Qwen3-Next checkpoint →
+    logits parity with the torch reference forward, sharded over the
+    full 8-device mesh (GDN de-interleave, gated attention, shared
+    expert, zero-centered norms all load-bearing)."""
+    from triton_dist_tpu.models import qwen_next
+    from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(FIXTURE, dtype=jnp.float32)
+    assert cfg.is_hybrid and cfg.gdn_conv_kernel == 4 and cfg.is_moe
+    ids = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0,
+                           cfg.vocab_size))
+    want = _torch_logits(ids)
+
+    got = spmd(
+        tp8_mesh,
+        lambda p, i: qwen_next.forward_tokens(p, i, cfg),
+        (qwen_next.param_specs(cfg), P(None, None)),
+        P(None, None, None))(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_hybrid_checkpoint_prefill_decode_parity(tp8_mesh):
+    """Prefill + recurrent/KV decode continuation must match the torch
+    all-tokens forward at every decoded position."""
+    from triton_dist_tpu.models import qwen_next
+    from triton_dist_tpu.models.dense import FwdContexts
+    from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(FIXTURE, dtype=jnp.float32)
+    s0, extra = 8, 3
+    ids = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (2, s0 + extra), 0,
+                           cfg.vocab_size))
+    want = _torch_logits(ids)
+
+    specs = qwen_next.param_specs(cfg)
+    cspec = qwen_next.cache_specs()
+
+    pre = spmd(
+        tp8_mesh,
+        lambda p, i: qwen_next.prefill(p, i, cfg, max_len=32),
+        (specs, P(None, None)), (P(None, None), cspec))
+    logits, cache = pre(params, jnp.asarray(ids[:, :s0]))
+    np.testing.assert_allclose(np.asarray(logits), want[:, s0 - 1],
+                               rtol=2e-3, atol=2e-3)
+
+    dec = spmd(
+        tp8_mesh,
+        lambda p, t, c: qwen_next.decode_step(p, t, c, cfg),
+        (specs, P(None), cspec), (P(None, None), cspec))
+    for t in range(extra):
+        logits, cache = dec(params, jnp.asarray(ids[:, s0 + t]), cache)
+        np.testing.assert_allclose(
+            np.asarray(logits), want[:, s0 + t], rtol=2e-3, atol=2e-3,
+            err_msg=f"decode step {t}")
+
+
+def test_hybrid_checkpoint_engine_serve(tp8_mesh):
+    """Engine.serve on the real-format checkpoint: greedy tokens agree
+    between the XLA oracle and the fused path."""
+    from triton_dist_tpu.models import Engine, qwen_next
+    from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg, params = load_hf_checkpoint(FIXTURE, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                             cfg.vocab_size)
+    outs = {}
+    for mode in ("xla", "fused"):
+        eng = Engine(cfg, tp8_mesh, mode=mode, max_len=32,
+                     params=params, model=qwen_next,
+                     block_m=8, block_n=8, block_k=32)
+        outs[mode] = np.asarray(eng.serve(ids, gen_len=4))
+    assert outs["xla"].shape == (2, 4)
+    np.testing.assert_array_equal(outs["xla"], outs["fused"])

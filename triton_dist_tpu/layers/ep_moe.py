@@ -19,25 +19,47 @@ from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
 
 
 def init(key, cfg, dtype=jnp.float32) -> Dict:
-    """cfg needs: hidden_size, moe_intermediate_size, num_experts."""
-    kr, kg, ku, kd = jax.random.split(key, 4)
+    """cfg needs: hidden_size, moe_intermediate_size, num_experts
+    (+ shared_expert_intermediate_size for the qwen3_next-style
+    always-on shared expert, 0 = none)."""
+    kr, kg, ku, kd, ksg, ksu, ksd, kss = jax.random.split(key, 8)
     d, f, e = cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts
     scale = d ** -0.5
-    return {
+    p = {
         "router": jax.random.normal(kr, (d, e), dtype) * scale,
         "w_gate": jax.random.normal(kg, (e, d, f), dtype) * scale,
         "w_up": jax.random.normal(ku, (e, d, f), dtype) * scale,
         "w_down": jax.random.normal(kd, (e, f, d), dtype) * (f ** -0.5),
     }
+    fs = getattr(cfg, "shared_expert_intermediate_size", 0)
+    if fs:
+        # Shared expert (Qwen3NextSparseMoeBlock): a dense SwiGLU every
+        # token takes, scaled by a sigmoid scalar gate, added to the
+        # routed combine.
+        p["w_shared_gate"] = jax.random.normal(ksg, (d, fs), dtype) * scale
+        p["w_shared_up"] = jax.random.normal(ksu, (d, fs), dtype) * scale
+        p["w_shared_down"] = jax.random.normal(
+            ksd, (fs, d), dtype) * (fs ** -0.5)
+        p["shared_gate"] = jax.random.normal(kss, (d,), dtype) * scale
+    return p
 
 
-def param_specs(axis: str = "ep") -> Dict:
-    return {
+def param_specs(axis: str = "ep", cfg=None) -> Dict:
+    s = {
         "router": P(None, None),
         "w_gate": P(axis, None, None),  # experts sharded
         "w_up": P(axis, None, None),
         "w_down": P(axis, None, None),
     }
+    if cfg is not None and getattr(cfg, "shared_expert_intermediate_size",
+                                   0):
+        # EP shards experts, not ffn dims: the dense shared expert is
+        # replicated and applied to each rank's own tokens.
+        s["w_shared_gate"] = P(None, None)
+        s["w_shared_up"] = P(None, None)
+        s["w_shared_down"] = P(None, None)
+        s["shared_gate"] = P(None)
+    return s
 
 
 def route(router_w, x, topk: int, *, norm_topk_prob: bool = True):
@@ -49,6 +71,27 @@ def route(router_w, x, topk: int, *, norm_topk_prob: bool = True):
     if norm_topk_prob:
         topk_w = topk_w / jnp.sum(topk_w, axis=-1, keepdims=True)
     return topk_ids.astype(jnp.int32), topk_w
+
+
+def shared_expert_out(params, x):
+    """Sigmoid-gated dense SwiGLU branch (qwen3_next shared expert);
+    None when the layer has no shared expert. Under TP ffn-sharded
+    weights the result is a PARTIAL sum (the caller's reduce completes
+    it — the sigmoid gate uses the replicated ``shared_gate`` vector so
+    every rank scales by the same factor); under replicated weights
+    (EP) it is the full contribution."""
+    if "w_shared_gate" not in params:
+        return None
+    g = jnp.dot(x, params["w_shared_gate"])
+    u = jnp.dot(x, params["w_shared_up"])
+    act = (jax.nn.silu(g.astype(jnp.float32))
+           * u.astype(jnp.float32)).astype(x.dtype)
+    out = jnp.dot(act, params["w_shared_down"],
+                  preferred_element_type=jnp.float32)
+    gate = jax.nn.sigmoid(jnp.dot(x.astype(jnp.float32),
+                                  params["shared_gate"]
+                                  .astype(jnp.float32)))
+    return out * gate[:, None]
 
 
 def fwd(params, x, ep_ctx: EPContext, *, topk: int,
@@ -65,7 +108,9 @@ def fwd(params, x, ep_ctx: EPContext, *, topk: int,
                                 params["w_up"], params["w_down"],
                                 group_sizes)
     expert_out = expert_out[inv]  # back to slot order
-    return ep_combine(expert_out, state, topk_w, ep_ctx)
+    y = ep_combine(expert_out, state, topk_w, ep_ctx)
+    sh = shared_expert_out(params, x)   # replicated weights: full value
+    return y if sh is None else (y + sh.astype(y.dtype))
 
 
 def fwd_2d(params, x, ep2d_ctx, *, topk: int,
@@ -84,7 +129,9 @@ def fwd_2d(params, x, ep2d_ctx, *, topk: int,
     expert_out = grouped_swiglu(sorted_tok, params["w_gate"],
                                 params["w_up"], params["w_down"],
                                 group_sizes)
-    return ep_combine_2d(expert_out[inv], state, topk_w, ep2d_ctx)
+    y = ep_combine_2d(expert_out[inv], state, topk_w, ep2d_ctx)
+    sh = shared_expert_out(params, x)
+    return y if sh is None else (y + sh.astype(y.dtype))
 
 
 def fwd_decode(params, x, *, topk: int, axis: str = "ep",
@@ -122,7 +169,11 @@ def fwd_decode(params, x, *, topk: int, axis: str = "ep",
     y = jnp.einsum("ebf,efd->ebd", act.astype(x.dtype),
                    params["w_down"])        # (e_loc, B, d)
     out = jnp.einsum("ebd,be->bd", y.astype(jnp.float32), w_be)
-    return jax.lax.psum(out, axis).astype(x.dtype)
+    out = jax.lax.psum(out, axis).astype(x.dtype)
+    # Replicated shared-expert weights: the full contribution adds
+    # AFTER the reduce (inside it, n ranks would count it n times).
+    sh = shared_expert_out(params, x)
+    return out if sh is None else (out + sh.astype(out.dtype))
 
 
 def fwd_fused(params, x, ep_ctx: EPFusedContext, *, topk: int,
@@ -132,6 +183,10 @@ def fwd_fused(params, x, ep_ctx: EPFusedContext, *, topk: int,
     Returns ((T_loc, d), num_dropped)."""
     topk_ids, topk_w = route(params["router"], x, topk,
                              norm_topk_prob=norm_topk_prob)
-    return ep_moe_fused(x, topk_ids, topk_w, params["w_gate"],
-                        params["w_up"], params["w_down"], ep_ctx,
-                        w_gu=params.get("w_gu"))
+    y, dropped = ep_moe_fused(x, topk_ids, topk_w, params["w_gate"],
+                              params["w_up"], params["w_down"], ep_ctx,
+                              w_gu=params.get("w_gu"))
+    sh = shared_expert_out(params, x)   # replicated weights: full value
+    if sh is not None:
+        y = y + sh.astype(y.dtype)
+    return y, dropped

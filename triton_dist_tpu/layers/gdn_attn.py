@@ -18,6 +18,19 @@ TP treatment ``layers/nvidia/tp_attn.py`` gives softmax attention:
 
 Gate parameterization: ``g = -softplus(x·wg + g_bias)`` (decay ≤ 0),
 ``beta = sigmoid(x·wb)`` — the standard gated-delta-net form.
+
+TWO CELLS share this module, selected by ``cfg.gdn_conv_kernel``:
+
+- 0 — the in-framework simplified cell above (wq/wk/wv/wg/wb/g_bias/wo,
+  equal k/v head counts, no conv);
+- >0 — the HF-checkpoint-faithful Qwen3-Next GatedDeltaNet
+  (``transformers/models/qwen3_next`` ``Qwen3NextGatedDeltaNet``):
+  short causal depthwise conv over (q,k,v) with SiLU, separate key/value
+  head counts with GQA repeat, ``g = -exp(A_log)·softplus(a+dt_bias)``,
+  z-gated per-head RMSNorm before the out-projection, q scaled by
+  ``dk**-0.5``. The mapper (``models/hf_loader.py``) de-interleaves
+  ``in_proj_qkvz``/``in_proj_ba``/``conv1d`` into this head-major
+  TP-shardable layout at load time.
 """
 
 from __future__ import annotations
@@ -33,6 +46,8 @@ from triton_dist_tpu.ops.gdn import gdn_fwd_chunked, gdn_decode_step
 
 
 def init(key, cfg, dtype=jnp.float32) -> Dict:
+    if getattr(cfg, "gdn_conv_kernel", 0):
+        return init_hf(key, cfg, dtype)
     kq, kk, kv, kg, kb, ko = jax.random.split(key, 6)
     d = cfg.hidden_size
     h = cfg.gdn_num_heads
@@ -54,7 +69,9 @@ def init(key, cfg, dtype=jnp.float32) -> Dict:
     }
 
 
-def param_specs(axis: str = "tp") -> Dict:
+def param_specs(axis: str = "tp", cfg=None) -> Dict:
+    if cfg is not None and getattr(cfg, "gdn_conv_kernel", 0):
+        return param_specs_hf(axis)
     return {
         "wq": P(None, axis),
         "wk": P(None, axis),
@@ -64,6 +81,208 @@ def param_specs(axis: str = "tp") -> Dict:
         "g_bias": P(None),
         "wo": P(axis, None),
     }
+
+
+# ---------------------------------------------------------------------------
+# HF-faithful Qwen3-Next cell
+# ---------------------------------------------------------------------------
+
+def init_hf(key, cfg, dtype=jnp.float32) -> Dict:
+    """Checkpoint-compatible parameter tree, already de-interleaved to
+    head-major per-projection matrices (the layout the mapper emits)."""
+    ks = jax.random.split(key, 8)
+    d = cfg.hidden_size
+    hk, hv = cfg.gdn_num_kh, cfg.gdn_num_heads
+    dk, dv = cfg.gdn_head_dim_k, cfg.gdn_head_dim_v
+    kk = cfg.gdn_conv_kernel
+    s = d ** -0.5
+    return {
+        "wq": jax.random.normal(ks[0], (d, hk * dk), dtype) * s,
+        "wk": jax.random.normal(ks[1], (d, hk * dk), dtype) * s,
+        "wv": jax.random.normal(ks[2], (d, hv * dv), dtype) * s,
+        "wz": jax.random.normal(ks[3], (d, hv * dv), dtype) * s,
+        "wb": jax.random.normal(ks[4], (d, hv), dtype) * s,
+        "wa": jax.random.normal(ks[5], (d, hv), dtype) * s,
+        # Depthwise causal conv taps, channel-major [q | k | v] in the
+        # head-major flat layout (so channel rows shard with the heads).
+        "conv_q": jnp.zeros((hk * dk, kk), dtype).at[:, -1].set(1.0),
+        "conv_k": jnp.zeros((hk * dk, kk), dtype).at[:, -1].set(1.0),
+        "conv_v": jnp.zeros((hv * dv, kk), dtype).at[:, -1].set(1.0),
+        "A_log": jnp.zeros((hv,), dtype),
+        "dt_bias": jnp.ones((hv,), dtype),
+        "norm_w": jnp.ones((dv,), dtype),
+        "wo": jax.random.normal(ks[6], (hv * dv, d), dtype) * (
+            (hv * dv) ** -0.5),
+    }
+
+
+def param_specs_hf(axis: str = "tp") -> Dict:
+    return {
+        "wq": P(None, axis), "wk": P(None, axis),
+        "wv": P(None, axis), "wz": P(None, axis),
+        "wb": P(None, axis), "wa": P(None, axis),
+        "conv_q": P(axis, None), "conv_k": P(axis, None),
+        "conv_v": P(axis, None),
+        "A_log": P(axis), "dt_bias": P(axis),
+        "norm_w": P(None),          # per-head dv weight — replicated
+        "wo": P(axis, None),
+    }
+
+
+def _hf_heads_loc(cfg, n: int):
+    hk, hv = cfg.gdn_num_kh, cfg.gdn_num_heads
+    if hk % n or hv % n:
+        raise ValueError(f"gdn heads ({hk} k, {hv} v) not divisible "
+                         f"by tp={n}")
+    return hk // n, hv // n
+
+
+def _causal_conv(x, w, k_size: int, state=None):
+    """Depthwise causal conv along seq. x: (B, S, C); w: (C, K);
+    ``state``: (B, C, K-1) trailing raw inputs from the previous
+    segment (None = zero history). Returns (y (B, S, C) with SiLU
+    applied, new_state (B, C, K-1))."""
+    b, s, c = x.shape
+    if state is None:
+        xp = jnp.pad(x, ((0, 0), (k_size - 1, 0), (0, 0)))
+    else:
+        xp = jnp.concatenate([state.swapaxes(1, 2), x], axis=1)
+    y = sum(xp[:, j:j + s, :] * w[:, j] for j in range(k_size))
+    new_state = xp[:, xp.shape[1] - (k_size - 1):, :].swapaxes(1, 2)
+    return jax.nn.silu(y.astype(jnp.float32)).astype(x.dtype), new_state
+
+
+def _hf_gdn_core(q, k, v, z, b, a, params, cfg, h_kloc, h_vloc, *,
+                 decode: bool, state, chunk: int = 64):
+    """Shared post-projection math: decay/beta parameterization, GQA
+    repeat, delta rule, z-gated RMSNorm. Shapes are (B, S, ...) flats;
+    decode means S == 1 with a recurrent state."""
+    dk, dv = cfg.gdn_head_dim_k, cfg.gdn_head_dim_v
+    rep = cfg.gdn_num_heads // cfg.gdn_num_kh
+    bsz, s = q.shape[0], q.shape[1]
+
+    q = q.reshape(bsz, s, h_kloc, dk)
+    k = k.reshape(bsz, s, h_kloc, dk)
+    v = v.reshape(bsz, s, h_vloc, dv)
+    if rep > 1:
+        q = jnp.repeat(q, rep, axis=2)
+        k = jnp.repeat(k, rep, axis=2)
+
+    beta = jax.nn.sigmoid(b.astype(jnp.float32))
+    g = (-jnp.exp(params["A_log"].astype(jnp.float32))
+         * jax.nn.softplus(a.astype(jnp.float32)
+                           + params["dt_bias"].astype(jnp.float32)))
+    scale = dk ** -0.5
+
+    if decode:
+        o, s_new = jax.vmap(
+            lambda S_, q_, k_, v_, g_, b_: gdn_decode_step(
+                S_, q_, k_, v_, g_, b_, scale=scale)
+        )(state, q[:, 0], k[:, 0], v[:, 0], g[:, 0], beta[:, 0])
+        o = o[:, None]                       # (B, 1, Hv_loc, dv)
+    else:
+        o, s_new = jax.vmap(
+            lambda q_, k_, v_, g_, b_: gdn_fwd_chunked(
+                q_, k_, v_, g_, b_, chunk=chunk, scale=scale)
+        )(q, k, v, g, beta)
+
+    # Z-gated per-head RMSNorm (HF Qwen3NextRMSNormGated: norm then
+    # weight then SiLU(z) gate, fp32 internally).
+    z = z.reshape(bsz, s, h_vloc, dv).astype(jnp.float32)
+    o32 = o.astype(jnp.float32)
+    var = jnp.mean(o32 * o32, axis=-1, keepdims=True)
+    o32 = o32 * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+    o32 = o32 * params["norm_w"].astype(jnp.float32)
+    o32 = o32 * jax.nn.silu(z)
+    return o32.astype(v.dtype).reshape(bsz, s, h_vloc * dv), s_new
+
+
+def fwd_prefill_hf(params, x, cfg, *, batch: int, mode: str = "xla",
+                   axis: str = "tp", ag_ctx=None, rs_ctx=None,
+                   ar_ctx=None, chunk: int = 64):
+    """HF-cell prefill. x: (tokens_loc, d) token-sharded. Returns
+    (out tokens_loc-sharded, (state (B, Hv_loc, dk, dv),
+    conv_state (B, C_loc, K-1)))."""
+    n = jax.lax.axis_size(axis)
+    h_kloc, h_vloc = _hf_heads_loc(cfg, n)
+    dk, dv = cfg.gdn_head_dim_k, cfg.gdn_head_dim_v
+    kk = cfg.gdn_conv_kernel
+
+    if mode == "xla":
+        x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        q = jnp.dot(x_full, params["wq"])
+    elif mode == "fused":
+        q, x_full = ag_gemm(x, params["wq"], ag_ctx, return_ag=True)
+    else:
+        raise ValueError(f"unknown GDN prefill mode {mode!r}")
+    k = jnp.dot(x_full, params["wk"])
+    v = jnp.dot(x_full, params["wv"])
+    z = jnp.dot(x_full, params["wz"])
+    b = jnp.dot(x_full, params["wb"])
+    a = jnp.dot(x_full, params["wa"])
+
+    s_full = x_full.shape[0] // batch
+    seq = lambda t: t.reshape(batch, s_full, t.shape[-1])
+    q, k, v, z, b, a = map(seq, (q, k, v, z, b, a))
+
+    # Causal depthwise conv + SiLU over the local (q,k,v) channels.
+    conv_w = jnp.concatenate(
+        [params["conv_q"], params["conv_k"], params["conv_v"]], axis=0)
+    qkv, conv_state = _causal_conv(
+        jnp.concatenate([q, k, v], axis=-1), conv_w, kk)
+    q, k, v = jnp.split(
+        qkv, [h_kloc * dk, 2 * h_kloc * dk], axis=-1)
+
+    o, state = _hf_gdn_core(q, k, v, z, b, a, params, cfg,
+                            h_kloc, h_vloc, decode=False, state=None,
+                            chunk=chunk)
+    o = o.reshape(batch * s_full, h_vloc * dv)
+
+    if mode == "fused":
+        out = gemm_rs(o, params["wo"], rs_ctx)
+    else:
+        out = jax.lax.psum_scatter(
+            jnp.dot(o, params["wo"], preferred_element_type=jnp.float32),
+            axis, scatter_dimension=0, tiled=True).astype(x.dtype)
+    return out, (state, conv_state)
+
+
+def fwd_decode_hf(params, x, cfg, state, conv_state, *,
+                  mode: str = "xla", axis: str = "tp", ar_ctx=None):
+    """HF-cell decode. x: (B, d) replicated; state (B, Hv_loc, dk, dv);
+    conv_state (B, C_loc, K-1). Returns (out, state', conv_state')."""
+    n = jax.lax.axis_size(axis)
+    h_kloc, h_vloc = _hf_heads_loc(cfg, n)
+    dk, dv = cfg.gdn_head_dim_k, cfg.gdn_head_dim_v
+    kk = cfg.gdn_conv_kernel
+    bsz = x.shape[0]
+
+    q = jnp.dot(x, params["wq"])[:, None]
+    k = jnp.dot(x, params["wk"])[:, None]
+    v = jnp.dot(x, params["wv"])[:, None]
+    z = jnp.dot(x, params["wz"])[:, None]
+    b = jnp.dot(x, params["wb"])[:, None]
+    a = jnp.dot(x, params["wa"])[:, None]
+
+    conv_w = jnp.concatenate(
+        [params["conv_q"], params["conv_k"], params["conv_v"]], axis=0)
+    qkv, conv_state = _causal_conv(
+        jnp.concatenate([q, k, v], axis=-1), conv_w, kk,
+        state=conv_state)
+    q, k, v = jnp.split(
+        qkv, [h_kloc * dk, 2 * h_kloc * dk], axis=-1)
+
+    o, s_new = _hf_gdn_core(q, k, v, z, b, a, params, cfg,
+                            h_kloc, h_vloc, decode=True, state=state)
+    o = o.reshape(bsz, h_vloc * dv)
+
+    if mode == "fused_ar":
+        out = gemm_ar(o, params["wo"], ar_ctx)
+    else:
+        out = jax.lax.psum(
+            jnp.dot(o, params["wo"], preferred_element_type=jnp.float32),
+            axis).astype(x.dtype)
+    return out, s_new, conv_state
 
 
 def _heads_loc(cfg, n: int) -> int:

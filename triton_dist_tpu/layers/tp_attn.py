@@ -43,6 +43,14 @@ def init(key, cfg, dtype=jnp.float32) -> Dict:
     if getattr(cfg, "qk_norm", True):
         p["q_norm"] = jnp.ones((hd,), dtype)
         p["k_norm"] = jnp.ones((hd,), dtype)
+    if getattr(cfg, "attn_gate", False):
+        # Qwen3-Next gated attention: q_proj emits per-head [q | gate]
+        # (modeling_qwen3_next.Qwen3NextAttention); de-interleaved to a
+        # separate column-parallel matrix so gate columns shard with
+        # their heads.
+        (kg,) = jax.random.split(jax.random.fold_in(kq, 1), 1)
+        p["wqg"] = jax.random.normal(
+            kg, (d, cfg.num_attention_heads * hd), dtype) * scale
     if getattr(cfg, "attention_bias", False):
         # Seed-OSS / Qwen2-style projection biases (the reference
         # shards q_proj.bias etc. the same way, layer init path).
@@ -65,6 +73,8 @@ def param_specs(axis: str = "tp", cfg=None) -> Dict:
     if cfg is None or getattr(cfg, "qk_norm", True):
         s["q_norm"] = P(None)
         s["k_norm"] = P(None)
+    if cfg is not None and getattr(cfg, "attn_gate", False):
+        s["wqg"] = P(None, axis)
     if cfg is not None and getattr(cfg, "attention_bias", False):
         s["bq"] = P(axis)
         s["bk"] = P(axis)
@@ -90,7 +100,8 @@ def _head_split(cfg, n: int):
 
 
 def _project_qkv(params, x, *, mode, axis, ag_ctx):
-    """Returns (q, k, v) as (tokens_full, *_loc) plus tokens_full count."""
+    """Returns (q, k, v, gate) as (tokens_full, *_loc); ``gate`` is
+    None unless the layer carries the Qwen3-Next attention gate."""
     if mode == "xla":
         x_full = jax.lax.all_gather(x, axis, axis=0, tiled=True)
         q = jnp.dot(x_full, params["wq"])
@@ -102,6 +113,7 @@ def _project_qkv(params, x, *, mode, axis, ag_ctx):
         v = jnp.dot(x_full, params["wv"])
     elif mode == "fused_ar":
         # Replicated tokens: plain local projections.
+        x_full = x
         q = jnp.dot(x, params["wq"])
         k = jnp.dot(x, params["wk"])
         v = jnp.dot(x, params["wv"])
@@ -112,7 +124,8 @@ def _project_qkv(params, x, *, mode, axis, ag_ctx):
         q = q + params["bq"]
         k = k + params["bk"]
         v = v + params["bv"]
-    return q, k, v
+    gate = jnp.dot(x_full, params["wqg"]) if "wqg" in params else None
+    return q, k, v, gate
 
 
 def _o_bias(params, y):
@@ -126,10 +139,21 @@ def _norm_rope(q, k, params, cfg, positions):
     if "q_norm" in params:       # Qwen3 per-head norm; absent for
         q = rms_norm(q, params["q_norm"], cfg.rms_norm_eps)  # Seed-OSS
         k = rms_norm(k, params["k_norm"], cfg.rms_norm_eps)
-    inv_freq = rope_freqs(cfg.head_dim, cfg.rope_theta)
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
-    return q, k
+    # Partial RoPE (Qwen3-Next rotates only the first fraction of each
+    # head; the rest passes through position-free).
+    rot = int(cfg.head_dim * getattr(cfg, "partial_rotary_factor", 1.0))
+    if rot % 2:
+        raise ValueError(
+            f"rotary dim {rot} (head_dim {cfg.head_dim} × factor "
+            f"{cfg.partial_rotary_factor}) must be even")
+    inv_freq = rope_freqs(rot, cfg.rope_theta)
+    if rot == cfg.head_dim:
+        return (apply_rope(q, positions, inv_freq),
+                apply_rope(k, positions, inv_freq))
+    rope_part = lambda t: jnp.concatenate(
+        [apply_rope(t[..., :rot], positions, inv_freq), t[..., rot:]],
+        axis=-1)
+    return rope_part(q), rope_part(k)
 
 
 def sdpa(q, k, v, *, causal: bool, kv_len=None, use_flash=None):
@@ -183,7 +207,8 @@ def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
     hd = cfg.head_dim
     h_loc, kv_loc = _head_split(cfg, n)
 
-    q, k, v = _project_qkv(params, x, mode=mode, axis=axis, ag_ctx=ag_ctx)
+    q, k, v, gate = _project_qkv(params, x, mode=mode, axis=axis,
+                                 ag_ctx=ag_ctx)
     tokens = q.shape[0]
     seq = tokens // batch
     q = q.reshape(batch, seq, h_loc, hd)
@@ -194,6 +219,8 @@ def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
 
     o = sdpa(q, k, v, causal=True)
     o = o.reshape(tokens, h_loc * hd)
+    if gate is not None:   # Qwen3-Next: sigmoid gate before o_proj
+        o = o * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(o.dtype)
 
     if mode == "xla":
         partial = jnp.dot(o, params["wo"], preferred_element_type=jnp.float32)
@@ -240,6 +267,9 @@ def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
     kv_len = jnp.full((b,), cache_len + 1, dtype=jnp.int32)
     o = sdpa(q, k_cache, v_cache, causal=False, kv_len=kv_len)
     o = o.reshape(b, h_loc * hd)
+    if "wqg" in params:   # Qwen3-Next: sigmoid gate before o_proj
+        gate = jnp.dot(x, params["wqg"])
+        o = o * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(o.dtype)
 
     if mode in ("xla",):
         y = jax.lax.psum(

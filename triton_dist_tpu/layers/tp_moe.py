@@ -14,17 +14,29 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.layers.ep_moe import init, route  # shared weights/router
+from triton_dist_tpu.layers.ep_moe import (  # shared weights/router
+    init, route, shared_expert_out,
+)
 from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
 
 
-def param_specs(axis: str = "tp") -> Dict:
-    return {
+def param_specs(axis: str = "tp", cfg=None) -> Dict:
+    s = {
         "router": P(None, None),
         "w_gate": P(None, None, axis),  # ffn dim sharded
         "w_up": P(None, None, axis),
         "w_down": P(None, axis, None),
     }
+    if cfg is not None and getattr(cfg, "shared_expert_intermediate_size",
+                                   0):
+        # Shared expert shards its ffn dim like tp_mlp; the scalar gate
+        # vector is replicated so each rank's partial carries the same
+        # sigmoid factor.
+        s["w_shared_gate"] = P(None, axis)
+        s["w_shared_up"] = P(None, axis)
+        s["w_shared_down"] = P(axis, None)
+        s["shared_gate"] = P(None)
+    return s
 
 
 def _expert_mlp(params, x, *, topk: int, num_experts: int,
@@ -59,14 +71,24 @@ def fwd(params, x, *, topk: int, num_experts: int, axis: str = "tp",
     out, topk_w = _expert_mlp(params, x_full, topk=topk,
                               num_experts=num_experts,
                               norm_topk_prob=norm_topk_prob)
+    sh = shared_expert_out(params, x_full)   # TP partial (or None)
     if mesh_ctx is not None:
         from triton_dist_tpu.ops.moe_reduce import moe_reduce_rs
 
         # topk_w stays float32 — the kernel combines in f32 either way,
         # and downcasting first would diverge from the unfused path.
+        if sh is not None:
+            # Ride the fused combine as one more "expert" column with
+            # weight 1 (the sigmoid gate is already folded in).
+            out = jnp.concatenate(
+                [out, sh.astype(out.dtype)[:, None]], axis=1)
+            topk_w = jnp.concatenate(
+                [topk_w, jnp.ones_like(topk_w[:, :1])], axis=1)
         return moe_reduce_rs(out, topk_w, ctx=mesh_ctx, axis=axis)
     partial = jnp.einsum("tkd,tk->td", out.astype(jnp.float32),
                          topk_w.astype(jnp.float32))
+    if sh is not None:
+        partial = partial + sh
     return jax.lax.psum_scatter(partial, axis, scatter_dimension=0,
                                 tiled=True).astype(x.dtype)
 
@@ -85,6 +107,9 @@ def fwd_ar(params, x, *, topk: int, num_experts: int, axis: str = "tp",
                               norm_topk_prob=norm_topk_prob)
     partial = jnp.einsum("tkd,tk->td", out.astype(jnp.float32),
                          topk_w.astype(jnp.float32))
+    sh = shared_expert_out(params, x)       # TP partial: inside the sum
+    if sh is not None:
+        partial = partial + sh
     return jax.lax.psum(partial, axis).astype(x.dtype)
 
 
@@ -156,6 +181,15 @@ def fwd_fused(params, x, *, topk: int, num_experts: int, mesh_ctx,
     y = y.reshape(n * t_loc, topk, d)
 
     w_full = jax.lax.all_gather(topk_w, axis, axis=0, tiled=True)
+    sh = shared_expert_out(
+        params, jax.lax.all_gather(x, axis, axis=0, tiled=True))
+    if sh is not None:
+        # Extra "expert" column with weight 1 (gate folded in); the
+        # activation gather here is the small dense branch only — the
+        # routed path's activations still never ride an XLA collective.
+        y = jnp.concatenate([y, sh.astype(y.dtype)[:, None]], axis=1)
+        w_full = jnp.concatenate(
+            [w_full, jnp.ones_like(w_full[:, :1])], axis=1)
     if epilogue == "ar":
         return moe_reduce_ar(y, w_full, ctx=mesh_ctx, axis=axis)
     return moe_reduce_rs(y, w_full, ctx=mesh_ctx, axis=axis)

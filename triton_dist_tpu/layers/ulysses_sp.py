@@ -29,11 +29,12 @@ def init(key, cfg, dtype=jnp.float32):
     Ulysses fwd applies no projection biases and assumes the q/k norm,
     so bias-carrying / norm-free (Seed-OSS-class) configs are rejected
     rather than silently mis-served."""
-    if getattr(cfg, "attention_bias", False) or not getattr(
-            cfg, "qk_norm", True):
+    if (getattr(cfg, "attention_bias", False)
+            or not getattr(cfg, "qk_norm", True)
+            or getattr(cfg, "attn_gate", False)):
         raise NotImplementedError(
             "ulysses_sp covers the Qwen3 layer shape (no attention "
-            "biases, per-head q/k norm)")
+            "biases or output gate, per-head q/k norm)")
     return tp_attn.init(key, cfg, dtype)
 
 

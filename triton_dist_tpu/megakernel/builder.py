@@ -105,6 +105,12 @@ class ModelBuilder:
                 "(no attention biases, per-head q/k norm); serve "
                 "bias-carrying / norm-free checkpoints (Seed-OSS) "
                 "through the layer Engine")
+        if getattr(cfg, "gdn_conv_kernel", 0) or getattr(
+                cfg, "attn_gate", False):
+            raise NotImplementedError(
+                "megakernel hybrid tasks cover the simplified "
+                "(conv-free) GDN cell; serve HF qwen3_next checkpoints "
+                "(conv + attention gate) through the layer Engine")
         self.cfg = cfg
         self.mesh = mesh
         self.mctx = MeshContext.from_mesh(mesh)

@@ -721,12 +721,12 @@ def gdn_decode_body(cfg, args, refs):
                 b_s = jnp.sum(jnp.where(sel, beta_all, 0.0))
                 q = qrow[0:1, cq:cq + dk].astype(jnp.float32)
                 k = krow[0:1, cq:cq + dk].astype(jnp.float32)
-                q = q / jnp.maximum(
-                    jnp.sqrt(jnp.sum(q * q, axis=1, keepdims=True)),
-                    1e-6)
-                k = k / jnp.maximum(
-                    jnp.sqrt(jnp.sum(k * k, axis=1, keepdims=True)),
-                    1e-6)
+                # FLA-convention L2 norm — must track ops/gdn._l2norm
+                # (the layer oracle this kernel is tested against).
+                q = q * jax.lax.rsqrt(
+                    jnp.sum(q * q, axis=1, keepdims=True) + 1e-6)
+                k = k * jax.lax.rsqrt(
+                    jnp.sum(k * k, axis=1, keepdims=True) + 1e-6)
                 v = vrow[0:1, cv:cv + dv].astype(jnp.float32)
 
                 pltpu.sync_copy(states.at[gl, bb, h], vS)

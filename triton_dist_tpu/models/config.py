@@ -47,6 +47,23 @@ class ModelConfig:
     gdn_head_dim_k: int = 128
     gdn_head_dim_v: int = 128
     full_attn_interval: int = 4
+    # HF-faithful Qwen3-Next cell fields. gdn_conv_kernel > 0 selects
+    # the checkpoint-compatible GatedDeltaNet parameterization (short
+    # causal depthwise conv + z-gated RMSNorm + A_log/dt_bias decay,
+    # HF ``linear_conv_kernel_dim``); 0 keeps the in-framework
+    # simplified cell (wg/g_bias gates, no conv).
+    gdn_conv_kernel: int = 0
+    # Qwen3-Next full-attention extras: per-head sigmoid output gate
+    # (q_proj emits [q | gate]) and partial RoPE (rotary on the first
+    # ``partial_rotary_factor`` fraction of head_dim).
+    attn_gate: bool = False
+    partial_rotary_factor: float = 1.0
+    # Qwen3-Next MoE shared expert (0 = none).
+    shared_expert_intermediate_size: int = 0
+    # Qwen3-Next RMSNorms are zero-centered ((1+w)·x̂, Gemma-style).
+    # Runtime layers always compute standard w·x̂ — the HF mapper folds
+    # the +1 into the stored weights at load time under this flag.
+    norm_zero_centered: bool = False
 
     @property
     def is_moe(self) -> bool:
@@ -55,6 +72,12 @@ class ModelConfig:
     @property
     def is_hybrid(self) -> bool:
         return self.gdn_num_heads > 0
+
+    @property
+    def gdn_num_kh(self) -> int:
+        """Key-head count (0 in the config means 'same as value
+        heads', the in-framework family's shape)."""
+        return self.gdn_num_key_heads or self.gdn_num_heads
 
     def layer_is_full_attn(self, layer_idx: int) -> bool:
         """Hybrid schedule: layers (interval-1, 2·interval-1, …) are full
@@ -162,6 +185,30 @@ class ModelConfig:
 
         d = req("hidden_size")
         heads = req("num_attention_heads")
+
+        # Hybrid layer schedule: real qwen3_next checkpoints serialize
+        # an explicit layer_types list; this config expresses the
+        # schedule as an interval (softmax layer last in each block),
+        # so verify the list IS that pattern rather than silently
+        # reinterpreting a custom schedule.
+        interval = get("full_attention_interval", 4) or 4
+        layer_types = get("layer_types")
+        # Only hybrid (GDN) models consult the schedule; non-hybrid
+        # layer_types lists (e.g. sliding-window patterns) are not this
+        # config's concern and must not block loading.
+        if layer_types and (get("linear_num_value_heads", 0) or 0):
+            fulls = [i for i, t in enumerate(layer_types)
+                     if t == "full_attention"]
+            if not fulls:
+                interval = len(layer_types) + 1  # pure linear attention
+            else:
+                interval = fulls[0] + 1
+                want = [i for i in range(len(layer_types))
+                        if i % interval == interval - 1]
+                if fulls != want:
+                    raise NotImplementedError(
+                        "layer_types is not an every-Nth-layer "
+                        f"full-attention schedule (got {layer_types})")
         return cls(
             vocab_size=req("vocab_size"),
             hidden_size=d,
@@ -197,5 +244,17 @@ class ModelConfig:
             gdn_num_key_heads=get("linear_num_key_heads", 0) or 0,
             gdn_head_dim_k=get("linear_key_head_dim", 128) or 128,
             gdn_head_dim_v=get("linear_value_head_dim", 128) or 128,
-            full_attn_interval=get("full_attention_interval", 4) or 4,
+            full_attn_interval=interval,
+            # qwen3_next checkpoints use the HF GatedDeltaNet cell,
+            # gated attention, and partial RoPE; other model types keep
+            # the plain-field defaults.
+            gdn_conv_kernel=(get("linear_conv_kernel_dim", 4) or 4
+                             if get("model_type") == "qwen3_next" else 0),
+            attn_gate=get("model_type") == "qwen3_next",
+            partial_rotary_factor=(
+                get("partial_rotary_factor", 0.25) or 0.25
+                if get("model_type") == "qwen3_next" else 1.0),
+            shared_expert_intermediate_size=get(
+                "shared_expert_intermediate_size", 0) or 0,
+            norm_zero_centered=get("model_type") == "qwen3_next",
         )

@@ -27,6 +27,17 @@ def _to_np(t):
     return np.asarray(t)
 
 
+def _norm_weight(state: Dict, key: str, cfg: ModelConfig, dtype):
+    """Plain RMSNorm weight; qwen3_next stores zero-centered weights
+    ((1+w)·x̂, ``Qwen3NextRMSNorm``) — fold the +1 here so runtime
+    layers stay standard w·x̂. The GDN cell's gated norm is NOT
+    zero-centered and must not come through this helper."""
+    w = jnp.asarray(_to_np(state[key]), dtype)
+    if getattr(cfg, "norm_zero_centered", False):
+        w = w + jnp.asarray(1.0, dtype)
+    return w
+
+
 def _attn_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
                   dtype) -> Dict:
     """Attention sub-dict for one layer, matching ``tp_attn.init``'s
@@ -34,15 +45,27 @@ def _attn_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
     Qwen2-style projection biases when ``cfg.attention_bias``)."""
     g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
     gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
+    gn = lambda k: _norm_weight(state, k, cfg, dtype)
     attn = {
-        "wq": gT(prefix + "self_attn.q_proj.weight"),
         "wk": gT(prefix + "self_attn.k_proj.weight"),
         "wv": gT(prefix + "self_attn.v_proj.weight"),
         "wo": gT(prefix + "self_attn.o_proj.weight"),
     }
+    if getattr(cfg, "attn_gate", False):
+        # Qwen3-Next gated attention: q_proj rows are per-head
+        # [hd q | hd gate] (Qwen3NextAttention chunks the doubled
+        # projection per head) — de-interleave so both matrices are
+        # plain head-major column-parallel.
+        h, hd = cfg.num_attention_heads, cfg.head_dim
+        qg = _to_np(state[prefix + "self_attn.q_proj.weight"])
+        qg = qg.reshape(h, 2 * hd, qg.shape[-1])
+        attn["wq"] = jnp.asarray(qg[:, :hd].reshape(h * hd, -1).T, dtype)
+        attn["wqg"] = jnp.asarray(qg[:, hd:].reshape(h * hd, -1).T, dtype)
+    else:
+        attn["wq"] = gT(prefix + "self_attn.q_proj.weight")
     if cfg.qk_norm:
-        attn["q_norm"] = g(prefix + "self_attn.q_norm.weight")
-        attn["k_norm"] = g(prefix + "self_attn.k_norm.weight")
+        attn["q_norm"] = gn(prefix + "self_attn.q_norm.weight")
+        attn["k_norm"] = gn(prefix + "self_attn.k_norm.weight")
     if cfg.attention_bias:
         attn["bq"] = g(prefix + "self_attn.q_proj.bias")
         attn["bk"] = g(prefix + "self_attn.k_proj.bias")
@@ -51,6 +74,56 @@ def _attn_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
         attn["bo"] = (g(bo_key) if bo_key in state else
                       jnp.zeros((cfg.hidden_size,), dtype))
     return attn
+
+
+def gdn_attn_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
+                     dtype) -> Dict:
+    """De-interleave one HF Qwen3NextGatedDeltaNet layer into the
+    head-major TP-shardable layout of ``layers.gdn_attn``'s HF cell.
+
+    HF packs ``in_proj_qkvz`` as hk row-groups of
+    ``[dk q | dk k | rep·dv v | rep·dv z]`` and ``in_proj_ba`` as hk
+    groups of ``[rep b | rep a]``
+    (``modeling_qwen3_next.fix_query_key_value_ordering``); the
+    de-interleave makes every projection globally head-major so plain
+    column sharding = head sharding. ``conv1d.weight`` channels are
+    already flat ``[q | k | v]`` post-ordering, so they split directly.
+    """
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+    hk, hv = cfg.gdn_num_kh, cfg.gdn_num_heads
+    dk, dv = cfg.gdn_head_dim_k, cfg.gdn_head_dim_v
+    rep = hv // hk
+    d = cfg.hidden_size
+
+    qkvz = _to_np(state[prefix + "in_proj_qkvz.weight"])  # (out, d)
+    qkvz = qkvz.reshape(hk, 2 * dk + 2 * rep * dv, d)
+    wq = qkvz[:, :dk].reshape(hk * dk, d)
+    wk = qkvz[:, dk:2 * dk].reshape(hk * dk, d)
+    wv = qkvz[:, 2 * dk:2 * dk + rep * dv].reshape(hv * dv, d)
+    wz = qkvz[:, 2 * dk + rep * dv:].reshape(hv * dv, d)
+
+    ba = _to_np(state[prefix + "in_proj_ba.weight"]).reshape(
+        hk, 2 * rep, d)
+    wb = ba[:, :rep].reshape(hv, d)
+    wa = ba[:, rep:].reshape(hv, d)
+
+    conv = _to_np(state[prefix + "conv1d.weight"])  # (C, 1, K)
+    conv = conv.reshape(conv.shape[0], conv.shape[-1])
+    key_dim = hk * dk
+
+    asj = lambda a: jnp.asarray(a.T, dtype)
+    return {
+        "wq": asj(wq), "wk": asj(wk), "wv": asj(wv), "wz": asj(wz),
+        "wb": asj(wb), "wa": asj(wa),
+        "conv_q": jnp.asarray(conv[:key_dim], dtype),
+        "conv_k": jnp.asarray(conv[key_dim:2 * key_dim], dtype),
+        "conv_v": jnp.asarray(conv[2 * key_dim:], dtype),
+        "A_log": g(prefix + "A_log"),
+        "dt_bias": g(prefix + "dt_bias"),
+        "norm_w": g(prefix + "norm.weight"),
+        "wo": jnp.asarray(_to_np(state[prefix + "out_proj.weight"]).T,
+                          dtype),
+    }
 
 
 def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
@@ -71,8 +144,10 @@ def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
                 "w_up": gT(p + "mlp.up_proj.weight"),
                 "w_down": gT(p + "mlp.down_proj.weight"),
             },
-            "ln_attn": g(p + "input_layernorm.weight"),
-            "ln_mlp": g(p + "post_attention_layernorm.weight"),
+            "ln_attn": _norm_weight(state, p + "input_layernorm.weight",
+                                    cfg, dtype),
+            "ln_mlp": _norm_weight(
+                state, p + "post_attention_layernorm.weight", cfg, dtype),
         })
     embed = g("model.embed_tokens.weight")
     lm_head = (embed if cfg.tie_word_embeddings
@@ -80,45 +155,111 @@ def params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
     return {
         "embed": embed,
         "layers": layers,
-        "ln_f": g("model.norm.weight"),
+        "ln_f": _norm_weight(state, "model.norm.weight", cfg, dtype),
         "lm_head": lm_head,
     }
 
 
-def moe_params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
-                                  dtype=jnp.bfloat16) -> Dict:
-    """Map a Qwen3-MoE HF state dict to the qwen_moe param pytree
-    (per-expert gate/up/down stacked to (E, d, f) / (E, f, d);
-    HF names: ``mlp.experts.N.{gate,up,down}_proj``, router =
-    ``mlp.gate``)."""
-    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+def _moe_from_hf(state: Dict, cfg: ModelConfig, prefix: str,
+                 dtype) -> Dict:
+    """One layer's MoE block: per-expert gate/up/down stacked to
+    (E, d, f) / (E, f, d) (HF ``mlp.experts.N.{gate,up,down}_proj``,
+    router = ``mlp.gate``), plus the qwen3_next shared expert when the
+    config carries one."""
     gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
 
-    def stack_T(prefix, proj):
+    def stack_T(proj):
         return jnp.stack([
             jnp.asarray(_to_np(
                 state[f"{prefix}experts.{e}.{proj}.weight"]).T, dtype)
             for e in range(cfg.num_experts)])
+
+    moe = {
+        "router": gT(prefix + "gate.weight"),
+        "w_gate": stack_T("gate_proj"),
+        "w_up": stack_T("up_proj"),
+        "w_down": stack_T("down_proj"),
+    }
+    if getattr(cfg, "shared_expert_intermediate_size", 0):
+        moe["w_shared_gate"] = gT(
+            prefix + "shared_expert.gate_proj.weight")
+        moe["w_shared_up"] = gT(prefix + "shared_expert.up_proj.weight")
+        moe["w_shared_down"] = gT(
+            prefix + "shared_expert.down_proj.weight")
+        # (1, d) single-logit gate → (d,) vector.
+        moe["shared_gate"] = jnp.asarray(
+            _to_np(state[prefix + "shared_expert_gate.weight"])
+            .reshape(-1), dtype)
+    return moe
+
+
+def moe_params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
+                                  dtype=jnp.bfloat16) -> Dict:
+    """Map a Qwen3-MoE HF state dict to the qwen_moe param pytree."""
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
 
     layers = []
     for i in range(cfg.num_hidden_layers):
         p = f"model.layers.{i}."
         layers.append({
             "attn": _attn_from_hf(state, cfg, p, dtype),
-            "moe": {
-                "router": gT(p + "mlp.gate.weight"),
-                "w_gate": stack_T(p + "mlp.", "gate_proj"),
-                "w_up": stack_T(p + "mlp.", "up_proj"),
-                "w_down": stack_T(p + "mlp.", "down_proj"),
-            },
-            "ln_attn": g(p + "input_layernorm.weight"),
-            "ln_mlp": g(p + "post_attention_layernorm.weight"),
+            "moe": _moe_from_hf(state, cfg, p + "mlp.", dtype),
+            "ln_attn": _norm_weight(state, p + "input_layernorm.weight",
+                                    cfg, dtype),
+            "ln_mlp": _norm_weight(
+                state, p + "post_attention_layernorm.weight", cfg, dtype),
         })
     embed = g("model.embed_tokens.weight")
     return {
         "embed": embed,
         "layers": layers,
-        "ln_f": g("model.norm.weight"),
+        "ln_f": _norm_weight(state, "model.norm.weight", cfg, dtype),
+        "lm_head": (embed if cfg.tie_word_embeddings
+                    else g("lm_head.weight")),
+    }
+
+
+def hybrid_params_from_hf_state_dict(state: Dict, cfg: ModelConfig,
+                                     dtype=jnp.bfloat16) -> Dict:
+    """Map a Qwen3-Next HF state dict to the ``models.qwen_next``
+    param pytree: ``linear_attention`` layers through the GDN
+    de-interleave (:func:`gdn_attn_from_hf`), ``full_attention`` layers
+    through the gated-attention split (:func:`_attn_from_hf`), MoE
+    blocks with the shared expert (:func:`_moe_from_hf`), dense MLP
+    otherwise. All plain RMSNorms go through the zero-centered fold."""
+    g = lambda k: jnp.asarray(_to_np(state[k]), dtype)
+    gT = lambda k: jnp.asarray(_to_np(state[k]).T, dtype)
+
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        if cfg.layer_is_full_attn(i):
+            mixer = _attn_from_hf(state, cfg, p, dtype)
+        else:
+            mixer = gdn_attn_from_hf(state, cfg, p + "linear_attn.",
+                                     dtype)
+        if cfg.is_moe:
+            mlp = _moe_from_hf(state, cfg, p + "mlp.", dtype)
+        else:
+            mlp = {
+                "w_gate": gT(p + "mlp.gate_proj.weight"),
+                "w_up": gT(p + "mlp.up_proj.weight"),
+                "w_down": gT(p + "mlp.down_proj.weight"),
+            }
+        layers.append({
+            "mixer": mixer,
+            "mlp": mlp,
+            "ln_attn": _norm_weight(state, p + "input_layernorm.weight",
+                                    cfg, dtype),
+            "ln_mlp": _norm_weight(
+                state, p + "post_attention_layernorm.weight", cfg,
+                dtype),
+        })
+    embed = g("model.embed_tokens.weight")
+    return {
+        "embed": embed,
+        "layers": layers,
+        "ln_f": _norm_weight(state, "model.norm.weight", cfg, dtype),
         "lm_head": (embed if cfg.tie_word_embeddings
                     else g("lm_head.weight")),
     }
@@ -148,22 +289,16 @@ def load_hf_checkpoint(path: str, dtype=jnp.bfloat16):
 
     with open(os.path.join(path, "config.json")) as f:
         cfg = config_from_hf(json.load(f))
-    if cfg.is_hybrid:
-        # Fail BEFORE reading shards (tens of GB for 80B-class
-        # checkpoints): a dense/MoE mapper would die with an opaque
-        # KeyError on the GDN projection keys (ADVICE r4).
-        raise NotImplementedError(
-            "load_hf_checkpoint has no weight mapper for hybrid "
-            "(Qwen3-Next / GDN) checkpoints yet — the in-framework "
-            "hybrid family initializes via models.qwen_next.init_params; "
-            "a hybrid mapper needs the separate gdn_num_key_heads / "
-            "gdn_num_heads projection split now carried by ModelConfig")
     state: Dict = {}
     shards = sorted(_glob.glob(os.path.join(path, "*.safetensors")))
     if not shards:
         raise FileNotFoundError(f"no *.safetensors under {path}")
     for shard in shards:
         state.update(load_file(shard))
-    mapper = (moe_params_from_hf_state_dict if cfg.is_moe
-              else params_from_hf_state_dict)
+    if cfg.is_hybrid:
+        mapper = hybrid_params_from_hf_state_dict
+    elif cfg.is_moe:
+        mapper = moe_params_from_hf_state_dict
+    else:
+        mapper = params_from_hf_state_dict
     return cfg, mapper(state, cfg, dtype)

@@ -52,8 +52,8 @@ def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
 
 def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
                 axis: str = "tp", ep_axis: str = "ep") -> Dict:
-    moe_specs = (tp_moe.param_specs(axis) if moe_impl == "tp"
-                 else ep_moe.param_specs(ep_axis))
+    moe_specs = (tp_moe.param_specs(axis, cfg) if moe_impl == "tp"
+                 else ep_moe.param_specs(ep_axis, cfg))
     layer_spec = {
         "attn": tp_attn.param_specs(axis, cfg),
         "moe": moe_specs,

@@ -41,9 +41,12 @@ from triton_dist_tpu.models.kv_cache import KVCache
 @dataclasses.dataclass
 class HybridCache:
     """kv: softmax layers' cache (indexed by full-attn layer ordinal);
-    states: (num_gdn_layers, B, H_loc, dk, dv) recurrent states."""
+    states: (num_gdn_layers, B, H_loc, dk, dv) recurrent states;
+    conv: (num_gdn_layers, B, C_loc, K-1) short-conv tails — zero-size
+    for the simplified (conv-free) cell."""
     kv: KVCache
     states: jax.Array
+    conv: jax.Array
 
     @property
     def length(self):
@@ -52,7 +55,7 @@ class HybridCache:
         return self.kv.length
 
     def tree_flatten(self):
-        return (self.kv, self.states), None
+        return (self.kv, self.states, self.conv), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -112,10 +115,10 @@ def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
     for li in range(cfg.num_hidden_layers):
         mixer = (tp_attn.param_specs(axis, cfg)
                  if cfg.layer_is_full_attn(li)
-                 else gdn_attn.param_specs(axis))
+                 else gdn_attn.param_specs(axis, cfg))
         layers.append({
             "mixer": mixer,
-            "mlp": (tp_moe.param_specs(axis) if cfg.is_moe
+            "mlp": (tp_moe.param_specs(axis, cfg) if cfg.is_moe
                     else tp_mlp.param_specs(axis)),
             "ln_attn": P(None),
             "ln_mlp": P(None),
@@ -125,14 +128,23 @@ def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
 
 
 def cache_specs(axis: str = "tp") -> "HybridCache":
-    """PartitionSpec pytree for :class:`HybridCache` (KV heads and GDN
-    heads both sharded along ``axis``) — consumed by the Engine's
-    shard_map in/out specs."""
+    """PartitionSpec pytree for :class:`HybridCache` (KV heads, GDN
+    heads, and conv channels all sharded along ``axis``) — consumed by
+    the Engine's shard_map in/out specs."""
     return HybridCache(
         kv=KVCache(k=P(None, None, None, axis, None),
                    v=P(None, None, None, axis, None),
                    length=P()),
-        states=P(None, None, axis, None, None))
+        states=P(None, None, axis, None, None),
+        conv=P(None, None, axis, None))
+
+
+def _conv_channels(cfg: ModelConfig) -> int:
+    """Global conv channel count of the HF cell (0 = conv-free cell)."""
+    if not cfg.gdn_conv_kernel:
+        return 0
+    return (2 * cfg.gdn_num_kh * cfg.gdn_head_dim_k
+            + cfg.gdn_num_heads * cfg.gdn_head_dim_v)
 
 
 def empty_cache(cfg: ModelConfig, batch: int, max_len: int, n: int,
@@ -145,7 +157,9 @@ def empty_cache(cfg: ModelConfig, batch: int, max_len: int, n: int,
                          cfg.head_dim, dtype=dtype),
         states=jnp.zeros((max(n_gdn, 1), batch, h_loc,
                           cfg.gdn_head_dim_k, cfg.gdn_head_dim_v),
-                         jnp.float32))
+                         jnp.float32),
+        conv=jnp.zeros((max(n_gdn, 1), batch, _conv_channels(cfg) // n,
+                        max(cfg.gdn_conv_kernel - 1, 0)), dtype))
 
 
 def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
@@ -161,6 +175,16 @@ def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
                 ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
             if cache is not None:
                 cache.kv = cache.kv.write_prefill(ordinal, *kv)
+        elif cfg.gdn_conv_kernel:
+            mix_out, (state, conv) = gdn_attn.fwd_prefill_hf(
+                lp["mixer"], h, cfg, batch=b, mode=mode, axis=axis,
+                ag_ctx=ctxs.ag, rs_ctx=ctxs.rs, ar_ctx=ctxs.ar)
+            if cache is not None:
+                cache.states = jax.lax.dynamic_update_slice(
+                    cache.states, state[None], (ordinal, 0, 0, 0, 0))
+                cache.conv = jax.lax.dynamic_update_slice(
+                    cache.conv, conv[None].astype(cache.conv.dtype),
+                    (ordinal, 0, 0, 0))
         else:
             mix_out, state = gdn_attn.fwd_prefill(
                 lp["mixer"], h, cfg, batch=b, mode=mode, axis=axis,
@@ -233,6 +257,7 @@ def decode_step(params, token_ids, cache: HybridCache,
 
     new_k, new_v = cache.kv.k, cache.kv.v
     new_states = cache.states
+    new_conv = cache.conv
     for li, lp in enumerate(params["layers"]):
         kind, ordinal = kinds[li]
         h = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
@@ -244,6 +269,16 @@ def decode_step(params, token_ids, cache: HybridCache,
                 new_k, lk[None], (ordinal, 0, 0, 0, 0))
             new_v = jax.lax.dynamic_update_slice(
                 new_v, lv[None], (ordinal, 0, 0, 0, 0))
+        elif cfg.gdn_conv_kernel:
+            mix_out, st, cv = gdn_attn.fwd_decode_hf(
+                lp["mixer"], h, cfg, new_states[ordinal],
+                new_conv[ordinal], mode=dec_mode, axis=axis,
+                ar_ctx=ctxs.ar)
+            new_states = jax.lax.dynamic_update_slice(
+                new_states, st[None], (ordinal, 0, 0, 0, 0))
+            new_conv = jax.lax.dynamic_update_slice(
+                new_conv, cv[None].astype(new_conv.dtype),
+                (ordinal, 0, 0, 0))
         else:
             mix_out, st = gdn_attn.fwd_decode(
                 lp["mixer"], h, cfg, new_states[ordinal],
@@ -270,5 +305,5 @@ def decode_step(params, token_ids, cache: HybridCache,
     logits = _lm_head(params, x, axis)
     cache = HybridCache(
         kv=KVCache(k=new_k, v=new_v, length=cache.kv.length + 1),
-        states=new_states)
+        states=new_states, conv=new_conv)
     return logits, cache

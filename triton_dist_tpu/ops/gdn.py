@@ -24,18 +24,27 @@ import jax
 import jax.numpy as jnp
 
 
-def gdn_fwd(q, k, v, g, beta, *, initial_state=None, normalize_qk=True):
+def _l2norm(x, eps: float = 1e-6):
+    """FLA-convention L2 normalization — x·rsqrt(Σx²+eps), matching the
+    qwen3_next reference kernels (``use_qk_l2norm_in_kernel``) so real
+    checkpoints reproduce bit-comparable activations."""
+    x32 = x.astype(jnp.float32)
+    return (x32 * jax.lax.rsqrt(
+        jnp.sum(x32 * x32, axis=-1, keepdims=True) + eps)).astype(x.dtype)
+
+
+def gdn_fwd(q, k, v, g, beta, *, initial_state=None, normalize_qk=True,
+            scale: float = 1.0):
     """q/k: (S, H, dk); v: (S, H, dv); g: (S, H) log-decay (≤ 0);
-    beta: (S, H) write strength (0, 1]. Returns (o (S, H, dv), S_final
-    (H, dk, dv))."""
+    beta: (S, H) write strength (0, 1]. ``scale`` multiplies q AFTER
+    the optional L2 norm (the HF cell uses dk**-0.5). Returns
+    (o (S, H, dv), S_final (H, dk, dv))."""
     s, h, dk = q.shape
     dv = v.shape[-1]
     if normalize_qk:
-        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
-                            1e-6)
-        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
-                            1e-6)
-    q32 = q.astype(jnp.float32)
+        q = _l2norm(q)
+        k = _l2norm(k)
+    q32 = q.astype(jnp.float32) * scale
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
@@ -60,7 +69,8 @@ def gdn_fwd(q, k, v, g, beta, *, initial_state=None, normalize_qk=True):
 
 
 def gdn_fwd_chunked(q, k, v, g, beta, *, chunk: int = 64,
-                    initial_state=None, normalize_qk=True):
+                    initial_state=None, normalize_qk=True,
+                    scale: float = 1.0):
     """Chunked WY-form GDN prefill (the reference ``gdn.py`` chunk
     machinery, :56-63 onward): within each chunk the implicit delta-rule
     updates are solved as ONE unit-lower-triangular system (the UT/WY
@@ -85,10 +95,9 @@ def gdn_fwd_chunked(q, k, v, g, beta, *, chunk: int = 64,
     s, h, dk = q.shape
     dv = v.shape[-1]
     if normalize_qk:
-        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
-                            1e-6)
-        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
-                            1e-6)
+        q = _l2norm(q)
+        k = _l2norm(k)
+    q = q.astype(jnp.float32) * scale
     c = min(chunk, s)
     pad = (-s) % c
     if pad:
@@ -119,7 +128,12 @@ def gdn_fwd_chunked(q, k, v, g, beta, *, chunk: int = 64,
         gam = jnp.exp(bsum)                    # (H,C) Γ_t
         beta_h = bch.T                         # (H,C)
         # e^{b_t - b_s}, masked to the causal triangle (≤ 1 everywhere).
-        diff = jnp.exp(bsum[:, :, None] - bsum[:, None, :])  # (H,C,C)
+        # Clamp the anti-causal (s > t) entries to 0 BEFORE the exp:
+        # they are multiplied by the triangle mask afterwards, but with
+        # strong decays (|g| ~ 20/token) exp of their POSITIVE exponent
+        # overflows to inf first and inf·0 = NaN.
+        diff = jnp.exp(jnp.minimum(
+            bsum[:, :, None] - bsum[:, None, :], 0.0))       # (H,C,C)
 
         kk = jnp.einsum("hsd,htd->hts", kh, kh)              # k_sᵀk_t
         a_mat = beta_h[:, :, None] * diff * kk * tri_lo
@@ -146,19 +160,18 @@ def gdn_fwd_chunked(q, k, v, g, beta, *, chunk: int = 64,
     return o.astype(v.dtype), S_final
 
 
-def gdn_decode_step(S, q, k, v, g, beta, *, normalize_qk=True):
+def gdn_decode_step(S, q, k, v, g, beta, *, normalize_qk=True,
+                    scale: float = 1.0):
     """Single-token step for inference. S: (H, dk, dv); q/k: (H, dk);
     v: (H, dv); g/beta: (H,). Returns (o (H, dv), S_new)."""
     if normalize_qk:
-        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
-                            1e-6)
-        k = k / jnp.maximum(jnp.linalg.norm(k, axis=-1, keepdims=True),
-                            1e-6)
+        q = _l2norm(q)
+        k = _l2norm(k)
     S = S * jnp.exp(g.astype(jnp.float32))[:, None, None]
     pred = jnp.einsum("hkv,hk->hv", S, k.astype(jnp.float32))
     delta = (v.astype(jnp.float32) - pred) * beta[:, None]
     S = S + jnp.einsum("hk,hv->hkv", k.astype(jnp.float32), delta)
-    o = jnp.einsum("hkv,hk->hv", S, q.astype(jnp.float32))
+    o = jnp.einsum("hkv,hk->hv", S, q.astype(jnp.float32) * scale)
     return o.astype(v.dtype), S
 
 
