@@ -48,9 +48,9 @@ def init(key, cfg, dtype=jnp.float32) -> Dict:
         # (modeling_qwen3_next.Qwen3NextAttention); de-interleaved to a
         # separate column-parallel matrix so gate columns shard with
         # their heads.
-        (kg,) = jax.random.split(jax.random.fold_in(kq, 1), 1)
         p["wqg"] = jax.random.normal(
-            kg, (d, cfg.num_attention_heads * hd), dtype) * scale
+            jax.random.fold_in(kq, 1),
+            (d, cfg.num_attention_heads * hd), dtype) * scale
     if getattr(cfg, "attention_bias", False):
         # Seed-OSS / Qwen2-style projection biases (the reference
         # shards q_proj.bias etc. the same way, layer init path).

@@ -191,6 +191,19 @@ class ModelConfig:
         # schedule as an interval (softmax layer last in each block),
         # so verify the list IS that pattern rather than silently
         # reinterpreting a custom schedule.
+        #
+        # Same fail-fast policy for the MoE schedule: every MoE layer
+        # is assumed sparse (decoder_sparse_step 1, no dense-only
+        # layers). Rejecting a non-default schedule HERE — before
+        # load_hf_checkpoint reads tens of GB of shards — beats an
+        # opaque KeyError from the per-layer mapper afterwards.
+        if get("num_experts", 0):
+            if get("decoder_sparse_step", 1) not in (None, 1) or \
+                    get("mlp_only_layers"):
+                raise NotImplementedError(
+                    "only the every-layer MoE schedule is supported "
+                    f"(decoder_sparse_step={get('decoder_sparse_step')}"
+                    f", mlp_only_layers={get('mlp_only_layers')})")
         interval = get("full_attention_interval", 4) or 4
         layer_types = get("layer_types")
         # Only hybrid (GDN) models consult the schedule; non-hybrid
