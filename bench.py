@@ -1054,6 +1054,31 @@ def battery(quiet=False, deadline=None):
         toks = np.asarray(eng.serve(ids, gen_len=8))
         assert toks.shape == (2, 8) and np.isfinite(toks).all()
 
+    def run_hybrid_hf_cell():
+        """HF-checkpoint-faithful Qwen3-Next cell (conv GDN + gated
+        attention + shared-expert MoE) through the Engine — the shape
+        real checkpoints serve with."""
+        from triton_dist_tpu.models import Engine, ModelConfig, qwen_next
+
+        n = len(mesh.devices.reshape(-1))
+        cfg = ModelConfig.tiny_next(
+            vocab_size=256, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=2, num_attention_heads=max(8, n),
+            num_key_value_heads=max(8, n), head_dim=32,
+            gdn_num_heads=2 * max(8, n), gdn_head_dim_k=32,
+            gdn_head_dim_v=32, full_attn_interval=2,
+            gdn_num_key_heads=max(8, n), gdn_conv_kernel=4,
+            attn_gate=True, partial_rotary_factor=0.25,
+            num_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=128,
+            shared_expert_intermediate_size=128)
+        eng = Engine(cfg, mesh, mode="xla", max_len=128, seed=9,
+                     model=qwen_next)
+        ids = jax.random.randint(jax.random.PRNGKey(19), (2, 64), 0,
+                                 cfg.vocab_size)
+        toks = np.asarray(eng.serve(ids, gen_len=8))
+        assert toks.shape == (2, 8) and np.isfinite(toks).all()
+
     def run_megakernel(paged):
         def go():
             from triton_dist_tpu.megakernel.engine import MegaKernelEngine
@@ -1132,6 +1157,7 @@ def battery(quiet=False, deadline=None):
         ("fused_sp_decode", run_fused_decode),
         ("ll_a2a_steps", run_ll_a2a_steps),
         ("hybrid_gdn_engine", run_hybrid_gdn),
+        ("hybrid_hf_cell_engine", run_hybrid_hf_cell),
         ("engine_decode_throughput", run_decode_perf),
         ("megakernel_prefill_decode", run_megakernel(False)),
         ("megakernel_paged", run_megakernel(True)),

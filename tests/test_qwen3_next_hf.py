@@ -334,3 +334,27 @@ def test_hybrid_checkpoint_engine_serve(tp8_mesh):
         outs[mode] = np.asarray(eng.serve(ids, gen_len=4))
     assert outs["xla"].shape == (2, 4)
     np.testing.assert_array_equal(outs["xla"], outs["fused"])
+
+
+def test_hybrid_checkpoint_ep_regime(tp8_mesh):
+    """EP expert sharding for the hybrid family: Engine(moe_impl='ep')
+    on the real checkpoint serves the same greedy tokens as the TP
+    regime (the regime that matters for 512-expert Qwen3-Next-80B)."""
+    from triton_dist_tpu.models import Engine, qwen_next
+    from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+    from jax.sharding import Mesh
+
+    cfg, params = load_hf_checkpoint(FIXTURE, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                             cfg.vocab_size)
+    # 4 experts → EP degree 4 (expert count bounds the ep axis).
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    eng_tp = Engine(cfg, mesh4, mode="xla", max_len=32,
+                    params=params, model=qwen_next, moe_impl="tp")
+    eng_ep = Engine(cfg, mesh4, mode="xla", max_len=32,
+                    params=params, model=qwen_next, moe_impl="ep",
+                    ep_axis="tp")
+    toks_tp = np.asarray(eng_tp.serve(ids, gen_len=4))
+    toks_ep = np.asarray(eng_ep.serve(ids, gen_len=4))
+    np.testing.assert_array_equal(toks_ep, toks_tp)

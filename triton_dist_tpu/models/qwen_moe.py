@@ -66,36 +66,41 @@ def param_specs(cfg: ModelConfig, *, moe_impl: str = "tp",
             "lm_head": P(axis, None)}
 
 
-def _moe_block(lp, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
-               ep_ctx, moe_block_m):
-    """One MoE FFN block in the requested parallel regime (the
-    ``ffn_fn`` hook plugged into the dense trunk/decode)."""
+def moe_ffn(moe, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
+            ep_ctx, moe_block_m=None):
+    """One MoE FFN block in the requested parallel regime. ``moe`` is
+    the MoE param dict (router/experts/shared); shared between
+    ``qwen_moe`` and the hybrid ``qwen_next`` FFN. ``moe_block_m=None``
+    takes the fused context's row tile (the Engine's ``block_m`` knob)."""
     if moe_impl == "tp":
         if mode == "fused" and ctxs.ag is not None:
             # Fully-fused pipeline: AG-fused grouped GEMM + Pallas
             # down-proj + fused RS epilogue (the reference's
             # ag_group_gemm/moe_reduce_rs layer pairing).
             return tp_moe.fwd_fused(
-                lp["moe"], h, topk=cfg.num_experts_per_tok,
+                moe, h, topk=cfg.num_experts_per_tok,
                 num_experts=cfg.num_experts,
-                mesh_ctx=ctxs.ag.mesh, axis=axis, block_m=moe_block_m,
+                mesh_ctx=ctxs.ag.mesh, axis=axis,
+                block_m=(ctxs.ag.block_m if moe_block_m is None
+                         else moe_block_m),
+                block_n=ctxs.ag.block_n, block_k=ctxs.ag.block_k,
                 norm_topk_prob=cfg.norm_topk_prob)
         return tp_moe.fwd(
-            lp["moe"], h, topk=cfg.num_experts_per_tok,
+            moe, h, topk=cfg.num_experts_per_tok,
             num_experts=cfg.num_experts, axis=axis,
             norm_topk_prob=cfg.norm_topk_prob)
     from triton_dist_tpu.ops.ep_a2a import EP2DContext
 
     if isinstance(ep_ctx, EP2DContext):
-        return ep_moe.fwd_2d(lp["moe"], h, ep_ctx,
+        return ep_moe.fwd_2d(moe, h, ep_ctx,
                              topk=cfg.num_experts_per_tok,
                              norm_topk_prob=cfg.norm_topk_prob)
-    return ep_moe.fwd(lp["moe"], h, ep_ctx,
+    return ep_moe.fwd(moe, h, ep_ctx,
                       topk=cfg.num_experts_per_tok,
                       norm_topk_prob=cfg.norm_topk_prob)
 
 
-def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
+def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
     """Small-batch (decode) MoE FFN: TP experts via ``tp_moe.fwd_ar``
     (the GEMM+AR pairing), EP experts via ``ep_moe.fwd_decode``
     (masked-local-experts + psum — see its docstring for why this
@@ -103,7 +108,7 @@ def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
     from triton_dist_tpu.ops.ep_a2a import EP2DContext
 
     if moe_impl == "tp":
-        return tp_moe.fwd_ar(lp["moe"], h, topk=cfg.num_experts_per_tok,
+        return tp_moe.fwd_ar(moe, h, topk=cfg.num_experts_per_tok,
                              num_experts=cfg.num_experts, axis=axis,
                              norm_topk_prob=cfg.norm_topk_prob)
     if isinstance(ep_ctx, EP2DContext):
@@ -112,16 +117,31 @@ def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
         ep_axis = ep_ctx.axis
     else:
         ep_axis = axis
-    return ep_moe.fwd_decode(lp["moe"], h, topk=cfg.num_experts_per_tok,
+    return ep_moe.fwd_decode(moe, h, topk=cfg.num_experts_per_tok,
                              axis=ep_axis,
                              norm_topk_prob=cfg.norm_topk_prob)
+
+
+def _moe_block(lp, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
+               ep_ctx, moe_block_m=None):
+    """Dense-trunk ``ffn_fn`` hook form (receives the whole layer
+    param dict)."""
+    return moe_ffn(lp["moe"], h, cfg, moe_impl=moe_impl, mode=mode,
+                   axis=axis, ctxs=ctxs, ep_ctx=ep_ctx,
+                   moe_block_m=moe_block_m)
+
+
+def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
+    """Dense-trunk decode hook form."""
+    return moe_ffn_decode(lp["moe"], h, cfg, moe_impl=moe_impl,
+                          axis=axis, ep_ctx=ep_ctx)
 
 
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
                    moe_impl: str = "tp", mode: str = "xla",
                    axis: str = "tp", ep_ctx: Optional[EPContext] = None,
                    ctxs: FwdContexts = FwdContexts(),
-                   moe_block_m: int = 64):
+                   moe_block_m: Optional[int] = None):
     """Per-shard all-token forward → (B, S, vocab) logits.
 
     For ``moe_impl="ep"`` the residual stream is token-sharded along the
@@ -159,7 +179,7 @@ def cache_specs(axis: str = "tp"):
 def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
             axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
             max_len: Optional[int] = None, moe_impl: str = "tp",
-            ep_ctx: Optional[EPContext] = None, moe_block_m: int = 64):
+            ep_ctx: Optional[EPContext] = None, moe_block_m: Optional[int] = None):
     """Per-shard prefill → (last-position logits (B, vocab), KVCache).
     Same contract as ``dense.prefill`` (the Engine's model protocol,
     reference ``Engine._init_model`` + ``DenseLLM.inference``)."""

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.layers import ep_moe, gdn_attn, tp_attn, tp_mlp, tp_moe
+from triton_dist_tpu.models.qwen_moe import moe_ffn, moe_ffn_decode
 from triton_dist_tpu.layers.norm import rms_norm
 from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.models.dense import (
@@ -110,7 +111,18 @@ def init_params(key, cfg: ModelConfig, dtype=jnp.float32) -> Dict:
             "lm_head": lm_head}
 
 
-def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
+def param_specs(cfg: ModelConfig, axis: str = "tp", *,
+                moe_impl: str = "tp", ep_axis: str = "ep") -> Dict:
+    """``moe_impl`` selects the FFN regime for MoE configs (same
+    contract as ``qwen_moe.param_specs`` — the Engine introspects the
+    kwarg and plumbs ``moe_impl``/``ep_ctx`` into prefill/decode)."""
+    if moe_impl not in ("tp", "ep"):
+        raise ValueError(f"unknown moe_impl {moe_impl!r}")
+    if moe_impl == "ep" and not cfg.is_moe:
+        raise ValueError("moe_impl='ep' on a non-MoE hybrid config")
+    if cfg.is_moe:
+        moe_specs = (tp_moe.param_specs(axis, cfg) if moe_impl == "tp"
+                     else ep_moe.param_specs(ep_axis, cfg))
     layers = []
     for li in range(cfg.num_hidden_layers):
         mixer = (tp_attn.param_specs(axis, cfg)
@@ -118,7 +130,7 @@ def param_specs(cfg: ModelConfig, axis: str = "tp") -> Dict:
                  else gdn_attn.param_specs(axis, cfg))
         layers.append({
             "mixer": mixer,
-            "mlp": (tp_moe.param_specs(axis, cfg) if cfg.is_moe
+            "mlp": (moe_specs if cfg.is_moe
                     else tp_mlp.param_specs(axis)),
             "ln_attn": P(None),
             "ln_mlp": P(None),
@@ -162,7 +174,8 @@ def empty_cache(cfg: ModelConfig, batch: int, max_len: int, n: int,
                         max(cfg.gdn_conv_kernel - 1, 0)), dtype))
 
 
-def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
+def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache,
+           moe_impl="tp", ep_ctx=None, moe_block_m=None):
     b, s = input_ids.shape
     kinds, _, _ = _layer_kinds(cfg)
     x = _embed_tokens(params, input_ids, mode=mode, axis=axis)
@@ -196,19 +209,12 @@ def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
         x = x + mix_out
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            if mode == "fused" and ctxs.ag is not None:
-                ffn_out = tp_moe.fwd_fused(
-                    lp["mlp"], h, topk=cfg.num_experts_per_tok,
-                    num_experts=cfg.num_experts,
-                    mesh_ctx=ctxs.ag.mesh, axis=axis,
-                    block_m=ctxs.ag.block_m, block_n=ctxs.ag.block_n,
-                    block_k=ctxs.ag.block_k,
-                    norm_topk_prob=cfg.norm_topk_prob)
-            else:
-                ffn_out = tp_moe.fwd(
-                    lp["mlp"], h, topk=cfg.num_experts_per_tok,
-                    num_experts=cfg.num_experts, axis=axis,
-                    norm_topk_prob=cfg.norm_topk_prob)
+            # Same regime dispatch as qwen_moe (tp-fused / tp / ep /
+            # ep-2d) — one helper, two models.
+            ffn_out = moe_ffn(
+                lp["mlp"], h, cfg, moe_impl=moe_impl, mode=mode,
+                axis=axis, ctxs=ctxs, ep_ctx=ep_ctx,
+                moe_block_m=moe_block_m)
         else:
             ffn_out = tp_mlp.fwd(lp["mlp"], h, mode=mode, axis=axis,
                                  ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
@@ -222,22 +228,27 @@ def _trunk(params, input_ids, cfg, *, mode, axis, ctxs, cache):
 
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
                    mode: str = "xla", axis: str = "tp",
-                   ctxs: FwdContexts = FwdContexts()):
+                   ctxs: FwdContexts = FwdContexts(),
+                   moe_impl: str = "tp", ep_ctx=None,
+                   moe_block_m: Optional[int] = None):
     b, s = input_ids.shape
     x, _ = _trunk(params, input_ids, cfg, mode=mode, axis=axis,
-                  ctxs=ctxs, cache=None)
+                  ctxs=ctxs, cache=None, moe_impl=moe_impl,
+                  ep_ctx=ep_ctx, moe_block_m=moe_block_m)
     return _lm_head(params, x, axis).reshape(b, s, cfg.vocab_size)
 
 
 def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
             axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
-            max_len: Optional[int] = None):
+            max_len: Optional[int] = None, moe_impl: str = "tp",
+            ep_ctx=None, moe_block_m: Optional[int] = None):
     n = jax.lax.axis_size(axis)
     b, s = input_ids.shape
     cache = empty_cache(cfg, b, max_len or s, n,
                         dtype=params["embed"].dtype)
     x, cache = _trunk(params, input_ids, cfg, mode=mode, axis=axis,
-                      ctxs=ctxs, cache=cache)
+                      ctxs=ctxs, cache=cache, moe_impl=moe_impl,
+                      ep_ctx=ep_ctx, moe_block_m=moe_block_m)
     cache.kv = dataclasses.replace(cache.kv,
                                    length=jnp.asarray(s, jnp.int32))
     last = x.reshape(b, s, cfg.hidden_size)[:, -1]
@@ -246,7 +257,8 @@ def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
 
 def decode_step(params, token_ids, cache: HybridCache,
                 cfg: ModelConfig, *, mode: str = "xla",
-                axis: str = "tp", ctxs: FwdContexts = FwdContexts()):
+                axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
+                moe_impl: str = "tp", ep_ctx=None):
     """One decode step; GDN layers advance their recurrent state in
     O(1), softmax layers append to the KV cache."""
     b = token_ids.shape[0]
@@ -288,13 +300,11 @@ def decode_step(params, token_ids, cache: HybridCache,
         x = x + mix_out
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            # Replicated decode rows: grouped SwiGLU over the local ffn
-            # shard + one AllReduce (the GEMM+AR decode regime).
-            x = x + tp_moe.fwd_ar(lp["mlp"], h,
-                                  topk=cfg.num_experts_per_tok,
-                                  num_experts=cfg.num_experts,
-                                  axis=axis,
-                                  norm_topk_prob=cfg.norm_topk_prob)
+            # Small-batch decode FFN in the requested regime (TP
+            # GEMM+AR, or EP masked-local-experts + psum).
+            x = x + moe_ffn_decode(lp["mlp"], h, cfg,
+                                   moe_impl=moe_impl, axis=axis,
+                                   ep_ctx=ep_ctx)
         else:
             mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
             x = x + tp_mlp.fwd(lp["mlp"], h, mode=mlp_mode, axis=axis,

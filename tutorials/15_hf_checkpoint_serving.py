@@ -1,0 +1,73 @@
+"""Tutorial 15: serving real HuggingFace checkpoints — dense, MoE, and
+hybrid Qwen3-Next.
+
+Reference capability: the reference loads HF checkpoints into its
+models (``models/dense.py:150`` init_parameters) and maps
+``ByteDance-Seed/Seed-OSS-36B`` / Qwen3 / Qwen3-MoE / Qwen3-Next onto
+its layer stack. Here the single ``load_hf_checkpoint`` entry point
+covers all four families; this tutorial walks the committed tiny
+REAL-format fixtures through it:
+
+1. dense Qwen3 (``tests/fixtures/qwen3_tiny``);
+2. hybrid Qwen3-Next (``tests/fixtures/qwen3_next_tiny``) — the
+   checkpoint-faithful GatedDeltaNet cell (short causal conv, z-gated
+   RMSNorm, A_log/dt_bias decay), gated attention with partial RoPE,
+   and the shared-expert MoE, all mapped from the serialized HF layout
+   (``in_proj_qkvz`` de-interleave, zero-centered norm folding).
+
+Run: python tutorials/15_hf_checkpoint_serving.py
+"""
+
+import os
+
+from _bootstrap import bootstrap
+
+jax = bootstrap()
+import jax.numpy as jnp
+import numpy as np
+
+import triton_dist_tpu as tdt
+from triton_dist_tpu.models import Engine, dense, qwen_next
+from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+mesh = tdt.make_mesh(tp=8)
+# The dense fixture has 4 KV heads — serve it on a 4-chip submesh
+# (TP degree is bounded by the checkpoint's KV-head count).
+mesh4 = tdt.make_mesh(tp=4, devices=jax.devices()[:4])
+
+# --- 1. dense Qwen3 checkpoint ---------------------------------------
+cfg_d, params_d = load_hf_checkpoint(
+    os.path.join(ROOT, "tests", "fixtures", "qwen3_tiny"),
+    dtype=jnp.float32)
+eng_d = Engine(cfg_d, mesh4, mode="fused", max_len=64, params=params_d,
+               block_m=8, block_n=8, block_k=32)
+ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0,
+                         cfg_d.vocab_size)
+toks_d = np.asarray(eng_d.serve(ids, gen_len=8))
+print("dense Qwen3 greedy tokens:", toks_d.tolist())
+
+# --- 2. hybrid Qwen3-Next checkpoint ---------------------------------
+# The config carries everything: layer_types -> GDN/full-attention
+# schedule, linear_* -> the GDN cell geometry, shared expert sizes.
+cfg_h, params_h = load_hf_checkpoint(
+    os.path.join(ROOT, "tests", "fixtures", "qwen3_next_tiny"),
+    dtype=jnp.float32)
+print(f"hybrid config: conv_kernel={cfg_h.gdn_conv_kernel} "
+      f"gdn {cfg_h.gdn_num_kh}k/{cfg_h.gdn_num_heads}v heads, "
+      f"{cfg_h.num_experts} experts + shared "
+      f"{cfg_h.shared_expert_intermediate_size}")
+eng_h = Engine(cfg_h, mesh, mode="fused", max_len=64, params=params_h,
+               model=qwen_next, block_m=8, block_n=8, block_k=32)
+ids_h = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                           cfg_h.vocab_size)
+toks_h = np.asarray(eng_h.serve(ids_h, gen_len=8))
+print("hybrid Qwen3-Next greedy tokens:", toks_h.tolist())
+
+# The decode loop's memory is CONSTANT in generated length for the GDN
+# layers: each advances a (B, H_loc, dk, dv) recurrent state plus a
+# (B, C_loc, K-1) conv tail — no KV growth outside the (rare)
+# full-attention layers. That asymmetry is the point of the hybrid
+# architecture for long generation.
+assert toks_d.shape == toks_h.shape == (2, 8)
+print("OK")
