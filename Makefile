@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: csrc test quick race apicheck ci bench-all
+.PHONY: csrc test quick race verify-faults apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -23,6 +23,11 @@ quick: csrc
 race: csrc
 	TRITON_DIST_TPU_DETECT_RACES=1 $(PY) -m pytest \
 	    tests/test_shmem.py tests/test_collectives.py -x -q
+
+# Fault battery: tier-1 plus tests/test_resilience.py under the race
+# detector on the CPU mesh (docs/resilience.md).
+verify-faults: csrc
+	bash scripts/verify_faults.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Fault-battery verification: the tier-1 battery plus the resilience
+# suite, both under the race detector on the CPU mesh
+# (docs/resilience.md). Wired to `make verify-faults`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export TRITON_DIST_TPU_DETECT_RACES=1
+
+PY=${PY:-python}
+
+echo "== tier-1 battery (race detector on) =="
+# test_resilience.py is excluded here: step 2 runs it in full
+# (including the slow subprocess plans), so collecting it twice only
+# duplicates CI wall-clock.
+$PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    --ignore=tests/test_resilience.py \
+    -p no:cacheprovider ${PYTEST_ARGS:-}
+
+echo "== resilience battery (including slow subprocess plans) =="
+$PY -m pytest tests/test_resilience.py -q -p no:cacheprovider

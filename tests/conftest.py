@@ -27,6 +27,13 @@ from triton_dist_tpu.parallel.mesh import MeshContext  # noqa: E402
 NUM_DEVICES = 8
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running fault plans (subprocess deadlock harness); "
+        "deselected from the tier-1 battery via -m 'not slow'")
+
+
 @pytest.fixture(scope="session")
 def tp8_mesh():
     """1D mesh: all 8 devices on the ``tp`` axis."""

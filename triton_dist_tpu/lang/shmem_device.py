@@ -39,6 +39,16 @@ from triton_dist_tpu.parallel.mesh import logical_device_id
 SIGNAL_SET = "set"   # reference: SignalOp::SET (DistributedAttrDefs.td:36)
 SIGNAL_ADD = "add"   # reference: SignalOp::ADD
 
+
+def _barriers_vacuous() -> bool:
+    """True when kernel-entry barriers have no meaning (and no
+    implementation): the old generic discharge interpreter runs the
+    mesh bulk-synchronously and has no rule for
+    ``get_barrier_semaphore`` — see ``utils/compat.py``."""
+    from triton_dist_tpu.utils import compat
+
+    return compat.degraded_interpret()
+
 # The full public surface (tests/test_shmem.py asserts this covers the
 # reference's ~80-name libshmem_device API one-to-one).
 __all__ = [
@@ -121,17 +131,44 @@ def remote_put(src_ref, dst_ref, send_sem, recv_sem, peer, *, axis: str,
     Reference: ``libshmem_device.putmem_nbi_block`` lowered to NVSHMEM
     (``NVIDIA/DistributedOpToLLVM.cpp:94-154``); here it is a single
     Mosaic ``make_async_remote_copy`` riding ICI (or DCN across slices).
+
+    Fault-injection hook (``resilience.faults``): inside an active
+    plan's op scope a put may be delayed (a dependent-FLOP spin folded
+    into the device id on the target rank), dropped, or duplicated —
+    the adversarial schedules the signal protocols must tolerate or
+    detect. Free when no plan is active.
     """
+    from triton_dist_tpu.resilience import faults
+
+    fault = faults.put_fault() if start else None
+    device_id = _resolve_device_id(ctx, axis, peer)
+    if fault is not None and fault.kind == "delay_dma" and fault.iters:
+        # The spin's result feeds the DMA descriptor, so it cannot be
+        # dead-code-eliminated; it costs iters dependent FLOPs on
+        # fault.rank and nothing elsewhere.
+        device_id = device_id + faults.rank_spin_zero(
+            axis, fault.rank, fault.iters)
     copy = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id=_resolve_device_id(ctx, axis, peer),
+        device_id=device_id,
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     if start:
-        copy.start()
+        if fault is not None and fault.kind == "drop_put":
+            @pl.when(jax.lax.axis_index(axis) != fault.rank)
+            def _():
+                copy.start()
+        elif fault is not None and fault.kind == "dup_put":
+            copy.start()
+
+            @pl.when(jax.lax.axis_index(axis) == fault.rank)
+            def _():
+                copy.start()   # second descriptor bind = duplicate DMA
+        else:
+            copy.start()
     return copy
 
 
@@ -314,6 +351,29 @@ def broadcastmem(dst_ref, src_ref, root: int, send_sem, recv_sem, *,
     full barrier over ``axis`` in this kernel."""
     me = rank(axis)
     n = num_ranks(axis)
+    if _barriers_vacuous():
+        # Generic discharge interpreter: the root-only put below is a
+        # rank-DIVERGENT site, and divergent sites deadlock the hidden
+        # collectives that interpreter resolves remote DMA with. Use a
+        # uniform ring relay instead: every rank forwards its dst right
+        # each step, with the root re-seeding its dst from src first
+        # (the incoming left-neighbour value would otherwise erase the
+        # payload and the relay would carry a single moving wave instead
+        # of a growing prefix). After n-1 steps every rank holds the
+        # root's payload. Semantics are bulk-synchronous there (every
+        # DMA site is a barrier), so no waits.
+        right = jax.lax.rem(me + 1, n)
+        for _step in range(n - 1):
+            @pl.when(me == root)
+            def _():
+                pltpu.sync_copy(src_ref, dst_ref)
+            remote_put(dst_ref, dst_ref, send_sem, recv_sem, right,
+                       axis=axis, ctx=ctx)
+
+        @pl.when(me == root)
+        def _():
+            pltpu.sync_copy(src_ref, dst_ref)
+        return
     if barrier:
         barrier_all(axis, ctx=ctx)
 
@@ -453,7 +513,20 @@ def notify(sem, peer=None, *, axis: Optional[str] = None, ctx=None,
     Reference: ``dl.notify`` (``distributed_ops.py:103``) — release-store /
     ``signal_op`` by CommScope (``NVIDIA/DistributedOpToLLVM.cpp:243-353``).
     Local signal: ``notify(sem)``. Remote: ``notify(sem, peer, axis="tp")``.
+
+    Fault-injection hook: an active drop_signal/dup_signal fault zeroes
+    or doubles the increment on the target rank (uniformly traced — the
+    site executes on every rank, only the increment diverges).
     """
+    if axis is not None:
+        from triton_dist_tpu.resilience import faults
+
+        fault = faults.signal_fault()
+        if fault is not None:
+            me = jax.lax.axis_index(axis)
+            scale = 0 if fault.kind == "drop_signal" else 2
+            inc = jnp.where(me == fault.rank, scale * inc,
+                            inc).astype(jnp.int32)
     if peer is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -539,15 +612,31 @@ def barrier_all(axis: str, *, ctx=None):
     ``barrier_all_intra_node_*`` kernels (``kernels/nvidia/common_ops.py``).
     Requires ``collective_id`` in the kernel's CompilerParams.
     """
+    if _barriers_vacuous():
+        return
     n = num_ranks(axis)
+    inc = _skewed_barrier_inc(axis)
     sem = pltpu.get_barrier_semaphore()
     for peer in range(n):
         pltpu.semaphore_signal(
-            sem, inc=1,
+            sem, inc=inc if peer == 0 else 1,
             device_id=_resolve_device_id(ctx, axis, peer),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
     pltpu.semaphore_wait(sem, n)
+
+
+def _skewed_barrier_inc(axis: str):
+    """Barrier-signal increment carrying an injected arrival skew: a
+    skew_barrier fault spins the target rank before its first signal
+    (the spin result rides the increment so it cannot be DCE'd; the
+    increment stays exactly 1)."""
+    from triton_dist_tpu.resilience import faults
+
+    fault = faults.barrier_fault()
+    if fault is None or not fault.iters:
+        return 1
+    return 1 + faults.rank_spin_zero(axis, fault.rank, fault.iters)
 
 
 def barrier_tile(axis: str, *, ctx=None, sem=None):
@@ -560,12 +649,14 @@ def barrier_tile(axis: str, *, ctx=None, sem=None):
     whatever kernel this device is still running.
     """
     if sem is None:
+        if _barriers_vacuous():
+            return
         sem = pltpu.get_barrier_semaphore()
     n = num_ranks(axis)
     me = rank(axis)
     left = jax.lax.rem(me + n - 1, n)
     right = jax.lax.rem(me + 1, n)
-    notify(sem, left, axis=axis, ctx=ctx)
+    notify(sem, left, axis=axis, ctx=ctx, inc=_skewed_barrier_inc(axis))
     notify(sem, right, axis=axis, ctx=ctx)
     wait(sem, 2)
 
@@ -582,6 +673,8 @@ def barrier(team):
     makes it the same operation as :func:`sync_all` scoped to a team
     (the delta :func:`quiet` documents).
     """
+    if _barriers_vacuous():
+        return
     sem = pltpu.get_barrier_semaphore()
     n = team.n_pes()
     for pe in range(n):

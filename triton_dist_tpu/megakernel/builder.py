@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -120,6 +121,11 @@ class ModelBuilder:
         self.max_len = max_len
         self.num_cores = num_cores
         self.strategy = strategy
+        # Scoreboard progress tracing (see _kernel): env-gated so the
+        # resilience harness can attribute a wedged schedule to its
+        # last-completed queue slot.
+        self.trace_progress = os.environ.get(
+            "TRITON_DIST_TPU_TRACE_PROGRESS") == "1"
         # profile=True: the step emits a 4th output — one (task_type,
         # arg0) row per executed queue slot — feeding core_activity()
         # (the reference megakernel's SM-activity metric,
@@ -734,6 +740,14 @@ class ModelBuilder:
                 "recv_sem": recv_sem, "tbl_s": tbl_s, "states": states,
                 "vrow": vrow, "vrow2": vrow2, "vS": vS}
 
+        # Progress tracing (TRITON_DIST_TPU_TRACE_PROGRESS=1): one line
+        # per queue slot as the scoreboard advances. In interpret mode
+        # this is the only progress signal that survives a wedged
+        # kernel — the resilience harness parses the last line to name
+        # the slot a deadlocked schedule stopped at.
+        if self.trace_progress:
+            pl.debug_print("TDT-PROGRESS q={} c={}", q, c)
+
         # Scoreboard waits: block until every cross-core predecessor's
         # edge semaphore has been signalled (reference
         # scoreboard_wait_deps).
@@ -776,8 +790,22 @@ class ModelBuilder:
         # here runs that variant, so the kernel does not consume it.)
         sstart, scount = sig_tab_s[q, c, 0], sig_tab_s[q, c, 1]
 
+        # Fault hook: a drop_edge plan suppresses one edge's completion
+        # signal — the canonical scoreboard failure (a consumer's wait
+        # then never satisfies; a blocking backend deadlocks, which the
+        # resilience harness must detect and attribute).
+        from triton_dist_tpu.resilience import faults
+
+        dropped_edge = faults.edge_drop("megakernel")
+
         def sig_step(k, _):
-            pltpu.semaphore_signal(edge_sem.at[sig_edges_s[sstart + k]], 1)
+            edge = sig_edges_s[sstart + k]
+            if dropped_edge is None:
+                pltpu.semaphore_signal(edge_sem.at[edge], 1)
+            else:
+                @pl.when(edge != dropped_edge)
+                def _():
+                    pltpu.semaphore_signal(edge_sem.at[edge], 1)
             return 0
 
         jax.lax.fori_loop(0, scount, sig_step, 0)

@@ -29,13 +29,25 @@ class MegaKernelEngine:
                  keep_params: bool = False, prefill_seq: int = 0,
                  num_cores: int = 1, strategy: str = "round_robin",
                  paged: bool = False, page=None, num_pages=None,
-                 cost_table=None):
+                 cost_table=None, timeout_s=None):
+        """``timeout_s`` arms a per-step watchdog: every
+        :meth:`decode_step` / :meth:`prefill` blocks on its result
+        under a deadline and raises a structured
+        :class:`~triton_dist_tpu.resilience.CommTimeoutError` (rank,
+        op, last-completed step counter — see :meth:`progress`) instead
+        of hanging on a wedged scoreboard. ``None`` keeps the
+        non-blocking async-dispatch behaviour."""
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
         self.max_len = max_len
         self.batch = batch
         self.paged = paged
+        self.timeout_s = timeout_s
+        # Host-side progress counters for watchdog/timeout diagnostics:
+        # how many megakernel launches completed, and the queue shape
+        # a wedged launch would be stuck inside.
+        self.steps_done = 0
         # Resolve the tile once; both builders and the page default use
         # the same value (no silently-divergent default formulas).
         t_tile = t_tile or min(128, max_len)
@@ -173,6 +185,31 @@ class MegaKernelEngine:
         self.v_cache = jax.device_put(
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
 
+    def progress(self) -> dict:
+        """Last-completed progress counters (CommTimeoutError payload):
+        completed megakernel launches plus the schedule geometry
+        (queue length x cores, scoreboard edge count) that frames
+        where a wedged launch can be stuck."""
+        return {
+            "steps_done": self.steps_done,
+            "qlen": self.builder.qlen,
+            "num_cores": self.builder.num_cores,
+            "n_edges": self.builder.n_edges,
+        }
+
+    def _finish(self, out, op: str):
+        """Bound the step's completion when a watchdog is armed; count
+        completed steps either way (the counter advances only after the
+        dispatch is known-good, so a raise cannot desync it)."""
+        if self.timeout_s is not None:
+            from triton_dist_tpu.resilience.watchdog import (
+                block_until_ready)
+
+            out = block_until_ready(out, timeout_s=self.timeout_s,
+                                    op=op, progress_fn=self.progress)
+        self.steps_done += 1
+        return out
+
     def reset_states(self):
         """Zero the GDN recurrent states (hybrid family) — REQUIRED
         between independent prompts on a reused engine: unlike stale KV
@@ -199,7 +236,7 @@ class MegaKernelEngine:
                 self._arena, self.k_cache, self.v_cache,
                 jnp.asarray(token_ids, jnp.int32),
                 jnp.asarray(cache_len, jnp.int32), self.block_table)
-        return logits
+        return self._finish(logits, "megakernel.decode_step")
 
     def prefill_chain(self, prompt_ids):
         """Feed a (B, S) prompt token-by-token (fallback when no
@@ -234,6 +271,7 @@ class MegaKernelEngine:
                                prompt_ids.reshape(-1),
                                jnp.asarray(start_pos, jnp.int32),
                                self.block_table))
+        logits = self._finish(logits, "megakernel.prefill")
         return logits.reshape(bsz, s, -1)[:, -1]
 
     def generate(self, first_tokens, steps: int, *, start_pos: int = 0):

@@ -148,3 +148,31 @@ def schedule_mc(n_tasks: int, src: Sequence[int], dst: Sequence[int], *,
         "sig_cores": sig_cores[:int(sig_count.sum())],
         "n_edges": n_edges,
     }
+
+
+def describe_slot(sched: dict, q: int, c: int) -> dict:
+    """Map a scoreboard step counter — the (queue position, core) pair
+    a progress trace or a watchdog reports — back to the task occupying
+    it, with the edge semaphores it waits on and signals.
+
+    The diagnostic half of the scoreboard: a deadlocked schedule stops
+    at some (q, c); this names the task and the exact edges whose
+    missing counts wedged it. ``task == -1`` is a NOOP padding slot.
+    """
+    queue = sched["queue"]
+    qlen, cores = queue.shape
+    if not (0 <= q < qlen and 0 <= c < cores):
+        raise IndexError(f"slot ({q}, {c}) outside queue {queue.shape}")
+    task = int(queue[q, c])
+    out = {"q": q, "core": c, "task": task,
+           "merged_index": q * cores + c}
+    if task >= 0:
+        ws, wc = int(sched["wait_start"][task]), int(
+            sched["wait_count"][task])
+        ss, sc = int(sched["sig_start"][task]), int(
+            sched["sig_count"][task])
+        out["waits_on_edges"] = [int(e) for e in
+                                 sched["wait_edges"][ws:ws + wc]]
+        out["signals_edges"] = [int(e) for e in
+                                sched["sig_edges"][ss:ss + sc]]
+    return out

@@ -22,6 +22,18 @@ from triton_dist_tpu.models.kv_cache import KVCache
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
+def _donated_lost(args) -> bool:
+    """True when any array argument was already donated into the failed
+    dispatch (decode donates the KV cache): a retry would dispatch on
+    deleted buffers and mask the original error, so the caller must
+    re-raise instead. Trace-time failures (the common fused-path case)
+    happen before donation and retry safely."""
+    for leaf in jax.tree.leaves(args):
+        if isinstance(leaf, jax.Array) and leaf.is_deleted():
+            return True
+    return False
+
+
 class Engine:
     """Greedy-decoding TP inference engine over a mesh.
 
@@ -37,7 +49,9 @@ class Engine:
                  block_m: int = 256, block_n: int = 256,
                  block_k: int = 512, model=None,
                  moe_impl: Optional[str] = None, ep_axis=None,
-                 ep_capacity: Optional[int] = None):
+                 ep_capacity: Optional[int] = None,
+                 fallback: Optional[str] = None, probe: bool = False,
+                 timeout_s: Optional[float] = None):
         """``moe_impl`` selects the MoE regime for ``models.qwen_moe``
         ("tp" | "ep"); with ``"ep"`` the Engine builds the EPContext
         itself (reference: the Engine serving the MoE demo). ``ep_axis``
@@ -45,7 +59,42 @@ class Engine:
         hierarchical ICI-by-DCN dispatch (``create_ep2d_context``);
         ``ep_capacity`` opts into the capped-drop dispatch (see
         ``create_ep_context`` for the drop-free mode's memory scaling).
+
+        Resilience knobs:
+
+        - ``fallback="xla"``: when a fused prefill/decode dispatch
+          raises, log once, rebuild that dispatch with ``mode="xla"``
+          (the plain-XLA collective path), and re-serve the request —
+          graceful degradation instead of a dead replica. Retry is
+          never attempted for a :class:`CommTimeoutError` — the wedged
+          dispatch still holds the device (and on decode the KV cache
+          was donated into it), so the timeout is re-raised as-is.
+        - ``probe=True`` (with ``fallback``): run
+          ``resilience.policy.health_probe`` at construction; if the
+          fused comm path is unhealthy on this platform, start degraded
+          immediately.
+        - ``timeout_s``: bound every prefill/decode wait; a miss raises
+          :class:`~triton_dist_tpu.resilience.CommTimeoutError`
+          carrying rank, op, and the last-completed decode-step
+          counter.
         """
+        if fallback not in (None, "xla"):
+            raise ValueError(f"fallback must be None or 'xla', "
+                             f"got {fallback!r}")
+        if probe and fallback is None:
+            raise ValueError(
+                "probe=True requires fallback='xla' — a failed probe "
+                "has nowhere to degrade to otherwise")
+        self.fallback = fallback
+        self.timeout_s = timeout_s
+        if probe and fallback == "xla" and mode != "xla":
+            from triton_dist_tpu.resilience import policy as _policy
+
+            if not _policy.health_probe(mesh, axis):
+                _policy.note_failure(
+                    f"engine[mode={mode}]",
+                    RuntimeError("startup health probe failed"))
+                mode = "xla"
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -108,6 +157,15 @@ class Engine:
             or isinstance(x, np.ndarray))
         self._specs = specs
 
+        self._prefill, self._decode = self._build(mode)
+
+    def _build(self, mode):
+        """Jit the prefill/decode dispatches for ``mode`` (called once
+        at construction, and again with mode="xla" on degradation)."""
+        model, cfg, axis = self.model, self.cfg, self.axis
+        model_kwargs, specs = self.model_kwargs, self._specs
+        max_len = self.max_len
+
         def _prefill(params, ids):
             return model.prefill(params, ids, cfg, mode=mode, axis=axis,
                                  ctxs=self.ctxs, max_len=max_len,
@@ -119,23 +177,58 @@ class Engine:
                                      **model_kwargs)
 
         kv_spec = model.cache_specs(axis)
-        self._prefill = jax.jit(jax.shard_map(
-            _prefill, mesh=mesh,
+        pre = jax.jit(jax.shard_map(
+            _prefill, mesh=self.mesh,
             in_specs=(specs, P(None, None)),
             out_specs=(P(None, None), kv_spec),
             check_vma=False))
-        self._decode = jax.jit(jax.shard_map(
-            _decode, mesh=mesh,
+        dec = jax.jit(jax.shard_map(
+            _decode, mesh=self.mesh,
             in_specs=(specs, P(None), kv_spec),
             out_specs=(P(None, None), kv_spec),
             check_vma=False), donate_argnums=(2,))
+        return pre, dec
+
+    def _degrade(self):
+        """Rebuild both dispatches on the plain-XLA collective path."""
+        if self.mode != "xla":
+            self.mode = "xla"
+            self._prefill, self._decode = self._build("xla")
+
+    def _dispatch(self, op: str, *args, retriable: bool = True):
+        """Run one prefill/decode dispatch under the resilience policy:
+        optional watchdog deadline, and (``fallback="xla"``) one
+        degrade-and-retry when the fused path raises."""
+        from triton_dist_tpu.resilience import policy as _policy
+        from triton_dist_tpu.resilience.watchdog import (
+            CommTimeoutError, block_until_ready)
+
+        fn = self._prefill if op == "prefill" else self._decode
+        try:
+            out = fn(self.params, *args)
+            if self.timeout_s is not None:
+                out = block_until_ready(
+                    out, timeout_s=self.timeout_s, op=f"engine.{op}",
+                    progress_fn=lambda: getattr(self, "_host_len", None))
+            return out
+        except CommTimeoutError:
+            raise          # wedged dispatch: inputs may be donated/lost
+        except Exception as e:  # noqa: BLE001 — degrade-and-retry
+            if (self.fallback != "xla" or self.mode == "xla"
+                    or not retriable or _donated_lost(args)):
+                raise
+            _policy.note_failure(f"engine.{op}[mode={self.mode}]", e)
+            self._degrade()
+            return self._dispatch(op, *args, retriable=False)
 
     def prefill(self, input_ids) -> Tuple[jax.Array, KVCache]:
         input_ids = jnp.asarray(input_ids)
+        out = self._dispatch("prefill", input_ids)
         # Host-side mirror of cache.length: lets decode() guard overruns
-        # without forcing a device sync per generated token.
+        # without forcing a device sync per generated token. Set only
+        # after the dispatch is known-good so a raise cannot desync it.
         self._host_len = int(input_ids.shape[1])
-        return self._prefill(self.params, input_ids)
+        return out
 
     def decode(self, tokens, cache) -> Tuple[jax.Array, KVCache]:
         # dynamic_update_slice clamps out-of-range starts, which would
@@ -148,7 +241,9 @@ class Engine:
         if length >= self.max_len:
             raise ValueError(
                 f"KV cache full ({self.max_len}); cannot decode further")
-        out = self._decode(self.params, tokens, cache)
+        out = self._dispatch("decode", tokens, cache)
+        # Advance only after _decode returned: a raised step must leave
+        # the overflow guard exactly where it was.
         self._host_len = length + 1
         return out
 

@@ -694,8 +694,11 @@ def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
     _, n_loc = b.shape
     out_dtype = ctx.out_dtype or a.dtype
     if n_o == 1:
+        # Call the impl, not the public wrapper: we are already inside
+        # the wrapper's "ag_gemm" fault scope, and re-entering it would
+        # double-count the host call for fail_kth_call plans.
         inner_ctx = dataclasses.replace(ctx, axis=inner_axis)
-        return ag_gemm(a, b, inner_ctx, return_ag=return_ag)
+        return _ag_gemm_impl(a, b, inner_ctx, return_ag=return_ag)
 
     tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
         ctx, m_loc, n_loc, kdim, a.dtype.itemsize)
@@ -754,6 +757,36 @@ def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
 
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
             force_kernel: bool = False, sim_ranks: int = 0, ws=None):
+    """Overlapped per-shard AllGather(A) @ B (call inside shard_map) —
+    see :func:`_ag_gemm_impl` for the full contract.
+
+    This wrapper is the resilience hook: it counts host-level calls for
+    ``fail_call`` fault plans, scopes the kernel-trace-time
+    put/signal/barrier hooks to op ``"ag_gemm"``, maps a
+    ``skew_barrier`` fault onto the kernel's straggler spin (the one
+    skew source that exists on every backend), and honors the
+    degradation policy (``resilience.policy.should_fallback``) by
+    re-dispatching through the XLA oracle."""
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("ag_gemm"):
+        if policy.should_fallback("ag_gemm") and not force_kernel:
+            a_full = jax.lax.all_gather(a, ctx.axis, axis=0, tiled=True)
+            out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
+                          ).astype(ctx.out_dtype or a.dtype)
+            return (out, a_full) if return_ag else out
+        skew = faults.barrier_fault()
+        if skew is not None and ctx.straggler_delay_iters == 0:
+            ctx = dataclasses.replace(
+                ctx, straggler_rank=skew.rank,
+                straggler_delay_iters=skew.iters)
+        return _ag_gemm_impl(a, b, ctx, return_ag=return_ag,
+                             force_kernel=force_kernel,
+                             sim_ranks=sim_ranks, ws=ws)
+
+
+def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
+                  force_kernel: bool = False, sim_ranks: int = 0, ws=None):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
 
     ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;

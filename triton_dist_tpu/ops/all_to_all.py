@@ -54,7 +54,24 @@ def all_to_all(x, *, ctx: MeshContext, axis: str = "ep",
                force_kernel: bool = False):
     """Per-shard all-to-all (inside shard_map): x (n, C, ...) where
     x[r] is the chunk destined for rank r; returns out (n, C, ...) where
-    out[r] is the chunk received from rank r."""
+    out[r] is the chunk received from rank r.
+
+    Resilience hook wrapper: fault plans count/scope on op
+    ``"all_to_all"``, and the degradation policy
+    (``resilience.policy.should_fallback``) re-dispatches through
+    ``lax.all_to_all`` (this also covers ``ep_dispatch``/``ep_combine``
+    capped-mode transport, which rides on this op)."""
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("all_to_all"):
+        if policy.should_fallback("all_to_all") and not force_kernel:
+            return all_to_all_ref(x, axis=axis)
+        return _all_to_all_impl(x, ctx=ctx, axis=axis,
+                                force_kernel=force_kernel)
+
+
+def _all_to_all_impl(x, *, ctx: MeshContext, axis: str,
+                     force_kernel: bool):
     n = ctx.size(axis)
     if x.shape[0] != n:
         raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")

@@ -60,6 +60,18 @@ def broadcast(x, root: int = 0, *, ctx: MeshContext, axis: str = "tp"):
         raise ValueError(f"root={root} out of range for axis size {n}")
     if n == 1:
         return x
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("broadcast"):
+        if policy.should_fallback("broadcast"):
+            # Root-only puts are rank-divergent — inexpressible on the
+            # old discharge interpreter; degrade to the XLA oracle.
+            return broadcast_ref(x, int(root), axis=axis)
+        return _broadcast_kernel_call(x, int(root), ctx, axis)
+
+
+def _broadcast_kernel_call(x, root: int, ctx: MeshContext, axis: str):
+    n = ctx.size(axis)
     kernel = functools.partial(_bcast_kernel, axis=axis, ctx=ctx,
                                root=int(root))
     return core_call(

@@ -439,6 +439,13 @@ def ep_dispatch(tokens, topk_ids, ctx: EPContext):
     -1 for empty slots, state). R = n*T*K in the default drop-free mode
     (exact splits, ragged transport), n*C in capped mode.
     """
+    from triton_dist_tpu.resilience import faults
+
+    with faults.on_op_call("ep_a2a"):
+        return _ep_dispatch_impl(tokens, topk_ids, ctx)
+
+
+def _ep_dispatch_impl(tokens, topk_ids, ctx: EPContext):
     if ctx.capacity is None:
         return _ep_dispatch_dropfree(tokens, topk_ids, ctx)
     n = ctx.mesh.size(ctx.axis)
@@ -485,6 +492,14 @@ def ep_combine(expert_out, state: DispatchState, topk_weights,
     """Return expert outputs to their source ranks and reduce with the
     top-k weights. expert_out: same row order as ep_dispatch's
     recv_tokens. Returns (T, d)."""
+    from triton_dist_tpu.resilience import faults
+
+    with faults.on_op_call("ep_a2a"):
+        return _ep_combine_impl(expert_out, state, topk_weights, ctx)
+
+
+def _ep_combine_impl(expert_out, state: DispatchState, topk_weights,
+                     ctx: EPContext):
     if isinstance(state, RaggedDispatchState):
         return _ep_combine_dropfree(expert_out, state, topk_weights, ctx)
     n = ctx.mesh.size(ctx.axis)

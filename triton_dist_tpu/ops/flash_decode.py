@@ -51,10 +51,23 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len, *, axis="sp",
     (B, H)-sized payload (the hierarchical analogue of the reference's
     intra/inter-rank combine pair, ``flash_decode.py:393/482``).
     """
-    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+    from triton_dist_tpu.resilience import faults
 
     if isinstance(axis, (tuple, list)):
         axis = tuple(axis)
+    # Resilience hook: sp_flash_decode is pure-XLA (einsums + psums) so
+    # only host-level fail_call plans apply; the scope still tags any
+    # nested comm for plan attribution.
+    with faults.on_op_call("flash_decode"):
+        return _sp_flash_decode_impl(q, k_shard, v_shard, kv_len,
+                                     axis=axis,
+                                     shard_offset=shard_offset)
+
+
+def _sp_flash_decode_impl(q, k_shard, v_shard, kv_len, *, axis,
+                          shard_offset):
+    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+
     n, me = flat_axis_rank(axis)
     b, h, hd = q.shape
     t_loc, kvh = k_shard.shape[1], k_shard.shape[2]

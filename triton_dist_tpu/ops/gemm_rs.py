@@ -371,6 +371,26 @@ def _gemm_rs_2d(a, b, ctx: GemmRSContext):
 
 def gemm_rs(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
             sim_ranks: int = 0):
+    """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``
+    — see :func:`_gemm_rs_impl` for the full contract.
+
+    Resilience hook wrapper: fault plans count/scope on op
+    ``"gemm_rs"``, and the degradation policy
+    (``resilience.policy.should_fallback``) re-dispatches through the
+    XLA oracle."""
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("gemm_rs"):
+        if (policy.should_fallback("gemm_rs") and not force_kernel
+                and not sim_ranks):
+            out = gemm_rs_ref(a, b, axis=ctx.axis)
+            return out.astype(ctx.out_dtype) if ctx.out_dtype else out
+        return _gemm_rs_impl(a, b, ctx, force_kernel=force_kernel,
+                             sim_ranks=sim_ranks)
+
+
+def _gemm_rs_impl(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
+                  sim_ranks: int = 0):
     """Overlapped per-shard (A @ B) reduce-scattered along ``ctx.axis``.
 
     ``a``: (M, K_loc) — activations, K sharded (row-parallel);

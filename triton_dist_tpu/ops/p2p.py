@@ -121,4 +121,20 @@ def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
     pipeline boundary supports ``jax.grad`` like the XLA path.
     """
     perm = tuple((int(s), int(d)) for s, d in perm)
-    return _p2p_put_diff(x, perm, ctx, axis)
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("p2p"):
+        if policy.should_fallback("p2p"):
+            # Graceful degradation: gather + select matches the full
+            # contract (zeros for non-receivers, MULTICAST srcs allowed
+            # — which lax.ppermute rejects) and differentiates through
+            # all_gather/where. Taken when the fused kernel's
+            # rank-divergent puts are unsupported on this platform or a
+            # prior dispatch failed.
+            full = jax.lax.all_gather(x, axis, axis=0)
+            me = jax.lax.axis_index(axis)
+            out = jnp.zeros_like(x)
+            for s, d in perm:       # dsts are unique by contract
+                out = jnp.where(me == d, full[s], out)
+            return out
+        return _p2p_put_diff(x, perm, ctx, axis)

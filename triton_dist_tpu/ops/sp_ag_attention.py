@@ -650,6 +650,27 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
     if cu_seqlens is not None and not causal:
         raise ValueError("varlen (cu_seqlens) requires causal=True")
     n = ctx.size(axis)
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("sp_ag_attention"):
+        if (policy.should_fallback("sp_ag_attention")
+                and not force_kernel and not sim_ranks and n > 1):
+            # Graceful degradation: the entry push set is causal-pruned
+            # per rank (``peer < ni``) — rank-DIVERGENT puts the old
+            # discharge interpreter cannot execute (they wedge the CPU
+            # mesh). The XLA ring composition is the same contract.
+            return sp_ag_attention(q, k, v, axis=axis, causal=causal,
+                                   cu_seqlens=cu_seqlens)
+        return _sp_ag_attention_fused_impl(
+            q, k, v, ctx=ctx, axis=axis, causal=causal, block_q=block_q,
+            block_kv=block_kv, cu_seqlens=cu_seqlens,
+            force_kernel=force_kernel, sim_ranks=sim_ranks)
+
+
+def _sp_ag_attention_fused_impl(q, k, v, *, ctx: MeshContext, axis,
+                                causal, block_q, block_kv, cu_seqlens,
+                                force_kernel, sim_ranks):
+    n = ctx.size(axis)
     if sim_ranks and sim_ranks > 1:
         # Single-chip overlap proxy (bench.py): play the LAST of
         # sim_ranks simulated ranks — the one that consumes every chunk

@@ -161,6 +161,20 @@ def qkv_gemm_a2a(x, w, ctx: UlyssesFusedContext):
     n_w, _, cols = w.shape
     if n_w != n:
         raise ValueError(f"w dim 0 ({n_w}) != axis size {n}")
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("ulysses_fused"):
+        if policy.should_fallback("ulysses_fused"):
+            # XLA form of the same contract: project onto every owner's
+            # head block, then exchange sequence slices — out[src] =
+            # x_src @ w[me] lands via all_to_all slot semantics.
+            z = jnp.einsum("sd,ndc->nsc", x, w)
+            return jax.lax.all_to_all(z, ctx.axis, 0, 0)
+        return _qkv_gemm_a2a_kernel_call(x, w, ctx, n, s_loc, cols)
+
+
+def _qkv_gemm_a2a_kernel_call(x, w, ctx, n, s_loc, cols):
+    d = x.shape[1]
     tm = min(ctx.block_m, s_loc)
     tn = min(ctx.block_n, cols)
     if s_loc % tm or cols % tn:
@@ -320,6 +334,21 @@ def o_a2a_gemm(o, w, ctx: UlyssesFusedContext):
     if s % n:
         raise ValueError(f"sequence {s} not divisible by sp={n}")
     s_loc = s // n
+    from triton_dist_tpu.resilience import faults, policy
+
+    with faults.on_op_call("ulysses_fused"):
+        if policy.should_fallback("ulysses_fused"):
+            # XLA form: exchange per-owner sequence chunks of my heads,
+            # then contract each received chunk with its owner's
+            # W_o rows and sum the partials.
+            recv = jax.lax.all_to_all(
+                o.reshape(n, s_loc, rows_loc), ctx.axis, 0, 0)
+            return jnp.einsum("nsr,nrd->sd", recv, w).astype(o.dtype)
+        return _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d)
+
+
+def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d):
+    s = n * s_loc
     tm = min(ctx.block_m, s_loc)
     tn = min(ctx.block_n, d)
     if s_loc % tm or d % tn:

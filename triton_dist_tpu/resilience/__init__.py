@@ -1,0 +1,46 @@
+"""Failure as a first-class, testable input (SURVEY.md north star:
+production serving needs protocols that are correct under adverse
+timing, not just on the happy path).
+
+Three layers:
+
+- :mod:`~triton_dist_tpu.resilience.faults` — a registry of named fault
+  plans (delay a remote DMA, drop/duplicate a signal increment, skew a
+  rank's barrier arrival, fail the k-th collective call) injected into
+  the interpret-mode comm path through thin hooks in ``lang`` and the
+  fused ops, so the full kernel battery replays under adversarial
+  schedules on the CPU mesh.
+- :mod:`~triton_dist_tpu.resilience.watchdog` — deadlines on host-
+  visible futures: :class:`CommTimeoutError` (rank + op + progress
+  counter) instead of an indistinguishable hang.
+- :mod:`~triton_dist_tpu.resilience.policy` — graceful degradation:
+  per-op fallback onto the plain-XLA collective path when a fused op
+  raises or a startup health probe fails on the current platform.
+
+``harness`` runs deadlock-prone fault plans in a subprocess with a hard
+deadline (a wedged interpreter thread cannot be cancelled in-process).
+"""
+
+from triton_dist_tpu.resilience.faults import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    InjectedFault,
+    active_plan,
+    battery,
+    get_plan,
+    inject,
+    on_op_call,
+    register_plan,
+)
+from triton_dist_tpu.resilience.watchdog import (  # noqa: F401
+    CommTimeoutError,
+    Watchdog,
+    block_until_ready,
+)
+from triton_dist_tpu.resilience.policy import (  # noqa: F401
+    FallbackPolicy,
+    health_probe,
+    note_failure,
+    reset as reset_policy,
+    should_fallback,
+)

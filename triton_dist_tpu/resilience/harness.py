@@ -1,0 +1,196 @@
+"""Subprocess battery runner for deadlock-prone fault plans.
+
+A dropped signal deadlocks the blocking interpreter *inside* a jitted
+dispatch — no in-process timeout can cancel it, and the wedged device
+thread would poison every later dispatch in the test process. So the
+battery replays each adversarial schedule in a child process with a
+hard deadline:
+
+- child (``python -m triton_dist_tpu.resilience.harness --plan P
+  --op O``): builds the 8-device CPU mesh, activates the plan, runs the
+  op against its oracle, prints ``TDT-PROGRESS ...`` markers as it
+  advances and a final ``TDT-RESULT OK|MISMATCH`` line;
+- parent (:func:`run_plan`): enforces ``deadline_s``; a deadline miss
+  kills the child and raises :class:`CommTimeoutError` whose
+  ``progress`` field is the child's last progress marker — rank, op,
+  and last-completed step, exactly what a hang never tells you.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+__all__ = ["run_plan", "CHILD_OPS"]
+
+CHILD_OPS = ("ag_gemm", "megakernel")
+
+
+def _child_env(extra_env: Optional[dict] = None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def run_plan(plan: str, op: str, *, deadline_s: float = 300.0,
+             rank: int = 0, k: int = 0, iters: int = 20000,
+             extra_env: Optional[dict] = None) -> Tuple[str, str]:
+    """Replay fault ``plan`` against ``op`` in a child process.
+
+    Returns ``(verdict, output)`` where verdict is ``"ok"`` (fault
+    tolerated — bit-correct output) — raises
+    :class:`CommTimeoutError` on a deadline miss (fault detected) and
+    :class:`RuntimeError` on any other child failure (mismatch or
+    crash: a protocol bug the battery just found).
+    """
+    cmd = [sys.executable, "-m", "triton_dist_tpu.resilience.harness",
+           "--plan", plan, "--op", op, "--rank", str(rank),
+           "--k", str(k), "--iters", str(iters)]
+    try:
+        proc = subprocess.run(
+            cmd, env=_child_env(extra_env), cwd=_repo_root(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=deadline_s, text=True)
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        out = out.decode("utf-8", "replace") if isinstance(out, bytes) \
+            else out
+        raise CommTimeoutError(
+            op=op, rank=rank, timeout_s=deadline_s,
+            progress=_last_progress(out),
+            detail=f"fault plan {plan!r} wedged the child process"
+        ) from None
+    out = proc.stdout or ""
+    if proc.returncode == 0 and "TDT-RESULT OK" in out:
+        return "ok", out
+    raise RuntimeError(
+        f"fault plan {plan!r} on op {op!r}: child exited "
+        f"rc={proc.returncode} without OK verdict; last progress: "
+        f"{_last_progress(out)!r}\n--- child output tail ---\n"
+        + "\n".join(out.splitlines()[-25:]))
+
+
+def _last_progress(output: str) -> Optional[str]:
+    last = None
+    for line in output.splitlines():
+        if line.startswith("TDT-PROGRESS"):
+            last = line[len("TDT-PROGRESS"):].strip()
+    return last
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Child entry
+# ---------------------------------------------------------------------------
+
+def _progress(**kv) -> None:
+    print("TDT-PROGRESS "
+          + " ".join(f"{k}={v}" for k, v in kv.items()), flush=True)
+
+
+def _child_ag_gemm(plan, rank):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.ops.ag_gemm import (
+        ag_gemm, ag_gemm_ref, create_ag_gemm_context)
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.resilience import faults
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("tp",))
+    mctx = MeshContext.from_mesh(mesh)
+    n, m_loc, kdim, nloc = 8, 16, 128, 128
+    a = (jnp.arange(n * m_loc * kdim, dtype=jnp.float32)
+         .reshape(n * m_loc, kdim) % 13) / 13.0
+    b = (jnp.arange(kdim * nloc, dtype=jnp.float32)
+         .reshape(kdim, nloc) % 7) / 7.0
+    ctx = create_ag_gemm_context(mctx, "tp", block_m=m_loc,
+                                 block_n=nloc, block_k=kdim)
+    _progress(rank=rank, phase="trace")
+    with faults.inject(plan):
+        run = jax.jit(jax.shard_map(
+            lambda a_, b_: ag_gemm(a_, b_, ctx), mesh=mesh,
+            in_specs=(P("tp", None), P(None, None)),
+            out_specs=P(None, None), check_vma=False))
+        out = run(a, b)
+        _progress(rank=rank, phase="dispatched")
+        out = jax.block_until_ready(out)
+    _progress(rank=rank, phase="complete")
+    want = jax.block_until_ready(jax.jit(jax.shard_map(
+        lambda a_, b_: ag_gemm_ref(a_, b_, axis="tp"), mesh=mesh,
+        in_specs=(P("tp", None), P(None, None)),
+        out_specs=P(None, None), check_vma=False))(a, b))
+    return np.allclose(np.asarray(out), np.asarray(want),
+                       rtol=1e-4, atol=1e-4)
+
+
+def _child_megakernel(plan, rank):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models.config import ModelConfig
+    from triton_dist_tpu.resilience import faults
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    toks = np.array([3, 5], np.int32)
+
+    _progress(rank=rank, phase="baseline")
+    base = MegaKernelEngine(cfg, mesh, batch=2, max_len=32)
+    want = np.asarray(jax.block_until_ready(base.generate(toks, 4)))
+
+    _progress(rank=rank, phase="faulted-trace")
+    with faults.inject(plan):
+        eng = MegaKernelEngine(cfg, mesh, batch=2, max_len=32)
+        _progress(rank=rank, phase="faulted-dispatch",
+                  steps_done=eng.steps_done)
+        got = np.asarray(jax.block_until_ready(eng.generate(toks, 4)))
+    _progress(rank=rank, phase="complete", steps_done=eng.steps_done)
+    return np.array_equal(got, want)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--plan", required=True)
+    p.add_argument("--op", required=True, choices=CHILD_OPS)
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--k", type=int, default=0)
+    p.add_argument("--iters", type=int, default=20000)
+    args = p.parse_args(argv)
+
+    from triton_dist_tpu.resilience import faults
+
+    plan = faults.get_plan(args.plan, op=args.op, rank=args.rank,
+                           k=args.k, iters=args.iters)
+    runner = {"ag_gemm": _child_ag_gemm,
+              "megakernel": _child_megakernel}[args.op]
+    ok = runner(plan, args.rank)
+    print("TDT-RESULT OK" if ok else "TDT-RESULT MISMATCH", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

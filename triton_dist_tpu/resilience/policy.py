@@ -1,0 +1,184 @@
+"""Graceful degradation: per-op fallback onto the plain-XLA path.
+
+Every fused op in this package has a semantically-equivalent XLA
+collective form (the ``mode="xla"`` oracles). This module decides —
+per op, automatically, logged once — when to take it:
+
+- the platform cannot express the fused op at all (e.g. the old
+  generic discharge interpreter cannot run rank-divergent one-sided
+  puts — see ``utils/compat.py``);
+- a fused dispatch raised at runtime (recorded via
+  :func:`note_failure`; subsequent calls re-route);
+- the operator forced it (``TRITON_DIST_TPU_FORCE_XLA="ag_gemm,p2p"``
+  or ``"*"``);
+- a startup :func:`health_probe` failed.
+
+The fused ops consult :func:`should_fallback` at dispatch — ``ag_gemm``,
+``gemm_rs``, ``all_to_all``, ``p2p``, ``broadcast``, ``ulysses_fused``,
+and ``sp_ag_attention`` each route to their XLA oracle when it answers
+True. ``ep_dispatch``/``ep_combine`` inherit the policy through the
+``all_to_all`` transport they ride on (their drop-free mode is already
+pure ``lax.ragged_all_to_all``), and ``flash_decode`` is pure XLA to
+begin with, so neither consults the policy under its own name. The
+model :class:`~triton_dist_tpu.models.engine.Engine` additionally wraps
+whole prefill/decode dispatches (``fallback="xla"``) so a mid-flight
+kernel failure degrades the serving path instead of killing it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger("triton_dist_tpu.resilience")
+
+__all__ = ["FallbackPolicy", "should_fallback", "note_failure",
+           "health_probe", "reset"]
+
+# Fused ops whose signal protocol is rank-divergent (one-sided puts
+# issued under a rank-dependent predicate — ``me == root``, causal
+# ``peer < n`` send pruning): inexpressible on the old bulk-synchronous
+# discharge interpreter, which resolves remote DMA through uniform
+# hidden collectives — a divergent site deadlocks the CPU mesh instead
+# of failing. Routed to XLA up front.
+DIVERGENT_PUT_OPS = frozenset(
+    {"p2p", "ulysses_fused", "broadcast", "sp_ag_attention"})
+
+
+class FallbackPolicy:
+    """Per-op fused-vs-XLA dispatch decisions with log-once semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._failed: Dict[str, str] = {}
+        self._logged: set = set()
+
+    # -- queries ----------------------------------------------------------
+
+    def forced_ops(self) -> frozenset:
+        raw = os.environ.get("TRITON_DIST_TPU_FORCE_XLA", "")
+        return frozenset(s.strip() for s in raw.split(",") if s.strip())
+
+    def platform_unsupported(self, op: str) -> Optional[str]:
+        from triton_dist_tpu.utils import compat
+
+        if op in DIVERGENT_PUT_OPS and compat.degraded_interpret():
+            return ("rank-divergent one-sided puts are inexpressible on "
+                    "the generic discharge interpreter")
+        return None
+
+    def should_fallback(self, op: str) -> bool:
+        forced = self.forced_ops()
+        if "*" in forced or op in forced:
+            self._log_once(op, "forced via TRITON_DIST_TPU_FORCE_XLA")
+            return True
+        reason = self.platform_unsupported(op)
+        if reason is not None:
+            self._log_once(op, reason)
+            return True
+        with self._lock:
+            if op in self._failed:
+                return True
+        return False
+
+    # -- recording --------------------------------------------------------
+
+    def note_failure(self, op: str, exc: BaseException) -> None:
+        """Record a fused-path failure; later calls of ``op`` fall back."""
+        with self._lock:
+            first = op not in self._failed
+            self._failed[op] = repr(exc)
+        if first:
+            logger.warning(
+                "fused op %r failed (%r); falling back to the XLA "
+                "collective path for subsequent calls", op, exc)
+
+    def _log_once(self, op: str, reason: str) -> None:
+        key = (op, reason)
+        with self._lock:
+            if key in self._logged:
+                return
+            self._logged.add(key)
+        logger.warning("op %r dispatching via XLA fallback: %s", op, reason)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failed.clear()
+            self._logged.clear()
+
+
+_GLOBAL = FallbackPolicy()
+
+
+def should_fallback(op: str) -> bool:
+    return _GLOBAL.should_fallback(op)
+
+
+def note_failure(op: str, exc: BaseException) -> None:
+    _GLOBAL.note_failure(op, exc)
+
+
+def reset() -> None:
+    """Clear recorded failures (test scaffolding)."""
+    _GLOBAL.reset()
+
+
+def health_probe(mesh, axis: str = "tp", *, timeout_s: float = 120.0) -> bool:
+    """Startup canary: run one tiny fused ``ag_gemm`` on ``mesh`` and
+    check it against the XLA oracle under a deadline.
+
+    Returns True when the fused comm path is healthy on this platform;
+    False (after logging) on mismatch, exception, or timeout — callers
+    (``Engine(fallback="xla", probe=True)``) then route through XLA.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import numpy as np
+
+    from triton_dist_tpu.ops.ag_gemm import (
+        ag_gemm, ag_gemm_ref, create_ag_gemm_context)
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.resilience.watchdog import (
+        CommTimeoutError, Watchdog)
+
+    mctx = MeshContext.from_mesh(mesh)
+    n = mesh.shape[axis]
+    m_loc, k, nn = 8, 128, 128
+    a = jnp.arange(n * m_loc * k, dtype=jnp.float32).reshape(
+        n * m_loc, k) / (m_loc * k)
+    b = jnp.ones((k, nn), jnp.float32) / k
+    ctx = create_ag_gemm_context(mctx, axis, block_m=m_loc, block_n=nn,
+                                 block_k=k)
+
+    def probe():
+        # force_kernel=True: the canary must exercise the REAL fused
+        # path — an already-active fallback (FORCE_XLA, a recorded
+        # failure) would otherwise reroute it to the oracle and the
+        # probe would compare XLA against XLA, vacuously healthy.
+        run = jax.jit(jax.shard_map(
+            lambda a_, b_: ag_gemm(a_, b_, ctx, force_kernel=True),
+            mesh=mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=P(None, None), check_vma=False))
+        ref = jax.jit(jax.shard_map(
+            lambda a_, b_: ag_gemm_ref(a_, b_, axis=axis), mesh=mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=P(None, None), check_vma=False))
+        out = jax.block_until_ready(run(a, b))
+        want = jax.block_until_ready(ref(a, b))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        return True
+
+    try:
+        return Watchdog(timeout_s, op="health_probe[ag_gemm]").run(probe)
+    except CommTimeoutError as e:
+        logger.warning("health probe timed out: %s", e)
+        return False
+    except Exception as e:  # noqa: BLE001 — any failure means unhealthy
+        logger.warning("health probe failed: %r", e)
+        return False

@@ -1,0 +1,127 @@
+"""Watchdogs and deadlines on host-visible futures.
+
+A lost signal inside a comm kernel makes ``block_until_ready`` hang
+with no diagnostic — indistinguishable from a slow step. The watchdog
+bounds every host-side wait and converts a miss into a structured
+:class:`CommTimeoutError` carrying rank, op name, and the last-completed
+progress counter.
+
+CAVEAT — in-process timeouts cannot *cancel* the stuck dispatch: the
+worker thread stays blocked (daemonized) and the device it wedged may
+be unusable for subsequent dispatches. The watchdog is therefore the
+right tool for *serving* (fail the request, alert, drain the replica)
+and for slow-but-terminating anomalies; the fault-injection *battery*
+additionally isolates guaranteed-deadlock plans in a subprocess
+(:mod:`~triton_dist_tpu.resilience.harness`) so a wedged interpreter
+cannot poison the test process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+__all__ = ["CommTimeoutError", "Watchdog", "block_until_ready"]
+
+
+class CommTimeoutError(TimeoutError):
+    """A bounded wait on a communication-dependent future expired.
+
+    Fields: ``op`` (which dispatch), ``rank`` (host process index),
+    ``timeout_s``, ``progress`` (last-completed step/scoreboard counter
+    the caller could observe — e.g. decode-step number or megakernel
+    queue slot), ``detail`` (free text).
+    """
+
+    def __init__(self, *, op: str, rank: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 progress: Any = None, detail: str = ""):
+        self.op = op
+        self.rank = rank
+        self.timeout_s = timeout_s
+        self.progress = progress
+        self.detail = detail
+        msg = (f"communication timeout in op {op!r}"
+               f" on rank {rank}"
+               f" after {timeout_s}s; last completed progress counter: "
+               f"{progress!r}")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+def _default_rank() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:  # bring-up failure — rank unknown
+        return -1
+
+
+class Watchdog:
+    """Bounded execution of blocking host calls.
+
+    ``progress_fn`` (optional) is sampled when the deadline expires and
+    becomes ``CommTimeoutError.progress`` — wire it to the engine's
+    step counter / scoreboard position so a timeout names the last
+    completed unit of work instead of just "it hung".
+    """
+
+    def __init__(self, timeout_s: float, *, op: str = "",
+                 progress_fn: Optional[Callable[[], Any]] = None):
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.op = op
+        self.progress_fn = progress_fn
+
+    def run(self, fn: Callable, *args, op: Optional[str] = None, **kwargs):
+        """Run ``fn(*args, **kwargs)``; raise :class:`CommTimeoutError`
+        if it does not return within the deadline."""
+        if self.timeout_s is None:
+            return fn(*args, **kwargs)
+        result: list = []
+        error: list = []
+
+        def _target():
+            try:
+                result.append(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                error.append(e)
+
+        t = threading.Thread(target=_target, daemon=True,
+                             name=f"tdt-watchdog[{op or self.op}]")
+        t.start()
+        t.join(self.timeout_s)
+        if t.is_alive():
+            progress = None
+            if self.progress_fn is not None:
+                try:
+                    progress = self.progress_fn()
+                except Exception as e:  # progress probe itself wedged
+                    progress = f"<progress_fn failed: {e!r}>"
+            raise CommTimeoutError(
+                op=op or self.op, rank=_default_rank(),
+                timeout_s=self.timeout_s, progress=progress,
+                detail="worker thread still blocked; the wedged dispatch "
+                       "cannot be cancelled in-process")
+        if error:
+            raise error[0]
+        return result[0]
+
+    def block_until_ready(self, x, *, op: Optional[str] = None):
+        import jax
+
+        return self.run(jax.block_until_ready, x, op=op)
+
+
+def block_until_ready(x, *, timeout_s: Optional[float], op: str,
+                      progress_fn: Optional[Callable[[], Any]] = None):
+    """``jax.block_until_ready`` with a deadline (None = unbounded)."""
+    import jax
+
+    if timeout_s is None:
+        return jax.block_until_ready(x)
+    return Watchdog(timeout_s, op=op,
+                    progress_fn=progress_fn).block_until_ready(x)

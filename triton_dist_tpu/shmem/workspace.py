@@ -55,21 +55,48 @@ def symm_tensor(mesh: Mesh, local_shape: Tuple[int, ...], dtype=jnp.float32,
     return jax.device_put(jnp.zeros(global_shape, dtype), sharding)
 
 
-def barrier_all(mesh: Mesh, axis: str = "tp") -> None:
+# Compiled host barriers, one per (mesh, axis): the closure used to be
+# rebuilt and re-jitted on every call, so every test-scaffolding
+# barrier paid a retrace. Mesh is hashable; the cache key is exact.
+# Size-bounded (FIFO eviction) so a process that churns through meshes
+# cannot pin unbounded Mesh objects + compiled executables.
+_BARRIER_CACHE: dict = {}
+_BARRIER_CACHE_MAX = 16
+
+
+def _compiled_barrier(mesh: Mesh, axis: str):
+    key = (mesh, axis)
+    fn = _BARRIER_CACHE.get(key)
+    if fn is None:
+        def inner(x):
+            return jax.lax.psum(x, axis)
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=P(), out_specs=P(), check_vma=False,
+        ))
+        while len(_BARRIER_CACHE) >= _BARRIER_CACHE_MAX:
+            _BARRIER_CACHE.pop(next(iter(_BARRIER_CACHE)))
+        _BARRIER_CACHE[key] = fn
+    return fn
+
+
+def barrier_all(mesh: Mesh, axis: str = "tp", *,
+                timeout_s: Optional[float] = None) -> None:
     """Host-level device barrier along ``axis`` — the analogue of
     ``nvshmem_barrier_all_on_stream`` (utils.py:325).
 
     XLA programs are already bulk-synchronous per dispatch; this exists
     for test scaffolding and for flushing outstanding async work: it runs
     a trivial psum across the axis and blocks until ready.
-    """
-    @jax.jit
-    def _bar():
-        def inner(x):
-            return jax.lax.psum(x, axis)
-        return jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=P(), out_specs=P(), check_vma=False,
-        )(jnp.zeros((), jnp.int32))
 
-    _bar().block_until_ready()
+    ``timeout_s`` bounds the wait: a peer wedged inside a comm kernel
+    leaves this barrier blocked forever — with a deadline it raises a
+    structured :class:`~triton_dist_tpu.resilience.CommTimeoutError`
+    (rank + op) instead of hanging the host.
+    """
+    from triton_dist_tpu.resilience.watchdog import block_until_ready
+
+    block_until_ready(_compiled_barrier(mesh, axis)(jnp.zeros((), jnp.int32)),
+                      timeout_s=timeout_s,
+                      op=f"shmem.barrier_all[{axis}]")

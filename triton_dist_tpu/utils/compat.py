@@ -1,0 +1,415 @@
+"""Graceful degradation across JAX versions (resilience layer 0).
+
+The package is written against the current JAX API surface
+(``jax.shard_map``, ``jax.lax.axis_size``, ``pltpu.CompilerParams``,
+``pltpu.InterpretParams``, ``pltpu.sync_copy``). Older JAX releases
+(0.4.x — e.g. the pinned toolchain on some hosts) expose the same
+functionality under earlier names/signatures. Rather than hard-failing
+at import (a silent platform outage — exactly the failure class
+``resilience/`` exists to eliminate), :func:`install` aliases the
+missing attributes to semantically-equivalent shims.
+
+Strictly additive: every shim is installed ONLY when the attribute is
+absent, so on a current JAX this module is a no-op. Shims target the
+interpret-mode (CPU mesh) battery; compiled-TPU execution on an old JAX
+is out of scope (the real chip ships with a matching JAX).
+
+Degradations that cannot be shimmed are recorded in
+:data:`DEGRADED_FEATURES` (queried by ``resilience.policy`` and the
+race-detector plumbing): e.g. JAX < 0.5 has no thread-per-device TPU
+interpreter, so ``InterpretParams(detect_races=...)`` maps to the
+generic interpreter with the race detector unavailable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+from typing import Any, Optional
+
+import jax
+
+# Feature name -> human-readable reason, populated by install() for
+# capabilities the running JAX cannot provide even through a shim.
+DEGRADED_FEATURES: dict[str, str] = {}
+
+_INSTALLED = False
+
+
+def _shard_map_shim():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    sig = inspect.signature(_sm)
+    has_check_rep = "check_rep" in sig.parameters
+
+    @functools.wraps(_sm)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and has_check_rep:
+            kw.setdefault("check_rep", check_vma)
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+
+    return shard_map
+
+
+def _axis_size_shim():
+    def axis_size(axis_name):
+        """``jax.lax.axis_size`` for old JAX: ``jax.core.axis_frame``
+        returns the bound axis size directly on 0.4.x."""
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= jax.core.axis_frame(a)
+            return n
+        return jax.core.axis_frame(axis_name)
+
+    return axis_size
+
+
+def _compiler_params_shim(pltpu):
+    legacy = pltpu.TPUCompilerParams
+    allowed = set(inspect.signature(legacy).parameters)
+
+    def CompilerParams(**kw):
+        """``pltpu.CompilerParams`` on old JAX: forward to
+        ``TPUCompilerParams``, dropping kwargs it does not know
+        (``has_side_effects`` — interpret mode has no DCE to guard
+        against, and compiled-TPU-on-old-JAX is out of scope)."""
+        return legacy(**{k: v for k, v in kw.items() if k in allowed})
+
+    return CompilerParams
+
+
+class InterpretParamsShim:
+    """Truthy stand-in for ``pltpu.InterpretParams`` on old JAX.
+
+    ``pl.pallas_call(interpret=<this>)`` selects the generic
+    interpreter (the object is truthy); the thread-per-device options
+    (``dma_execution_mode``, ``detect_races``) have no generic-
+    interpreter analogue and are carried only for introspection.
+    Unknown keywords (future InterpretParams options) are absorbed into
+    ``extra`` instead of raising — a new option must not hard-crash old
+    JAX. Immutable/hashable so it is safe inside jit-cached
+    pallas_call params.
+    """
+
+    def __init__(self, dma_execution_mode: Optional[str] = None,
+                 detect_races: bool = False, **extra: Any):
+        object.__setattr__(self, "dma_execution_mode", dma_execution_mode)
+        object.__setattr__(self, "detect_races", detect_races)
+        object.__setattr__(self, "extra", tuple(sorted(extra.items())))
+
+    def __setattr__(self, name, value):
+        raise dataclasses.FrozenInstanceError(
+            f"cannot assign to field {name!r}")
+
+    def _key(self):
+        return (self.dma_execution_mode, self.detect_races, self.extra)
+
+    def __eq__(self, other):
+        return (isinstance(other, InterpretParamsShim)
+                and self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"InterpretParamsShim(dma_execution_mode="
+                f"{self.dma_execution_mode!r}, detect_races="
+                f"{self.detect_races!r}, extra={self.extra!r})")
+
+    def __bool__(self) -> bool:
+        return True
+
+
+def _sync_copy_shim(pltpu):
+    def sync_copy(src_ref, dst_ref):
+        """``pltpu.sync_copy`` on old JAX: a plain ref copy — valid in
+        the generic interpreter (the only supported backend for these
+        shims), where ANY-space refs are ordinary buffers."""
+        dst_ref[...] = src_ref[...]
+
+    return sync_copy
+
+
+def _shard_axis_of(axis_env):
+    """The one mesh axis remote traffic can route over in the discharge
+    interpreter: the stock rules reject ANY second named axis, but a
+    canonical ``make_mesh`` binds all five (dp, pp, ep, sp, tp) with
+    size-1 placeholders — only axes with size > 1 matter. Returns None
+    for a fully-trivial (single-device) mesh; raises for genuinely
+    multi-dimensional ones (inexpressible here)."""
+    nontrivial = [n for n, s in axis_env.axis_sizes.items()
+                  if n is not None and s > 1]
+    if len(nontrivial) > 1:
+        raise NotImplementedError(
+            "Meshes with more than one non-trivial named axis are not "
+            "supported by the discharge-interpreter compat rules "
+            "(triton_dist_tpu.utils.compat)")
+    return nontrivial[0] if nontrivial else None
+
+
+def _install_remote_dma_discharge() -> None:
+    """Replace the stock ``dma_start`` discharge rule's axis selection.
+
+    Identical semantics to JAX's rule (all_gather + one-sender-per-
+    receiver routing), but the shard axis is chosen by
+    :func:`_shard_axis_of` so canonical meshes with size-1 placeholder
+    axes work; a fully-trivial mesh degenerates to a local copy (the
+    only addressable peer is self).
+    """
+    import jax.numpy as jnp
+    from jax._src import core as jax_core
+    from jax._src import tree_util
+    from jax._src.pallas import core as pl_core
+    from jax._src.pallas.mosaic import primitives as mp
+    from jax._src.state import discharge as state_discharge
+
+    def _rule(in_avals, out_avals, *args, tree, device_id_type):
+        (src_ref, src_transforms, dst_ref, dst_transforms, dst_sem,
+         dst_sem_transforms, src_sem, src_sem_transforms,
+         device_id) = tree_util.tree_unflatten(tree, args)
+        (_, src_transforms_avals, _, dst_transforms_avals, dst_sem_aval,
+         dst_sem_transforms_avals, src_sem_aval, src_sem_transforms_avals,
+         _) = tree_util.tree_unflatten(tree, in_avals)
+        del out_avals
+        is_remote = device_id is not None
+        if not is_remote:
+            assert src_sem is None
+            assert src_sem_transforms is None
+
+        n_src_sem_t = len(tree_util.tree_leaves(src_sem_transforms_avals))
+        n_dst_sem_t = len(tree_util.tree_leaves(dst_sem_transforms_avals))
+        n_src_t = len(tree_util.tree_leaves(src_transforms_avals))
+        n_dst_t = len(tree_util.tree_leaves(dst_transforms_avals))
+
+        updates = state_discharge.transform_array(src_ref, src_transforms)
+        local_src = updates
+
+        if is_remote:
+            if device_id_type == mp.DeviceIdType.MESH:
+                device_id = tree_util.tree_leaves(device_id)
+                if len(device_id) != 1:
+                    raise NotImplementedError(
+                        "MESH device ids with more than one coordinate "
+                        "are not supported by the compat dma rule")
+                device_id = device_id[0]
+            shard_axis = _shard_axis_of(jax_core.get_axis_env())
+            if shard_axis is None:
+                # Single-device mesh: the only peer is me — local copy.
+                pass
+            else:
+                my_axis = jax.lax.axis_index(shard_axis)
+                who_copy_to_me = jax.lax.all_gather(
+                    device_id, shard_axis) == my_axis
+                index = jnp.argmax(who_copy_to_me, axis=0)
+                global_updates = jax.lax.all_gather(updates, shard_axis)
+                updates = jax.lax.dynamic_index_in_dim(
+                    global_updates, index, axis=0, keepdims=False)
+                global_dst_t = tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(x, shard_axis),
+                    dst_transforms)
+                dst_transforms = tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_index_in_dim(
+                        x, index, axis=0, keepdims=False), global_dst_t)
+
+        _, new_dst = state_discharge.transform_swap_array(
+            dst_ref, dst_transforms, updates)
+
+        recv_size = jnp.minimum(updates.size, pl_core.SEMAPHORE_MAX_VALUE)
+        recv_size = jnp.array(recv_size,
+                              dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        dst_sem_value = mp._transform_semaphore(
+            dst_sem, dst_sem_transforms, dst_sem_aval)
+        _, new_dst_sem = state_discharge.transform_swap_array(
+            dst_sem, dst_sem_transforms, dst_sem_value + recv_size)
+        if is_remote:
+            send_size = jnp.minimum(local_src.size,
+                                    pl_core.SEMAPHORE_MAX_VALUE)
+            send_size = jnp.array(send_size,
+                                  dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+            src_sem_value = mp._transform_semaphore(
+                src_sem, src_sem_transforms, src_sem_aval)
+            _, new_src_sem = state_discharge.transform_swap_array(
+                src_sem, src_sem_transforms, src_sem_value + send_size)
+        else:
+            new_src_sem = None
+
+        new_vals = (None,)
+        new_vals += (None,) * n_src_t
+        new_vals += (new_dst,)
+        new_vals += (None,) * n_dst_t
+        new_vals += (new_dst_sem,)
+        new_vals += (None,) * n_dst_sem_t
+        if is_remote:
+            new_vals += (new_src_sem,)
+            new_vals += (None,) * n_src_sem_t
+            new_vals += (None,)
+        assert len(new_vals) == len(in_avals)
+        return new_vals, []
+
+    state_discharge.register_discharge_rule(mp.dma_start_p)(_rule)
+    DEGRADED_FEATURES["remote_dma_multiaxis"] = (
+        "compat dma rule: routes over the single non-trivial mesh axis "
+        "(size-1 placeholder axes tolerated; true 2D meshes rejected)")
+
+
+def _install_remote_signal_discharge() -> None:
+    """Teach the old generic interpreter remote semaphore signals.
+
+    JAX 0.4.x's ``semaphore_signal`` discharge rule raises
+    ``NotImplementedError`` for ``device_id is not None``. The remote
+    DMA rule in the same file already shows the SPMD recipe: all_gather
+    the (target, value) pairs over the shard axis and apply the portion
+    addressed to me. We re-register the rule with that recipe so
+    ``dl.notify(sem, peer)`` — the signal half of every fused op's
+    protocol — runs on the CPU mesh.
+
+    Valid only for signal sites executed uniformly by every rank (the
+    same SPMD restriction the stock remote-DMA rule documents); the
+    fused ops in this package satisfy it.
+    """
+    import jax.numpy as jnp
+    from jax._src import core as jax_core
+    from jax._src import tree_util
+    from jax._src.pallas import core as pl_core
+    from jax._src.pallas.mosaic import primitives as mosaic_primitives
+    from jax._src.state import discharge as state_discharge
+
+    def _rule(in_avals, out_avals, *flat_args, args_tree, device_id_type):
+        del out_avals
+        (ref, transforms, inc, device_id,
+         core_index) = args_tree.unflatten(flat_args)
+        if core_index is not None:
+            raise NotImplementedError(
+                "Multiple core support not implemented.")
+        sem_value = mosaic_primitives._transform_semaphore(
+            ref, transforms, in_avals[0])
+        inc = inc.astype(pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        if device_id is not None:
+            if device_id_type == mosaic_primitives.DeviceIdType.MESH:
+                device_id = tree_util.tree_leaves(device_id)
+                if len(device_id) != 1:
+                    raise NotImplementedError(
+                        "MESH device ids with more than one coordinate "
+                        "are not supported by the compat signal rule")
+                device_id = device_id[0]
+            shard_axis = _shard_axis_of(jax_core.get_axis_env())
+            if shard_axis is None:
+                # Single-device mesh: the only target is rank 0 (me).
+                inc = jnp.where(
+                    jnp.asarray(device_id, jnp.int32) == 0, inc,
+                    jnp.zeros_like(inc)
+                ).astype(pl_core.SEMAPHORE_INTERPRET_DTYPE)
+            else:
+                my_axis = jax.lax.axis_index(shard_axis)
+                # Every rank contributes (target, inc); I apply the sum
+                # of increments addressed to me. Unlike the DMA rule's
+                # argmax this handles zero or several senders per
+                # target.
+                targets = jax.lax.all_gather(
+                    jnp.asarray(device_id, jnp.int32), shard_axis)
+                incs = jax.lax.all_gather(inc, shard_axis)
+                inc = jnp.sum(
+                    jnp.where(targets == my_axis, incs,
+                              jnp.zeros_like(incs))
+                ).astype(pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        _, new_sem_value = state_discharge.transform_swap_array(
+            ref, transforms, sem_value + inc)
+        return ((new_sem_value,) + (None,) * (len(in_avals) - 1), ())
+
+    state_discharge.register_discharge_rule(
+        mosaic_primitives.semaphore_signal_p)(_rule)
+    DEGRADED_FEATURES["remote_semaphore_signal"] = (
+        "emulated via all_gather in the discharge interpreter "
+        "(uniform SPMD signal sites only)")
+
+
+def install() -> None:
+    """Alias missing JAX APIs to compat shims (idempotent, additive)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _INSTALLED = True
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_shim()
+        DEGRADED_FEATURES["jax.shard_map"] = (
+            "aliased to jax.experimental.shard_map (check_vma -> "
+            "check_rep)")
+
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_shim()
+        DEGRADED_FEATURES["jax.lax.axis_size"] = (
+            "aliased to jax.core.axis_frame")
+
+    if not hasattr(pltpu, "CompilerParams"):
+        pltpu.CompilerParams = _compiler_params_shim(pltpu)
+        DEGRADED_FEATURES["pltpu.CompilerParams"] = (
+            "aliased to TPUCompilerParams; has_side_effects dropped")
+
+    if not hasattr(pltpu, "InterpretParams"):
+        pltpu.InterpretParams = InterpretParamsShim
+        DEGRADED_FEATURES["pltpu.InterpretParams"] = (
+            "generic interpreter only: dma_execution_mode ignored, "
+            "detect_races unavailable")
+
+    if not hasattr(pltpu, "sync_copy"):
+        pltpu.sync_copy = _sync_copy_shim(pltpu)
+        DEGRADED_FEATURES["pltpu.sync_copy"] = (
+            "plain ref copy (interpret mode only)")
+
+    if not hasattr(pltpu, "HBM"):
+        # Older JAX has no distinct HBM memory space; ANY (unpinned)
+        # is the same placement for interpret-mode purposes.
+        pltpu.HBM = pltpu.ANY
+        DEGRADED_FEATURES["pltpu.HBM"] = "aliased to pltpu.ANY"
+
+    if not hasattr(pltpu, "trace_value"):
+        pltpu.trace_value = lambda label, value: None
+        DEGRADED_FEATURES["pltpu.trace_value"] = (
+            "no-op (xprof scalar markers unavailable)")
+
+    if isinstance(getattr(pltpu, "InterpretParams", None), type) and (
+            pltpu.InterpretParams is InterpretParamsShim):
+        # No thread-per-device TPU interpreter on this JAX: interpret
+        # mode is the generic DISCHARGE simulator — bulk-synchronous,
+        # semaphore waits decrement without blocking, remote DMA
+        # resolves through hidden all_gathers. Consequences the rest of
+        # the package keys off this flag:
+        #   - kernel-entry barriers are vacuous (lang.shmem_device
+        #     skips get_barrier_semaphore, which has no interpret rule);
+        #   - a lost signal cannot deadlock (waits do not block), so
+        #     fault plans that deadlock the real protocol degrade to
+        #     tolerated faults here (tests/test_resilience.py branches
+        #     on this);
+        #   - the vector-clock race detector is unavailable.
+        DEGRADED_FEATURES["tpu_interpret_mode"] = (
+            "generic discharge interpreter: non-blocking semaphores, "
+            "no-op barriers, no race detector")
+        _install_remote_signal_discharge()
+        _install_remote_dma_discharge()
+
+
+def degraded(feature: str) -> bool:
+    """True when ``feature`` runs through a lossy compat shim."""
+    return feature in DEGRADED_FEATURES
+
+
+def degraded_interpret() -> bool:
+    """True when interpret mode is active AND running through the lossy
+    generic discharge interpreter (non-blocking semaphores, no-op
+    barriers, no divergent remote puts).
+
+    The single gate for every behavior that must stay in lockstep on
+    that backend: vacuous kernel-entry barriers
+    (``lang.shmem_device``), skipped divergent fault kinds
+    (``resilience.faults``), and forced XLA fallback for
+    rank-divergent-put ops (``resilience.policy``).
+    """
+    from triton_dist_tpu.utils.distributed import use_interpret
+
+    return degraded("tpu_interpret_mode") and use_interpret()

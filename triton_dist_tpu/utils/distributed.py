@@ -14,6 +14,8 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import time
+import warnings
 from typing import Optional
 
 import jax
@@ -68,14 +70,24 @@ def interpret_arg():
     under the vector-clock race detector — the deliberate signal-
     protocol checker SURVEY.md §5 calls for (the reference only has a
     compute-sanitizer hook).
+
+    Fault-injection hook: an active ``resilience.faults`` plan may
+    override the DMA execution mode (``dma_on_wait`` = every transfer
+    completes as late as its wait allows — the maximally-adversarial
+    arrival schedule the signal protocols must tolerate).
     """
     if use_interpret():
         from jax.experimental.pallas import tpu as pltpu
 
-        return pltpu.InterpretParams(
-            dma_execution_mode="eager",
-            detect_races=os.environ.get(
-                "TRITON_DIST_TPU_DETECT_RACES") == "1")
+        from triton_dist_tpu.resilience import faults
+
+        kwargs = {
+            "dma_execution_mode": "eager",
+            "detect_races": os.environ.get(
+                "TRITON_DIST_TPU_DETECT_RACES") == "1",
+        }
+        kwargs.update(faults.interpret_overrides())
+        return pltpu.InterpretParams(**kwargs)
     return False
 
 
@@ -96,29 +108,75 @@ def interpret_mode(value: bool = True):
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None, *,
+                           max_attempts: Optional[int] = None,
+                           backoff_s: float = 0.5) -> None:
     """Initialize multi-host JAX if the standard env vars are present.
 
     Single-host (including the CPU-mesh test configuration) needs no
     initialization; multi-host pods read ``COORDINATOR_ADDRESS`` /
     ``NUM_PROCESSES`` / ``PROCESS_ID`` (or the arguments), mirroring the
     torchrun env-var contract in the reference (``utils.py:342-347``).
+
+    Coordinator connect is retried with exponential backoff
+    (``max_attempts`` tries, first sleep ``backoff_s`` doubling each
+    round; default 3, or ``TRITON_DIST_TPU_INIT_RETRIES``): on a pod,
+    workers race the coordinator's bind, and one refused connection
+    must not kill a whole slice's bring-up. The last failure is
+    re-raised with the attempt count.
     """
     addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     nproc = num_processes or _int_env("NUM_PROCESSES")
     pid = process_id if process_id is not None else _int_env("PROCESS_ID")
-    if addr and nproc and nproc > 1:
-        jax.distributed.initialize(coordinator_address=addr,
-                                   num_processes=nproc,
-                                   process_id=pid or 0)
+    if not (addr and nproc and nproc > 1):
+        return
+    if max_attempts is None:
+        max_attempts = _int_env("TRITON_DIST_TPU_INIT_RETRIES") or 3
+    delay = backoff_s
+    for attempt in range(1, max_attempts + 1):
+        try:
+            jax.distributed.initialize(coordinator_address=addr,
+                                       num_processes=nproc,
+                                       process_id=pid or 0)
+            return
+        except Exception as e:  # noqa: BLE001 — filtered below
+            # Only transient bring-up races are worth retrying: a
+            # ValueError/TypeError (malformed address/config) or a
+            # re-init of a live runtime ("already initialized") cannot
+            # be fixed by waiting — fail loudly and immediately instead
+            # of burying the cause under backoff warnings. Keep the
+            # match tight: "address already in use" (coordinator port
+            # in TIME_WAIT after a restart) IS the retryable race.
+            msg = str(e).lower()
+            if (isinstance(e, (ValueError, TypeError))
+                    or ("already" in msg and "in use" not in msg)):
+                raise
+            if attempt == max_attempts:
+                raise RuntimeError(
+                    f"jax.distributed.initialize failed after "
+                    f"{max_attempts} attempts (coordinator {addr}, "
+                    f"process {pid or 0}/{nproc})") from e
+            warnings.warn(
+                f"initialize_distributed attempt {attempt}/"
+                f"{max_attempts} failed ({e!r}); retrying in "
+                f"{delay:.1f}s", RuntimeWarning, stacklevel=2)
+            time.sleep(delay)
+            delay *= 2
 
 
 def finalize_distributed() -> None:
-    """Reference: utils.py:302 finalize_distributed."""
+    """Reference: utils.py:302 finalize_distributed.
+
+    Teardown failures are non-fatal but must stay diagnosable: a
+    swallowed shutdown error on one host of a pod looks identical to a
+    clean exit until the next job inherits a half-dead coordinator.
+    """
     try:
         jax.distributed.shutdown()
-    except (RuntimeError, ValueError):
-        pass
+    except (RuntimeError, ValueError) as e:
+        warnings.warn(
+            f"jax.distributed.shutdown failed during teardown: {e!r}",
+            RuntimeWarning, stacklevel=2)
 
 
 def _int_env(name: str) -> Optional[int]:
