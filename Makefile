@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: csrc test quick race verify-faults apicheck ci bench-all
+.PHONY: csrc test quick race verify-faults bench-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -28,6 +28,11 @@ race: csrc
 # detector on the CPU mesh (docs/resilience.md).
 verify-faults: csrc
 	bash scripts/verify_faults.sh
+
+# Overlap-schedule smoke: swizzle/prefetch parity sweep + interpret-mode
+# bench on the CPU mesh — verify-faults' perf sibling (docs/perf.md).
+bench-smoke: csrc
+	bash scripts/bench_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

@@ -179,6 +179,11 @@ def _load_last_result():
     return None, None
 
 
+def _probe_cache_path() -> str:
+    return os.path.join(os.path.dirname(_last_result_path()),
+                        "backend_probe.json")
+
+
 def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
     """Retry backend bring-up in SUBPROCESSES (jax caches a failed
     backend for the life of the process, so in-process retries are
@@ -188,7 +193,50 @@ def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
 
     Round-2 failure mode this guards: the axon TPU tunnel was down at
     bench time, ``jax.devices()`` raised once, and the whole round
-    recorded rc=1 with nothing measured (VERDICT r2 weak #1)."""
+    recorded rc=1 with nothing measured (VERDICT r2 weak #1).
+
+    BENCH_r05 failure mode this guards: a CPU-ONLY container ate a
+    240 s probe timeout (and would have retried to the full budget)
+    before falling back. Three fixes: the per-attempt timeout is capped
+    at BENCH_PROBE_TIMEOUT_S (default 30 s); a probe that COMPLETES and
+    reports only-CPU devices is a definite verdict — a CPU container
+    will not grow a TPU, so it short-circuits the retry loop; and the
+    verdict is cached (BENCH_PROBE_TTL_S, default 3600 s) so reruns
+    skip the probe entirely. ``BENCH_BACKEND=cpu|tpu`` forces the
+    verdict with no probe at all."""
+    forced = os.environ.get("BENCH_BACKEND")
+    if forced == "tpu":
+        return None
+    if forced == "cpu":
+        return "cpu-only (forced via BENCH_BACKEND)"
+    ttl = float(os.environ.get("BENCH_PROBE_TTL_S", "3600"))
+    if ttl > 0:
+        try:
+            with open(_probe_cache_path()) as f:
+                cached = json.load(f)
+            if (cached.get("error") is not None
+                    and time.time() - cached.get("checked_at", 0) < ttl):
+                return cached.get("error")
+        except (OSError, json.JSONDecodeError, TypeError):
+            pass
+
+    def _remember(error):
+        # ONLY the definite cpu-only verdict is cacheable: a CPU
+        # container will not grow a TPU within the TTL, but a present
+        # TPU (or a transient tunnel error) can change state between
+        # runs — replaying those would crash a later run in-process
+        # (TPU-present cached, tunnel since dropped) or extend an
+        # outage verdict past the outage.
+        if error is not None and error.startswith("cpu-only"):
+            try:
+                with open(_probe_cache_path(), "w") as f:
+                    json.dump({"checked_at": time.time(), "error": error},
+                              f)
+            except OSError:
+                pass
+        return error
+
+    probe_cap = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "30"))
     err, t_end, first = None, time.monotonic() + budget_s, True
     while first or time.monotonic() < t_end:
         if not first:
@@ -198,19 +246,127 @@ def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
             # The axon plugin pins jax_platforms="axon,cpu": a failed
             # TPU init can fall back to CPU, which would pass a bare
             # device-count probe and then "measure" Mosaic kernels on
-            # the CPU backend. Require a non-CPU device.
+            # the CPU backend. Require a non-CPU device — but report
+            # a completed CPU-only probe distinctly from a crash.
             r = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "assert d and d[0].platform != 'cpu', d"],
-                capture_output=True, text=True, timeout=240)
+                 "import os, jax; "
+                 "cfg = (jax.config.jax_platforms "
+                 "       or os.environ.get('JAX_PLATFORMS') or ''); "
+                 "print('CONFIG=' + cfg); "
+                 "d = jax.devices(); "
+                 "print('PLATFORM=' + (d[0].platform if d else 'none'))"],
+                capture_output=True, text=True,
+                timeout=max(min(probe_cap, t_end - time.monotonic()),
+                            5.0))
         except subprocess.TimeoutExpired:
-            err = "probe timeout (240s)"
+            err = f"probe timeout ({probe_cap:.0f}s)"
             continue
         if r.returncode == 0:
-            return None
+            platform, cfg = "unknown", ""
+            for line in r.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    platform = line.split("=", 1)[1]
+                elif line.startswith("CONFIG="):
+                    cfg = line.split("=", 1)[1]
+            if platform not in ("cpu", "none", "unknown"):
+                return _remember(None)
+            non_cpu = [p for p in cfg.replace(" ", "").split(",")
+                       if p and p != "cpu"]
+            if non_cpu:
+                # A non-CPU platform is configured (the axon plugin
+                # pins "axon,cpu") but init fell back to CPU — a
+                # transient tunnel blip, not a definite verdict: keep
+                # retrying and never cache it.
+                err = (f"configured platform {non_cpu[0]!r} fell back "
+                       "to cpu (transient init failure?)")
+                continue
+            # Definite verdict: no non-CPU platform is even configured
+            # and the backend came up CPU-only. Retrying cannot change
+            # that — stop now.
+            return _remember(f"cpu-only backend (platform={platform})")
         err = (r.stderr.strip().splitlines() or ["unknown"])[-1][:300]
-    return err
+    return _remember(err)
+
+
+def _interpret_bench(reason: str) -> None:
+    """CPU-only fallback: measure the overlap-schedule family on the
+    interpret mesh instead of stalling toward a stale replay.
+
+    The interpreter executes the REAL kernel schedule — ring puts,
+    arrival waits, panel staging, swizzled chunk order — so the ratio
+    below tracks schedule correctness and interpreter-step overhead,
+    NOT hardware overlap efficiency (``detail.interpret_mode`` flags
+    it; the last genuine hardware measurement rides along in detail).
+    Small shapes: the interpreter is ~1000x silicon."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.ops import (ag_gemm, create_ag_gemm_context,
+                                     create_gemm_rs_context, gemm_rs)
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.utils.testing import spmd
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    mctx = MeshContext.from_mesh(mesh)
+    sim = 4
+    a = jax.random.normal(jax.random.PRNGKey(0), (256, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (32, 64), jnp.float32)
+
+    ag_ctx = create_ag_gemm_context(mctx, block_m=16, block_n=8)
+    rs_ctx = create_gemm_rs_context(mctx, block_m=16, block_n=16)
+    steps = {
+        "ag_gemm": spmd(mesh, lambda x, w: ag_gemm(x, w, ag_ctx,
+                                                   sim_ranks=sim),
+                        (P(None, None), P(None, None)), P(None, None)),
+        "gemm_rs": spmd(mesh, lambda x, w: gemm_rs(x, w, rs_ctx,
+                                                   sim_ranks=sim),
+                        (P(None, None), P(None, None)), P(None, None)),
+        "compute": spmd(mesh,
+                        lambda x, w: jnp.dot(
+                            x, w, preferred_element_type=jnp.float32
+                        ).astype(x.dtype),
+                        (P(None, None), P(None, None)), P(None, None)),
+    }
+    want = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    times = {}
+    for name, step in steps.items():
+        got = np.asarray(step(a, b), np.float32)  # warmup + correctness
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(step(a, b))
+            best = min(best, time.perf_counter() - t0)
+        times[name] = best
+
+    eff = times["compute"] / max(times["ag_gemm"], 1e-9)
+    last, src = _load_last_result()
+    out = {
+        "metric": "ag_gemm_overlap_efficiency_interpret",
+        "value": round(float(eff), 4),
+        "unit": "ratio_vs_compute_only_gemm_interpret",
+        "vs_baseline": None,   # interpreter ratios are not comparable
+        "detail": {
+            "interpret_mode": True,
+            "backend_unavailable": True,
+            "probe_verdict": reason,
+            "measured_at_unix": int(time.time()),
+            "sim_ranks": sim,
+            "ag_gemm_ms": round(times["ag_gemm"] * 1e3, 3),
+            "gemm_rs_ms": round(times["gemm_rs"] * 1e3, 3),
+            "gemm_rs_efficiency": round(
+                float(times["compute"] / max(times["gemm_rs"], 1e-9)), 4),
+            "compute_only_ms": round(times["compute"] * 1e3, 3),
+            "shape_m_k_n": [256, 32, 64],
+            "stale_source": src,
+            "stale_value": (last or {}).get("value"),
+            "stale_vs_baseline": (last or {}).get("vs_baseline"),
+        },
+    }
+    print(json.dumps(out))
 
 
 def _emit_unavailable(error: str, attempts) -> None:
@@ -244,6 +400,14 @@ def main():
     backoff = float(os.environ.get("BENCH_INIT_BACKOFF_S", "30"))
     err = _probe_backend(budget, backoff)
     if err is not None:
+        # No TPU: measure the overlap schedules on the interpret mesh
+        # (BENCH_INTERPRET=0 restores the bare stale-replay record).
+        if os.environ.get("BENCH_INTERPRET", "1") != "0":
+            try:
+                _interpret_bench(err)
+                return
+            except Exception as e:
+                err = f"{err}; interpret bench failed: {str(e)[:200]}"
         _emit_unavailable(err, f"{budget:.0f}s budget")
         return
 

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Overlap-schedule smoke battery on the CPU interpret mesh (no TPU):
+#
+#  1. tests/test_overlap.py — swizzled-vs-identity numerical parity for
+#     every (swizzle_mode, prefetch_depth) across the fused-op family,
+#     the schedule arithmetic units, and the autotune e2e loop;
+#  2. an interpret-mode bench.py pass, asserting it completes fast
+#     (no probe stall) and reports non-null ag_gemm / gemm_rs values.
+#
+# Sibling of scripts/verify_faults.sh: tier-1-adjacent, wired as
+# `make bench-smoke`. A broken schedule (wrong slot arithmetic, a wait
+# reordered past its put) fails here in minutes instead of on hardware.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PY=${PY:-python}
+
+echo "== overlap-schedule parity sweep (CPU interpret mesh) =="
+$PY -m pytest tests/test_overlap.py -q
+
+echo "== interpret-mode bench (must not stall, values must be non-null) =="
+out=$(BENCH_BACKEND=cpu BENCH_BATTERY_BUDGET_S=0 timeout 300 $PY bench.py)
+echo "$out" | tail -1
+$PY - "$out" <<'EOF'
+import json, sys
+rec = json.loads(sys.argv[1].strip().splitlines()[-1])
+assert rec["value"] is not None, rec
+assert rec["detail"].get("gemm_rs_efficiency") is not None, rec
+assert rec["detail"].get("interpret_mode"), rec
+print("bench-smoke: ok "
+      f"(ag_gemm={rec['value']}, "
+      f"gemm_rs={rec['detail']['gemm_rs_efficiency']})")
+EOF

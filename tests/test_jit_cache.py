@@ -1,0 +1,72 @@
+"""CompiledCache + cached host-level transport wrappers
+(``utils/jit_cache.py``, ``ops.p2p_put_host``, ``ops.broadcast_host``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.utils.jit_cache import CompiledCache
+
+
+def test_compiled_cache_hit_and_introspection():
+    cache = CompiledCache(4)
+    builds = []
+
+    def build():
+        builds.append(1)
+        return object()
+
+    a = cache.get_or_build("k", build)
+    assert cache.get_or_build("k", build) is a
+    assert builds == [1]
+    assert len(cache) == 1 and "k" in cache and cache["k"] is a
+    cache.clear()
+    assert len(cache) == 0
+
+
+def test_compiled_cache_fifo_eviction():
+    cache = CompiledCache(2)
+    for k in ("a", "b", "c"):
+        cache.get_or_build(k, lambda k=k: k.upper())
+    assert len(cache) == 2
+    assert "a" not in cache            # oldest evicted
+    assert cache["b"] == "B" and cache["c"] == "C"
+
+
+def test_compiled_cache_rejects_bad_size():
+    with pytest.raises(ValueError):
+        CompiledCache(0)
+
+
+def test_host_transport_wrappers(tp8_mesh):
+    """p2p_put_host / broadcast_host: correct results AND the compiled
+    callable is reused (one cache entry, identical object) on repeat
+    calls with the same geometry."""
+    from triton_dist_tpu.ops import broadcast_host, p2p_put_host
+    from triton_dist_tpu.ops.broadcast import _BCAST_HOST_CACHE
+    from triton_dist_tpu.ops.p2p import _P2P_HOST_CACHE
+
+    x = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        NamedSharding(tp8_mesh, P("tp", None)))
+    xs = np.asarray(x)
+
+    perm = tuple((r, (r + 1) % 8) for r in range(8))
+    _P2P_HOST_CACHE.clear()
+    got = np.asarray(p2p_put_host(x, perm, tp8_mesh, axis="tp"))
+    want = np.zeros_like(xs)
+    for s, d in perm:
+        want[d] = xs[s]
+    np.testing.assert_allclose(got, want)
+    compiled = _P2P_HOST_CACHE[(tp8_mesh, "tp", perm, 2)]
+    p2p_put_host(x, perm, tp8_mesh, axis="tp")
+    assert _P2P_HOST_CACHE[(tp8_mesh, "tp", perm, 2)] is compiled
+    assert len(_P2P_HOST_CACHE) == 1
+
+    _BCAST_HOST_CACHE.clear()
+    got_b = np.asarray(broadcast_host(x, 3, mesh=tp8_mesh, axis="tp"))
+    np.testing.assert_allclose(got_b, np.tile(xs[3], (8, 1)))
+    broadcast_host(x, 3, mesh=tp8_mesh, axis="tp")
+    assert len(_BCAST_HOST_CACHE) == 1

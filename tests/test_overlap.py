@@ -1,0 +1,379 @@
+"""Shared overlap-engine tests (``triton_dist_tpu/lang/overlap.py``).
+
+Three layers, matching the ISSUE-2 acceptance criteria:
+
+1. Pure schedule arithmetic: permutation/inverse/slot properties of the
+   rank-swizzled chunk orders, and ``choose_depth`` resolution.
+2. Numerical parity on the CPU interpret mesh: for EVERY
+   ``(swizzle_mode, prefetch_depth)`` in each op's config space, the
+   fused kernel must match its oracle — the swizzle only reorders
+   waits/compute, never the result.
+3. The ``autotune`` decorator end-to-end on ag_gemm: sweep → winner
+   persisted in the tune cache → cache hit (no re-sweep) on the second
+   call; plus the in-trace ``ag_gemm_tuned`` cache-hit path.
+
+Shapes follow test_fused_gemm.py's note: interpret mode on the CPU mesh
+needs every buffer small, so these stay tiny — kernel logic is
+shape-agnostic.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import tune
+from triton_dist_tpu.lang import overlap
+from triton_dist_tpu.parallel.mesh import MeshContext
+from triton_dist_tpu.utils.testing import spmd, assert_allclose
+
+DEPTHS = (0, 1, 2, 3)   # 0 = auto; 1..3 = stage-and-wait/double/triple
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def _mesh1():
+    return Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+
+# ---------------------------------------------------------------------------
+# 1. schedule arithmetic
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("world", [1, 2, 4, 8])
+@pytest.mark.parametrize("mode", overlap.SWIZZLE_MODES)
+def test_schedule_is_permutation(world, mode):
+    for rank in range(world):
+        order = overlap.schedule(rank, world, world, mode)
+        assert sorted(order) == list(range(world))
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_schedule_rank_anchors(world):
+    """"ag"/"a2a" start on the locally-resident chunk (zero exposed
+    latency); "rs" FINISHES each chunk at its owner (the running sum's
+    last hop lands home)."""
+    for rank in range(world):
+        assert overlap.schedule(rank, world, world, "ag")[0] == rank
+        assert overlap.schedule(rank, world, world, "a2a")[0] == rank
+        assert overlap.schedule(rank, world, world, "rs")[-1] == rank
+        assert overlap.schedule(rank, world, world, "identity") == \
+            tuple(range(world))
+
+
+@pytest.mark.parametrize("world", [1, 2, 4, 8])
+@pytest.mark.parametrize("mode", overlap.SWIZZLE_MODES)
+def test_chunk_at_step_of_inverse(world, mode):
+    for rank in range(world):
+        for s in range(world):
+            c = overlap.chunk_at(s, rank, world, mode)
+            assert overlap.step_of(c, rank, world, mode) == s
+
+
+def test_schedule_arg_validation():
+    with pytest.raises(ValueError, match="unknown swizzle_mode"):
+        overlap.schedule(0, 4, 4, "zigzag")
+    with pytest.raises(ValueError, match="schedules exactly world"):
+        overlap.schedule(0, 4, 3, "ag")
+    # identity allows any chunk count (plain grid order).
+    assert overlap.schedule(0, 4, 6, "identity") == (0, 1, 2, 3, 4, 5)
+
+
+def test_chunk_at_matches_traced_arithmetic():
+    """Host ints and traced values must compute the same schedule (the
+    kernels use traced grid indices, the hosts use ints)."""
+    world = 8
+    for mode in overlap.SWIZZLE_MODES:
+        for rank in range(world):
+            host = [overlap.chunk_at(s, rank, world, mode)
+                    for s in range(world)]
+            traced = jax.jit(lambda s, r, m=mode: overlap.chunk_at(
+                s, r, world, m))
+            got = [int(traced(jnp.int32(s), jnp.int32(rank)))
+                   for s in range(world)]
+            assert got == host, (mode, rank)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_a2a_slot_bijection(world):
+    """Per-destination, the n-1 sources map onto distinct slots
+    0..n-2 — a consumer never blocks on traffic it does not read."""
+    for dst in range(world):
+        slots = [overlap.a2a_slot(src, dst, world)
+                 for src in range(world) if src != dst]
+        assert sorted(slots) == list(range(world - 1))
+        # Receiver-side arithmetic in sp_ag_attention: chunk src is
+        # processed at step k = (dst - src) mod n, waiting slot n-k-1.
+        for src in range(world):
+            if src == dst:
+                continue
+            k = (dst - src) % world
+            assert overlap.a2a_slot(src, dst, world) == world - k - 1
+
+
+def test_ring_chunk():
+    assert overlap.ring_chunk(0, 3, 8) == 3         # local chunk
+    assert overlap.ring_chunk(1, 3, 8) == 2         # left neighbour's
+    assert overlap.ring_chunk(7, 0, 8) == 1
+
+
+def test_choose_depth():
+    kb = 1024
+    # auto (0) = double buffering when it fits and there is a body to
+    # hide staging under.
+    assert overlap.choose_depth(0, 64 * kb, 1024 * kb, 4, 8) == 2
+    # explicit depths honored when they fit...
+    assert overlap.choose_depth(1, 64 * kb, 1024 * kb, 4, 8) == 1
+    assert overlap.choose_depth(3, 64 * kb, 1024 * kb, 4, 8) == 3
+    # ...clamped (never rejected) against the VMEM budget...
+    assert overlap.choose_depth(3, 400 * kb, 1024 * kb, 4, 8) == 2
+    assert overlap.choose_depth(3, 900 * kb, 1024 * kb, 4, 8) == 1
+    # ...against the panel count...
+    assert overlap.choose_depth(3, 64 * kb, 1024 * kb, 4, 2) == 2
+    # ...and down to 1 when the chunk has no body ahead of the boundary.
+    assert overlap.choose_depth(2, 64 * kb, 1024 * kb, 1, 8) == 1
+    # chunk_len=None: staging is not cross-chunk, so the >=2-bodies
+    # guard does not apply even at one body per chunk.
+    assert overlap.choose_depth(2, 64 * kb, 1024 * kb, None, 8) == 2
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        overlap.choose_depth(4, kb, kb, 4, 4)
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        overlap.choose_depth(-1, kb, kb, 4, 4)
+
+
+# ---------------------------------------------------------------------------
+# 2. swizzled-vs-identity numerical parity, full config space
+# ---------------------------------------------------------------------------
+
+def _ag_modes():
+    from triton_dist_tpu.ops.ag_gemm import SWIZZLE_MODES
+    return SWIZZLE_MODES
+
+
+@pytest.mark.parametrize("mode,depth",
+                         list(itertools.product(("ag", "identity"),
+                                                DEPTHS)))
+def test_ag_gemm_parity(tp8_mesh, tp8_ctx, mode, depth):
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+
+    assert mode in _ag_modes()
+    a = _rand((128, 32), 70)
+    b = _rand((32, 64), 71)
+    # m_loc=16/block_m=8 -> 2 bodies per chunk, so cross-chunk
+    # prefetch (depth >= 2) genuinely engages.
+    ctx = create_ag_gemm_context(tp8_ctx, block_m=8, block_n=8,
+                                 swizzle_mode=mode, prefetch_depth=depth)
+    f = spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    # Host oracle: the column-sharded outputs reassemble to the full
+    # product (no second interpret compile per test).
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)
+
+
+def test_ag_gemm_swizzled_equals_identity(tp8_mesh, tp8_ctx):
+    """Direct parity of the two schedules (not just oracle-closeness):
+    the swizzle reorders chunk traversal, and chunk contributions land
+    in disjoint output rows, so outputs are bit-identical."""
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+
+    a = _rand((128, 32), 72)
+    b = _rand((32, 64), 73)
+    outs = {}
+    for mode in ("ag", "identity"):
+        ctx = create_ag_gemm_context(tp8_ctx, block_m=8, block_n=8,
+                                     swizzle_mode=mode)
+        outs[mode] = np.asarray(
+            spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+                 (P("tp", None), P(None, "tp")), P(None, "tp"))(a, b))
+    np.testing.assert_array_equal(outs["ag"], outs["identity"])
+
+
+@pytest.mark.parametrize("mode,depth",
+                         list(itertools.product(("rs", "identity"),
+                                                DEPTHS)))
+def test_gemm_rs_parity(tp8_mesh, tp8_ctx, mode, depth):
+    from triton_dist_tpu.ops import create_gemm_rs_context, gemm_rs
+
+    a = _rand((128, 128), 74)
+    b = _rand((128, 64), 75)
+    ctx = create_gemm_rs_context(tp8_ctx, block_m=16, block_n=32,
+                                 swizzle_mode=mode, prefetch_depth=depth)
+    f = spmd(tp8_mesh, lambda x, w: gemm_rs(x, w, ctx),
+             (P(None, "tp"), P("tp", None)), P("tp", None))
+    # Host oracle: the row-scattered shards reassemble to the full
+    # product.
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode,depth",
+                         list(itertools.product(("a2a", "identity"),
+                                                DEPTHS)))
+def test_a2a_gemm_parity(tp8_mesh, tp8_ctx, mode, depth):
+    from triton_dist_tpu.ops.a2a_gemm import (a2a_gemm_fused,
+                                              create_a2a_gemm_context)
+
+    x = _rand((64, 2, 32), 76)   # per-shard (8, 2, 32)
+    w = _rand((32, 16), 77)
+    fctx = create_a2a_gemm_context(tp8_ctx, "tp", swizzle_mode=mode,
+                                   prefetch_depth=depth)
+    f = spmd(tp8_mesh, lambda v, ww: a2a_gemm_fused(v, ww, fctx),
+             (P("tp", None, None), P(None, None)), P("tp", None))
+    # Host oracle: rank r's recv[src] = shard src's chunk r, so its
+    # output rows are concat_src(x[8*src + r]) @ w.
+    xs, wn = np.asarray(x), np.asarray(w)
+    want = np.concatenate([
+        np.concatenate([xs[8 * src + r] for src in range(8)]) @ wn
+        for r in range(8)])
+    assert_allclose(f(x, w), want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode,depth",
+                         list(itertools.product(("a2a", "identity"),
+                                                DEPTHS)))
+def test_ulysses_o_a2a_gemm_parity(mode, depth):
+    """Consumer-side Ulysses kernel under the full config space, on the
+    single-chip sim ring (the multi-rank form routes to XLA on the
+    interpret mesh — the sim runs the REAL swizzled kernel schedule)."""
+    from triton_dist_tpu.ops.ulysses_fused import (
+        create_ulysses_fused_context, o_a2a_gemm)
+
+    mesh1 = _mesh1()
+    ctx1 = MeshContext.from_mesh(mesh1)
+    n, s_loc, rows, d = 4, 8, 8, 16
+    o = _rand((n * s_loc, rows), 78) * 0.3
+    w = _rand((n, rows, d), 79) * 0.3
+    ctx = create_ulysses_fused_context(ctx1, axis="tp", block_m=8,
+                                       block_n=8, swizzle_mode=mode,
+                                       prefetch_depth=depth)
+    f = spmd(mesh1, lambda x, ww: o_a2a_gemm(x, ww, ctx, sim_ranks=n),
+             (P(None, None), P(None, None, None)), P(None, None))
+    want = jnp.einsum("nsr,nrd->sd", o.reshape(n, s_loc, rows), w)
+    assert_allclose(f(o, w), want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_sp_ag_attention_prefetch_depth_parity(depth):
+    """KV-stager depth knob on the self-sim ring: every depth must
+    reproduce dense causal attention of the last rank's query slice."""
+    from triton_dist_tpu.ops import sp_ag_attention_fused
+    from triton_dist_tpu.ops.sp_ag_attention import _masked_attn
+
+    mesh1 = _mesh1()
+    ctx1 = MeshContext.from_mesh(mesh1)
+    s, h, kvh, hd, n_sim = 32, 4, 2, 16, 4
+    q = _rand((s, h, hd), 80) * 0.5
+    k = _rand((s, kvh, hd), 81) * 0.5
+    v = _rand((s, kvh, hd), 82) * 0.5
+    out = spmd(mesh1,
+               lambda a, b, c: sp_ag_attention_fused(
+                   a, b, c, ctx=ctx1, axis="tp", block_q=4, block_kv=8,
+                   sim_ranks=n_sim, prefetch_depth=depth),
+               (P(None, None, None),) * 3, P(None, None, None))(q, k, v)
+    s_loc = s // n_sim
+    want = _masked_attn(q[-s_loc:], k, v, (n_sim - 1) * s_loc)
+    assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# 3. autotune end-to-end on ag_gemm
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fresh_tune_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRITON_DIST_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(tune, "_CACHE_PATH", None)
+    monkeypatch.setattr(tune, "_CACHE", None)
+    yield tmp_path
+    tune._CACHE_PATH = None
+    tune._CACHE = None
+
+
+def test_autotune_ag_gemm_end_to_end(tp8_mesh, tp8_ctx, fresh_tune_cache,
+                                     monkeypatch):
+    """The decorator's full loop on ag_gemm: sweep every config (each
+    one actually dispatched through the fused kernel), persist the
+    winner, and hit the cache — no timing — on the second call."""
+    import triton_dist_tpu.autotuner as autotuner
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+
+    # Single-dispatch timer: the chained-slope harness is a hardware
+    # measurement tool — one interpret-mode run per config is enough to
+    # drive the sweep deterministically here.
+    timed = []
+
+    def quick_perf(fn, args, **_):
+        import time
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        timed.append(1)
+        return time.perf_counter() - t0
+
+    monkeypatch.setattr(autotuner, "perf_func", quick_perf)
+
+    configs = [
+        {"block_m": 8, "block_n": 8},
+        {"block_m": 8, "block_n": 8, "swizzle_mode": "identity"},
+        {"block_m": 8, "block_n": 8, "prefetch_depth": 1},
+    ]
+
+    @autotuner.autotune(
+        "ag_gemm_e2e_test", configs,
+        key_fn=lambda a_, b_: {
+            "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
+            "dtype": str(a_.dtype), "mesh": tune.mesh_key(tp8_ctx)})
+    def run(a_, b_, block_m=8, block_n=8, swizzle_mode="ag",
+            prefetch_depth=0):
+        ctx = create_ag_gemm_context(
+            tp8_ctx, "tp", block_m, block_n, 512,
+            swizzle_mode=swizzle_mode, prefetch_depth=prefetch_depth)
+        return spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+                    (P("tp", None), P(None, "tp")), P(None, "tp"))(a_, b_)
+
+    a = _rand((128, 32), 83)
+    b = _rand((32, 64), 84)
+    want = jnp.dot(a, b)
+
+    # First call: sweeps all three configs, persists the winner.
+    assert_allclose(run(a, b), want, rtol=1e-4, atol=1e-4)
+    assert len(timed) == len(configs)
+    key = tune.make_key("ag_gemm_e2e_test", m=128, k=32, n=64,
+                        dtype=str(a.dtype), mesh=tune.mesh_key(tp8_ctx))
+    winner = tune.load_autotune_data(key)
+    assert winner in configs
+
+    # Second call: cache hit — any timing attempt is a test failure.
+    def no_timing(*_a, **_k):   # pragma: no cover
+        raise AssertionError("cache hit must not re-sweep")
+
+    monkeypatch.setattr(autotuner, "perf_func", no_timing)
+    assert_allclose(run(a, b), want, rtol=1e-4, atol=1e-4)
+
+
+def test_ag_gemm_tuned_in_trace_uses_cached_winner(tp8_mesh, tp8_ctx,
+                                                   fresh_tune_cache):
+    """ag_gemm_tuned inside shard_map (tracers: nothing can be timed)
+    must pick up a pre-persisted winner keyed on (mesh shape, shard
+    M/K/N, dtype) — the persistent-cache contract for the fused-op
+    family."""
+    from triton_dist_tpu.ops import ag_gemm_tuned
+
+    a = _rand((128, 32), 85)
+    b = _rand((32, 64), 86)
+    m_loc, k = 128 // 8, 32
+    n_loc = 64 // 8
+    key = tune.make_key("ag_gemm", m=m_loc, k=k, n=n_loc,
+                        dtype=str(a.dtype), world=8,
+                        mesh=tune.mesh_key(tp8_ctx))
+    winner = {"block_m": 8, "block_n": 8, "block_k": 32,
+              "swizzle_mode": "identity", "prefetch_depth": 1}
+    tune.store_autotune_data(key, winner)
+
+    f = spmd(tp8_mesh,
+             lambda x, w: ag_gemm_tuned(x, w, tp8_ctx, axis="tp"),
+             (P("tp", None), P(None, "tp")), P(None, "tp"))
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)

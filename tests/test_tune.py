@@ -1,0 +1,119 @@
+"""Persistent autotune-cache coverage (``triton_dist_tpu/tune.py``):
+round-trip, dependency-stamp invalidation, and concurrent writers
+leaving one valid JSON file (the ISSUE-2 satellite)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from triton_dist_tpu import tune
+
+
+@pytest.fixture
+def fresh_cache(tmp_path, monkeypatch):
+    """Redirect the tune cache into a private tmp dir and reset the
+    module's memoized path + in-memory cache around the test."""
+    monkeypatch.setenv("TRITON_DIST_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(tune, "_CACHE_PATH", None)
+    monkeypatch.setattr(tune, "_CACHE", None)
+    yield tmp_path
+    tune._CACHE_PATH = None
+    tune._CACHE = None
+
+
+def test_round_trip(fresh_cache):
+    key = tune.make_key("some_op", m=128, k=64, n=32, dtype="bfloat16")
+    cfg = {"block_m": 256, "swizzle_mode": "ag", "prefetch_depth": 2}
+    assert tune.load_autotune_data(key) is None
+    tune.store_autotune_data(key, cfg, seconds=1.5e-3)
+    assert tune.load_autotune_data(key) == cfg
+    # A fresh process (cleared memo) reads the same winner from disk.
+    tune._CACHE = None
+    assert tune.load_autotune_data(key) == cfg
+    rec = json.load(open(tune.cache_path()))[key]
+    assert rec["seconds"] == pytest.approx(1.5e-3)
+    assert rec["versions"] == tune._dep_versions()
+
+
+def test_make_key_stable_and_distinct(fresh_cache):
+    k1 = tune.make_key("op", m=128, n=64)
+    assert k1 == tune.make_key("op", n=64, m=128)   # order-insensitive
+    assert k1 != tune.make_key("op", m=128, n=65)
+    assert k1 != tune.make_key("op2", m=128, n=64)
+    assert k1.startswith("op:")
+
+
+def test_dep_stamp_invalidation(fresh_cache, monkeypatch):
+    """A winner tuned under a different stack (jax version, backend)
+    must read as a miss, not a hit."""
+    key = tune.make_key("op", m=8)
+    tune.store_autotune_data(key, {"block_m": 64})
+    assert tune.load_autotune_data(key) == {"block_m": 64}
+    monkeypatch.setattr(
+        tune, "_dep_versions",
+        lambda: {"jax": "999.0", "triton_dist_tpu": "x", "backend": "tpu"})
+    assert tune.load_autotune_data(key) is None
+
+
+def test_corrupt_cache_file_is_a_miss(fresh_cache):
+    with open(tune.cache_path(), "w") as f:
+        f.write("{ not json")
+    assert tune.load_autotune_data(tune.make_key("op")) is None
+    # And storing over the corrupt file heals it.
+    key = tune.make_key("op", m=1)
+    tune.store_autotune_data(key, {"block_m": 8})
+    assert tune.load_autotune_data(key) == {"block_m": 8}
+
+
+def test_concurrent_writers_leave_valid_json(fresh_cache):
+    """Threaded store_autotune_data from many writers: the final file
+    must be one complete JSON document containing every key (the _LOCK
+    serializes in-process writers; the private-temp-file + os.replace
+    protocol keeps any reader off half-written bytes)."""
+    n_threads, n_writes = 8, 10
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(n_writes):
+                key = tune.make_key("op", thread=tid, i=i)
+                tune.store_autotune_data(key, {"block_m": 8 * (i + 1)},
+                                         seconds=float(i))
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    data = json.load(open(tune.cache_path()))   # parses = not corrupt
+    assert len(data) == n_threads * n_writes
+    tune._CACHE = None                          # force re-read from disk
+    for tid in range(n_threads):
+        for i in range(n_writes):
+            key = tune.make_key("op", thread=tid, i=i)
+            assert tune.load_autotune_data(key) == {"block_m": 8 * (i + 1)}
+    # No leftover temp files from any writer.
+    leftovers = [p for p in os.listdir(fresh_cache) if p.endswith(".tmp")]
+    assert leftovers == []
+
+
+def test_clear_cache(fresh_cache):
+    key = tune.make_key("op", m=2)
+    tune.store_autotune_data(key, {"block_m": 16})
+    tune.clear_cache()
+    assert tune.load_autotune_data(key) is None
+    assert not os.path.exists(tune.cache_path())
+
+
+def test_mesh_key():
+    class FakeMesh:
+        axes = ("tp", "dp")
+        sizes = (8, 2)
+
+    assert tune.mesh_key(FakeMesh()) == "tp8xdp2"

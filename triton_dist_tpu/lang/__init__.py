@@ -21,3 +21,7 @@ from triton_dist_tpu.lang.pallas_helpers import (  # noqa: F401
     comm_compiler_params,
     next_collective_id,
 )
+# Shared overlap engine (rank-swizzled schedules, prefetch-depth panel
+# staging, coalesced per-chunk signalling) — consumed by the fused-op
+# family. Imported last: it builds on shmem_device.
+from triton_dist_tpu.lang import overlap  # noqa: F401

@@ -9,8 +9,10 @@ runtime) and Python only marshals arrays.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import tempfile
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -23,18 +25,60 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def _cache_dir() -> str:
+    """Fallback .so location for read-only checkouts."""
+    from triton_dist_tpu import tune
+
+    path = os.path.join(tune.cache_dir(), "csrc")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _compile_so(src: str, so: str) -> None:
+    """Compile ``src`` into ``so`` safely under concurrency: g++ writes
+    a process-private temp file which is then atomically renamed into
+    place. Two racing processes each build a complete .so and the
+    rename winner-takes-last — a reader can never dlopen a half-written
+    library (the failure mode of compiling straight to the shared
+    path)."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", prefix=".tdt_sched_",
+                               dir=os.path.dirname(so))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", tmp,
+             src],
+            check=True)
+        os.chmod(tmp, 0o755)  # mkstemp's 0600 would break shared caches
+        os.replace(tmp, so)   # atomic within the directory
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def _load_lib():
     global _LIB
     if _LIB is not None:
         return _LIB
     csrc = os.path.join(_repo_root(), "csrc")
-    so = os.path.join(csrc, "libtdt_scheduler.so")
     src = os.path.join(csrc, "megakernel_scheduler.cc")
+    so = os.path.join(csrc, "libtdt_scheduler.so")
     if (not os.path.exists(so)
             or os.path.getmtime(so) < os.path.getmtime(src)):
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", so, src],
-            check=True)
+        try:
+            _compile_so(src, so)
+        except (OSError, PermissionError):
+            # Read-only checkout: build into the user cache dir instead.
+            # The cache dir is shared across checkouts whose sources may
+            # diverge, so the .so is keyed by source-content hash — an
+            # mtime check against the current checkout could accept a
+            # foreign checkout's binary.
+            with open(src, "rb") as f:
+                digest = hashlib.sha1(f.read()).hexdigest()[:12]
+            so = os.path.join(_cache_dir(),
+                              f"libtdt_scheduler-{digest}.so")
+            if not os.path.exists(so):
+                _compile_so(src, so)
     lib = ctypes.CDLL(so)
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.tdt_schedule.restype = ctypes.c_int32

@@ -19,7 +19,9 @@ from triton_dist_tpu.ops.reduce_scatter import (  # noqa: F401
 from triton_dist_tpu.ops.allreduce import (  # noqa: F401
     all_reduce, all_reduce_2d, all_reduce_ref, AllReduceMethod,
 )
-from triton_dist_tpu.ops.p2p import p2p_put, ppermute_ref  # noqa: F401
+from triton_dist_tpu.ops.p2p import (  # noqa: F401
+    p2p_put, p2p_put_host, ppermute_ref,
+)
 from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401
     AGGemmContext, create_ag_gemm_context, ag_gemm, ag_gemm_ref,
     ag_gemm_tuned,
@@ -57,7 +59,8 @@ from triton_dist_tpu.ops.ulysses import (  # noqa: F401
 )
 from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
     UlyssesFusedContext, create_ulysses_fused_context, qkv_gemm_a2a,
-    o_a2a_gemm, group_qkv_columns, group_o_rows, ulysses_attn_fused,
+    o_a2a_gemm, o_a2a_gemm_tuned, group_qkv_columns, group_o_rows,
+    ulysses_attn_fused,
 )
 from triton_dist_tpu.ops.low_latency import (  # noqa: F401
     fast_allgather, ll_a2a, ll_a2a_steps,
@@ -78,7 +81,10 @@ from triton_dist_tpu.ops.flash_decode import (  # noqa: F401
 from triton_dist_tpu.ops.gdn import (  # noqa: F401
     gdn_fwd, gdn_decode_step, gdn_ref,
 )
-from triton_dist_tpu.ops.broadcast import broadcast, broadcast_ref  # noqa: F401
+from triton_dist_tpu.ops.broadcast import (  # noqa: F401
+    broadcast, broadcast_host, broadcast_ref,
+)
 from triton_dist_tpu.ops.a2a_gemm import (  # noqa: F401
-    a2a_gemm, a2a_gemm_ref, a2a_gemm_fused, create_a2a_gemm_context,
+    a2a_gemm, a2a_gemm_ref, a2a_gemm_fused, a2a_gemm_tuned,
+    create_a2a_gemm_context,
 )

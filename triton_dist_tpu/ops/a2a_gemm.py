@@ -33,9 +33,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.lang as dl
-from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.lang import core_call, overlap
 from triton_dist_tpu.ops.all_to_all import all_to_all, all_to_all_ref
 from triton_dist_tpu.parallel.mesh import MeshContext
+
+# Overlap-schedule config space (lang/overlap.py): "a2a" walks chunks
+# by ring offset starting with the local one (zero exposed latency on
+# chunk 0 while every remote chunk is in flight); "identity" walks
+# sources in plain 0..n-1 order — the first chunks are usually remote,
+# so their flight time is exposed: the baseline the swizzle is
+# parity-tested and benchmarked against. Puts are identical either way
+# (all fired at entry, rank-convergent); only waits/compute reorder.
+SWIZZLE_MODES = ("a2a", "identity")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,15 +56,28 @@ class A2AGemmContext:
     block_n: int = 256
     block_k: int = 512
     out_dtype: Optional[jnp.dtype] = None
+    # Overlap-engine knobs (lang/overlap.py): chunk-traversal order and
+    # panel prefetch depth (0 = auto, 1..3), both autotunable via
+    # a2a_gemm_tuned.
+    swizzle_mode: str = "a2a"
+    prefetch_depth: int = 0
 
 
 def create_a2a_gemm_context(mesh: MeshContext, axis: str = "tp",
                             block_m: int = 256, block_n: int = 256,
-                            block_k: int = 512,
-                            out_dtype=None) -> A2AGemmContext:
+                            block_k: int = 512, out_dtype=None,
+                            swizzle_mode: str = "a2a",
+                            prefetch_depth: int = 0) -> A2AGemmContext:
+    if swizzle_mode not in SWIZZLE_MODES:
+        raise ValueError(f"unknown a2a_gemm swizzle_mode {swizzle_mode!r} "
+                         f"(expected one of {SWIZZLE_MODES})")
+    if not 0 <= prefetch_depth <= 3:
+        raise ValueError(f"prefetch_depth must be 0 (auto) or 1..3, got "
+                         f"{prefetch_depth}")
     return A2AGemmContext(mesh=mesh, axis=axis, block_m=block_m,
                           block_n=block_n, block_k=block_k,
-                          out_dtype=out_dtype)
+                          out_dtype=out_dtype, swizzle_mode=swizzle_mode,
+                          prefetch_depth=prefetch_depth)
 
 
 def a2a_gemm_ref(x, w, *, axis: str = "tp", **_):
@@ -68,7 +90,8 @@ def a2a_gemm_ref(x, w, *, axis: str = "tp", **_):
 def _a2a_gemm_kernel(x_ref, b_ref, o_ref, recv_ws, a_panel, acc_v,
                      send_sem, recv_sem, panel_sem, local_sem, *,
                      axis: str, ctx: MeshContext, c_loc: int, tm: int,
-                     tk: int, n_ranks: int, n_buf: int, write_recv: bool):
+                     tk: int, n_ranks: int, n_buf: int, mode: str,
+                     write_recv: bool):
     k = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -78,7 +101,11 @@ def _a2a_gemm_kernel(x_ref, b_ref, o_ref, recv_ws, a_panel, acc_v,
     n_k = pl.num_programs(3)
     me = dl.rank(axis)
     n = n_ranks
-    src = jax.lax.rem(me + k, n)  # chunk computed at grid step k
+    # Chunk (= source rank) computed at grid step k under the active
+    # swizzle: "a2a" = ring offset from me (local chunk first),
+    # "identity" = plain source order 0..n-1.
+    src = overlap.chunk_at(k, me, n, mode)
+    own = src == me
 
     chunk_of = lambda r: recv_ws.at[pl.ds(r * c_loc, c_loc)]
 
@@ -93,60 +120,67 @@ def _a2a_gemm_kernel(x_ref, b_ref, o_ref, recv_ws, a_panel, acc_v,
         if write_recv:
             pltpu.make_async_copy(x_ref.at[me], chunk_of(me),
                                   local_sem).start()
-        # Fire every outgoing chunk now; the k=0 local GEMM hides the
-        # flight time. Arrival slot is keyed by (src - dst) mod n so
-        # sender and receiver agree without any handshake:
-        # sender me -> peer (me+off) signals slot n-off-1; the receiver
-        # waits chunk (me+k) at slot k-1.
+        # Fire every outgoing chunk now; the local-chunk GEMM hides the
+        # flight time. Arrival slot is keyed by (src - dst) mod n
+        # (overlap.a2a_slot) so sender and receiver agree without any
+        # handshake, whatever order the active swizzle consumes in.
         for off in range(1, n):
             peer = jax.lax.rem(me + off, n)
             dl.remote_put(x_ref.at[peer], chunk_of(me),
-                          send_sem.at[off - 1], recv_sem.at[n - off - 1],
+                          send_sem.at[off - 1],
+                          recv_sem.at[overlap.a2a_slot(me, me + off, n)],
                           peer, axis=axis, ctx=ctx)
 
     chunk_start = jnp.logical_and(
         i == 0, jnp.logical_and(j == 0, kk == 0))
 
-    @pl.when(jnp.logical_and(k > 0, chunk_start))
+    @pl.when(jnp.logical_and(jnp.logical_not(own), chunk_start))
     def _():
-        dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(src), 1)
+        dl.wait_arrivals(recv_sem.at[overlap.a2a_slot(src, me, n)],
+                         chunk_of(src), 1)
 
-    def start_panel_copy(ii, buf):
-        """Stage row panel ii of this chunk (full K) into VMEM. The local
-        chunk reads straight from the input; received chunks read the
-        workspace (arrival certified above)."""
-        @pl.when(k == 0)
+    stager = overlap.PanelStager(a_panel, panel_sem, n_buf)
+
+    def stage_panel(off, p):
+        """Stage row panel ``off`` of this chunk (full K) into global
+        panel ``p``'s buffer. The local chunk reads straight from the
+        input; received chunks read the workspace (arrival certified
+        above)."""
+        @pl.when(own)
         def _():
-            pltpu.make_async_copy(
-                x_ref.at[me, pl.ds(ii * tm, tm)], a_panel.at[buf],
-                panel_sem).start()
+            stager.start(x_ref.at[me, pl.ds(off * tm, tm)], p)
 
-        @pl.when(k > 0)
+        @pl.when(jnp.logical_not(own))
         def _():
-            pltpu.make_async_copy(
-                recv_ws.at[pl.ds(src * c_loc + ii * tm, tm)],
-                a_panel.at[buf], panel_sem).start()
+            stager.start(recv_ws.at[pl.ds(src * c_loc + off * tm, tm)], p)
 
-    def wait_panel(buf):
-        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
-                              panel_sem).wait()
-
-    buf = jax.lax.rem(i, n_buf) if n_buf > 1 else 0
+    # Global panel index: consecutive panels rotate buffers across
+    # chunk boundaries too (i-based indexing collides when n_i is not a
+    # multiple of the depth).
+    p_glob = k * n_i + i
 
     @pl.when(jnp.logical_and(j == 0, kk == 0))
     def _():
         if n_buf == 1:
-            start_panel_copy(i, 0)
-            wait_panel(0)
+            stage_panel(i, p_glob)
+            stager.wait(p_glob)
         else:
             @pl.when(i == 0)
             def _():
-                start_panel_copy(i, buf)
-            wait_panel(buf)
+                # Lead panels: staged at chunk start (post-wait) —
+                # unlike ag_gemm there is no per-chunk ring event to
+                # hide them behind; depth still pipelines the rest.
+                for off in stager.lead_range(n_i):
+                    stage_panel(jnp.int32(off), k * n_i + off)
+            stager.wait(p_glob)
 
-            @pl.when(i + 1 < n_i)
+            @pl.when(i + (n_buf - 1) < n_i)
             def _():
-                start_panel_copy(i + 1, jax.lax.rem(i + 1, n_buf))
+                # In-chunk rule: at panel i's wait point, stage the
+                # panel depth-1 ahead (still inside this chunk).
+                stage_panel(i + (n_buf - 1), p_glob + (n_buf - 1))
+
+    buf = stager.buf(p_glob)
 
     @pl.when(kk == 0)
     def _():
@@ -211,16 +245,18 @@ def a2a_gemm_fused(x, w, ctx: A2AGemmContext, *,
     n_i, n_j, n_k = c_loc // tm, n_out // tn, d // tk
 
     panel_bytes = tm * d * x.dtype.itemsize
-    n_buf = 2 if (n_i > 1 and 2 * panel_bytes <= panel_budget) else 1
+    n_buf = overlap.choose_depth(ctx.prefetch_depth, panel_bytes,
+                                 panel_budget, n_i * n_j * n_k, n * n_i)
 
     def c_index(k, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)
-        src = jax.lax.rem(me + k, n)
+        src = overlap.chunk_at(k, me, n, ctx.swizzle_mode)
         return (src * n_i + i, j)
 
     kernel = functools.partial(
         _a2a_gemm_kernel, axis=ctx.axis, ctx=mesh, c_loc=c_loc, tm=tm,
-        tk=tk, n_ranks=n, n_buf=n_buf, write_recv=return_recv)
+        tk=tk, n_ranks=n, n_buf=n_buf, mode=ctx.swizzle_mode,
+        write_recv=return_recv)
 
     out, recv = core_call(
         kernel,
@@ -242,7 +278,7 @@ def a2a_gemm_fused(x, w, ctx: A2AGemmContext, *,
             pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
-            pltpu.SemaphoreType.DMA(()),                # panel_sem
+            pltpu.SemaphoreType.DMA((n_buf,)),          # panel_sem (per buf)
             pltpu.SemaphoreType.DMA(()),                # local_sem
         ],
         cost_estimate=pl.CostEstimate(
@@ -277,3 +313,40 @@ def a2a_gemm(x, w, *, ctx: MeshContext, axis: str = "tp",
     n, c, d = recv.shape
     return jnp.dot(recv.reshape(n * c, d), w,
                    preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def a2a_gemm_tuned(x, w, mesh: MeshContext, *, axis: str = "tp",
+                   configs=None, **kw):
+    """Autotuned fused A2A+GEMM: sweeps block configs AND the
+    overlap-engine knobs (``swizzle_mode``, ``prefetch_depth``) on
+    first use per (mesh shape, C/d/N, dtype) key and persists the
+    winner (the ag_gemm_tuned contract extended to the a2a family)."""
+    from triton_dist_tpu import tune
+    from triton_dist_tpu.autotuner import autotune
+
+    if configs is None:
+        configs = [
+            {"block_m": 512, "block_n": 512, "block_k": 1024},
+            {"block_m": 256, "block_n": 512, "block_k": 2048},
+            {"block_m": 256, "block_n": 256, "block_k": 512},
+            # Overlap-engine sweep: deeper panel pipelining and the
+            # source-order baseline.
+            {"block_m": 256, "block_n": 256, "block_k": 512,
+             "prefetch_depth": 3},
+            {"block_m": 256, "block_n": 256, "block_k": 512,
+             "swizzle_mode": "identity"},
+        ]
+
+    @autotune("a2a_gemm", configs,
+              key_fn=lambda x_, w_, **kk: {
+                  "c": x_.shape[1], "d": x_.shape[2], "n": w_.shape[1],
+                  "dtype": str(x_.dtype), "world": mesh.size(axis),
+                  "mesh": tune.mesh_key(mesh)})
+    def _run(x_, w_, block_m=256, block_n=256, block_k=512,
+             swizzle_mode="a2a", prefetch_depth=0):
+        fctx = create_a2a_gemm_context(
+            mesh, axis, block_m, block_n, block_k,
+            swizzle_mode=swizzle_mode, prefetch_depth=prefetch_depth)
+        return a2a_gemm_fused(x_, w_, fctx, **kw)
+
+    return _run(x, w)

@@ -35,8 +35,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.lang as dl
-from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.lang import core_call, overlap
 from triton_dist_tpu.parallel.mesh import MeshContext
+from triton_dist_tpu.tune import mesh_key  # noqa: F401  (re-export)
+
+# Overlap-schedule config space (the shared-engine knobs, lang/overlap.py):
+# "ag" walks chunks in ring-arrival order (local first — the reference's
+# threadblock swizzle); "identity" pumps the whole ring convergently
+# before compute, the unswizzled baseline the swizzled schedule is
+# parity-tested and benchmarked against.
+SWIZZLE_MODES = ("ag", "identity")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,8 +70,16 @@ class AGGemmContext:
     # chunk-arrival granularity, currently slower on hardware because
     # aliasing constrains Mosaic's multiple buffering). NOTE: "pipelined"
     # needs >= 2 grid bodies per ring chunk (its arrival wait runs one
-    # body early) and falls back to "panel" below that.
+    # body early) and falls back to "panel" below that; it also requires
+    # swizzle_mode "ag" (its pipeline prefetches chunk k's A block before
+    # the body runs, so step 0 must be the pre-placed local chunk) and
+    # falls back to "panel" under "identity".
     variant: str = "panel"
+    # Overlap-engine knobs (lang/overlap.py): chunk-traversal order and
+    # panel prefetch depth (0 = auto, 1..3 = stage-and-wait / double /
+    # triple buffering), both autotunable via ag_gemm_tuned.
+    swizzle_mode: str = "ag"
+    prefetch_depth: int = 0
 
 
 def create_ag_gemm_context(mesh: MeshContext, axis: str = "tp",
@@ -71,15 +87,24 @@ def create_ag_gemm_context(mesh: MeshContext, axis: str = "tp",
                            block_k: int = 512, out_dtype=None,
                            straggler_rank: int = -1,
                            straggler_delay_iters: int = 0,
-                           variant: str = "panel") -> AGGemmContext:
+                           variant: str = "panel",
+                           swizzle_mode: str = "ag",
+                           prefetch_depth: int = 0) -> AGGemmContext:
     if variant not in ("panel", "pipelined"):
         raise ValueError(f"unknown ag_gemm variant {variant!r} "
                          "(expected 'panel' or 'pipelined')")
+    if swizzle_mode not in SWIZZLE_MODES:
+        raise ValueError(f"unknown ag_gemm swizzle_mode {swizzle_mode!r} "
+                         f"(expected one of {SWIZZLE_MODES})")
+    if not 0 <= prefetch_depth <= 3:
+        raise ValueError(f"prefetch_depth must be 0 (auto) or 1..3, got "
+                         f"{prefetch_depth}")
     return AGGemmContext(mesh=mesh, axis=axis, block_m=block_m,
                          block_n=block_n, block_k=block_k,
                          out_dtype=out_dtype, straggler_rank=straggler_rank,
                          straggler_delay_iters=straggler_delay_iters,
-                         variant=variant)
+                         variant=variant, swizzle_mode=swizzle_mode,
+                         prefetch_depth=prefetch_depth)
 
 
 def ag_gemm_ref(a, b, *, axis: str = "tp", **_):
@@ -101,38 +126,10 @@ def _straggler_spin(acc_v, me, straggler_rank: int, delay_iters: int):
             acc_v[0, 0] = spin * 0.0
 
 
-def _drain_sends(send_sem, chunk_ref, n: int):
-    """Consume all ring send-semaphore counts before kernel exit."""
-    for s in range(n - 1):
-        dl.wait_arrivals(send_sem.at[s], chunk_ref, 1)
-
-
-def _certify_and_forward(k, me, n, right, chunk_of, sim_src_of, send_sem,
-                         recv_sem, *, axis, ctx):
-    """Shared ring-step boundary: certify chunk k+1's arrival (slot k),
-    then forward it right on slot k+1 while the caller computes on it
-    (sim mode sources the forward from the full-A ref instead — the
-    self-ring's wire). Used by both kernel variants' early waits."""
-    nxt = jax.lax.rem(me - (k + 1) + n, n)
-    dl.wait_arrivals(recv_sem.at[k], chunk_of(nxt), 1)
-
-    @pl.when(k + 1 < n - 1)
-    def _():
-        if sim_src_of is not None:
-            nxt2 = jax.lax.rem(me - (k + 2) + 2 * n, n)
-            dl.remote_put(sim_src_of(nxt2), chunk_of(nxt2),
-                          send_sem.at[k + 1], recv_sem.at[k + 1], me,
-                          axis=axis, ctx=ctx)
-        else:
-            dl.remote_put(chunk_of(nxt), chunk_of(nxt), send_sem.at[k + 1],
-                          recv_sem.at[k + 1], right, axis=axis, ctx=ctx)
-    return nxt
-
-
 def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                     recv_sem, panel_sem, local_sem, *, axis: str,
                     ctx: MeshContext, m_loc: int, tm: int, tk: int,
-                    n_ranks: int, n_buf: int, write_ag: bool,
+                    n_ranks: int, n_buf: int, mode: str, write_ag: bool,
                     straggler_rank: int = -1,
                     straggler_delay_iters: int = 0, sim: bool = False):
     k = pl.program_id(0)
@@ -144,56 +141,59 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
     n_k = pl.num_programs(3)
     me = dl.rank(axis)
     n = n_ranks
-    c = jax.lax.rem(me - k + n, n)
+    # Chunk computed at grid step k under the active swizzle mode:
+    # "ag" = ring-arrival order (me - k), "identity" = 0..n-1.
+    c = overlap.chunk_at(k, me, n, mode)
     right = jax.lax.rem(me + 1, n)
     lin = (i * n_j + j) * n_k + kk          # body index within chunk k
     chunk_len = n_i * n_j * n_k
-    # Cross-chunk prefetch mode: with two panel buffers, the chunk-(k+1)
-    # arrival wait, ring forward, and first-panel staging all run near
-    # the end of chunk k — the ring-step boundary exposes neither the
-    # arrival latency nor a cold panel load. Needs >= 2 bodies per
-    # chunk (the wait must precede the boundary body). The staging body
-    # is the second-to-last EXCEPT when each panel is a single body
+    # Cross-chunk prefetch mode (n_buf = prefetch depth d >= 2, resolved
+    # by overlap.choose_depth — which guarantees chunk_len >= 2 here):
+    # the chunk-(k+1) arrival wait, ring forward, and lead-panel staging
+    # all run near the end of chunk k, so the ring-step boundary exposes
+    # neither the arrival latency nor a cold panel load. The staging
+    # body is the second-to-last EXCEPT when each panel is a single body
     # (n_j*n_k == 1): there the second-to-last body still computes from
-    # the buffer the next chunk's panel would land in, so staging moves
-    # to the last body (panel p and p+2 share a buffer; p's compute
-    # must have finished).
-    cross = n_buf > 1 and chunk_len >= 2
+    # a buffer the next chunk's lead panels would land in, so staging
+    # moves to the last body (global panels p and p+d share a buffer;
+    # p's compute must have finished — see overlap.PanelStager's plan).
+    cross = n_buf > 1
     boundary_lin = chunk_len - 2 if n_j * n_k >= 2 else chunk_len - 1
+    # Grid step at which my own chunk is computed (its panels read the
+    # local input, not the ring workspace).
+    own_step = 0 if mode == "ag" else me
 
     chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
+    sim_src = ((lambda r: a_ref.at[pl.ds(r * m_loc, m_loc)])
+               if sim else None)
+    stager = overlap.PanelStager(a_panel, panel_sem, n_buf)
 
-    def start_panel_copy(ii, buf):
-        """Start panel ii of chunk c into a_panel[buf]. My own chunk
-        (k == 0) reads straight from the input; received chunks read
-        from the workspace — arrival certified by the chunk-start wait
-        (non-cross mode, above) or by the previous chunk's boundary
-        body (cross mode, the ``lin == boundary_lin`` block below)."""
-        @pl.when(k == 0)
+    def stage_panel(step, chunk, off, p):
+        """Stage row panel ``off`` of the chunk computed at ``step``
+        into global panel ``p``'s buffer: the own chunk reads straight
+        from the input, every other chunk reads the ring workspace —
+        arrival certified by the chunk-start wait (non-cross mode), the
+        previous chunk's boundary body (cross mode), or the up-front
+        ring pump ("identity" mode)."""
+        @pl.when(step == own_step)
         def _():
-            off = (me * m_loc if sim else 0)
-            pltpu.make_async_copy(a_ref.at[pl.ds(off + ii * tm, tm)],
-                                  a_panel.at[buf], panel_sem).start()
+            base = (me * m_loc if sim else 0)
+            stager.start(a_ref.at[pl.ds(base + off * tm, tm)], p)
 
-        @pl.when(k > 0)
+        @pl.when(step != own_step)
         def _():
-            pltpu.make_async_copy(
-                a_ws.at[pl.ds(c * m_loc + ii * tm, tm)],
-                a_panel.at[buf], panel_sem).start()
-
-    def wait_panel(buf):
-        pltpu.make_async_copy(a_panel.at[buf], a_panel.at[buf],
-                              panel_sem).wait()
+            stager.start(a_ws.at[pl.ds(chunk * m_loc + off * tm, tm)], p)
 
     first = jnp.logical_and(k == 0, lin == 0)
 
     @pl.when(first)
     def _():
-        if cross:
-            # Panel 0 of my own chunk reads the local input — no peer
-            # dependency, so its HBM->VMEM copy hides under the entry
-            # barrier's neighbour round-trip.
-            start_panel_copy(0, 0)
+        if cross and mode == "ag":
+            # Lead panels of chunk 0 (my own chunk) read the local input
+            # — no peer dependency, so their HBM->VMEM copies hide under
+            # the entry barrier's neighbour round-trip.
+            for off in stager.lead_range(n_i):
+                stage_panel(jnp.int32(0), c, off, off)
         _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         # Peers must be in-kernel before any remote traffic.
         dl.barrier_tile(axis, ctx=ctx)
@@ -205,46 +205,50 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
             src0 = (a_ref.at[pl.ds(0, m_loc)] if sim else a_ref)
             pltpu.make_async_copy(src0, chunk_of(me), local_sem).start()
         if n > 1:
+            # Ring kick-off (event 0): deliver ring chunk 1. In sim
+            # (single-chip overlap proxy) the put is self-targeted and
+            # sources the true chunk from the full input — identical
+            # schedule/semaphores/traffic, peer = self, wire = HBM.
             if sim:
-                # Self-simulated ring (single-chip overlap proxy): the
-                # chunk step k+1 will need is DMA'd from the input to my
-                # own workspace — identical schedule/semaphores/traffic
-                # to the real ring, peer = self, wire = HBM.
                 nxt = jax.lax.rem(me - 1 + n, n)
-                dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
-                              chunk_of(nxt), send_sem.at[0],
+                dl.remote_put(sim_src(nxt), chunk_of(nxt), send_sem.at[0],
                               recv_sem.at[0], me, axis=axis, ctx=ctx)
             else:
                 dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
                               recv_sem.at[0], right, axis=axis, ctx=ctx)
+            if mode == "identity":
+                # Unswizzled baseline: pump the WHOLE ring, convergently,
+                # before any compute — all comm latency exposed. This is
+                # the schedule the "ag" swizzle is parity-tested and
+                # benchmarked against.
+                overlap.pump_ring(range(1, n), me=me, world=n, right=right,
+                                  chunk_of=chunk_of, send_sem=send_sem,
+                                  recv_sem=recv_sem, axis=axis, ctx=ctx,
+                                  sim_src_of=sim_src)
+        if cross and mode == "identity":
+            # Chunk 0 is rank 0's chunk (remote unless me == 0) — its
+            # lead panels can only stage after the pump above.
+            for off in stager.lead_range(n_i):
+                stage_panel(jnp.int32(0), c, off, off)
 
     chunk_start = jnp.logical_and(
         i == 0, jnp.logical_and(j == 0, kk == 0))
 
-    if not cross:
+    if mode == "ag" and not cross:
         @pl.when(jnp.logical_and(k > 0, chunk_start))
         def _():
-            # Chunk c arrives from the left neighbour's step-(k-1) put.
-            dl.wait_arrivals(recv_sem.at[k - 1], chunk_of(c), 1)
+            # Ring event k: certify chunk c's arrival (slot k-1) and
+            # forward it right (slot k) while we compute on it.
+            overlap.pump_ring_event(k, me=me, world=n, right=right,
+                                    chunk_of=chunk_of, send_sem=send_sem,
+                                    recv_sem=recv_sem, axis=axis, ctx=ctx,
+                                    sim_src_of=sim_src)
 
-            # Forward it right (steps 1..n-2) while we compute on it.
-            @pl.when(k < n - 1)
-            def _():
-                if sim:
-                    nxt = jax.lax.rem(me - (k + 1) + n, n)
-                    dl.remote_put(a_ref.at[pl.ds(nxt * m_loc, m_loc)],
-                                  chunk_of(nxt), send_sem.at[k],
-                                  recv_sem.at[k], me, axis=axis, ctx=ctx)
-                else:
-                    dl.remote_put(chunk_of(c), chunk_of(c), send_sem.at[k],
-                                  recv_sem.at[k], right, axis=axis,
-                                  ctx=ctx)
-
-    # Global panel index: consecutive panels alternate buffers even
-    # across ring-chunk boundaries (an i-based index collides when n_i
-    # is odd — chunk k's last panel and chunk k+1's first would share).
+    # Global panel index: consecutive panels rotate buffers even across
+    # ring-chunk boundaries (an i-based index collides when n_i is not
+    # a multiple of the depth — chunk k's last panel and chunk k+1's
+    # first would share a buffer).
     p_glob = k * n_i + i
-    buf = jax.lax.rem(p_glob, n_buf) if n_buf > 1 else 0
 
     @pl.when(jnp.logical_and(j == 0, kk == 0))
     def _():
@@ -252,34 +256,38 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
         # loop then slices it in VMEM. (Staging per (j, kk) would either
         # re-read A n_j times or go stale — the panel holds all K.)
         if n_buf == 1:
-            start_panel_copy(i, 0)
-            wait_panel(0)
+            stage_panel(k, c, i, p_glob)
+            stager.wait(p_glob)
         else:
-            # Every panel was prefetched during its predecessor (the
-            # first via the pre-barrier copy, chunk-crossing ones at
-            # the boundary body below) — the wait is warm.
-            wait_panel(buf)
+            # Every panel was staged ahead (lead panels at the warm-up /
+            # boundary sites, the rest by the in-chunk rule below) — the
+            # wait is warm in the steady state.
+            stager.wait(p_glob)
 
-            @pl.when(i + 1 < n_i)
+            @pl.when(i + (n_buf - 1) < n_i)
             def _():
-                start_panel_copy(i + 1, jax.lax.rem(p_glob + 1, n_buf))
+                # In-chunk rule: at panel i's wait point, stage the
+                # panel depth-1 ahead while it is still inside chunk k.
+                stage_panel(k, c, i + (n_buf - 1), p_glob + (n_buf - 1))
 
     if cross and n > 1:
         @pl.when(jnp.logical_and(k < n - 1, lin == boundary_lin))
         def _():
-            # Certify chunk k+1's arrival one body before its first
-            # panel is needed, forward it right, and stage its first
-            # panel — the ring-step boundary costs nothing when the
-            # transfer beat the compute (the steady state).
-            sim_src = ((lambda r: a_ref.at[pl.ds(r * m_loc, m_loc)])
-                       if sim else None)
-            nxt = _certify_and_forward(k, me, n, right, chunk_of, sim_src,
-                                       send_sem, recv_sem, axis=axis,
-                                       ctx=ctx)
-            pltpu.make_async_copy(
-                a_ws.at[pl.ds(nxt * m_loc, tm)],
-                a_panel.at[jax.lax.rem((k + 1) * n_i, n_buf)],
-                panel_sem).start()
+            if mode == "ag":
+                # Certify chunk k+1's arrival one body before its first
+                # panel is needed and forward it right — the ring-step
+                # boundary costs nothing when the transfer beat the
+                # compute (the steady state).
+                overlap.pump_ring_event(k + 1, me=me, world=n, right=right,
+                                        chunk_of=chunk_of,
+                                        send_sem=send_sem,
+                                        recv_sem=recv_sem, axis=axis,
+                                        ctx=ctx, sim_src_of=sim_src)
+            c_next = overlap.chunk_at(k + 1, me, n, mode)
+            for off in stager.lead_range(n_i):
+                stage_panel(k + 1, c_next, off, (k + 1) * n_i + off)
+
+    buf = stager.buf(p_glob)
 
     @pl.when(kk == 0)
     def _():
@@ -300,7 +308,7 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
 
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
-        _drain_sends(send_sem, chunk_of(0), n)
+        overlap.drain_sends(send_sem, chunk_of(0), range(n - 1))
 
     if write_ag:
         @pl.when(last)
@@ -367,14 +375,15 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
                 dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
                               recv_sem.at[0], right, axis=axis, ctx=ctx)
 
-    # Early wait: during chunk k's second-to-last body, certify chunk
-    # k+1's arrival (slot k) and forward it — before the pipeline
-    # prefetches chunk k+1's first A block.
+    # Early wait: during chunk k's second-to-last body, process ring
+    # event k+1 — certify chunk k+1's arrival (slot k) and forward it —
+    # before the pipeline prefetches chunk k+1's first A block.
     @pl.when(jnp.logical_and(k < n - 1, lin == chunk_len - 2))
     def _():
-        _certify_and_forward(k, me, n, right, chunk_of,
-                             sim_chunk if sim else None,
-                             send_sem, recv_sem, axis=axis, ctx=ctx)
+        overlap.pump_ring_event(k + 1, me=me, world=n, right=right,
+                                chunk_of=chunk_of, send_sem=send_sem,
+                                recv_sem=recv_sem, axis=axis, ctx=ctx,
+                                sim_src_of=sim_chunk if sim else None)
 
     @pl.when(kk == 0)
     def _():
@@ -391,7 +400,7 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
 
     @pl.when(jnp.logical_and(last, n > 1))
     def _():
-        _drain_sends(send_sem, chunk_of(0), n)
+        overlap.drain_sends(send_sem, chunk_of(0), range(n - 1))
 
 
 def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
@@ -464,11 +473,14 @@ def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
     return out, a_full
 
 
-def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize):
+def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize,
+                  n_ranks: int):
     """Shared tile-size policy for the panel-staging kernels: clamp tm
-    to the VMEM panel budget, check divisibility, pick the panel buffer
-    count (2 when a double-buffered pair fits and there are >= 2 bodies
-    per ring chunk — the cross-chunk prefetch precondition)."""
+    to the VMEM panel budget, check divisibility, resolve the requested
+    ``prefetch_depth`` against the budget and the grid geometry
+    (:func:`overlap.choose_depth` — depth >= 2 enables the cross-chunk
+    prefetch path; depth is clamped, never rejected, so one tuned config
+    stays runnable across shapes)."""
     tm = min(ctx.block_m, m_loc)
     tn = min(ctx.block_n, n_loc)
     tk = min(ctx.block_k, kdim)
@@ -487,8 +499,9 @@ def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize):
             f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
     n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
     panel_bytes = tm * kdim * itemsize
-    n_buf = 2 if (n_i * n_j * n_k >= 2
-                  and 2 * panel_bytes <= panel_budget) else 1
+    n_buf = overlap.choose_depth(ctx.prefetch_depth, panel_bytes,
+                                 panel_budget, n_i * n_j * n_k,
+                                 n_ranks * n_i)
     return tm, tn, tk, n_i, n_j, n_k, n_buf
 
 
@@ -699,9 +712,17 @@ def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
         # double-count the host call for fail_kth_call plans.
         inner_ctx = dataclasses.replace(ctx, axis=inner_axis)
         return _ag_gemm_impl(a, b, inner_ctx, return_ag=return_ag)
+    if ctx.swizzle_mode != "ag":
+        raise ValueError(
+            "the hierarchical (outer, inner) ag_gemm only has the 'ag' "
+            f"schedule (got swizzle_mode={ctx.swizzle_mode!r})")
+    if ctx.prefetch_depth > 2:
+        # The 2D kernel's staging plan is one-panel-ahead; deeper
+        # requests clamp to classic double buffering.
+        ctx = dataclasses.replace(ctx, prefetch_depth=2)
 
     tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
-        ctx, m_loc, n_loc, kdim, a.dtype.itemsize)
+        ctx, m_loc, n_loc, kdim, a.dtype.itemsize, n)
     m_full = n * m_loc
 
     def c_index(q, i, j, kk):
@@ -846,19 +867,29 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         return (c, a) if return_ag else c
 
     tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
-        ctx, m_loc, n_loc, kdim, a.dtype.itemsize)
-    if n * n_i == 1:
-        n_buf = 1     # a single panel total — nothing to double-buffer
+        ctx, m_loc, n_loc, kdim, a.dtype.itemsize, n)
     m_full = n * m_loc
 
-    if ws is not None and not (ctx.variant == "pipelined"
-                               and n_i * n_j * n_k >= 2):
+    from triton_dist_tpu.utils.distributed import use_interpret
+
+    # Sim-on-interpreter falls back to the panel kernel: the pipelined
+    # variant reads A through a BlockSpec over the ALIASED workspace
+    # input, and the interpret path snapshot-copies aliased operands —
+    # the self-ring's put-delivered chunks land in the output ref where
+    # the pipelined reads can never see them (real multi-rank interpret
+    # discharges through ref state and is unaffected; hardware aliases
+    # for real).
+    pipelined = (ctx.variant == "pipelined" and n_i * n_j * n_k >= 2
+                 and ctx.swizzle_mode == "ag"
+                 and not (sim and use_interpret()))
+    if ws is not None and not pipelined:
         raise ValueError(
             "ws (persistent workspace) applies to the pipelined "
-            "variant only (with >= 2 grid bodies — this grid falls "
-            "back to the panel kernel, whose workspace is an output "
-            "with no init cost to amortize)")
-    if ctx.variant == "pipelined" and n_i * n_j * n_k >= 2:
+            "variant only (with >= 2 grid bodies and the 'ag' "
+            "schedule — this grid falls back to the panel kernel, "
+            "whose workspace is an output with no init cost to "
+            "amortize)")
+    if pipelined:
         out, a_full = _ag_gemm_v2(a, b, ctx, n, m_loc, kdim, n_loc,
                                   out_dtype, tm, tn, tk, n_i, n_j, n_k,
                                   sim=sim, ws=ws)
@@ -866,13 +897,13 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
 
     def c_index(k, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)
-        c = jax.lax.rem(me - k + n, n)
+        c = overlap.chunk_at(k, me, n, ctx.swizzle_mode)
         return (c * n_i + i, j)
 
     kernel = functools.partial(
         _ag_gemm_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
-        tk=tk, n_ranks=n, n_buf=n_buf, write_ag=return_ag,
-        straggler_rank=ctx.straggler_rank,
+        tk=tk, n_ranks=n, n_buf=n_buf, mode=ctx.swizzle_mode,
+        write_ag=return_ag, straggler_rank=ctx.straggler_rank,
         straggler_delay_iters=ctx.straggler_delay_iters, sim=sim)
 
     # The gather workspace is always a second kernel output: Mosaic only
@@ -887,7 +918,7 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
         pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
-        pltpu.SemaphoreType.DMA(()),                # panel_sem
+        pltpu.SemaphoreType.DMA((n_buf,)),          # panel_sem (per buf)
         pltpu.SemaphoreType.DMA(()),                # local_sem
     ]
 
@@ -913,10 +944,13 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     return (out, a_full) if return_ag else out
 
 
+
+
 def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
                   configs=None, **kw):
-    """Autotuned ag_gemm: sweeps block configs on first use per
-    (shape, dtype, mesh) key and persists the winner (reference:
+    """Autotuned ag_gemm: sweeps block configs AND the overlap-engine
+    knobs (``swizzle_mode``, ``prefetch_depth``) on first use per
+    (mesh shape, M/K/N, dtype) key and persists the winner (reference:
     ``@triton_dist.tune.autotune`` on ``ag_gemm``,
     ``allgather_gemm.py:565-569``)."""
     from triton_dist_tpu.autotuner import autotune
@@ -927,6 +961,17 @@ def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
             {"block_m": 512, "block_n": 512, "block_k": 2048},
             {"block_m": 512, "block_n": 1024, "block_k": 1024},
             {"block_m": 256, "block_n": 256, "block_k": 512},
+            # Overlap-engine sweep: deeper panel pipelining for when one
+            # panel of lead time cannot cover the arrival/HBM latency,
+            # and the unswizzled comm-then-compute baseline (wins only
+            # when the problem is too small to hide any transfer — the
+            # tuner proving overlap pays is the point of sweeping it).
+            {"block_m": 256, "block_n": 256, "block_k": 512,
+             "prefetch_depth": 3},
+            {"block_m": 256, "block_n": 512, "block_k": 1024,
+             "prefetch_depth": 1},
+            {"block_m": 256, "block_n": 256, "block_k": 512,
+             "swizzle_mode": "identity"},
         ]
 
     def _prune(cfg, a_, b_):
@@ -943,11 +988,14 @@ def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
     @autotune("ag_gemm", configs,
               key_fn=lambda a_, b_, **kk: {
                   "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
-                  "dtype": str(a_.dtype), "world": mesh.size(axis)},
+                  "dtype": str(a_.dtype), "world": mesh.size(axis),
+                  "mesh": mesh_key(mesh)},
               prune_fn=_prune)
-    def _run(a_, b_, block_m=256, block_n=256, block_k=512):
+    def _run(a_, b_, block_m=256, block_n=256, block_k=512,
+             swizzle_mode="ag", prefetch_depth=0):
         ctx = create_ag_gemm_context(mesh, axis, block_m, block_n,
-                                     block_k)
+                                     block_k, swizzle_mode=swizzle_mode,
+                                     prefetch_depth=prefetch_depth)
         return ag_gemm(a_, b_, ctx, **kw)
 
     return _run(a, b)

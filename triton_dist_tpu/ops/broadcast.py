@@ -70,6 +70,27 @@ def broadcast(x, root: int = 0, *, ctx: MeshContext, axis: str = "tp"):
         return _broadcast_kernel_call(x, int(root), ctx, axis)
 
 
+# Compiled host-level broadcasts, one per (mesh, axis, root) — the
+# barrier_all cache pattern (utils.jit_cache): control-plane broadcasts
+# (uids/metadata) recur with identical geometry, and rebuilding
+# jit(shard_map(...)) per call retraced every time.
+from triton_dist_tpu.utils.jit_cache import CompiledCache, cached_dim0_spmd
+
+_BCAST_HOST_CACHE = CompiledCache(16)
+
+
+def broadcast_host(x, root: int = 0, *, mesh, axis: str = "tp"):
+    """Host-level :func:`broadcast`: ``x`` sharded on dim 0 along
+    ``axis``; every rank's slot is replaced by the root's shard. The
+    shard_map wrapper is compiled once per (mesh, axis, root) and
+    cached — repeat calls are dispatches, not retraces."""
+    root = int(root)
+    return cached_dim0_spmd(
+        _BCAST_HOST_CACHE, mesh, axis, x.ndim, root,
+        lambda xs: broadcast(xs, root, ctx=MeshContext.from_mesh(mesh),
+                             axis=axis))(x)
+
+
 def _broadcast_kernel_call(x, root: int, ctx: MeshContext, axis: str):
     n = ctx.size(axis)
     kernel = functools.partial(_bcast_kernel, axis=axis, ctx=ctx,

@@ -25,8 +25,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.lang as dl
-from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.lang import core_call, overlap
 from triton_dist_tpu.parallel.mesh import MeshContext
+
+# Overlap-schedule config space (lang/overlap.py): "rs" is the
+# reduce-scatter-producer ring order — step s computes chunk
+# (me - s - 1) % n so each chunk's running sum visits ranks in ring
+# sequence, finishing at its owner, with compute hiding every hop.
+# "identity" is the unswizzled baseline: the full partial GEMM first,
+# then a separate ring reduce-scatter — compute and communication
+# fully serialized.
+SWIZZLE_MODES = ("rs", "identity")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,15 +48,31 @@ class GemmRSContext:
     block_n: int = 256
     block_k: int = 512
     out_dtype: Optional[jnp.dtype] = None
+    swizzle_mode: str = "rs"
+    # Staging depth for the INBOUND running sum (this op's analogue of
+    # ag_gemm's panel prefetch): 1 = sync-copy the received tile at its
+    # fold point; 2 (and the 0 = auto default) = start the HBM->VMEM
+    # copy at the tile's first K-block so it rides under the whole MXU
+    # contraction. Depth 3 clamps to 2 — one tile is consumed per fold,
+    # so a single copy of lead time covers the load.
+    prefetch_depth: int = 0
 
 
 def create_gemm_rs_context(mesh: MeshContext, axis: str = "tp",
                            block_m: int = 256, block_n: int = 256,
-                           block_k: int = 512,
-                           out_dtype=None) -> GemmRSContext:
+                           block_k: int = 512, out_dtype=None,
+                           swizzle_mode: str = "rs",
+                           prefetch_depth: int = 0) -> GemmRSContext:
+    if swizzle_mode not in SWIZZLE_MODES:
+        raise ValueError(f"unknown gemm_rs swizzle_mode {swizzle_mode!r} "
+                         f"(expected one of {SWIZZLE_MODES})")
+    if not 0 <= prefetch_depth <= 3:
+        raise ValueError(f"prefetch_depth must be 0 (auto) or 1..3, got "
+                         f"{prefetch_depth}")
     return GemmRSContext(mesh=mesh, axis=axis, block_m=block_m,
                          block_n=block_n, block_k=block_k,
-                         out_dtype=out_dtype)
+                         out_dtype=out_dtype, swizzle_mode=swizzle_mode,
+                         prefetch_depth=prefetch_depth)
 
 
 def gemm_rs_ref(a, b, *, axis: str = "tp", **_):
@@ -71,14 +96,20 @@ def _rs_blocks(ctx: GemmRSContext, m_loc, n_dim, k_loc):
 
 
 def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
-                    acc_v, tmp_v, out_v, send_sem, recv_sem, *,
+                    acc_v, tmp_v, out_v, send_sem, recv_sem, tmp_sem, *,
                     axis: str, ctx: MeshContext, m_loc: int, tm: int,
-                    tn: int, n_ranks: int, sim: bool = False):
+                    tn: int, n_ranks: int, n_buf: int, sim: bool = False):
     """``sim=True`` (single-chip overlap proxy): the ring runs against
     myself — sends, waits, adds, and per-step traffic are all real, but
     the received partial is folded with the runtime weight ``w_ref``
     (0 in sim, 1 in real — a value the compiler cannot fold away), so
-    the per-chunk outputs stay the verifiable local GEMM result."""
+    the per-chunk outputs stay the verifiable local GEMM result.
+
+    ``n_buf`` (resolved from ``ctx.prefetch_depth``): 2 = the received
+    running-sum tile starts its HBM->VMEM copy at the tile's FIRST
+    K-block and is only waited at the fold (the load hides under the
+    contraction); 1 = sync copy at the fold point (the unprefetched
+    baseline the knob is benchmarked against)."""
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -105,6 +136,16 @@ def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
         # Running sum for this step's chunk arrives from the left.
         dl.wait_arrivals(recv_sem.at[s - 1], recv_hbm.at[s - 1], 1)
 
+    if n_buf > 1:
+        @pl.when(jnp.logical_and(s > 0, kk == 0))
+        def _():
+            # Prefetch this tile's inbound partial under the K loop
+            # (arrival was certified at chunk start, which runs earlier
+            # in this same body for i == j == 0).
+            pltpu.make_async_copy(
+                recv_hbm.at[s - 1, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
+                tmp_v, tmp_sem).start()
+
     # Partial product for this (tile, K-block), accumulated over kk.
     @pl.when(kk == 0)
     def _():
@@ -119,9 +160,13 @@ def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
         def _():
             # Add the accumulated partial from upstream devices (weight
             # 1.0; the sim self-ring weights it 0.0 — same VPU work).
-            pltpu.sync_copy(
-                recv_hbm.at[s - 1, pl.ds(i * tm, tm), pl.ds(j * tn, tn)],
-                tmp_v)
+            if n_buf > 1:
+                pltpu.make_async_copy(tmp_v, tmp_v, tmp_sem).wait()
+            else:
+                pltpu.sync_copy(
+                    recv_hbm.at[s - 1, pl.ds(i * tm, tm),
+                                pl.ds(j * tn, tn)],
+                    tmp_v)
             acc_v[...] = acc_v[...] + tmp_v[...] * w_ref[0, 0]
 
         @pl.when(s < n - 1)
@@ -139,7 +184,7 @@ def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
         if sim:
             # Every chunk's (local-partial) result is emitted so the
             # whole output is checkable against the plain GEMM.
-            c = jax.lax.rem(me - s - 1 + 2 * n, n)
+            c = overlap.chunk_at(s, me, n, "rs")
             out_v[...] = acc_v[...].astype(out_v.dtype)
             pltpu.sync_copy(out_v, o_ref.at[pl.ds(c * m_loc + i * tm, tm),
                                             pl.ds(j * tn, tn)])
@@ -163,6 +208,98 @@ def _gemm_rs_kernel(a_ref, b_ref, w_ref, o_ref, recv_hbm, send_hbm,
 
     @pl.when(last)
     def _():
+        for t in range(n - 1):
+            dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
+
+
+def _gemm_rs_identity_kernel(a_ref, b_ref, w_ref, o_ref, part_hbm,
+                             recv_hbm, send_hbm, acc_v, tmp_v, sum_v,
+                             out_v, send_sem, recv_sem, *, axis: str,
+                             ctx: MeshContext, m_loc: int, tm: int,
+                             tn: int, n_ranks: int, sim: bool = False):
+    """Unswizzled baseline ("identity" schedule): the FULL partial GEMM
+    first — chunks walked in plain 0..n-1 order into a partials
+    workspace — then a serialized ring reduce-scatter at the last grid
+    body. Compute and communication never overlap: this is the schedule
+    the "rs" swizzle is parity-tested and benchmarked against.
+
+    Interpret-mesh safety: every ring put sits in the final body's
+    static hop loop — identical sites in identical order on all ranks
+    (the module-level convergence rule in ``lang/overlap.py``).
+    ``sim=True`` matches the ring kernel's proxy contract: self-targeted
+    hops, received partials runtime-weighted by ``w_ref`` (0), per-chunk
+    local results emitted across the full (m_full, N) output."""
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kk = pl.program_id(3)
+    n_i = pl.num_programs(1)
+    n_j = pl.num_programs(2)
+    n_k = pl.num_programs(3)
+    me = dl.rank(axis)
+    n = n_ranks
+    right = me if sim else jax.lax.rem(me + 1, n)
+
+    first = jnp.logical_and(
+        s == 0, jnp.logical_and(i == 0, jnp.logical_and(j == 0, kk == 0)))
+
+    @pl.when(first)
+    def _():
+        dl.barrier_tile(axis, ctx=ctx)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_v[...] = jnp.zeros_like(acc_v)
+
+    acc_v[...] += jnp.dot(a_ref[...], b_ref[...],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        # Chunk s's partial tile is complete — bank it for the reduce
+        # phase (chunk id IS the grid step under "identity").
+        pltpu.sync_copy(acc_v, part_hbm.at[s, pl.ds(i * tm, tm),
+                                           pl.ds(j * tn, tn)])
+        if sim:
+            out_v[...] = acc_v[...].astype(out_v.dtype)
+            pltpu.sync_copy(out_v, o_ref.at[pl.ds(s * m_loc + i * tm, tm),
+                                            pl.ds(j * tn, tn)])
+
+    last = jnp.logical_and(
+        s == n - 1,
+        jnp.logical_and(i == n_i - 1,
+                        jnp.logical_and(j == n_j - 1, kk == n_k - 1)))
+
+    @pl.when(last)
+    def _():
+        # Serialized ring reduce-scatter over the banked partials: hop t
+        # folds and forwards the running sum for chunk (me - t - 1) % n
+        # — the same visit order as the fused "rs" schedule, but with
+        # every hop's latency fully exposed (nothing left to compute).
+        for t in range(n):
+            c_t = overlap.chunk_at(t, me, n, "rs")
+            if t > 0:
+                dl.wait_arrivals(recv_sem.at[t - 1], recv_hbm.at[t - 1],
+                                 1)
+            for ti in range(n_i):
+                for tj in range(n_j):
+                    rows, cols = pl.ds(ti * tm, tm), pl.ds(tj * tn, tn)
+                    pltpu.sync_copy(
+                        part_hbm.at[c_t, rows, cols], sum_v)
+                    if t > 0:
+                        pltpu.sync_copy(recv_hbm.at[t - 1, rows, cols],
+                                        tmp_v)
+                        sum_v[...] = sum_v[...] + tmp_v[...] * w_ref[0, 0]
+                    if t < n - 1:
+                        pltpu.sync_copy(sum_v,
+                                        send_hbm.at[t, rows, cols])
+                    elif not sim:
+                        out_v[...] = sum_v[...].astype(out_v.dtype)
+                        pltpu.sync_copy(out_v, o_ref.at[rows, cols])
+            if t < n - 1:
+                dl.remote_put(send_hbm.at[t], recv_hbm.at[t],
+                              send_sem.at[t], recv_sem.at[t], right,
+                              axis=axis, ctx=ctx)
         for t in range(n - 1):
             dl.wait_arrivals(send_sem.at[t], recv_hbm.at[0], 1)
 
@@ -313,6 +450,10 @@ def _gemm_rs_2d(a, b, ctx: GemmRSContext):
     out_dtype = ctx.out_dtype or a.dtype
     if n_o == 1:
         return gemm_rs(a, b, dataclasses.replace(ctx, axis=inner_axis))
+    if ctx.swizzle_mode != "rs":
+        raise ValueError(
+            "the hierarchical (outer, inner) gemm_rs only has the 'rs' "
+            f"schedule (got swizzle_mode={ctx.swizzle_mode!r})")
     if m_full % n:
         raise ValueError(f"M={m_full} not divisible by mesh size {n}")
     m_loc = m_full // n
@@ -434,19 +575,67 @@ def _gemm_rs_impl(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
         raise ValueError(f"M={m_full} not divisible by axis size {n}")
     m_loc = m_full // n
     tm, tn, tk, n_i, n_j, n_k = _rs_blocks(ctx, m_loc, n_dim, k_loc)
+    mode = ctx.swizzle_mode
+    # Inbound-partial staging depth: one tile per fold, so anything
+    # deeper than classic double buffering clamps to 2 (0 = auto = 2).
+    n_buf = 1 if ctx.prefetch_depth == 1 else 2
 
     def a_index(s, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)
-        c = jax.lax.rem(me - s - 1 + n, n)
+        c = overlap.chunk_at(s, me, n, mode)
         return (c * n_i + i, kk)
-
-    kernel = functools.partial(
-        _gemm_rs_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
-        tn=tn, n_ranks=n, sim=sim)
 
     # Runtime fold weight for received partials (see kernel docstring).
     w_recv = jnp.full((1, 1), 0.0 if sim else 1.0, jnp.float32)
     out_rows = m_full if sim else m_loc
+
+    in_specs = [
+        pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((tk, tn), lambda s, i, j, kk: (kk, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1), lambda s, i, j, kk: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    cost = pl.CostEstimate(
+        flops=2 * m_full * k_loc * n_dim,
+        bytes_accessed=(m_full * k_loc + k_loc * n_dim * n * n_i
+                        + m_loc * n_dim) * a.dtype.itemsize,
+        transcendentals=0,
+    )
+    ring_ws = jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
+                                   jnp.float32)
+
+    if mode == "identity":
+        kernel = functools.partial(
+            _gemm_rs_identity_kernel, axis=ctx.axis, ctx=mesh,
+            m_loc=m_loc, tm=tm, tn=tn, n_ranks=n, sim=sim)
+        out, *_ = core_call(
+            kernel,
+            comm=True,
+            grid=(n, n_i, n_j, n_k),
+            out_shape=(
+                jax.ShapeDtypeStruct((out_rows, n_dim), out_dtype),
+                jax.ShapeDtypeStruct((n, m_loc, n_dim), jnp.float32),
+                ring_ws, ring_ws,
+            ),
+            in_specs=in_specs,
+            out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
+                            for _ in range(4)),
+            scratch_shapes=[
+                pltpu.VMEM((tm, tn), jnp.float32),           # acc_v
+                pltpu.VMEM((tm, tn), jnp.float32),           # tmp_v
+                pltpu.VMEM((tm, tn), jnp.float32),           # sum_v
+                pltpu.VMEM((tm, tn), out_dtype),             # out_v
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # send_sem
+                pltpu.SemaphoreType.DMA((max(n - 1, 1),)),   # recv_sem
+            ],
+            cost_estimate=cost,
+        )(a, b, w_recv)
+        return out
+
+    kernel = functools.partial(
+        _gemm_rs_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc, tm=tm,
+        tn=tn, n_ranks=n, n_buf=n_buf, sim=sim)
 
     # Ring workspaces are extra outputs (Mosaic forbids HBM scratch on
     # real TPUs); callers discard them.
@@ -456,18 +645,9 @@ def _gemm_rs_impl(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
         grid=(n, n_i, n_j, n_k),
         out_shape=(
             jax.ShapeDtypeStruct((out_rows, n_dim), out_dtype),
-            jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
-                                 jnp.float32),
-            jax.ShapeDtypeStruct((max(n - 1, 1), m_loc, n_dim),
-                                 jnp.float32),
+            ring_ws, ring_ws,
         ),
-        in_specs=[
-            pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((tk, tn), lambda s, i, j, kk: (kk, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda s, i, j, kk: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY)),
@@ -477,13 +657,9 @@ def _gemm_rs_impl(a, b, ctx: GemmRSContext, *, force_kernel: bool = False,
             pltpu.VMEM((tm, tn), out_dtype),                 # out_v
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # send_sem
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),       # recv_sem
+            pltpu.SemaphoreType.DMA(()),                     # tmp_sem
         ],
-        cost_estimate=pl.CostEstimate(
-            flops=2 * m_full * k_loc * n_dim,
-            bytes_accessed=(m_full * k_loc + k_loc * n_dim * n * n_i
-                            + m_loc * n_dim) * a.dtype.itemsize,
-            transcendentals=0,
-        ),
+        cost_estimate=cost,
     )(a, b, w_recv)
     return out
 
@@ -495,6 +671,7 @@ def gemm_rs_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
     before timing): configs whose modeled VMEM cannot lower, or whose
     modeled roofline time is >2x the best candidate's, are vetoed
     without a compile."""
+    from triton_dist_tpu import tune
     from triton_dist_tpu.autotuner import autotune
     from triton_dist_tpu.tools.perf_model import (
         gemm_rs_vmem_bytes, gemm_time_model_s,
@@ -506,6 +683,15 @@ def gemm_rs_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
             {"block_m": 512, "block_n": 128, "block_k": 4096},
             {"block_m": 512, "block_n": 128, "block_k": 2048},
             {"block_m": 256, "block_n": 256, "block_k": 1024},
+            # Overlap-engine sweep (lang/overlap.py knobs): the
+            # unprefetched fold (does hiding the partial load under the
+            # contraction pay at this shape?) and the serialized
+            # comm-after-compute baseline (wins only when the problem
+            # is too small to hide any hop).
+            {"block_m": 512, "block_n": 128, "block_k": 4096,
+             "prefetch_depth": 1},
+            {"block_m": 512, "block_n": 128, "block_k": 2048,
+             "swizzle_mode": "identity"},
         ]
 
     def _prune(cfg, a_, b_):
@@ -537,11 +723,14 @@ def gemm_rs_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
     @autotune("gemm_rs", configs,
               key_fn=lambda a_, b_, **kk: {
                   "m": a_.shape[0], "k": a_.shape[1], "n": b_.shape[1],
-                  "dtype": str(a_.dtype), "world": mesh.size(axis)},
+                  "dtype": str(a_.dtype), "world": mesh.size(axis),
+                  "mesh": tune.mesh_key(mesh)},
               prune_fn=_prune)
-    def _run(a_, b_, block_m=256, block_n=256, block_k=512):
+    def _run(a_, b_, block_m=256, block_n=256, block_k=512,
+             swizzle_mode="rs", prefetch_depth=0):
         ctx = create_gemm_rs_context(mesh, axis, block_m, block_n,
-                                     block_k)
+                                     block_k, swizzle_mode=swizzle_mode,
+                                     prefetch_depth=prefetch_depth)
         return gemm_rs(a_, b_, ctx, **kw)
 
     return _run(a, b)

@@ -138,3 +138,26 @@ def p2p_put(x, perm: Sequence[Tuple[int, int]], *, ctx: MeshContext,
                 out = jnp.where(me == d, full[s], out)
             return out
         return _p2p_put_diff(x, perm, ctx, axis)
+
+
+# Compiled host-level transports, one per (mesh, axis, perm) — the
+# barrier_all cache pattern (utils.jit_cache): pipeline drivers calling
+# per microbatch used to rebuild jit(shard_map(...)) each step and
+# retrace every call.
+from triton_dist_tpu.utils.jit_cache import CompiledCache, cached_dim0_spmd
+
+_P2P_HOST_CACHE = CompiledCache(16)
+
+
+def p2p_put_host(x, perm: Sequence[Tuple[int, int]], mesh, *,
+                 axis: str = "pp"):
+    """Host-level :func:`p2p_put`: ``x`` sharded on dim 0 along
+    ``axis``; each (src, dst) edge moves src's shard into dst's slot
+    (non-receivers get zeros). The shard_map wrapper is compiled once
+    per (mesh, axis, perm) and cached — repeat calls are dispatches,
+    not retraces."""
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    return cached_dim0_spmd(
+        _P2P_HOST_CACHE, mesh, axis, x.ndim, perm,
+        lambda xs: p2p_put(xs, perm, ctx=MeshContext.from_mesh(mesh),
+                           axis=axis))(x)

@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.lang as dl
-from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.lang import core_call, overlap
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
@@ -176,8 +176,8 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
                        recv_sem, k_sem, v_sem, *, inner_axis: str,
                        outer_axis: Optional[str], ctx: MeshContext,
                        n_inner: int, n_outer: int, s_loc: int, kvh: int,
-                       rep: int, tq: int, tkv: int, causal: bool,
-                       varlen: bool, sim: bool = False):
+                       rep: int, tq: int, tkv: int, n_buf: int,
+                       causal: bool, varlen: bool, sim: bool = False):
     i = pl.program_id(0)   # query tile (outer: arrival waits only at i=0)
     k = pl.program_id(1)   # chunk step; src = (me - k) mod n
     n_i = pl.num_programs(0)
@@ -195,7 +195,10 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
         ii = dl.rank(inner_axis)
         oo = dl.rank(outer_axis) if outer_axis is not None else 0
     me = oo * ni + ii  # global rank, outer-major (canonical mesh order)
-    src = jax.lax.rem(me - k + n, n)
+    # Chunk consumed at step k: ring-arrival order, local chunk first —
+    # the overlap engine's "ag" schedule (the causal pruning below
+    # depends on it: src = me - k without wrap iff k <= me).
+    src = overlap.chunk_at(k, me, n, "ag")
 
     if varlen:
         # Per-sequence send/compute pruning: chunk dst reads chunk
@@ -241,12 +244,12 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
     scale = 1.0 / (float(hd) ** 0.5)
 
     def slot_for(src_glob, dst_glob):
-        """Arrival-semaphore slot for chunk ``src_glob`` at ``dst_glob``:
-        (src - dst) mod n - 1. The receiver processes that chunk at step
+        """Arrival-semaphore slot for chunk ``src_glob`` at ``dst_glob``
+        (overlap.a2a_slot): the receiver processes that chunk at step
         k = (dst - src) mod n, so this is slot n - k - 1 — matching the
         receiver's wait below. Both sides compute it from rank
         arithmetic — no handshake."""
-        return jax.lax.rem(src_glob - dst_glob + 2 * n, n) - 1
+        return overlap.a2a_slot(src_glob, dst_glob, n)
 
     # Flat send-semaphore enumeration (n-1 sends total per rank):
     # [0, ni-1)            inner pushes of my own chunk
@@ -374,51 +377,48 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
 
     n_t = kvh * n_kv  # flat KV-tile loop: t -> (head g, kv tile kvt)
 
-    def start_kv(t: int, buf: int):
-        """Stage KV tile t into panel slot buf: own chunk straight from
-        the input, received chunks from the RDMA-fed workspace. K and V
-        ride separate semaphores so the two copies overlap."""
+    # Depth-n_buf KV tile staging (overlap.PanelStager — the ag_gemm
+    # panel discipline with the prefetch_depth knob): K and V ride
+    # separate per-buffer semaphores so the two copies overlap.
+    ks = overlap.PanelStager(k_panel, k_sem, n_buf)
+    vs = overlap.PanelStager(v_panel, v_sem, n_buf)
+
+    def start_kv(t: int):
+        """Stage KV tile t into its rotating panel buffer: own chunk
+        straight from the input, received chunks from the RDMA-fed
+        workspace."""
         g, kvt = t // n_kv, t % n_kv
 
         own_off = (n - 1) * s_loc if sim else 0  # sim input holds FULL S
 
         @pl.when(k == 0)
         def _():
-            pltpu.make_async_copy(
-                k_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)],
-                k_panel.at[buf], k_sem).start()
-            pltpu.make_async_copy(
-                v_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)],
-                v_panel.at[buf], v_sem).start()
+            ks.start(k_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)], t)
+            vs.start(v_ref.at[g, pl.ds(own_off + kvt * tkv, tkv)], t)
 
         @pl.when(k > 0)
         def _():
-            pltpu.make_async_copy(
-                k_ws.at[src, g, pl.ds(kvt * tkv, tkv)], k_panel.at[buf],
-                k_sem).start()
-            pltpu.make_async_copy(
-                v_ws.at[src, g, pl.ds(kvt * tkv, tkv)], v_panel.at[buf],
-                v_sem).start()
-
-    def wait_kv(buf: int):
-        pltpu.make_async_copy(k_panel.at[buf], k_panel.at[buf],
-                              k_sem).wait()
-        pltpu.make_async_copy(v_panel.at[buf], v_panel.at[buf],
-                              v_sem).wait()
+            ks.start(k_ws.at[src, g, pl.ds(kvt * tkv, tkv)], t)
+            vs.start(v_ws.at[src, g, pl.ds(kvt * tkv, tkv)], t)
 
     @pl.when(need)
     def _():
         q_tile = q_ref[...]  # (H, tq, hd) — pipelined by BlockSpec
         for t in range(n_t):
             g, kvt = t // n_kv, t % n_kv
-            buf = t % 2
-            # Double-buffered staging (ag_gemm panel pattern): tile t+1
-            # transfers while tile t computes; only t=0 blocks cold.
-            if t == 0:
-                start_kv(0, 0)
-            wait_kv(buf)
-            if t + 1 < n_t:
-                start_kv(t + 1, (t + 1) % 2)
+            buf = t % n_buf
+            # Pipelined staging, depth n_buf: tiles t+1..t+n_buf-1
+            # transfer while tile t computes; only t=0 blocks cold
+            # (n_buf=1 is stage-and-wait).
+            if n_buf == 1:
+                start_kv(t)
+            elif t == 0:
+                for p in range(min(n_buf - 1, n_t)):
+                    start_kv(p)
+            ks.wait(t)
+            vs.wait(t)
+            if n_buf > 1 and t + n_buf - 1 < n_t:
+                start_kv(t + n_buf - 1)
 
             q_g = q_tile[g * rep:(g + 1) * rep].reshape(rep * tq, hd)
             s = jax.lax.dot_general(
@@ -524,7 +524,7 @@ def _sp_ag_attn_kernel(q_ref, k_ref, v_ref, cu_ref, o_ref, k_ws, v_ws,
 
 def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
                      block_q, block_kv, cu_seqlens=None,
-                     sim_ranks: int = 0):
+                     sim_ranks: int = 0, prefetch_depth: int = 0):
     """Shared host-side setup for the 1D and hierarchical fused forms.
 
     ``sim_ranks > 1`` (1-device axis): q/k/v hold the FULL sequence;
@@ -565,6 +565,13 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
     while tkv > 1 and s_loc % tkv:
         tkv //= 2
     n_qt = s_loc // tq
+    # KV panel prefetch depth (overlap.choose_depth): K+V panel pair per
+    # buffer against the VMEM budget; one flat KV-tile loop of
+    # kvh · (s_loc // tkv) panels per chunk.
+    n_t = kvh * (s_loc // tkv)
+    n_buf = overlap.choose_depth(
+        prefetch_depth, 2 * tkv * hd * k.dtype.itemsize,
+        4 * 1024 * 1024, n_t, n_t)
 
     # Head-major layouts: per-head KV rows are contiguous for staging,
     # and the chunk push is one dense (KVH, S_loc, hd) DMA.
@@ -575,8 +582,8 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
     kernel = functools.partial(
         _sp_ag_attn_kernel, inner_axis=inner_axis, outer_axis=outer_axis,
         ctx=ctx, n_inner=ni, n_outer=no, s_loc=s_loc,
-        kvh=kvh, rep=rep, tq=tq, tkv=tkv, causal=causal, varlen=varlen,
-        sim=sim)
+        kvh=kvh, rep=rep, tq=tq, tkv=tkv, n_buf=n_buf, causal=causal,
+        varlen=varlen, sim=sim)
 
     # Sim: query tiles come from the last simulated rank's slice of the
     # FULL q (the kernel's output covers only that slice).
@@ -606,15 +613,15 @@ def _sp_ag_attn_call(q, k, v, *, ctx, inner_axis, outer_axis, causal,
             pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, tkv, hd), k.dtype),           # k_panel (dbuf)
-            pltpu.VMEM((2, tkv, hd), v.dtype),           # v_panel (dbuf)
+            pltpu.VMEM((n_buf, tkv, hd), k.dtype),       # k_panel
+            pltpu.VMEM((n_buf, tkv, hd), v.dtype),       # v_panel
             pltpu.VMEM((kvh, rep * tq), jnp.float32),    # m_v
             pltpu.VMEM((kvh, rep * tq), jnp.float32),    # l_v
             pltpu.VMEM((kvh, rep * tq, hd), jnp.float32),  # acc_v
             pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),  # send_sem
             pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),  # recv_sem
-            pltpu.SemaphoreType.DMA(()),                  # k_sem
-            pltpu.SemaphoreType.DMA(()),                  # v_sem
+            pltpu.SemaphoreType.DMA((n_buf,)),            # k_sem (per buf)
+            pltpu.SemaphoreType.DMA((n_buf,)),            # v_sem (per buf)
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * n * s_loc * s_loc * h * hd,
@@ -630,7 +637,7 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
                           causal: bool = True, block_q: int = 256,
                           block_kv: int = 1024, cu_seqlens=None,
                           force_kernel: bool = False,
-                          sim_ranks: int = 0):
+                          sim_ranks: int = 0, prefetch_depth: int = 0):
     """Kernel-level KV-allgather attention (call inside shard_map).
 
     q: (S_loc, H, hd); k/v: (S_loc, KVH, hd), sequence-sharded along
@@ -664,12 +671,14 @@ def sp_ag_attention_fused(q, k, v, *, ctx: MeshContext, axis: str = "sp",
         return _sp_ag_attention_fused_impl(
             q, k, v, ctx=ctx, axis=axis, causal=causal, block_q=block_q,
             block_kv=block_kv, cu_seqlens=cu_seqlens,
-            force_kernel=force_kernel, sim_ranks=sim_ranks)
+            force_kernel=force_kernel, sim_ranks=sim_ranks,
+            prefetch_depth=prefetch_depth)
 
 
 def _sp_ag_attention_fused_impl(q, k, v, *, ctx: MeshContext, axis,
                                 causal, block_q, block_kv, cu_seqlens,
-                                force_kernel, sim_ranks):
+                                force_kernel, sim_ranks,
+                                prefetch_depth: int = 0):
     n = ctx.size(axis)
     if sim_ranks and sim_ranks > 1:
         # Single-chip overlap proxy (bench.py): play the LAST of
@@ -689,20 +698,23 @@ def _sp_ag_attention_fused_impl(q, k, v, *, ctx: MeshContext, axis,
         return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=axis,
                                 outer_axis=None, causal=causal,
                                 block_q=block_q, block_kv=block_kv,
-                                sim_ranks=sim_ranks)
+                                sim_ranks=sim_ranks,
+                                prefetch_depth=prefetch_depth)
     if n == 1 and not force_kernel:
         return _masked_attn(q, k, v, 0, causal=causal,
                             cu_seqlens=cu_seqlens)
     return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=axis,
                             outer_axis=None, causal=causal,
                             block_q=block_q, block_kv=block_kv,
-                            cu_seqlens=cu_seqlens)
+                            cu_seqlens=cu_seqlens,
+                            prefetch_depth=prefetch_depth)
 
 
 def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
                        inner_axis: str = "sp", outer_axis: str = "dp",
                        causal: bool = True, block_q: int = 256,
-                       block_kv: int = 1024, cu_seqlens=None):
+                       block_kv: int = 1024, cu_seqlens=None,
+                       prefetch_depth: int = 0):
     """Hierarchical (ICI/DCN) KV-allgather attention — the inter-node
     schedule (reference ``sp_ag_attention_inter_node.py:116,329,505``).
 
@@ -733,4 +745,5 @@ def sp_ag_attention_2d(q, k, v, *, ctx: MeshContext,
     return _sp_ag_attn_call(q, k, v, ctx=ctx, inner_axis=inner_axis,
                             outer_axis=outer_axis, causal=causal,
                             block_q=block_q, block_kv=block_kv,
-                            cu_seqlens=cu_seqlens)
+                            cu_seqlens=cu_seqlens,
+                            prefetch_depth=prefetch_depth)

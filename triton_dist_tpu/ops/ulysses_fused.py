@@ -37,8 +37,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.lang as dl
-from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.lang import core_call, overlap
 from triton_dist_tpu.parallel.mesh import MeshContext
+
+# Overlap-schedule config space (lang/overlap.py) for the CONSUMER side
+# (o_a2a_gemm): "a2a" walks sources by ring offset starting with the
+# local chunk — compute starts immediately while every remote chunk is
+# in flight; "identity" walks sources in plain 0..n-1 order (the first
+# sources are usually remote, so their flight time is exposed) — the
+# baseline the swizzle is parity-tested and benchmarked against. The
+# producer side (qkv_gemm_a2a) keeps its static peer walk: its chunk
+# ORDER is the output-production order, not a consumption order (and a
+# dynamic weight index map measured ~20% slower).
+SWIZZLE_MODES = ("a2a", "identity")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,18 +60,33 @@ class UlyssesFusedContext:
     axis: str = "sp"
     block_m: int = 256   # row-panel tile (sequence dim)
     block_n: int = 256   # output-column tile
+    # Overlap-engine knobs (lang/overlap.py): source-traversal order of
+    # the consumer kernel and panel prefetch depth (0 = auto, 1..3 =
+    # stage-and-wait / double / triple buffering), autotunable via
+    # o_a2a_gemm_tuned.
+    swizzle_mode: str = "a2a"
+    prefetch_depth: int = 0
 
 
 def create_ulysses_fused_context(mesh: MeshContext, axis: str = "sp",
-                                 block_m: int = 256, block_n: int = 256
+                                 block_m: int = 256, block_n: int = 256,
+                                 swizzle_mode: str = "a2a",
+                                 prefetch_depth: int = 0
                                  ) -> UlyssesFusedContext:
+    if swizzle_mode not in SWIZZLE_MODES:
+        raise ValueError(f"unknown ulysses swizzle_mode {swizzle_mode!r} "
+                         f"(expected one of {SWIZZLE_MODES})")
+    if not 0 <= prefetch_depth <= 3:
+        raise ValueError(f"prefetch_depth must be 0 (auto) or 1..3, got "
+                         f"{prefetch_depth}")
     return UlyssesFusedContext(mesh=mesh, axis=axis, block_m=block_m,
-                               block_n=block_n)
+                               block_n=block_n, swizzle_mode=swizzle_mode,
+                               prefetch_depth=prefetch_depth)
 
 
 def _qkv_kernel(x_ref, w_ref, out_ref, x_pan, z_row, bsem, psem,
                 recv_sem, *, axis: str, ctx: MeshContext, n_ranks: int,
-                tm: int, n_i: int, n_j: int):
+                tm: int, n_i: int, n_j: int, n_buf: int):
     i = pl.program_id(0)
     po = pl.program_id(1)
     j = pl.program_id(2)
@@ -83,21 +109,30 @@ def _qkv_kernel(x_ref, w_ref, out_ref, x_pan, z_row, bsem, psem,
         # All-peer puts → all-peer entry barrier.
         dl.barrier_all(axis, ctx=ctx)
 
+    # Row panels pipeline depth-`n_buf` deep (overlap.PanelStager —
+    # ag_gemm's A-panel discipline with the depth knob): panel
+    # i + depth - 1 prefetches while i computes. All panels read the
+    # local input, so staging needs no arrival certification.
+    stager = overlap.PanelStager(x_pan, psem, n_buf)
+
+    def stage_row(i2, p):
+        stager.start(x_ref.at[pl.ds(i2 * tm, tm)], p)
+
     @pl.when(jnp.logical_and(po == 0, j == 0))
     def _():
-        # Row panels double-buffer: panel i+1 prefetches while i
-        # computes (same discipline as ag_gemm's A panels).
-        @pl.when(i == 0)
-        def _():
-            pltpu.make_async_copy(x_ref.at[rows], x_pan.at[0],
-                                  psem).start()
-        pltpu.make_async_copy(x_pan.at[0], x_pan.at[0], psem).wait()
+        if n_buf == 1:
+            stage_row(i, i)
+            stager.wait(i)
+        else:
+            @pl.when(i == 0)
+            def _():
+                for off in stager.lead_range(n_i):
+                    stage_row(jnp.int32(off), off)
+            stager.wait(i)
 
-        @pl.when(i + 1 < n_i)
-        def _():
-            pltpu.make_async_copy(
-                x_ref.at[pl.ds((i + 1) * tm, tm)],
-                x_pan.at[jax.lax.rem(i + 1, 2)], psem).start()
+            @pl.when(i + (n_buf - 1) < n_i)
+            def _():
+                stage_row(i + (n_buf - 1), i + (n_buf - 1))
 
     @pl.when(j == 0)
     def _():
@@ -112,7 +147,7 @@ def _qkv_kernel(x_ref, w_ref, out_ref, x_pan, z_row, bsem, psem,
     # flush and the put are ONE async DMA per (row panel, peer),
     # directly from VMEM — per-tile sync stores measured 14x slower.
     z_row[p2, :, pl.ds(j * tn, tn)] = jnp.dot(
-        x_pan[jax.lax.rem(i, 2)], w_ref[0],
+        x_pan[stager.buf(i)], w_ref[0],
         preferred_element_type=jnp.float32).astype(z_row.dtype)
 
     @pl.when(j == n_j - 1)
@@ -181,10 +216,17 @@ def _qkv_gemm_a2a_kernel_call(x, w, ctx, n, s_loc, cols):
         raise ValueError(f"(block_m={tm}, block_n={tn}) must divide "
                          f"(S_loc={s_loc}, cols_loc={cols})")
     n_i, n_j = s_loc // tm, cols // tn
+    # chunk_len=None: the row panels all read the LOCAL input (no
+    # arrival certification), so staging panel i+1 under panel i's GEMM
+    # is safe even at one body per (row, peer) chunk (the historical
+    # hardcoded double buffer). Depth still clamps to the n_i panels.
+    n_buf = overlap.choose_depth(ctx.prefetch_depth,
+                                 tm * d * x.dtype.itemsize,
+                                 4 * 1024 * 1024, None, n_i)
 
     kernel = functools.partial(
         _qkv_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n, tm=tm,
-        n_i=n_i, n_j=n_j)
+        n_i=n_i, n_j=n_j, n_buf=n_buf)
 
     def w_index(i, po, j):
         return (po, 0, j)
@@ -202,10 +244,10 @@ def _qkv_gemm_a2a_kernel_call(x, w, ctx, n, s_loc, cols):
         # otherwise try to place the full-size buffer in VMEM.
         out_specs=pl.BlockSpec(memory_space=pltpu.HBM),  # recv buffer
         scratch_shapes=[
-            pltpu.VMEM((2, tm, d), x.dtype),            # x panels
+            pltpu.VMEM((n_buf, tm, d), x.dtype),        # x panels
             pltpu.VMEM((2, tm, cols), x.dtype),         # z_row parity
             pltpu.SemaphoreType.DMA((2,)),              # z_row busy
-            pltpu.SemaphoreType.DMA(()),                # panel prefetch
+            pltpu.SemaphoreType.DMA((n_buf,)),          # panel (per buf)
             pltpu.SemaphoreType.DMA(()),                # recv aggregate
         ],
         cost_estimate=pl.CostEstimate(
@@ -220,17 +262,29 @@ def _qkv_gemm_a2a_kernel_call(x, w, ctx, n, s_loc, cols):
 
 def _o_kernel(o_ref, w_ref, out_ref, recv_ws, panel, acc_v, send_sem,
               recv_sem, psem, *, axis: str, ctx: MeshContext,
-              n_ranks: int, s_loc: int, tm: int, n_j: int):
+              n_ranks: int, s_loc: int, tm: int, n_j: int, n_buf: int,
+              mode: str, sim: bool = False):
+    """``mode`` (overlap-engine swizzle): source consumed at grid step
+    ``k`` is ``overlap.chunk_at(k, me, n, mode)`` — "a2a" starts on the
+    local chunk (zero exposed latency) and eats arrivals by ring
+    offset; "identity" is the plain 0..n-1 source order. The partial
+    sums commute, so any order is numerically identical.
+
+    ``sim=True`` (single-chip overlap proxy, ag_gemm's contract): the
+    n-1 remote sources become self-puts sourcing row-chunk ``src`` of
+    the input — same slots, waits, staging, and per-step traffic; wire
+    = HBM. The input is then read as "what each source sends me":
+    ``out = sum_src o[src] @ w[src]``."""
     i = pl.program_id(0)
-    k = pl.program_id(1)   # k IS the source rank (static weight map)
+    k = pl.program_id(1)   # grid step; source = chunk_at(k, me, n, mode)
     j = pl.program_id(2)
     n_i = pl.num_programs(0)
     me = dl.rank(axis)
     n = n_ranks
+    src = overlap.chunk_at(k, me, n, mode)
+    own = src == me
     tn = w_ref.shape[-1]   # column tile (out_ref holds the full row)
-    rows = pl.ds(i * tm, tm)
-    lin = i * n + k        # linear (row, source) block index
-    par = jax.lax.rem(lin, 2)
+    lin = i * n + k        # linear (row, step) block index
 
     first = jnp.logical_and(i == 0, jnp.logical_and(k == 0, j == 0))
 
@@ -241,56 +295,79 @@ def _o_kernel(o_ref, w_ref, out_ref, recv_ws, panel, acc_v, send_sem,
         # sequence-owner's chunk now, then eat arrivals under the MXU.
         # Each sender signals its own recv_sem slot so the consumer can
         # certify *which* source landed (a scalar semaphore could be
-        # bumped by a different, not-yet-needed source).
+        # bumped by a different, not-yet-needed source). The put set is
+        # rank-convergent — the swizzle only reorders waits/compute.
         for off in range(1, n):
-            p = jax.lax.rem(me + off, n)
-            dl.remote_put(o_ref.at[pl.ds(p * s_loc, s_loc)],
-                          recv_ws.at[me], send_sem.at[off - 1],
-                          recv_sem.at[me], p, axis=axis, ctx=ctx)
+            if sim:
+                dl.remote_put(o_ref.at[pl.ds(off * s_loc, s_loc)],
+                              recv_ws.at[off], send_sem.at[off - 1],
+                              recv_sem.at[off], me, axis=axis, ctx=ctx)
+            else:
+                p = jax.lax.rem(me + off, n)
+                dl.remote_put(o_ref.at[pl.ds(p * s_loc, s_loc)],
+                              recv_ws.at[me], send_sem.at[off - 1],
+                              recv_sem.at[me], p, axis=axis, ctx=ctx)
 
     @pl.when(jnp.logical_and(
-        jnp.logical_and(i == 0, j == 0), k != me))
+        jnp.logical_and(i == 0, j == 0), jnp.logical_not(own)))
     def _():
-        dl.wait_arrivals(recv_sem.at[k], recv_ws.at[0], 1)
+        dl.wait_arrivals(recv_sem.at[src], recv_ws.at[0], 1)
 
-    def start_panel(i2, k2, buf):
-        """Start the (row i2, source k2) panel copy into panel[buf].
-        My own sequence slice reads the input directly."""
-        @pl.when(k2 == me)
+    stager = overlap.PanelStager(panel, psem, n_buf)
+
+    def src_of(k2):
+        return overlap.chunk_at(k2, me, n, mode)
+
+    def start_panel(i2, k2, p):
+        """Stage the (row i2, step k2) panel into global panel ``p``'s
+        buffer. My own sequence slice reads the input directly."""
+        src2 = src_of(k2)
+
+        @pl.when(src2 == me)
         def _():
-            pltpu.make_async_copy(
-                o_ref.at[pl.ds(me * s_loc + i2 * tm, tm)],
-                panel.at[buf], psem).start()
+            stager.start(o_ref.at[pl.ds(me * s_loc + i2 * tm, tm)], p)
 
-        @pl.when(k2 != me)
+        @pl.when(src2 != me)
         def _():
-            pltpu.make_async_copy(
-                recv_ws.at[k2, pl.ds(i2 * tm, tm)], panel.at[buf],
-                psem).start()
+            stager.start(recv_ws.at[src2, pl.ds(i2 * tm, tm)], p)
 
-    # A block's panel may be prefetched during the previous block only
-    # if its source is already certified: any i > 0 row (all sources
-    # were waited during i == 0), or the own-input source k == me.
+    # A block's panel may be staged AHEAD of its step only if its source
+    # is already certified: any i > 0 row (all sources were waited
+    # during the i == 0 sweep), or the own-input source. `ok` is
+    # time-independent, so it doubles as "was this block prefetched".
+    def ok_pred(i2, k2):
+        return jnp.logical_or(i2 > 0, src_of(k2) == me)
+
     @pl.when(j == 0)
     def _():
-        prefetched = jnp.logical_or(i > 0, k == me)
+        if n_buf == 1:
+            start_panel(i, k, lin)
+            stager.wait(lin)
+        else:
+            @pl.when(lin == 0)
+            def _():
+                start_panel(jnp.int32(0), jnp.int32(0), 0)
+                for q in range(1, n_buf - 1):
+                    # Bootstrap lead panels (depth 3): stage what is
+                    # certifiable now; the rest cold-load at their step.
+                    i_q, k_q = q // n, q % n
 
-        @pl.when(jnp.logical_and(lin > 0, jnp.logical_not(prefetched)))
-        def _():
-            start_panel(i, k, par)  # cold load (fresh arrival)
+                    @pl.when(ok_pred(i_q, k_q))
+                    def _(i_q=i_q, k_q=k_q, q=q):
+                        start_panel(jnp.int32(i_q), jnp.int32(k_q), q)
 
-        @pl.when(lin == 0)
-        def _():
-            start_panel(i, k, par)
-        pltpu.make_async_copy(panel.at[0], panel.at[0], psem).wait()
+            @pl.when(jnp.logical_and(lin > 0,
+                                     jnp.logical_not(ok_pred(i, k))))
+            def _():
+                start_panel(i, k, lin)  # cold load (fresh arrival)
+            stager.wait(lin)
 
-        nxt = lin + 1
-        i2, k2 = nxt // n, jax.lax.rem(nxt, n)
-        ok = jnp.logical_or(i2 > 0, k2 == me)
+            nxt = lin + n_buf - 1
+            i2, k2 = jax.lax.div(nxt, n), jax.lax.rem(nxt, n)
 
-        @pl.when(jnp.logical_and(nxt < n_i * n, ok))
-        def _():
-            start_panel(i2, k2, jax.lax.rem(nxt, 2))
+            @pl.when(jnp.logical_and(nxt < n_i * n, ok_pred(i2, k2)))
+            def _():
+                start_panel(i2, k2, nxt)
 
     @pl.when(jnp.logical_and(k == 0, j == 0))
     def _():
@@ -298,7 +375,8 @@ def _o_kernel(o_ref, w_ref, out_ref, recv_ws, panel, acc_v, send_sem,
 
     # Each source's chunk is a partial product over its head rows.
     acc_v[:, pl.ds(j * tn, tn)] += jnp.dot(
-        panel[par], w_ref[0], preferred_element_type=jnp.float32)
+        panel[stager.buf(lin)], w_ref[0],
+        preferred_element_type=jnp.float32)
 
     @pl.when(jnp.logical_and(k == n - 1, j == n_j - 1))
     def _():
@@ -316,7 +394,7 @@ def _o_kernel(o_ref, w_ref, out_ref, recv_ws, panel, acc_v, send_sem,
             dl.wait_arrivals(send_sem.at[off], recv_ws.at[0], 1)
 
 
-def o_a2a_gemm(o, w, ctx: UlyssesFusedContext):
+def o_a2a_gemm(o, w, ctx: UlyssesFusedContext, *, sim_ranks: int = 0):
     """Fused gather all-to-all + O projection.
 
     o: (S, rows_loc) attention output for MY heads over the FULL
@@ -324,8 +402,23 @@ def o_a2a_gemm(o, w, ctx: UlyssesFusedContext):
     grouped by head owner. Returns (S_loc, d) — sequence re-sharded,
     heads re-contracted: equal to ``post_attn_a2a(o) @ W_o`` with the
     A2A hidden under the GEMM (each source chunk is a partial product).
+
+    ``sim_ranks > 1`` (requires a size-1 mesh axis): single-chip
+    overlap proxy — the full A2A schedule runs with self-targeted puts,
+    reading row-chunk ``src`` of ``o`` as "what source ``src`` sends
+    me"; oracle ``einsum("nsr,nrd->sd", o.reshape(n, s_loc, r), w)``.
+    Identical slots, waits, staging, and per-step traffic to the real
+    kernel (and it runs on the CPU interpret mesh, where the real
+    multi-rank form is routed to XLA) — what bench.py and the overlap
+    parity tests measure.
     """
     n = ctx.mesh.size(ctx.axis)
+    sim = bool(sim_ranks and sim_ranks > 1)
+    if sim:
+        if n != 1:
+            raise ValueError("sim_ranks requires a size-1 mesh axis "
+                             f"(got {n} ranks)")
+        n = sim_ranks
     s, rows_loc = o.shape
     n_w, rows_w, d = w.shape
     if n_w != n or rows_w != rows_loc:
@@ -337,17 +430,18 @@ def o_a2a_gemm(o, w, ctx: UlyssesFusedContext):
     from triton_dist_tpu.resilience import faults, policy
 
     with faults.on_op_call("ulysses_fused"):
-        if policy.should_fallback("ulysses_fused"):
+        if policy.should_fallback("ulysses_fused") and not sim:
             # XLA form: exchange per-owner sequence chunks of my heads,
             # then contract each received chunk with its owner's
             # W_o rows and sum the partials.
             recv = jax.lax.all_to_all(
                 o.reshape(n, s_loc, rows_loc), ctx.axis, 0, 0)
             return jnp.einsum("nsr,nrd->sd", recv, w).astype(o.dtype)
-        return _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d)
+        return _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d,
+                                       sim=sim)
 
 
-def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d):
+def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d, sim=False):
     s = n * s_loc
     tm = min(ctx.block_m, s_loc)
     tn = min(ctx.block_n, d)
@@ -355,13 +449,20 @@ def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d):
         raise ValueError(f"(block_m={tm}, block_n={tn}) must divide "
                          f"(S_loc={s_loc}, d={d})")
     n_i, n_j = s_loc // tm, d // tn
+    # chunk_len=None: the o-kernel stages at BLOCK granularity (the
+    # panel index advances every (i, k) block), so the >=2-bodies-per-
+    # chunk precondition for cross-chunk staging does not apply here.
+    n_buf = overlap.choose_depth(ctx.prefetch_depth,
+                                 tm * rows_loc * o.dtype.itemsize,
+                                 4 * 1024 * 1024, None, n * n_i)
 
     kernel = functools.partial(
         _o_kernel, axis=ctx.axis, ctx=ctx.mesh, n_ranks=n, s_loc=s_loc,
-        tm=tm, n_j=n_j)
+        tm=tm, n_j=n_j, n_buf=n_buf, mode=ctx.swizzle_mode, sim=sim)
 
     def w_index(i, k, j):
-        return (k, 0, j)
+        me = jax.lax.axis_index(ctx.axis)
+        return (overlap.chunk_at(k, me, n, ctx.swizzle_mode), 0, j)
 
     out, _ = core_call(
         kernel,
@@ -382,11 +483,11 @@ def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d):
             pl.BlockSpec(memory_space=pltpu.HBM),       # recv buffer
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, tm, rows_loc), o.dtype),     # panel parity
+            pltpu.VMEM((n_buf, tm, rows_loc), o.dtype),  # panels
             pltpu.VMEM((tm, d), jnp.float32),           # acc (all cols)
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send per peer
             pltpu.SemaphoreType.DMA((n,)),              # recv per src
-            pltpu.SemaphoreType.DMA(()),                # panel prefetch
+            pltpu.SemaphoreType.DMA((n_buf,)),          # panel (per buf)
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * s_loc * n * rows_loc * d,
@@ -396,6 +497,42 @@ def _o_a2a_gemm_kernel_call(o, w, ctx, n, s_loc, rows_loc, d):
         ),
     )(o, w)
     return out
+
+
+def o_a2a_gemm_tuned(o, w, mesh: MeshContext, *, axis: str = "sp",
+                     configs=None, **kw):
+    """Autotuned fused A2A+O-projection: sweeps tile sizes AND the
+    overlap-engine knobs (``swizzle_mode``, ``prefetch_depth``) on
+    first use per (mesh shape, S/rows/d, dtype) key and persists the
+    winner (the ag_gemm_tuned contract extended to the Ulysses
+    consumer)."""
+    from triton_dist_tpu import tune
+    from triton_dist_tpu.autotuner import autotune
+
+    if configs is None:
+        configs = [
+            {"block_m": 256, "block_n": 256},
+            {"block_m": 512, "block_n": 512},
+            {"block_m": 128, "block_n": 256},
+            # Overlap-engine sweep: deeper panel pipelining and the
+            # plain 0..n-1 source-order baseline.
+            {"block_m": 256, "block_n": 256, "prefetch_depth": 3},
+            {"block_m": 256, "block_n": 256, "swizzle_mode": "identity"},
+        ]
+
+    @autotune("ulysses_o_a2a_gemm", configs,
+              key_fn=lambda o_, w_, **kk: {
+                  "s": o_.shape[0], "rows": o_.shape[1],
+                  "d": w_.shape[2], "dtype": str(o_.dtype),
+                  "world": mesh.size(axis), "mesh": tune.mesh_key(mesh)})
+    def _run(o_, w_, block_m=256, block_n=256, swizzle_mode="a2a",
+             prefetch_depth=0):
+        fctx = create_ulysses_fused_context(
+            mesh, axis, block_m, block_n, swizzle_mode=swizzle_mode,
+            prefetch_depth=prefetch_depth)
+        return o_a2a_gemm(o_, w_, fctx, **kw)
+
+    return _run(o, w)
 
 
 def group_qkv_columns(w_qkv, *, n: int, num_heads: int, num_kv_heads: int,

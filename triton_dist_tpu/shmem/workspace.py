@@ -57,28 +57,23 @@ def symm_tensor(mesh: Mesh, local_shape: Tuple[int, ...], dtype=jnp.float32,
 
 # Compiled host barriers, one per (mesh, axis): the closure used to be
 # rebuilt and re-jitted on every call, so every test-scaffolding
-# barrier paid a retrace. Mesh is hashable; the cache key is exact.
-# Size-bounded (FIFO eviction) so a process that churns through meshes
-# cannot pin unbounded Mesh objects + compiled executables.
-_BARRIER_CACHE: dict = {}
-_BARRIER_CACHE_MAX = 16
+# barrier paid a retrace (utils.jit_cache.CompiledCache documents the
+# pattern; ops/p2p.py and ops/broadcast.py share it).
+from triton_dist_tpu.utils.jit_cache import CompiledCache
+
+_BARRIER_CACHE = CompiledCache(16)
 
 
 def _compiled_barrier(mesh: Mesh, axis: str):
-    key = (mesh, axis)
-    fn = _BARRIER_CACHE.get(key)
-    if fn is None:
+    def build():
         def inner(x):
             return jax.lax.psum(x, axis)
 
-        fn = jax.jit(jax.shard_map(
+        return jax.jit(jax.shard_map(
             inner, mesh=mesh,
             in_specs=P(), out_specs=P(), check_vma=False,
         ))
-        while len(_BARRIER_CACHE) >= _BARRIER_CACHE_MAX:
-            _BARRIER_CACHE.pop(next(iter(_BARRIER_CACHE)))
-        _BARRIER_CACHE[key] = fn
-    return fn
+    return _BARRIER_CACHE.get_or_build((mesh, axis), build)
 
 
 def barrier_all(mesh: Mesh, axis: str = "tp", *,

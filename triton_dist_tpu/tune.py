@@ -12,6 +12,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import tempfile
 import threading
 from typing import Any, Dict, Optional
 
@@ -20,15 +21,23 @@ _CACHE: Optional[Dict] = None
 _CACHE_PATH: Optional[str] = None
 
 
+def cache_dir() -> str:
+    """The package's persistent cache root (``TRITON_DIST_TPU_CACHE_DIR``,
+    default ``~/.cache/triton_dist_tpu``) — the single resolution point
+    shared by the tune cache, the bench probe verdict, and the
+    megakernel scheduler's read-only-checkout ``.so`` fallback."""
+    base = os.environ.get(
+        "TRITON_DIST_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "triton_dist_tpu"))
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
 def cache_path() -> str:
     global _CACHE_PATH
     if _CACHE_PATH is None:
-        base = os.environ.get(
-            "TRITON_DIST_TPU_CACHE_DIR",
-            os.path.join(os.path.expanduser("~"), ".cache",
-                         "triton_dist_tpu"))
-        os.makedirs(base, exist_ok=True)
-        _CACHE_PATH = os.path.join(base, "tune_cache.json")
+        _CACHE_PATH = os.path.join(cache_dir(), "tune_cache.json")
     return _CACHE_PATH
 
 
@@ -43,6 +52,13 @@ def _dep_versions() -> Dict[str, str]:
         "triton_dist_tpu": triton_dist_tpu.__version__,
         "backend": jax.default_backend(),
     }
+
+
+def mesh_key(mesh) -> str:
+    """Stable mesh-shape attribute for autotune cache keys (the ISSUE-2
+    contract: tuned winners are keyed on (mesh shape, M/N/K, dtype)).
+    ``mesh`` is a :class:`~triton_dist_tpu.parallel.mesh.MeshContext`."""
+    return "x".join(f"{a}{s}" for a, s in zip(mesh.axes, mesh.sizes))
 
 
 def make_key(op: str, **attrs) -> str:
@@ -76,14 +92,27 @@ def load_autotune_data(key: str) -> Optional[Dict[str, Any]]:
 
 def store_autotune_data(key: str, config: Dict[str, Any],
                         seconds: Optional[float] = None) -> None:
+    """Record a tuned winner and persist the whole cache atomically.
+
+    ``_LOCK`` serializes in-process writers; the PRIVATE temp file (not
+    a fixed ``.tmp`` suffix) + ``os.replace`` keeps concurrent
+    PROCESSES from interleaving writes into one half-written file — a
+    reader sees either the old complete JSON or the new one."""
     with _LOCK:
         cache = _load()
         cache[key] = {"config": config, "seconds": seconds,
                       "versions": _dep_versions()}
-        tmp = cache_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(cache, f, indent=1, sort_keys=True)
-        os.replace(tmp, cache_path())
+        fd, tmp = tempfile.mkstemp(
+            prefix=".tune_", suffix=".tmp",
+            dir=os.path.dirname(cache_path()))
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(cache, f, indent=1, sort_keys=True)
+            os.chmod(tmp, 0o644)  # mkstemp's 0600 would break shared caches
+            os.replace(tmp, cache_path())
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
 
 def clear_cache() -> None:

@@ -1,0 +1,66 @@
+"""Bounded compiled-callable caches.
+
+The PR-1 ``barrier_all`` fix generalized: a host-level helper that
+wraps an op in ``jax.jit(jax.shard_map(...))`` used to rebuild the
+closure on every call — a fresh ``jit`` object owns a fresh trace
+cache, so EVERY call retraced and recompiled. Caching the wrapped
+callable per exact key (Mesh is hashable) makes the second call a
+dispatch. FIFO-bounded so a process that churns through meshes cannot
+pin unbounded Mesh objects + compiled executables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable
+
+
+class CompiledCache:
+    """FIFO-bounded ``key -> compiled callable`` map.
+
+    Supports ``len()`` / ``[]`` / ``clear()`` so tests can introspect
+    hits the way they already do for the barrier cache.
+    """
+
+    def __init__(self, max_size: int = 16):
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        self._cache: Dict[Hashable, Any] = {}
+        self.max_size = max_size
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Any]):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build()
+            while len(self._cache) >= self.max_size:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = fn
+        return fn
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def __getitem__(self, key: Hashable):
+        return self._cache[key]
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._cache
+
+
+def cached_dim0_spmd(cache: CompiledCache, mesh, axis: str, ndim: int,
+                     key_extra: Hashable, fn: Callable):
+    """Compiled ``jit(shard_map(fn))`` over one array sharded on dim 0
+    along ``axis``, cached per (mesh, axis, key_extra, ndim) — the
+    shared shape of the host-level transport wrappers (ops.p2p_put_host,
+    ops.broadcast_host). ``fn`` is only traced when the key misses, so
+    captured statics (perm, root) belong in ``key_extra``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def build():
+        spec = P(axis, *([None] * (ndim - 1)))
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,),
+                                     out_specs=spec, check_vma=False))
+    return cache.get_or_build((mesh, axis, key_extra, ndim), build)
