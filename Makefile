@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: csrc test quick race verify-faults bench-smoke apicheck ci bench-all
+.PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
+	apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -33,6 +34,12 @@ verify-faults: csrc
 # bench on the CPU mesh — verify-faults' perf sibling (docs/perf.md).
 bench-smoke: csrc
 	bash scripts/bench_smoke.sh
+
+# Megakernel scheduler battery: dynamic-vs-static token-exactness on the
+# CPU mesh + interpret-mode bench with non-null megakernel values
+# (docs/megakernel.md, dynamic scoreboard scheduler).
+bench-megakernel: csrc
+	bash scripts/bench_megakernel.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

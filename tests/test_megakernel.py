@@ -97,14 +97,28 @@ def tp2_mesh():
     return Mesh(np.array(jax.devices()[:NTP]), ("tp",))
 
 
-@pytest.mark.parametrize("cores,strategy", [(1, "round_robin"),
-                                            (2, "round_robin"),
-                                            (2, "cost_lpt")])
-def test_megakernel_decode_vs_layers(tp2_mesh, cores, strategy):
+@pytest.mark.parametrize("cores,strategy,schedule", [
+    (1, "round_robin", "static"),
+    (2, "round_robin", "static"),
+    (2, "cost_lpt", "static"),
+    (1, "round_robin", "dynamic"),
+    (2, "cost_lpt", "dynamic"),
+])
+def test_megakernel_decode_vs_layers(tp2_mesh, cores, strategy,
+                                     schedule):
     mesh = tp2_mesh
     mb = ModelBuilder(CFG, mesh, batch=B, max_len=MAXLEN, tile_w=16,
-                      t_tile=16, num_cores=cores, strategy=strategy)
-    if cores > 1:
+                      t_tile=16, num_cores=cores, strategy=strategy,
+                      schedule=schedule)
+    if schedule == "dynamic":
+        # The claim list covers every task exactly once, and with
+        # multiple cores the cross-core claim edges really exist.
+        claimed = sorted(int(t) for t in mb.claims.reshape(-1)
+                         if t >= 0)
+        assert claimed == list(range(len(mb.graph.tasks)))
+        if cores > 1:
+            assert mb.n_edges > 0
+    if schedule == "static" and cores > 1:
         # The padded schedule really uses both queues and emits a
         # scoreboard.
         assert (mb.task_types != int(TaskType.NOOP)).any(axis=1).all()
@@ -685,6 +699,262 @@ def test_perfetto_export_labels_timing_model(tp2_mesh):
     assert spans and all(e["args"]["timing"] == "calibrated"
                          for e in spans)
     assert any(e["dur"] > 0 for e in spans)
+
+
+def _graph_cases():
+    """Synthetic dependency graphs for the scheduler sweeps."""
+    chain = ([0, 1, 2], [1, 2, 3], 4)
+    diamond = ([0, 0, 1, 2, 3], [1, 2, 3, 3, 4], 5)
+    # Skewed: a heavy chain plus a crowd of light independents.
+    sk_src = [0, 1, 2]
+    sk_dst = [1, 2, 3]
+    skewed = (sk_src, sk_dst, 12)
+    wide = ([0] * 6, list(range(1, 7)), 8)
+    return {"chain": chain, "diamond": diamond, "skewed": skewed,
+            "wide": wide}
+
+
+@pytest.mark.parametrize("gname", sorted(_graph_cases()))
+@pytest.mark.parametrize("cores", [1, 2, 3, 4])
+def test_scheduler_fairness_every_task_claimed_once(gname, cores):
+    """Starvation sweep: across every (graph, core count, priority
+    bucket) combination — including adversarial priorities that starve
+    a bucket if the claim loop ever could — each task is claimed
+    exactly once, holes only arise from pinning, and the claim order
+    is topologically valid."""
+    from triton_dist_tpu.megakernel.scheduler import schedule_dyn
+
+    src, dst, n = _graph_cases()[gname]
+    rng = np.random.RandomState(hash(gname) % 2 ** 16)
+    for trial in range(3):
+        prio = rng.randint(0, 1 << 20, size=n)
+        bkt = rng.randint(0, 3, size=n)
+        pin = np.where(rng.rand(n) < 0.3,
+                       rng.randint(0, cores, size=n), -1)
+        d = schedule_dyn(n, src, dst, num_cores=cores, priority=prio,
+                         bucket=bkt, task_cost=rng.randint(1, 50, n),
+                         pin_core=pin)
+        order = d["claim_order"]
+        claimed = sorted(int(t) for t in order if t >= 0)
+        assert claimed == list(range(n)), (gname, cores, trial)
+        # claim_of inverts claim_order.
+        for i, t in enumerate(order):
+            if t >= 0:
+                assert d["claim_of"][t] == i
+        # Topological validity + pinning honored.
+        pos = {int(t): i for i, t in enumerate(order) if t >= 0}
+        for a, b2 in zip(src, dst):
+            assert pos[b2] > pos[a]
+        for t in range(n):
+            if pin[t] >= 0:
+                assert pos[t] % cores == pin[t] % cores
+        # Holes can only come from pinning.
+        if (pin < 0).all():
+            assert (order >= 0).all()
+        # Every cross-core wait has a matching signal.
+        assert d["n_edges"] == len(d["wait_edges"]) == len(
+            d["sig_edges"])
+
+
+def test_dynamic_beats_cost_lpt_on_skewed_graph():
+    """The acceptance comparison: on a skewed-cost graph the dynamic
+    claim schedule must show strictly fewer idle scoreboard steps (NOOP
+    slots) AND a strictly better timed model than cost_lpt — the
+    static packer balances total load blind to readiness, so the heavy
+    chain serializes behind padding."""
+    from triton_dist_tpu.megakernel.graph import comm_priority
+    from triton_dist_tpu.megakernel.scheduler import (
+        prune_deps, schedule_dyn, schedule_mc, simulate_static)
+    from triton_dist_tpu.megakernel.task import Task
+
+    # Heavy chain 0->1->2->3 (cost 40 each) + 8 light independents.
+    src = [0, 1, 2]
+    dst = [1, 2, 3]
+    n = 12
+    cost = [40, 40, 40, 40] + [10] * 8
+    tasks = [Task(task_id=i, task_type=TaskType.LINEAR, args=(),
+                  deps=([i - 1] if 1 <= i <= 3 else []))
+             for i in range(n)]
+    prio, bkt, _ = comm_priority(tasks, n_ranks=1, task_cost=cost)
+    # Critical-path priority must rank the chain head first.
+    assert prio[0] == max(prio)
+
+    s = schedule_mc(n, src, dst, num_cores=2, strategy="cost_lpt",
+                    task_cost=cost)
+    ps, pd = prune_deps(n, src, dst)
+    stat = simulate_static(n, ps, pd, s["queue"], task_cost=cost)
+    d = schedule_dyn(n, src, dst, num_cores=2, priority=prio,
+                     bucket=bkt, task_cost=cost)
+
+    static_noops = int((s["queue"] < 0).sum())
+    dyn_slots = -(-d["n_claims"] // 2) * 2
+    dyn_noops = int((d["claim_order"] < 0).sum()) + dyn_slots - d[
+        "n_claims"]
+    assert dyn_noops < static_noops, (dyn_noops, static_noops)
+    assert d["idle_units"] < stat["idle_units"], (d, stat)
+    assert d["makespan"] <= stat["makespan"], (d, stat)
+
+
+def test_dynamic_fewer_idle_steps_interpret_counter(tp2_mesh):
+    """Model-level skewed-cost comparison scored on the INTERPRET-MODE
+    step counter: a profiled step executes strictly fewer NOOP slots
+    under the dynamic scheduler than under cost_lpt when the cost
+    table is skewed (LINEAR weighted heavy)."""
+    mesh = tp2_mesh
+    skew = {int(tt): 1.0 for tt in TaskType}
+    skew[int(TaskType.LINEAR)] = 8.0
+    noops = {}
+    for schedule in ("static", "dynamic"):
+        mb = ModelBuilder(CFG, mesh, batch=B, max_len=MAXLEN, tile_w=16,
+                          t_tile=16, num_cores=2, strategy="cost_lpt",
+                          schedule=schedule, profile=True,
+                          cost_table=skew)
+        params = dense.init_params(jax.random.PRNGKey(0), CFG)
+        specs = dense.param_specs(CFG)
+        cache_shape = (CFG.num_hidden_layers, B, MAXLEN,
+                       CFG.num_key_value_heads, CFG.head_dim)
+        kvspec = P(None, None, None, "tp", None)
+        pack = spmd(mesh, mb.pack_arena, (specs,), P("tp", None))
+        arena = pack(params)
+        step = spmd(mesh, mb.step_fn(),
+                    (P("tp", None), kvspec, kvspec, P(None), P()),
+                    (P(None, "tp"), P("tp", None), kvspec, kvspec,
+                     P(None, None)))
+        _, _, _, _, prof = step(arena, jnp.zeros(cache_shape),
+                                jnp.zeros(cache_shape),
+                                jnp.asarray([1, 2], jnp.int32),
+                                jnp.asarray(0, jnp.int32))
+        prof = np.asarray(prof)
+        executed_noops = int(
+            (prof[:, 0] == int(TaskType.NOOP) + 1).sum())
+        assert executed_noops == mb.noop_slots()
+        noops[schedule] = executed_noops
+        # The profile-feedback fold sees exactly the executed units.
+        assert mb.profile_unit_counts(prof) == mb.task_unit_counts()
+    assert noops["dynamic"] < noops["static"], noops
+
+
+def test_megakernel_dynamic_token_exact_all_families(tp2_mesh):
+    """Acceptance: schedule="dynamic" produces token-exact greedy
+    output vs static on the dense, MoE, and hybrid-GDN families."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models import qwen_moe, qwen_next
+
+    mcfg = ModelConfig.tiny_moe(vocab_size=64, hidden_size=32,
+                                num_hidden_layers=2,
+                                num_attention_heads=4,
+                                num_key_value_heads=2, head_dim=8,
+                                num_experts=4, num_experts_per_tok=2,
+                                moe_intermediate_size=32)
+    hcfg = ModelConfig.tiny_next(vocab_size=64, hidden_size=32,
+                                 num_hidden_layers=4,
+                                 num_attention_heads=4,
+                                 num_key_value_heads=2, head_dim=8,
+                                 gdn_num_heads=8, gdn_head_dim_k=8,
+                                 gdn_head_dim_v=8, full_attn_interval=2)
+    fams = [("dense", CFG, None),
+            ("moe", mcfg, qwen_moe),
+            ("hybrid", hcfg, qwen_next)]
+    for name, cfg, model in fams:
+        params = (model.init_params(jax.random.PRNGKey(21), cfg)
+                  if model is not None
+                  else dense.init_params(jax.random.PRNGKey(21), cfg))
+        toks = {}
+        for schedule in ("static", "dynamic"):
+            eng = MegaKernelEngine(cfg, tp2_mesh, batch=B, max_len=32,
+                                   tile_w=16, t_tile=16, params=params,
+                                   num_cores=2, strategy="cost_lpt",
+                                   schedule=schedule)
+            toks[schedule] = np.asarray(
+                eng.generate(jnp.asarray([3, 7], jnp.int32), steps=4))
+        np.testing.assert_array_equal(
+            toks["static"], toks["dynamic"],
+            err_msg=f"dynamic schedule diverged on {name}")
+
+
+def test_dynamic_dropped_edge_terminates_or_raises(tp2_mesh):
+    """Fault-injection gate: a dropped scoreboard edge under the
+    dynamic scheduler must terminate (the compat interpreter's
+    semaphores never block) or raise — never livelock. The Watchdog
+    deadline converts a livelock into a hard failure."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.resilience import CommTimeoutError, faults
+    from triton_dist_tpu.resilience.watchdog import Watchdog
+
+    plan = faults.get_plan("dropped_edge", op="megakernel", k=0)
+    with faults.inject(plan):
+        eng = MegaKernelEngine(CFG, tp2_mesh, batch=B, max_len=32,
+                               tile_w=16, t_tile=16, seed=4,
+                               num_cores=2, schedule="dynamic")
+        assert eng.builder.n_edges > 0  # the plan has an edge to drop
+        try:
+            toks = Watchdog(120.0, op="megakernel.dynamic").run(
+                lambda: np.asarray(eng.generate(
+                    jnp.zeros((B,), jnp.int32), steps=2)))
+        except CommTimeoutError as e:
+            # A blocking backend wedges on the missing signal — the
+            # structured timeout IS the accepted outcome there.
+            assert e.op == "megakernel.dynamic"
+            return
+    # Non-blocking backend: the run must have terminated with sane
+    # output and the claim-counter progress must be intact.
+    assert toks.shape == (B, 2)
+    prog = eng.progress()
+    assert prog["progress_counter"] == "claim"
+    assert prog["steps_done"] == 2
+
+
+def test_describe_slot_dynamic_and_claim():
+    """describe_slot on a dynamic schedule attributes (q, c) as a
+    claim-counter value: claimed task id, priority bucket, and edge
+    semaphores — not a static queue position."""
+    from triton_dist_tpu.megakernel.scheduler import (
+        describe_claim, schedule_dyn)
+
+    src, dst = [0, 0, 1, 2], [1, 2, 3, 3]
+    d = schedule_dyn(4, src, dst, num_cores=2,
+                     priority=[3, 2, 1, 0], bucket=[0, 0, 1, 1])
+    seen = set()
+    for claim in range(d["n_claims"]):
+        desc = describe_claim(d, claim)
+        assert desc["schedule"] == "dynamic"
+        assert desc["claim"] == claim
+        assert desc["core"] == claim % 2
+        if desc["task"] >= 0:
+            seen.add(desc["task"])
+            assert "bucket" in desc
+    assert seen == {0, 1, 2, 3}
+    from triton_dist_tpu.megakernel.scheduler import describe_slot
+    assert describe_slot(d, 0, 1) == describe_claim(d, 1)
+    # Tail padding past n_claims is named, not an error.
+    tail = describe_claim(d, d["n_claims"] + 1)
+    assert tail["task"] == -1 and tail["tail_padding"]
+
+
+def test_tune_schedule_persists_and_auto_resolves(tp2_mesh, tmp_path,
+                                                 monkeypatch):
+    """The schedule autotune entry: tune_schedule times both modes,
+    persists the winner under the (model, mesh, batch, cores) key, and
+    MegaKernelEngine(schedule="auto") resolves to it from the cache."""
+    import triton_dist_tpu.tune as tune
+    from triton_dist_tpu.megakernel.engine import (
+        MegaKernelEngine, lookup_schedule, tune_schedule)
+
+    monkeypatch.setenv("TRITON_DIST_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(tune, "_CACHE", None)
+    monkeypatch.setattr(tune, "_CACHE_PATH", None)
+
+    assert lookup_schedule(CFG, tp2_mesh, batch=B) == "static"  # untuned
+    winner = tune_schedule(CFG, tp2_mesh, batch=B, max_len=32,
+                           tile_w=16, t_tile=16, reps=1)
+    assert winner in ("static", "dynamic")
+    assert lookup_schedule(CFG, tp2_mesh, batch=B) == winner
+    # Cached: a second call must not re-time (hits the cache).
+    assert tune_schedule(CFG, tp2_mesh, batch=B, max_len=32,
+                         tile_w=16, t_tile=16, reps=1) == winner
+    eng = MegaKernelEngine(CFG, tp2_mesh, batch=B, max_len=32,
+                           tile_w=16, t_tile=16, schedule="auto")
+    assert eng.schedule == winner
 
 
 def test_megakernel_serves_real_checkpoints(tp2_mesh):

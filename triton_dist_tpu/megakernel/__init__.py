@@ -15,11 +15,22 @@ Execution model mapping:
   scoreboard; cross-chip tasks (allreduce) synchronize with DMA
   semaphores. The native C++ scheduler (``csrc/megakernel_scheduler.cc``)
   orders tasks, packs multi-core queues, and prunes dependencies.
+- ``schedule="dynamic"``: instead of walking precomputed per-core slot
+  lists, each grid slot pops the next task off a claim counter in the
+  scoreboard workspace (comm-priority-ordered ready list, per-bucket
+  claim semaphores) — the TPU form of the reference's in-kernel
+  runtime scheduler (docs/megakernel.md, "Dynamic scoreboard
+  scheduling").
 """
 
-from triton_dist_tpu.megakernel.task import TaskType, Task  # noqa: F401
-from triton_dist_tpu.megakernel.graph import Graph  # noqa: F401
-from triton_dist_tpu.megakernel.scheduler import schedule, prune_deps  # noqa: F401
+from triton_dist_tpu.megakernel.task import (  # noqa: F401
+    COLLECTIVE_TYPES, Task, TaskType,
+)
+from triton_dist_tpu.megakernel.graph import Graph, comm_priority  # noqa: F401
+from triton_dist_tpu.megakernel.scheduler import (  # noqa: F401
+    describe_claim, describe_slot, prune_deps, schedule, schedule_dyn,
+    simulate_static,
+)
 from triton_dist_tpu.megakernel.builder import (  # noqa: F401
     ModelBuilder, calibrate_cost_table,
 )

@@ -29,8 +29,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_dist_tpu.lang import core_call, comm_compiler_params
 from triton_dist_tpu.megakernel import kernels as K
-from triton_dist_tpu.megakernel.graph import Graph
-from triton_dist_tpu.megakernel.scheduler import schedule_mc
+from triton_dist_tpu.megakernel.graph import Graph, comm_priority
+from triton_dist_tpu.megakernel.scheduler import (
+    prune_deps, schedule_dyn, schedule_mc, simulate_static)
 from triton_dist_tpu.megakernel.task import ARGS_MAX, TaskType
 from triton_dist_tpu.models.config import ModelConfig
 from triton_dist_tpu.parallel.mesh import MeshContext
@@ -88,6 +89,7 @@ class ModelBuilder:
                  max_len: int, axis: str = "tp",
                  tile_w: Optional[int] = None, t_tile: Optional[int] = None,
                  num_cores: int = 1, strategy: str = "round_robin",
+                 schedule: str = "static",
                  seq: int = 1, paged: bool = False,
                  page: Optional[int] = None, profile: bool = False,
                  cost_table: Optional[dict] = None):
@@ -98,7 +100,19 @@ class ModelBuilder:
         (``core/scheduler.py:42-100``). ``strategy="cost_lpt"`` is the
         static load-balanced analogue of the reference's
         ``enable_runtime_scheduler`` (TPU cores share no atomic queue
-        head, so balancing happens at schedule time from task costs)."""
+        head, so balancing happens at schedule time from task costs).
+
+        ``schedule="dynamic"`` replaces the per-core slot lists with
+        the dynamic scoreboard scheduler: a comm-priority-ordered claim
+        list popped at run time via a claim counter in the scoreboard
+        workspace (SMEM counter + per-priority-bucket claim
+        semaphores), so no slot carries a precomputed task binding and
+        the merged-order NOOP padding disappears — the closest TPU form
+        of the reference's in-kernel atomic queue head. ``strategy`` is
+        ignored in dynamic mode; the claim order comes from
+        ``graph.comm_priority`` (remote-peer-unblocking collectives
+        first, critical path as tiebreak), sharpened by the same
+        ``cost_table`` feedback ``cost_lpt`` uses."""
         if getattr(cfg, "attention_bias", False) or not getattr(
                 cfg, "qk_norm", True):
             raise NotImplementedError(
@@ -121,6 +135,10 @@ class ModelBuilder:
         self.max_len = max_len
         self.num_cores = num_cores
         self.strategy = strategy
+        if schedule not in ("static", "dynamic"):
+            raise ValueError(f"schedule must be 'static' or 'dynamic', "
+                             f"got {schedule!r}")
+        self.schedule = schedule
         # Scoreboard progress tracing (see _kernel): env-gated so the
         # resilience harness can attribute a wedged schedule to its
         # last-completed queue slot.
@@ -514,6 +532,18 @@ class ModelBuilder:
         self.arena_rows = self._cursor
 
         # -------- native schedule --------
+        # The kernel's allreduce body substitutes the STATIC
+        # ar_max_tiles for the (traced) tiles descriptor arg — enforce
+        # the contract here so a future task recording a narrower
+        # collective fails loudly at build time, not by reducing
+        # garbage tiles on device.
+        for t in g.tasks:
+            if (t.task_type == TaskType.ALLREDUCE
+                    and t.args[1] != self.ar_max_tiles):
+                raise ValueError(
+                    f"ALLREDUCE task {t.task_id} moves {t.args[1]} "
+                    f"tiles but the kernel body is specialized to "
+                    f"ar_max_tiles={self.ar_max_tiles}")
         src, dst = g.edges()
         # Collectives pin to core 0: the SPMD comm order must match
         # across chips, and the ICI semaphores live on one core.
@@ -521,26 +551,97 @@ class ModelBuilder:
             [0 if t.task_type == TaskType.ALLREDUCE else -1
              for t in g.tasks], np.int32)
         cost = np.array([self._task_cost(t) for t in g.tasks], np.int32)
+        # Prune once so the static packing, the dynamic claim order,
+        # and both timed simulators all see the same edge set.
+        if len(src):
+            psrc, pdst = prune_deps(len(g.tasks), src, dst)
+        else:
+            psrc = pdst = np.zeros(0, np.int32)
+        self._pruned_edges = (psrc, pdst)
+        if self.schedule == "dynamic":
+            self._schedule_dynamic(psrc, pdst, pin, cost)
+        else:
+            self._schedule_static(psrc, pdst, pin, cost)
+
+    def _schedule_static(self, src, dst, pin, cost):
+        """Precomputed per-core slot lists (round_robin / zig_zag /
+        cost_lpt) with merged-order NOOP padding — the original static
+        scoreboard."""
+        g = self.graph
         sched = schedule_mc(len(g.tasks), src, dst,
                             num_cores=self.num_cores,
                             strategy=self.strategy, task_cost=cost,
-                            pin_core=pin)
+                            pin_core=pin, dep_opt=False)
+        self.sched = sched
         queue = sched["queue"]                     # (Q, C) ids or -1
         self.qlen = queue.shape[0]
         self.n_edges = sched["n_edges"]
-        qc = queue.reshape(-1)
+        sim = simulate_static(len(g.tasks), src, dst, queue,
+                              task_cost=cost)
+        self.idle_units = sim["idle_units"]
+        self.makespan = sim["makespan"]
+        # Static mode runs no claim protocol; keep the bucket tables
+        # at their 1-element placeholders (uniform kernel signature).
+        self.n_buckets = 1
+        self.bucket_claims = np.zeros(1, np.int32)
+        self.claim_bucket = np.zeros(queue.size, np.int32)
+        self._emit_slot_tables(queue.reshape(-1), queue.shape, sched)
+
+    def _schedule_dynamic(self, src, dst, pin, cost):
+        """Dynamic scoreboard schedule: ONE comm-priority-ordered claim
+        list (scheduler.schedule_dyn) the kernel pops via the claim
+        counter in the scoreboard workspace — the TPU analogue of the
+        reference's in-kernel runtime scheduler (model_builder.py:89,
+        124: SMs claiming off an atomic queue head). No merged-order
+        padding: the claim order is topological, so idle (NOOP) slots
+        shrink to pinning holes + tail round-up."""
+        g = self.graph
+        prio, bkt, n_buckets = comm_priority(g.tasks, n_ranks=self.n,
+                                             task_cost=cost)
+        dyn = schedule_dyn(len(g.tasks), src, dst,
+                           num_cores=self.num_cores, priority=prio,
+                           bucket=bkt, task_cost=cost, pin_core=pin,
+                           dep_opt=False)
+        self.sched = dyn
+        C = self.num_cores
+        n_claims = dyn["n_claims"]
+        self.n_claims = n_claims
+        self.qlen = _cdiv(max(n_claims, 1), C)
+        self.n_edges = dyn["n_edges"]
+        self.idle_units = dyn["idle_units"]
+        self.makespan = dyn["makespan"]
+        claims = np.full(self.qlen * C, -1, np.int32)
+        claims[:n_claims] = dyn["claim_order"]
+        self.claims = claims.reshape(self.qlen, C)
+        # Per-claim bucket (holes/tail count against bucket 0) and the
+        # per-bucket claim totals the last slot drains the claim
+        # semaphores by. EVERY slot signals exactly one bucket, so the
+        # totals sum to qlen * C.
+        self.n_buckets = n_buckets
+        bkt_arr = np.asarray(bkt, np.int32)
+        self.claim_bucket = np.where(claims >= 0, bkt_arr[claims], 0
+                                     ).astype(np.int32)
+        self.bucket_claims = np.bincount(
+            self.claim_bucket, minlength=n_buckets).astype(np.int32)
+        self._emit_slot_tables(claims, self.claims.shape, dyn)
+
+    def _emit_slot_tables(self, qc, shape, sched):
+        """Flat slot list (static merged queue or dynamic claim order)
+        → the prefetched type/arg/wait/signal tables."""
+        g = self.graph
         noop_args = [0] * ARGS_MAX
         self.task_types = np.array(
             [g.tasks[t].task_type if t >= 0 else int(TaskType.NOOP)
-             for t in qc], np.int32).reshape(queue.shape)
+             for t in qc], np.int32).reshape(shape)
         # Static work units per queue slot — the progress-counter →
         # time model's design row (slot_durations()).
         self.slot_units = np.array(
             [self._task_units(g.tasks[t]) if t >= 0 else 0
-             for t in qc], np.int64).reshape(queue.shape)
+             for t in qc], np.int64).reshape(shape)
         self.task_args = np.array(
             [g.tasks[t].encoded_args() if t >= 0 else noop_args
-             for t in qc], np.int32).reshape(*queue.shape, ARGS_MAX)
+             for t in qc], np.int32).reshape(*shape, ARGS_MAX)
+        self._used_types = {int(v) for v in np.unique(self.task_types)}
         # Per-slot wait/signal tables (edge-semaphore scoreboard).
         wtab, stab = [], []
         wedges, sedges, scores_ = [], [], []
@@ -556,12 +657,18 @@ class ModelBuilder:
             stab.append((len(sedges), sc))
             sedges.extend(sched["sig_edges"][ss:ss + sc])
             scores_.extend(sched["sig_cores"][ss:ss + sc])
-        self.wait_tab = np.array(wtab, np.int32).reshape(
-            *queue.shape, 2)
-        self.sig_tab = np.array(stab, np.int32).reshape(*queue.shape, 2)
+        self.wait_tab = np.array(wtab, np.int32).reshape(*shape, 2)
+        self.sig_tab = np.array(stab, np.int32).reshape(*shape, 2)
         self.wait_edges = np.array(wedges or [0], np.int32)
         self.sig_edges = np.array(sedges or [0], np.int32)
         self.sig_cores = np.array(scores_ or [0], np.int32)
+
+    def noop_slots(self) -> int:
+        """Idle scoreboard steps in the schedule: grid slots that
+        execute no task (static merged-order padding, or dynamic
+        pinning holes + tail round-up). The interpret-mode step counter
+        the static-vs-dynamic comparison is scored on."""
+        return int((self.task_types == int(TaskType.NOOP)).sum())
 
     def _task_cost(self, t) -> int:
         """Cost estimate feeding the cost_lpt strategy: static work
@@ -579,6 +686,26 @@ class ModelBuilder:
         for t in self.graph.tasks:
             k = int(t.task_type)
             counts[k] = counts.get(k, 0) + self._task_units(t)
+        return counts
+
+    def profile_unit_counts(self, prof) -> dict:
+        """Unit counts per task type from a warmup step's EXECUTED slot
+        records (``profile=True`` output) — the profile-guided
+        counterpart of :meth:`task_unit_counts`. Where the static count
+        trusts the graph, this counts what the scoreboard actually ran
+        (slot tags paired with the schedule's per-slot units), so a
+        ``(profile_unit_counts(prof), wall_seconds)`` observation feeds
+        :func:`calibrate_cost_table` with measured executions; the
+        resulting ``cost_table`` re-schedules BOTH ``cost_lpt`` and the
+        dynamic claim order on step 2+."""
+        tags = np.asarray(prof)[:, 0].reshape(-1)
+        units = np.asarray(self.slot_units).reshape(-1)
+        counts = {}
+        for tag, u in zip(tags.tolist(), units.tolist()):
+            k = int(tag) - 1         # tags are task_type + 1
+            if tag <= 0 or k == int(TaskType.NOOP):
+                continue
+            counts[k] = counts.get(k, 0) + int(u)
         return counts
 
     def _task_units(self, t) -> int:
@@ -707,8 +834,8 @@ class ModelBuilder:
             gdn_dv=self.cfg.gdn_head_dim_v)
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
-                wait_edges_s, sig_edges_s, len_s, tok_s, tbl_s,
-                arena_in, kc_in, vc_in, *tail):
+                wait_edges_s, sig_edges_s, bucket_s, bsizes_s, len_s,
+                tok_s, tbl_s, arena_in, kc_in, vc_in, *tail):
         if self.hybrid:
             states_in, tail = tail[0], tail[1:]
         arena, k_cache, v_cache = tail[:3]
@@ -728,12 +855,36 @@ class ModelBuilder:
             tail = tail[3:]
         else:
             vrow = vrow2 = vS = None
-        edge_sem, send_sem, recv_sem = tail
+        claim_cnt, claim_sem, edge_sem, send_sem, recv_sem = tail
         cfg = self.kernel_config()
         q = pl.program_id(0)
         c = pl.program_id(1)
-        ttype = types_s[q, c]
-        args = tuple(args_s[q, c, j] for j in range(ARGS_MAX))
+        C = self.num_cores
+        if self.schedule == "dynamic":
+            # Device-side task claiming: no slot carries a precomputed
+            # task binding — each grid slot pops the next entry off the
+            # claim counter in the scoreboard workspace and executes
+            # whatever the counter hands it (reference: the runtime
+            # scheduler's atomic queue head, model_builder.py:89,124).
+            # Under the sequential merged order the claim sequence is
+            # deterministic (slot (q, c) draws claim q*C + c), which is
+            # what keeps the SPMD collective order identical across
+            # chips; a concurrent megacore claim draws the same values
+            # through fetch-add order on the per-core subsequences.
+            @pl.when(jnp.logical_and(q == 0, c == 0))
+            def _():
+                claim_cnt[0] = 0
+
+            slot = claim_cnt[0]
+            claim_cnt[0] = slot + 1
+            # Per-priority-bucket claim accounting, visible in the
+            # scoreboard workspace as semaphore counts (the wait/signal
+            # tables' sibling): every slot signals exactly one bucket.
+            pltpu.semaphore_signal(claim_sem.at[bucket_s[slot]], 1)
+        else:
+            slot = q * C + c
+        ttype = types_s[slot]
+        args = tuple(args_s[slot, j] for j in range(ARGS_MAX))
         refs = {"arena": arena, "k_cache": k_cache, "v_cache": v_cache,
                 "va": va, "vb": vb, "vc": vc, "vw": vw, "acc": acc,
                 "vhd": vhd, "vkt": vkt, "vsq": vsq, "send_sem": send_sem,
@@ -744,14 +895,20 @@ class ModelBuilder:
         # per queue slot as the scoreboard advances. In interpret mode
         # this is the only progress signal that survives a wedged
         # kernel — the resilience harness parses the last line to name
-        # the slot a deadlocked schedule stopped at.
+        # the slot a deadlocked schedule stopped at. Dynamic mode
+        # reports the CLAIM COUNTER value, not a static queue position:
+        # feed it to scheduler.describe_claim to name the claimed task.
         if self.trace_progress:
-            pl.debug_print("TDT-PROGRESS q={} c={}", q, c)
+            if self.schedule == "dynamic":
+                pl.debug_print("TDT-PROGRESS claim={} task_type={}",
+                               slot, ttype)
+            else:
+                pl.debug_print("TDT-PROGRESS q={} c={}", q, c)
 
         # Scoreboard waits: block until every cross-core predecessor's
         # edge semaphore has been signalled (reference
         # scoreboard_wait_deps).
-        wstart, wcount = wait_tab_s[q, c, 0], wait_tab_s[q, c, 1]
+        wstart, wcount = wait_tab_s[slot, 0], wait_tab_s[slot, 1]
 
         def wait_step(k, _):
             pltpu.semaphore_wait(edge_sem.at[wait_edges_s[wstart + k]], 1)
@@ -776,6 +933,14 @@ class ModelBuilder:
             (lambda: K.gdn_decode_body(cfg, args, refs))
             if self.hybrid else (lambda: None),
         ]
+        # lax.switch traces EVERY branch, scheduled or not — and a body
+        # whose geometry does not fit this build (the decode cache
+        # bodies under a prefill-shaped cfg where batch counts B*S
+        # rows) fails at trace time. Stub types absent from the
+        # schedule; the queue can never select them.
+        used = self._used_types
+        branches = [br if i in used else (lambda: None)
+                    for i, br in enumerate(branches)]
         jax.lax.switch(ttype, branches)
         if prof_ref is not None:
             # tag = task_type + 1: the Perfetto exporter treats a
@@ -788,7 +953,7 @@ class ModelBuilder:
         # targeted at the consumer core — sig_cores in the schedule
         # carries that mapping — but no execution environment available
         # here runs that variant, so the kernel does not consume it.)
-        sstart, scount = sig_tab_s[q, c, 0], sig_tab_s[q, c, 1]
+        sstart, scount = sig_tab_s[slot, 0], sig_tab_s[slot, 1]
 
         # Fault hook: a drop_edge plan suppresses one edge's completion
         # signal — the canonical scoreboard failure (a consumer's wait
@@ -810,6 +975,20 @@ class ModelBuilder:
 
         jax.lax.fori_loop(0, scount, sig_step, 0)
 
+        if self.schedule == "dynamic":
+            # Drain the per-bucket claim semaphores once every claim
+            # has been accounted (a TPU kernel must exit with zeroed
+            # semaphores). The final slot waits for each bucket's full
+            # claim total — by then all qlen*C signals have been (or,
+            # concurrently, will be) raised.
+            @pl.when(jnp.logical_and(q == self.qlen - 1, c == C - 1))
+            def _():
+                def drain(k, _):
+                    pltpu.semaphore_wait(claim_sem.at[k], bsizes_s[k])
+                    return 0
+
+                jax.lax.fori_loop(0, self.n_buckets, drain, 0)
+
     def step_fn(self):
         """Per-shard decode step:
         (arena, k_cache, v_cache, token_ids (B,), cache_len)
@@ -821,12 +1000,18 @@ class ModelBuilder:
         caches at jit level."""
         b, w, d_t = self.batch, self.w, self.d_tiles
         cfg = self.cfg
-        types = jnp.asarray(self.task_types)
-        args = jnp.asarray(self.task_args)
-        wait_tab = jnp.asarray(self.wait_tab)
-        sig_tab = jnp.asarray(self.sig_tab)
+        # Slot tables are prefetched FLAT (slot-major): static slots
+        # index them at q*C + c, dynamic slots at the claim-counter
+        # value — one kernel, two binding rules.
+        n_slots = self.qlen * self.num_cores
+        types = jnp.asarray(self.task_types).reshape(n_slots)
+        args = jnp.asarray(self.task_args).reshape(n_slots, ARGS_MAX)
+        wait_tab = jnp.asarray(self.wait_tab).reshape(n_slots, 2)
+        sig_tab = jnp.asarray(self.sig_tab).reshape(n_slots, 2)
         wait_edges = jnp.asarray(self.wait_edges)
         sig_edges = jnp.asarray(self.sig_edges)
+        bucket = jnp.asarray(self.claim_bucket).reshape(-1)
+        bsizes = jnp.asarray(self.bucket_claims)
 
         def step(arena, k_cache, v_cache, token_ids, cache_len,
                  block_table=None, states=None):
@@ -851,7 +1036,7 @@ class ModelBuilder:
                     (1, 2), lambda q, c, *_: (q * C + c, 0),
                     memory_space=pltpu.VMEM))
             grid_spec = pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=9,
+                num_scalar_prefetch=11,
                 grid=(self.qlen, self.num_cores),
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_big,
                 out_specs=out_specs,
@@ -873,6 +1058,9 @@ class ModelBuilder:
                                 self.cfg.gdn_head_dim_v),
                                jnp.float32),                # vS
                 ] if self.hybrid else []) + [
+                    pltpu.SMEM((1,), jnp.int32),            # claim_cnt
+                    pltpu.SemaphoreType.REGULAR(
+                        (max(self.n_buckets, 1),)),         # claim_sem
                     pltpu.SemaphoreType.REGULAR(
                         (max(self.n_edges, 1),)),           # scoreboard
                     pltpu.SemaphoreType.DMA((max(self.n - 1, 1),)),
@@ -910,8 +1098,8 @@ class ModelBuilder:
                 grid_spec=grid_spec,
                 out_shape=tuple(out_shape),
                 input_output_aliases=(
-                    {9: 0, 10: 1, 11: 2, 12: 3} if self.hybrid
-                    else {9: 0, 10: 1, 11: 2}),
+                    {11: 0, 12: 1, 13: 2, 14: 3} if self.hybrid
+                    else {11: 0, 12: 1, 13: 2}),
                 # A rankless megakernel traces no barrier: Mosaic
                 # rejects a collective_id without one.
                 compiler_params=(comm_compiler_params() if self.n > 1
@@ -919,8 +1107,8 @@ class ModelBuilder:
                                      has_side_effects=True)),
             )
             operands = [types, args, wait_tab, sig_tab, wait_edges,
-                        sig_edges, len_arr, tok_arr, tbl_arr, arena,
-                        k_cache, v_cache]
+                        sig_edges, bucket, bsizes, len_arr, tok_arr,
+                        tbl_arr, arena, k_cache, v_cache]
             if self.hybrid:
                 operands.append(states)
             outs = list(outs_fn(*operands))

@@ -28,6 +28,7 @@ class MegaKernelEngine:
                  seed: int = 0, tile_w=None, t_tile=None,
                  keep_params: bool = False, prefill_seq: int = 0,
                  num_cores: int = 1, strategy: str = "round_robin",
+                 schedule: str = "static",
                  paged: bool = False, page=None, num_pages=None,
                  cost_table=None, timeout_s=None):
         """``timeout_s`` arms a per-step watchdog: every
@@ -36,7 +37,14 @@ class MegaKernelEngine:
         :class:`~triton_dist_tpu.resilience.CommTimeoutError` (rank,
         op, last-completed step counter — see :meth:`progress`) instead
         of hanging on a wedged scoreboard. ``None`` keeps the
-        non-blocking async-dispatch behaviour."""
+        non-blocking async-dispatch behaviour.
+
+        ``schedule``: ``"static"`` (per-core slot lists packed by
+        ``strategy``), ``"dynamic"`` (device-side claim counter over a
+        comm-priority-ordered ready list — see docs/megakernel.md), or
+        ``"auto"`` (the :func:`tune_schedule` winner persisted in the
+        tune cache for this (model, mesh, batch, cores) key; falls
+        back to static when never tuned)."""
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -44,6 +52,10 @@ class MegaKernelEngine:
         self.batch = batch
         self.paged = paged
         self.timeout_s = timeout_s
+        if schedule == "auto":
+            schedule = lookup_schedule(cfg, mesh, batch=batch,
+                                       num_cores=num_cores, axis=axis)
+        self.schedule = schedule
         # Host-side progress counters for watchdog/timeout diagnostics:
         # how many megakernel launches completed, and the queue shape
         # a wedged launch would be stuck inside.
@@ -62,7 +74,8 @@ class MegaKernelEngine:
                                     max_len=max_len, axis=axis,
                                     tile_w=tile_w, t_tile=t_tile,
                                     num_cores=num_cores,
-                                    strategy=strategy, paged=paged,
+                                    strategy=strategy,
+                                    schedule=self.schedule, paged=paged,
                                     page=page, cost_table=cost_table)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
@@ -110,7 +123,8 @@ class MegaKernelEngine:
                 cfg, mesh, batch=batch * prefill_seq, max_len=max_len,
                 axis=axis, tile_w=tile_w, t_tile=t_tile,
                 seq=prefill_seq, num_cores=num_cores, strategy=strategy,
-                paged=paged, page=page, cost_table=cost_table)
+                schedule=self.schedule, paged=paged, page=page,
+                cost_table=cost_table)
             self.prefill_seq = prefill_seq
             pack_builder = self.prefill_builder
             pstep = self.prefill_builder.step_fn()
@@ -187,15 +201,40 @@ class MegaKernelEngine:
 
     def progress(self) -> dict:
         """Last-completed progress counters (CommTimeoutError payload):
-        completed megakernel launches plus the schedule geometry
-        (queue length x cores, scoreboard edge count) that frames
-        where a wedged launch can be stuck."""
-        return {
+        completed megakernel launches plus the schedule geometry that
+        frames where a wedged launch can be stuck. Dynamic mode reports
+        CLAIM-COUNTER geometry (total claims, priority buckets, per-
+        bucket claim totals) instead of a static queue shape: the
+        in-flight position is a claim-counter value — resolve it with
+        :meth:`describe_slot` / ``scheduler.describe_claim``, never as
+        a static queue index."""
+        out = {
             "steps_done": self.steps_done,
+            "schedule": self.schedule,
             "qlen": self.builder.qlen,
             "num_cores": self.builder.num_cores,
             "n_edges": self.builder.n_edges,
         }
+        if self.schedule == "dynamic":
+            out["n_claims"] = self.builder.n_claims
+            out["n_buckets"] = self.builder.n_buckets
+            out["bucket_claims"] = [
+                int(v) for v in self.builder.bucket_claims]
+            out["progress_counter"] = "claim"
+        else:
+            out["progress_counter"] = "static_slot"
+        return out
+
+    def describe_slot(self, q: int, c: int = 0) -> dict:
+        """Attribute a progress-counter position to the task occupying
+        it: static mode maps a (queue position, core) pair through the
+        packed queue; dynamic mode treats ``q * num_cores + c`` as the
+        CLAIM-COUNTER value and names the claimed task, its priority
+        bucket, and the edge semaphores it waits on — what a watchdog
+        needs to attribute a wedged schedule."""
+        from triton_dist_tpu.megakernel.scheduler import describe_slot
+
+        return describe_slot(self.builder.sched, q, c)
 
     def _finish(self, out, op: str):
         """Bound the step's completion when a watchdog is armed; count
@@ -284,3 +323,78 @@ class MegaKernelEngine:
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out.append(tok)
         return jnp.stack(out, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# static-vs-dynamic schedule autotune (persisted in the tune cache)
+# ---------------------------------------------------------------------------
+
+def _schedule_key(cfg, mesh, *, batch: int, num_cores: int, axis: str):
+    from triton_dist_tpu import tune
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    return tune.make_key(
+        "megakernel_schedule", model=tune.model_key(cfg),
+        mesh=tune.mesh_key(MeshContext.from_mesh(mesh)), batch=batch,
+        cores=num_cores, axis=axis)
+
+
+def lookup_schedule(cfg, mesh, *, batch: int, num_cores: int = 1,
+                    axis: str = "tp") -> str:
+    """Resolve ``schedule="auto"``: the persisted :func:`tune_schedule`
+    winner for this (model, mesh, batch, cores) key, or ``"static"``
+    when never tuned."""
+    from triton_dist_tpu import tune
+
+    cached = tune.load_autotune_data(
+        _schedule_key(cfg, mesh, batch=batch, num_cores=num_cores,
+                      axis=axis))
+    if cached and cached.get("schedule") in ("static", "dynamic"):
+        return cached["schedule"]
+    return "static"
+
+
+def tune_schedule(cfg, mesh, *, batch: int, max_len: int = 512,
+                  axis: str = "tp", num_cores: int = 1, reps: int = 3,
+                  params=None, seed: int = 0, use_cache: bool = True,
+                  **builder_kw) -> str:
+    """OFFLINE static-vs-dynamic sweep (the ``tune_spmd`` pattern):
+    build one engine per schedule mode, run a warmup ``decode_step``
+    (compile + profile-feedback primer), time ``reps`` steps each, and
+    persist the winner under the (model, mesh, batch, cores) key so
+    ``MegaKernelEngine(schedule="auto")`` picks it up. Returns the
+    winning mode. Timing on the interpret backend tracks scheduler/
+    interpreter overhead rather than silicon — meaningful relatively
+    (same task bodies both modes), and re-keyed per backend by the tune
+    cache's dependency stamp."""
+    import time as _time
+
+    from triton_dist_tpu import tune
+
+    key = _schedule_key(cfg, mesh, batch=batch, num_cores=num_cores,
+                        axis=axis)
+    if use_cache:
+        cached = tune.load_autotune_data(key)
+        if cached and cached.get("schedule") in ("static", "dynamic"):
+            return cached["schedule"]
+    times = {}
+    toks = jnp.zeros((batch,), jnp.int32)
+    for mode in ("static", "dynamic"):
+        eng = MegaKernelEngine(cfg, mesh, batch=batch, max_len=max_len,
+                               axis=axis, num_cores=num_cores,
+                               schedule=mode, params=params, seed=seed,
+                               **builder_kw)
+        np.asarray(eng.decode_step(toks, 0))        # compile + warmup
+        best = float("inf")
+        for i in range(reps):
+            t0 = _time.perf_counter()
+            np.asarray(eng.decode_step(toks, 1 + i))
+            best = min(best, _time.perf_counter() - t0)
+        times[mode] = best
+    winner = min(times, key=times.get)
+    tune.store_autotune_data(
+        key, {"schedule": winner,
+              "times_ms": {m: round(t * 1e3, 3)
+                           for m, t in times.items()}},
+        times[winner])
+    return winner

@@ -1,11 +1,14 @@
 """Dependency graph (reference: ``mega_triton_kernel/core/graph.py:101``
-``Graph`` with dependency optimization under ``enable_dep_opt``)."""
+``Graph`` with dependency optimization under ``enable_dep_opt``) and
+the comm-aware priority policy feeding the dynamic scoreboard
+scheduler."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
-from triton_dist_tpu.megakernel.task import Task, TaskType
+from triton_dist_tpu.megakernel.task import (COLLECTIVE_TYPES, Task,
+                                             TaskType)
 
 
 class Graph:
@@ -57,3 +60,89 @@ class Graph:
                 src.append(d)
                 dst.append(t.task_id)
         return src, dst
+
+
+# Priority layout: comm weight in the high bits, critical path in the
+# low 15 — both fit comfortably in an int32 for any decode graph.
+_CP_BITS = 15
+N_PRIORITY_BUCKETS = 3
+
+
+def comm_priority(tasks: Sequence[Task], *, n_ranks: int = 1,
+                  task_cost: Sequence[int] = None):
+    """Comm-aware claim priority for the dynamic scheduler, computed
+    host-side from the task graph.
+
+    A collective's completion releases ``n_ranks - 1`` remote chips
+    (every peer's matching allreduce spins until this rank's
+    contribution lands), so tasks are ordered by how many remote-peer-
+    unblocking collectives their completion leads to:
+
+    - ``priority[t]``: (#distinct collective descendants of t) *
+      (n_ranks - 1) in the high bits — the number of remote-peer
+      releases t's completion contributes to — with the cost-weighted
+      critical-path length to a sink as the low-bits tiebreak (longest
+      path first, the classic list-scheduling heuristic; with
+      ``n_ranks == 1`` it is the whole priority).
+    - ``bucket[t]`` (0 = claimed first):
+      0 — collectives and their direct predecessors (completion
+          immediately releases, or enables the release of, remote
+          peers);
+      1 — tasks with any collective downstream;
+      2 — the local-only tail (e.g. the LM head after the last
+          allreduce) — nothing remote ever waits on these.
+
+    ``task_cost`` feeds the critical-path term — pass the SAME costs
+    the schedule uses so profile-guided ``cost_table`` reweighting
+    (builder.calibrate_cost_table) sharpens the dynamic claim order
+    exactly as it sharpens ``cost_lpt``.
+
+    Returns ``(priority, bucket, n_buckets)`` as int32 lists.
+    Task ids must be topologically ordered (Graph.add guarantees it:
+    dependencies only ever point at earlier ids).
+    """
+    n = len(tasks)
+    cost = list(task_cost) if task_cost is not None else [1] * n
+    succ: List[List[int]] = [[] for _ in range(n)]
+    for t in tasks:
+        for d in t.deps:
+            succ[d].append(t.task_id)
+
+    # Distinct-collective descendant sets as bitmasks over the (small)
+    # collective population; python ints make the union O(words).
+    bit = {}
+    for t in tasks:
+        if t.task_type in COLLECTIVE_TYPES:
+            bit[t.task_id] = len(bit)
+    mask = [0] * n
+    cp = [0] * n
+    for tid in reversed(range(n)):
+        m = (1 << bit[tid]) if tid in bit else 0
+        best = 0
+        for s in succ[tid]:
+            m |= mask[s]
+            if cp[s] > best:
+                best = cp[s]
+        mask[tid] = m
+        cp[tid] = best + max(int(cost[tid]), 0)
+
+    max_cp = max(cp) if cp else 1
+    peers = max(n_ranks - 1, 0)
+    pre_comm = set()
+    for t in tasks:
+        if t.task_type in COLLECTIVE_TYPES:
+            pre_comm.update(t.deps)
+
+    priority, bucket = [], []
+    for t in tasks:
+        tid = t.task_id
+        unblocks = bin(mask[tid]).count("1") * peers
+        cp_scaled = (cp[tid] * ((1 << _CP_BITS) - 1)) // max(max_cp, 1)
+        priority.append((unblocks << _CP_BITS) + cp_scaled)
+        if t.task_type in COLLECTIVE_TYPES or tid in pre_comm:
+            bucket.append(0)
+        elif mask[tid]:
+            bucket.append(1)
+        else:
+            bucket.append(2)
+    return priority, bucket, N_PRIORITY_BUCKETS

@@ -62,21 +62,21 @@ def _load_lib():
         return _LIB
     csrc = os.path.join(_repo_root(), "csrc")
     src = os.path.join(csrc, "megakernel_scheduler.cc")
-    so = os.path.join(csrc, "libtdt_scheduler.so")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(src)):
+    # Content-hash keyed binary in BOTH locations (the csrc/Makefile
+    # builds the same name): a scheduler edit — e.g. the dynamic-queue
+    # precompute — can never be shadowed by a stale mtime-fresh .so,
+    # and checkouts sharing a cache dir cannot accept each other's
+    # builds.
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    name = f"libtdt_scheduler-{digest}.so"
+    so = os.path.join(csrc, name)
+    if not os.path.exists(so):
         try:
             _compile_so(src, so)
         except (OSError, PermissionError):
             # Read-only checkout: build into the user cache dir instead.
-            # The cache dir is shared across checkouts whose sources may
-            # diverge, so the .so is keyed by source-content hash — an
-            # mtime check against the current checkout could accept a
-            # foreign checkout's binary.
-            with open(src, "rb") as f:
-                digest = hashlib.sha1(f.read()).hexdigest()[:12]
-            so = os.path.join(_cache_dir(),
-                              f"libtdt_scheduler-{digest}.so")
+            so = os.path.join(_cache_dir(), name)
             if not os.path.exists(so):
                 _compile_so(src, so)
     lib = ctypes.CDLL(so)
@@ -94,6 +94,16 @@ def _load_lib():
         ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p, i32p, i32p,
         i32p, i32p, i32p, i32p, i32p, i32p]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.tdt_schedule_dyn.restype = ctypes.c_int32
+    lib.tdt_schedule_dyn.argtypes = [
+        ctypes.c_int32, i32p, i32p, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, i32p, ctypes.c_int32, i32p, i32p, i32p, i32p,
+        i32p, i32p, i32p, i32p, i32p, i64p]
+    lib.tdt_sim_static.restype = ctypes.c_int32
+    lib.tdt_sim_static.argtypes = [
+        ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p,
+        ctypes.c_int32, ctypes.c_int32, i32p, i64p]
     _LIB = lib
     return lib
 
@@ -194,6 +204,100 @@ def schedule_mc(n_tasks: int, src: Sequence[int], dst: Sequence[int], *,
     }
 
 
+def schedule_dyn(n_tasks: int, src: Sequence[int], dst: Sequence[int],
+                 *, num_cores: int, priority: Sequence[int] = None,
+                 bucket: Sequence[int] = None,
+                 task_cost: Sequence[int] = None,
+                 pin_core: Sequence[int] = None, dep_opt: bool = True):
+    """Dynamic-claim schedule (``tdt_schedule_dyn``): ONE priority-
+    ordered claim list the device pops via the scoreboard claim
+    counter, instead of per-core slot lists. Claim index ``i`` binds
+    to core ``i % num_cores``; ``-1`` entries are holes (NOOP claims
+    emitted when the next index's core has no eligible pinned task).
+
+    Returns dict with:
+      ``claim_order`` (n_claims,), ``claim_of`` (task -> claim idx),
+      ``bucket`` (per task), task-indexed ``wait_*``/``sig_*``
+      scoreboard tables (edges for deps whose claim cores differ),
+      ``n_claims``, ``n_edges``, ``num_cores``, and the timed-model
+      ``idle_units`` / ``makespan`` (compare with
+      :func:`simulate_static` on the same costs).
+    """
+    lib = _load_lib()
+    s, d = _as_i32(src), _as_i32(dst)
+    if dep_opt and len(s):
+        s, d = prune_deps(n_tasks, s, d)
+    prio = _as_i32(priority if priority is not None
+                   else np.zeros(n_tasks))
+    bkt = _as_i32(bucket if bucket is not None else np.zeros(n_tasks))
+    cost = _as_i32(task_cost if task_cost is not None
+                   else np.ones(n_tasks))
+    pin = _as_i32(pin_core if pin_core is not None
+                  else -np.ones(n_tasks))
+    # Holes only arise from pinning: at most num_cores - 1 per claim.
+    cap = n_tasks * num_cores + num_cores
+    order = np.zeros(max(cap, 1), np.int32)
+    claim_of = np.zeros(max(n_tasks, 1), np.int32)
+    wait_start = np.zeros(max(n_tasks, 1), np.int32)
+    wait_count = np.zeros(max(n_tasks, 1), np.int32)
+    wait_edges = np.zeros(max(len(s), 1), np.int32)
+    sig_start = np.zeros(max(n_tasks, 1), np.int32)
+    sig_count = np.zeros(max(n_tasks, 1), np.int32)
+    sig_edges = np.zeros(max(len(s), 1), np.int32)
+    sig_cores = np.zeros(max(len(s), 1), np.int32)
+    meta = np.zeros(4, np.int64)
+    rc = lib.tdt_schedule_dyn(
+        n_tasks, _ptr(s), _ptr(d), len(s), num_cores, _ptr(prio),
+        _ptr(bkt), _ptr(cost), _ptr(pin), cap, _ptr(order),
+        _ptr(claim_of), _ptr(wait_start), _ptr(wait_count),
+        _ptr(wait_edges), _ptr(sig_start), _ptr(sig_count),
+        _ptr(sig_edges), _ptr(sig_cores),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc == -1:
+        raise ValueError("dependency cycle in task graph")
+    if rc != 0:
+        raise ValueError(f"scheduler error {rc}")
+    n_claims, n_edges = int(meta[0]), int(meta[1])
+    return {
+        "claim_order": order[:n_claims], "claim_of": claim_of,
+        "bucket": bkt, "num_cores": num_cores,
+        "wait_start": wait_start, "wait_count": wait_count,
+        "wait_edges": wait_edges[:int(wait_count.sum())],
+        "sig_start": sig_start, "sig_count": sig_count,
+        "sig_edges": sig_edges[:int(sig_count.sum())],
+        "sig_cores": sig_cores[:int(sig_count.sum())],
+        "n_claims": n_claims, "n_edges": n_edges,
+        "idle_units": int(meta[2]), "makespan": int(meta[3]),
+    }
+
+
+def simulate_static(n_tasks: int, src: Sequence[int],
+                    dst: Sequence[int], queue, *,
+                    task_cost: Sequence[int] = None) -> dict:
+    """Timed replay of a :func:`schedule_mc` queue under the dynamic
+    scheduler's cost model (``tdt_sim_static``): per-core columns in
+    order, a task starts at max(core free, preds' finish), NOOPs are
+    free. Returns {"idle_units", "makespan"} — the static baseline the
+    dynamic claim schedule's metrics are compared against.
+
+    Pass the SAME (possibly pruned) edges the schedule was built from;
+    this function does not re-prune."""
+    lib = _load_lib()
+    s, d = _as_i32(src), _as_i32(dst)
+    qarr = _as_i32(queue)
+    qlen, cores = qarr.shape
+    cost = _as_i32(task_cost if task_cost is not None
+                   else np.ones(n_tasks))
+    meta = np.zeros(2, np.int64)
+    rc = lib.tdt_sim_static(
+        n_tasks, _ptr(s), _ptr(d), len(s), _ptr(qarr.reshape(-1)),
+        qlen, cores, _ptr(cost),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise ValueError(f"simulator error {rc}")
+    return {"idle_units": int(meta[0]), "makespan": int(meta[1])}
+
+
 def describe_slot(sched: dict, q: int, c: int) -> dict:
     """Map a scoreboard step counter — the (queue position, core) pair
     a progress trace or a watchdog reports — back to the task occupying
@@ -202,7 +306,16 @@ def describe_slot(sched: dict, q: int, c: int) -> dict:
     The diagnostic half of the scoreboard: a deadlocked schedule stops
     at some (q, c); this names the task and the exact edges whose
     missing counts wedged it. ``task == -1`` is a NOOP padding slot.
+
+    Accepts either a static :func:`schedule_mc` dict or a dynamic
+    :func:`schedule_dyn` dict — for the latter, slot (q, c) is the
+    claim-counter value ``q * num_cores + c`` and the answer names the
+    CLAIMED task (see :func:`describe_claim`), not a static queue
+    position.
     """
+    if "claim_order" in sched:
+        cores = int(sched["num_cores"])
+        return describe_claim(sched, q * cores + c)
     queue = sched["queue"]
     qlen, cores = queue.shape
     if not (0 <= q < qlen and 0 <= c < cores):
@@ -211,6 +324,38 @@ def describe_slot(sched: dict, q: int, c: int) -> dict:
     out = {"q": q, "core": c, "task": task,
            "merged_index": q * cores + c}
     if task >= 0:
+        ws, wc = int(sched["wait_start"][task]), int(
+            sched["wait_count"][task])
+        ss, sc = int(sched["sig_start"][task]), int(
+            sched["sig_count"][task])
+        out["waits_on_edges"] = [int(e) for e in
+                                 sched["wait_edges"][ws:ws + wc]]
+        out["signals_edges"] = [int(e) for e in
+                                sched["sig_edges"][ss:ss + sc]]
+    return out
+
+
+def describe_claim(sched: dict, claim: int) -> dict:
+    """Dynamic-mode counterpart of :func:`describe_slot`: attribute a
+    claim-counter value (what the dynamic kernel's progress trace and
+    the watchdog report) to the claimed task, its priority bucket, and
+    the edge semaphores it waits on / signals. ``task == -1`` is a
+    hole (NOOP claim). Claims beyond ``n_claims`` are tail padding
+    NOOPs of the last partially-filled grid row."""
+    n_claims = int(sched["n_claims"])
+    cores = int(sched["num_cores"])
+    if claim < 0:
+        raise IndexError(f"claim {claim} negative")
+    out = {"claim": claim, "core": claim % cores,
+           "schedule": "dynamic"}
+    if claim >= n_claims:
+        out["task"] = -1
+        out["tail_padding"] = True
+        return out
+    task = int(sched["claim_order"][claim])
+    out["task"] = task
+    if task >= 0:
+        out["bucket"] = int(sched["bucket"][task])
         ws, wc = int(sched["wait_start"][task]), int(
             sched["wait_count"][task])
         ss, sc = int(sched["sig_start"][task]), int(

@@ -30,6 +30,14 @@ class TaskType(enum.IntEnum):
     GDN_DECODE = 13        # args: q,k,v,graw,braw,gbias,out offs, gdn_idx
 
 
+# Task types whose completion unblocks REMOTE peers: every other rank's
+# matching collective blocks until this rank's contribution lands, so
+# finishing one of these (or the work feeding it) releases n-1 chips,
+# not one core. The dynamic scheduler's comm-aware priority
+# (graph.comm_priority) is built on this set.
+COLLECTIVE_TYPES = frozenset({TaskType.ALLREDUCE})
+
+
 @dataclasses.dataclass
 class Task:
     task_id: int
@@ -37,6 +45,11 @@ class Task:
     args: Tuple[int, ...]
     deps: List[int] = dataclasses.field(default_factory=list)
     layer: int = -1
+
+    @property
+    def unblocks_remote(self) -> bool:
+        """True for tasks remote peers wait on (collectives)."""
+        return self.task_type in COLLECTIVE_TYPES
 
     def encoded_args(self) -> List[int]:
         a = list(self.args)[:ARGS_MAX]

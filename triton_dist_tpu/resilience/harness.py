@@ -141,6 +141,8 @@ def _child_ag_gemm(plan, rank):
 
 
 def _child_megakernel(plan, rank):
+    import os
+
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -155,18 +157,27 @@ def _child_megakernel(plan, rank):
                            head_dim=8)
     mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
     toks = np.array([3, 5], np.int32)
+    # TDT_MK_SCHEDULE=dynamic replays the plan against the dynamic
+    # scoreboard scheduler (claim-counter execution) instead of the
+    # static queues — the dropped-edge plan must wedge or survive
+    # identically; the progress markers below then carry claim-counter
+    # semantics (engine.progress()["progress_counter"] == "claim").
+    schedule = os.environ.get("TDT_MK_SCHEDULE", "static")
 
-    _progress(rank=rank, phase="baseline")
-    base = MegaKernelEngine(cfg, mesh, batch=2, max_len=32)
+    _progress(rank=rank, phase="baseline", schedule=schedule)
+    base = MegaKernelEngine(cfg, mesh, batch=2, max_len=32,
+                            schedule=schedule)
     want = np.asarray(jax.block_until_ready(base.generate(toks, 4)))
 
-    _progress(rank=rank, phase="faulted-trace")
+    _progress(rank=rank, phase="faulted-trace", schedule=schedule)
     with faults.inject(plan):
-        eng = MegaKernelEngine(cfg, mesh, batch=2, max_len=32)
+        eng = MegaKernelEngine(cfg, mesh, batch=2, max_len=32,
+                               schedule=schedule)
         _progress(rank=rank, phase="faulted-dispatch",
-                  steps_done=eng.steps_done)
+                  schedule=schedule, steps_done=eng.steps_done)
         got = np.asarray(jax.block_until_ready(eng.generate(toks, 4)))
-    _progress(rank=rank, phase="complete", steps_done=eng.steps_done)
+    _progress(rank=rank, phase="complete", schedule=schedule,
+              steps_done=eng.steps_done)
     return np.array_equal(got, want)
 
 

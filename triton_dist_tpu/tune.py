@@ -61,6 +61,20 @@ def mesh_key(mesh) -> str:
     return "x".join(f"{a}{s}" for a, s in zip(mesh.axes, mesh.sizes))
 
 
+def model_key(cfg) -> str:
+    """Stable identity for a model config (a frozen dataclass): the
+    sha256 of its sorted field dict. The megakernel schedule autotune
+    (``megakernel.engine.tune_schedule``) keys its static-vs-dynamic
+    winner on (model_key, mesh_key, batch, cores) — the attributes the
+    task graph and therefore the winning schedule depend on."""
+    import dataclasses
+
+    d = {k: str(v) for k, v in sorted(
+        dataclasses.asdict(cfg).items())}
+    blob = json.dumps(d, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
 def make_key(op: str, **attrs) -> str:
     """Stable key from op name + shapes/dtypes/mesh attributes
     (reference ``triton_dist_key``, ``utils.py:862``)."""
