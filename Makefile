@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	apicheck ci bench-all
+	serve-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -40,6 +40,12 @@ bench-smoke: csrc
 # (docs/megakernel.md, dynamic scoreboard scheduler).
 bench-megakernel: csrc
 	bash scripts/bench_megakernel.sh
+
+# Serving battery: continuous batching + streaming chat server on the
+# CPU mesh, gated on per-request token-exactness vs Engine.serve and
+# the fixed-decode-shape jit-cache check (docs/serving.md).
+serve-smoke: csrc
+	bash scripts/serve_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

@@ -329,6 +329,49 @@ def _interpret_megakernel_times() -> dict:
     return out
 
 
+def _interpret_serving_times() -> dict:
+    """Serving throughput on the CPU mesh: the continuous-batching
+    ServingEngine vs gang ("static") batching over the SAME engine and
+    workload — a skewed gen-length mix, so static burns decode slots on
+    finished requests while continuous recycles them. Absolute numbers
+    track the XLA-on-CPU decode step, not silicon; the continuous /
+    static RATIO is the scheduling win and is shape-stable."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend warmup
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=4,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8], [9], [10, 11], [12]]
+    gens = [2, 10, 2, 10, 2, 10]          # skewed: static wastes slots
+
+    out = {"serving_tokens_per_s": {}, "serving_decode_dispatches": {},
+           "serving_decode_cache_entries": {}}
+    for policy in ("continuous", "static"):
+        srv = ServingEngine(eng, num_slots=2, page=8, policy=policy)
+        srv.generate([[1, 2]], max_new_tokens=2)     # compile warmup
+        for k in srv.stats_counters:
+            srv.stats_counters[k] = type(srv.stats_counters[k])(0)
+        for p, g in zip(prompts, gens):
+            srv.submit(p, max_new_tokens=g)
+        srv.run()
+        st = srv.stats()
+        out["serving_tokens_per_s"][policy] = round(
+            st.get("tokens_per_s", 0.0), 2)
+        out["serving_decode_dispatches"][policy] = st[
+            "decode_dispatches"]
+        out["serving_decode_cache_entries"][policy] = (
+            srv.decode_cache_size())
+    return out
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -388,6 +431,11 @@ def _interpret_bench(reason: str) -> None:
     except Exception as e:  # megakernel bench must not sink the record
         mk = {"megakernel_decode_step_ms": None,
               "megakernel_error": str(e)[:200]}
+    try:
+        sv = _interpret_serving_times()
+    except Exception as e:  # serving bench must not sink the record
+        sv = {"serving_tokens_per_s": None,
+              "serving_error": str(e)[:200]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -407,6 +455,7 @@ def _interpret_bench(reason: str) -> None:
             "compute_only_ms": round(times["compute"] * 1e3, 3),
             "shape_m_k_n": [256, 32, 64],
             **mk,
+            **sv,
             "stale_source": src,
             "stale_value": (last or {}).get("value"),
             "stale_vs_baseline": (last or {}).get("vs_baseline"),

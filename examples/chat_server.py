@@ -1,13 +1,16 @@
-"""Minimal serving loop — the reference's megakernel ``model_server.py``
-/ chat-demo analogue (``mega_triton_kernel/test/models``).
+"""Streaming serving loop — the reference's megakernel ``model_server.py``
+/ chat-demo analogue (``mega_triton_kernel/test/models``), now on the
+continuous-batching :class:`~triton_dist_tpu.serving.ServingEngine`.
 
-Reads one prompt of space-separated token ids per line on stdin, greedy-
-decodes, prints the generated ids. With ``--hf-dir`` it loads a real
-local HF checkpoint (config.json + safetensors) through
-``models.hf_loader.load_hf_checkpoint`` and serves THAT model (dense or
-MoE — the Engine picks the MoE contract from the config); otherwise a
-tiny randomly-initialized dense model. ``--megakernel`` swaps the layer
-engine for the persistent-kernel runtime.
+Reads one prompt of space-separated token ids per line on stdin and
+STREAMS the generated ids as they decode (one token per flush — no
+more waiting for the full ``--gen-len``). Malformed prompt lines (non-
+integer tokens) terminate with a nonzero exit and a diagnostic instead
+of a traceback. With ``--hf-dir`` it loads a real local HF checkpoint
+(config.json + safetensors) through ``models.hf_loader`` and serves
+THAT model (dense or MoE); otherwise a tiny randomly-initialized dense
+model. ``--megakernel`` swaps in the persistent-kernel runtime — the
+same ServingEngine drives it through the prefill-lane decode batch.
 
 Run: printf '1 2 3\n9 8 7\n' | python examples/chat_server.py --gen-len 8
 """
@@ -25,6 +28,12 @@ def main():
     ap.add_argument("--tp", type=int, default=4)
     ap.add_argument("--gen-len", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=2,
+                    help="decode-batch width of the serving engine "
+                         "(layer path)")
+    ap.add_argument("--page", type=int, default=None,
+                    help="KV page size (layer path; must divide "
+                         "--max-len)")
     ap.add_argument("--hf-dir", default=None,
                     help="local HF checkpoint directory")
     ap.add_argument("--megakernel", action="store_true")
@@ -40,24 +49,26 @@ def main():
     import jax
     if os.environ.get("TDT_REAL_TPU") != "1":
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
 
     import triton_dist_tpu as tdt
     from triton_dist_tpu.models import Engine, ModelConfig, qwen_moe
+    from triton_dist_tpu.serving import ServingEngine
+
+    import jax.numpy as jnp
 
     if args.hf_dir and args.megakernel:
         sys.exit("--megakernel serves the built-in tiny model only; "
                  "drop one of --hf-dir/--megakernel")
-    mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
-    mk = None
     if args.hf_dir:
         from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
 
         cfg, params = load_hf_checkpoint(args.hf_dir, dtype=jnp.float32)
+        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
         model_kw = ({"model": qwen_moe} if cfg.is_moe else {})
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
                      params=params, **model_kw)
+        srv = ServingEngine(eng, num_slots=args.slots, page=args.page)
     elif args.megakernel:
         from jax.sharding import Mesh
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
@@ -71,41 +82,44 @@ def main():
         else:
             cfg = ModelConfig.tiny(vocab_size=128)
         mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
-        # One engine for the whole session: construction/jit are
-        # prompt-length independent (prefill_chain is length-agnostic).
+        # One engine for the whole session; the ServingEngine streams
+        # prompts through its prefill lane, so slot count = batch.
         mk = MegaKernelEngine(cfg, mesh1d, batch=args.tp,
                               max_len=args.max_len, tile_w=16, t_tile=16)
-        eng = None
+        srv = ServingEngine(mk)
     else:
         cfg = ModelConfig.tiny(vocab_size=128)
+        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len)
+        srv = ServingEngine(eng, num_slots=args.slots, page=args.page)
 
     print(f"serving {cfg.model_name} (vocab {cfg.vocab_size}); one "
           "prompt of space-separated token ids per line:", flush=True)
-    for line in sys.stdin:
-        ids = [int(t) % cfg.vocab_size for t in line.split()]
-        if not ids:
+    for lineno, line in enumerate(sys.stdin, 1):
+        if not line.split():
             continue
-        if len(ids) + args.gen_len > args.max_len:
-            print(f"-> [skipped: prompt {len(ids)} + gen {args.gen_len} "
-                  f"exceeds --max-len {args.max_len}]", flush=True)
+        try:
+            ids = [int(t) % cfg.vocab_size for t in line.split()]
+        except ValueError as e:
+            print(f"error: line {lineno} is not space-separated token "
+                  f"ids ({e})", file=sys.stderr, flush=True)
+            sys.exit(2)
+
+        print("->", end="", flush=True)
+
+        def stream(tok, handle):
+            print(f" {tok}", end="", flush=True)
+
+        try:
+            srv.submit(ids, max_new_tokens=args.gen_len,
+                       stream_cb=stream)
+        except ValueError as e:
+            # Too long for the configured capacity: skip the request,
+            # keep the server alive (old behaviour, same message spot).
+            print(f" [skipped: {e}]", flush=True)
             continue
-        # Token-sharded prefill needs B*S divisible by tp; serving
-        # B=tp copies of the prompt satisfies it for ANY length (the
-        # rows are identical; row 0 is the answer).
-        prompt = jnp.asarray(np.tile(np.array([ids], np.int32),
-                                     (args.tp, 1)))
-        if args.megakernel:
-            # Fresh recurrent state per prompt (hybrid family): stale
-            # KV is masked by cache_len, stale GDN state is not.
-            mk.reset_states()
-            seed = mk.prefill_chain(prompt)
-            toks = np.asarray(mk.generate(seed, steps=args.gen_len,
-                                          start_pos=len(ids) - 1))
-        else:
-            toks = np.asarray(eng.serve(prompt, gen_len=args.gen_len))
-        print("->", " ".join(str(t) for t in toks[0].tolist()),
-              flush=True)
+        srv.run()
+        print(flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Serving smoke battery on the CPU interpret mesh (no TPU):
+#
+#  1. tests/test_serving.py — block manager, continuous-batching
+#     token-exactness under churn, backpressure, deadlines, and the
+#     CommTimeoutError containment path;
+#  2. the streaming chat server end-to-end over stdin (layer path),
+#     including the malformed-line nonzero-exit contract;
+#  3. a per-request token-exactness gate: ServingEngine output vs the
+#     sequential Engine.serve baseline, plus the fixed-decode-shape
+#     jit-cache check and the continuous-vs-static dispatch-count win.
+#
+# Sibling of scripts/bench_smoke.sh: tier-1-adjacent, wired as
+# `make serve-smoke`. A broken allocator or a decode-batch shape leak
+# (recompilation per request) fails here in minutes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PY=${PY:-python}
+
+echo "== serving battery (CPU mesh) =="
+$PY -m pytest tests/test_serving.py -q
+
+echo "== streaming chat server e2e =="
+out=$(printf '1 2 3\n9 8 7 6\n' | timeout 300 $PY examples/chat_server.py \
+      --tp 2 --gen-len 6)
+echo "$out"
+lines=$(echo "$out" | grep -c '^-> [0-9 ]*$' || true)
+[ "$lines" -eq 2 ] || { echo "expected 2 streamed replies, got $lines"; exit 1; }
+
+echo "== malformed prompt line must exit nonzero (no traceback) =="
+if printf 'not a token id\n' | timeout 300 $PY examples/chat_server.py \
+      --tp 2 --gen-len 2 2>/tmp/serve_smoke_err.txt; then
+  echo "chat server accepted a malformed line"; exit 1
+fi
+grep -q "not space-separated token ids" /tmp/serve_smoke_err.txt
+grep -q "Traceback" /tmp/serve_smoke_err.txt && { echo "traceback leaked"; exit 1; }
+
+echo "== per-request token-exactness + fixed-shape decode gate =="
+timeout 600 $PY - <<'EOF'
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.serving import ServingEngine
+
+TP = 4
+cfg = ModelConfig.tiny()
+eng = Engine(cfg, Mesh(np.array(jax.devices()[:TP]), ("tp",)),
+             mode="xla", max_len=64, seed=3)
+rng = np.random.RandomState(0)
+prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                        rng.randint(1, 8))]
+           for _ in range(5)]
+gens = [int(g) for g in rng.randint(1, 7, len(prompts))]
+
+base = []
+for p, g in zip(prompts, gens):
+    ids = jnp.asarray(np.tile(np.asarray([p], np.int32), (TP, 1)))
+    base.append(np.asarray(eng.serve(ids, gen_len=g))[0].tolist())
+
+results = {}
+for policy in ("continuous", "static"):
+    srv = ServingEngine(eng, num_slots=2, page=8, policy=policy)
+    hs = [srv.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    srv.run()
+    got = [h.tokens for h in hs]
+    assert got == base, f"{policy}: serving != Engine.serve baseline"
+    results[policy] = srv.stats()["decode_dispatches"]
+    if policy == "continuous":
+        warm = srv.decode_cache_size()
+        srv.generate([prompts[0]], max_new_tokens=2)
+        assert srv.decode_cache_size() == warm, "decode re-specialized"
+assert results["continuous"] <= results["static"], results
+print(f"serve-smoke: ok (token-exact x{len(prompts)}; dispatches "
+      f"continuous={results['continuous']} <= static={results['static']})")
+EOF

@@ -8,10 +8,13 @@ lengths, shuffled page tables).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.ops.flash_decode import flash_decode_ref
-from triton_dist_tpu.ops.paged_flash_decode import paged_flash_decode
+from triton_dist_tpu.ops.paged_flash_decode import (
+    paged_flash_decode, paged_flash_decode_ref,
+)
 from triton_dist_tpu.utils.testing import spmd
 
 N = 8          # ranks
@@ -88,6 +91,98 @@ def test_paged_decode_8_ranks_ragged(tp8_mesh, tp8_ctx):
     want = flash_decode_ref(q, jnp.asarray(k_dense),
                             jnp.asarray(v_dense), kv_len)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_ragged_final_page():
+    """Serving edge: a slot whose length ends mid-page (neither at a
+    page boundary nor filling its final table entry)."""
+    k_dense, v_dense, kp, vp, tbl = _build(10, 1)
+    q = jax.random.normal(jax.random.PRNGKey(11), (B, H, HD))
+    # Batch 0: one full page + 3 tokens into the ragged final page;
+    # batch 1: 1 token (first page barely started).
+    kv_len = jnp.array([PAGE + 3, 1], jnp.int32)
+    out = jax.jit(lambda *a: paged_flash_decode(*a))(
+        q, jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+        jnp.asarray(tbl[0]), kv_len)
+    want = flash_decode_ref(q, jnp.asarray(k_dense[:, :SHARD]),
+                            jnp.asarray(v_dense[:, :SHARD]), kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_zero_length_slot():
+    """A freed/parked batch slot (kv_len 0) must stay finite and not
+    perturb live rows — the fixed-shape serving batch's empty lane."""
+    k_dense, v_dense, kp, vp, tbl = _build(12, 1)
+    q = jax.random.normal(jax.random.PRNGKey(13), (B, H, HD))
+    kv_len = jnp.array([0, PAGE + 2], jnp.int32)
+    out = np.asarray(jax.jit(lambda *a: paged_flash_decode(*a))(
+        q, jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+        jnp.asarray(tbl[0]), kv_len))
+    assert np.isfinite(out).all(), "parked slot produced non-finite"
+    want = flash_decode_ref(q, jnp.asarray(k_dense[:, :SHARD]),
+                            jnp.asarray(v_dense[:, :SHARD]), kv_len)
+    np.testing.assert_allclose(out[1], np.asarray(want)[1],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_freed_and_reused_slot():
+    """Recycling: free batch 0's pages, hand the SAME pool slots to a
+    new sequence (new contents, new table) — results must track only
+    the table, with no leakage from the freed request's data."""
+    rng = np.random.RandomState(14)
+    k_dense, v_dense, kp, vp, tbl = _build(14, 1)
+    q = jax.random.normal(jax.random.PRNGKey(15), (B, H, HD))
+    kv_len = jnp.array([SHARD - 2, SHARD - 5], jnp.int32)
+    f = jax.jit(lambda kp_, vp_, tbl_: paged_flash_decode(
+        q, kp_, vp_, tbl_, kv_len))
+    o1 = np.asarray(f(jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+                      jnp.asarray(tbl[0])))
+
+    # "Free" batch 0's pages and re-fill those pool slots with a new
+    # request's KV (batch 0 becomes a fresh sequence in-place).
+    k_new = rng.randn(SHARD, KVH, HD).astype(np.float32)
+    v_new = rng.randn(SHARD, KVH, HD).astype(np.float32)
+    kp2, vp2 = kp.copy(), vp.copy()
+    for p in range(P_MAX):
+        pid = tbl[0, 0, p]
+        kp2[0, pid] = k_new[p * PAGE:(p + 1) * PAGE].transpose(1, 0, 2)
+        vp2[0, pid] = v_new[p * PAGE:(p + 1) * PAGE].transpose(1, 0, 2)
+    o2 = np.asarray(f(jnp.asarray(kp2[0]), jnp.asarray(vp2[0]),
+                      jnp.asarray(tbl[0])))
+    want0 = flash_decode_ref(q[0:1], jnp.asarray(k_new[None]),
+                             jnp.asarray(v_new[None]), kv_len[0:1])
+    np.testing.assert_allclose(o2[0], np.asarray(want0)[0],
+                               rtol=2e-4, atol=2e-4)
+    # Batch 1 (untouched pages) is bit-identical across the reuse.
+    np.testing.assert_array_equal(o1[1], o2[1])
+
+
+def test_paged_decode_longer_than_table_row_raises():
+    """A request longer than one block-table row (kv_len beyond
+    p_max·page) must fail loudly, naming the offending slot."""
+    _, _, kp, vp, tbl = _build(16, 1)
+    q = jax.random.normal(jax.random.PRNGKey(17), (B, H, HD))
+    kv_len = jnp.array([SHARD + 1, 3], jnp.int32)
+    with pytest.raises(ValueError, match="slot 0.*table row"):
+        paged_flash_decode(q, jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+                           jnp.asarray(tbl[0]), kv_len)
+
+
+def test_paged_decode_ref_matches_kernel():
+    """The XLA gather oracle (the serving engine's attn_impl='ref')
+    agrees with the Pallas kernel on ragged lengths."""
+    _, _, kp, vp, tbl = _build(18, 1)
+    q = jax.random.normal(jax.random.PRNGKey(19), (B, H, HD))
+    kv_len = jnp.array([SHARD - 3, PAGE + 1], jnp.int32)
+    out = jax.jit(lambda *a: paged_flash_decode(*a))(
+        q, jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+        jnp.asarray(tbl[0]), kv_len)
+    ref = paged_flash_decode_ref(q, jnp.asarray(kp[0]),
+                                 jnp.asarray(vp[0]),
+                                 jnp.asarray(tbl[0]), kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
 
 

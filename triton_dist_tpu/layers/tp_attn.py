@@ -234,14 +234,15 @@ def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
     return (y, (k, v)) if kv_out else y
 
 
-def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
-               mode: str = "xla", axis: str = "tp", ar_ctx=None):
-    """Single-token decode. x: (B, d) replicated; caches
-    (B, max_len, KV_loc, hd); cache_len: scalar current length.
-    Returns (y (B, d) replicated, updated caches).
+def decode_project(params, x, cfg, positions, *, axis: str = "tp"):
+    """Project one decode token per row: QKV + q/k norm + rope.
 
-    Reference: decode path of ``TP_Attn`` + ``KV_Cache``
-    (``models/kv_cache.py``), gemm_ar mode (``e2e_dense.md:34``).
+    x: (B, d) replicated; ``positions``: (B,) int32 — PER-ROW cache
+    positions, so a continuous-batching step can rope each slot at its
+    own length (the single-request form passes a broadcast scalar).
+    Returns (q (B, 1, H_loc, hd), k_tok (B, 1, KV_loc, hd),
+    v_tok (B, 1, KV_loc, hd)); the caller appends k/v through the
+    cache's ``append_decode`` contract before attending.
     """
     n = jax.lax.axis_size(axis)
     hd = cfg.head_dim
@@ -256,8 +257,47 @@ def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
     q = q.reshape(b, 1, h_loc, hd)
     k = k.reshape(b, 1, kv_loc, hd)
     v = v.reshape(b, 1, kv_loc, hd)
-    positions = jnp.full((b, 1), cache_len, dtype=jnp.int32)
-    q, k = _norm_rope(q, k, params, cfg, positions)
+    pos2 = jnp.asarray(positions, jnp.int32).reshape(b, 1)
+    q, k = _norm_rope(q, k, params, cfg, pos2)
+    return q, k, v
+
+
+def decode_output(params, o, x, *, mode: str = "xla", axis: str = "tp",
+                  ar_ctx=None):
+    """Attention output path of a decode step: optional Qwen3-Next
+    sigmoid gate (projected from the layer input ``x``), row-parallel
+    o-proj, and the cross-shard reduce. o: (B, h_loc·hd); returns
+    (B, d) replicated."""
+    if "wqg" in params:   # Qwen3-Next: sigmoid gate before o_proj
+        gate = jnp.dot(x, params["wqg"])
+        o = o * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(o.dtype)
+    if mode in ("xla",):
+        y = jax.lax.psum(
+            jnp.dot(o, params["wo"], preferred_element_type=jnp.float32),
+            axis).astype(x.dtype)
+    else:  # fused / fused_ar decode both use gemm_ar (small M)
+        y = gemm_ar(o, params["wo"], ar_ctx)
+    return _o_bias(params, y)
+
+
+def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
+               mode: str = "xla", axis: str = "tp", ar_ctx=None):
+    """Single-token decode. x: (B, d) replicated; caches
+    (B, max_len, KV_loc, hd); cache_len: scalar current length.
+    Returns (y (B, d) replicated, updated caches).
+
+    Composition of :func:`decode_project` → cache append →
+    :func:`sdpa` → :func:`decode_output`; kept as the whole-layer
+    entry point for per-layer-cache callers (qwen_next's hybrid
+    decode). The Engine's dense path drives the same pieces through
+    :meth:`KVCache.append_decode` instead.
+
+    Reference: decode path of ``TP_Attn`` + ``KV_Cache``
+    (``models/kv_cache.py``), gemm_ar mode (``e2e_dense.md:34``).
+    """
+    b = x.shape[0]
+    positions = jnp.broadcast_to(cache_len, (b,)).astype(jnp.int32)
+    q, k, v = decode_project(params, x, cfg, positions, axis=axis)
 
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
@@ -266,15 +306,6 @@ def fwd_decode(params, x, cfg, k_cache, v_cache, cache_len, *,
 
     kv_len = jnp.full((b,), cache_len + 1, dtype=jnp.int32)
     o = sdpa(q, k_cache, v_cache, causal=False, kv_len=kv_len)
-    o = o.reshape(b, h_loc * hd)
-    if "wqg" in params:   # Qwen3-Next: sigmoid gate before o_proj
-        gate = jnp.dot(x, params["wqg"])
-        o = o * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(o.dtype)
-
-    if mode in ("xla",):
-        y = jax.lax.psum(
-            jnp.dot(o, params["wo"], preferred_element_type=jnp.float32),
-            axis).astype(x.dtype)
-    else:  # fused / fused_ar decode both use gemm_ar (small M)
-        y = gemm_ar(o, params["wo"], ar_ctx)
-    return _o_bias(params, y), (k_cache, v_cache)
+    o = o.reshape(b, -1)
+    y = decode_output(params, o, x, mode=mode, axis=axis, ar_ctx=ar_ctx)
+    return y, (k_cache, v_cache)

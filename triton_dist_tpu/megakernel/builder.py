@@ -1018,7 +1018,13 @@ class ModelBuilder:
             if self.hybrid and states is None:
                 raise ValueError("hybrid megakernel step needs the GDN "
                                  "states buffer")
-            len_arr = jnp.asarray([cache_len], jnp.int32)
+            # cache_len: scalar (uniform batch, the classic form) or a
+            # (batch,) vector of PER-ROW positions — the live-slot
+            # serving form. Either way the kernel sees a (batch,) SMEM
+            # vector; write_kv/attn_decode index it per row, the
+            # prefill bodies read the shared base at [0].
+            len_arr = jnp.broadcast_to(
+                jnp.asarray(cache_len, jnp.int32).reshape(-1), (b,))
             tok_arr = jnp.asarray(token_ids, jnp.int32)
             if block_table is None:
                 # Dense mode: a 1-element placeholder keeps the prefetch

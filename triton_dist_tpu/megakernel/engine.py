@@ -130,8 +130,8 @@ class MegaKernelEngine:
             pstep = self.prefill_builder.step_fn()
             self._prefill_step = jax.jit(jax.shard_map(
                 pstep, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
-                          tblspec),
+                in_specs=(P(axis, None), kvspec, kvspec, P(None),
+                          P(None), tblspec),
                 out_specs=(P(None, axis), P(axis, None), kvspec,
                            kvspec),
                 check_vma=False), donate_argnums=(0, 1, 2))
@@ -147,16 +147,16 @@ class MegaKernelEngine:
             stspec = P(None, None, axis, None, None)
             self._step = jax.jit(jax.shard_map(
                 step, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
-                          tblspec, stspec),
+                in_specs=(P(axis, None), kvspec, kvspec, P(None),
+                          P(None), tblspec, stspec),
                 out_specs=(P(None, axis), P(axis, None), kvspec, kvspec,
                            stspec),
                 check_vma=False), donate_argnums=(0, 1, 2, 6))
         else:
             self._step = jax.jit(jax.shard_map(
                 step, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None), P(),
-                          tblspec),
+                in_specs=(P(axis, None), kvspec, kvspec, P(None),
+                          P(None), tblspec),
                 out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
                 check_vma=False), donate_argnums=(0, 1, 2))
 
@@ -258,23 +258,41 @@ class MegaKernelEngine:
         if self.states is not None:
             self.states = jax.tree.map(jnp.zeros_like, self.states)
 
+    def reset_slot(self, slot: int):
+        """Zero ONE batch slot's recurrent state (hybrid family) — the
+        per-slot form of :meth:`reset_states` a continuous-batching
+        scheduler calls when recycling a slot for a new request (stale
+        KV needs no reset: the slot's fresh positions overwrite it and
+        its per-slot length masks the tail). No-op for dense/MoE."""
+        if self.states is not None:
+            self.states = self.states.at[:, slot].set(0.0)
+
     def decode_step(self, token_ids, cache_len) -> jax.Array:
         """token_ids: (B,) → logits (B, vocab). Embedding, the whole
         transformer stack, and the LM head all run inside the
         megakernel; the vocab-sharded logits are stitched by the
-        out_specs."""
+        out_specs.
+
+        ``cache_len``: a scalar (uniform batch — the classic form) OR a
+        (B,) vector of PER-SLOT positions, the live-slot serving form:
+        each batch row appends and attends at its own length, so a
+        continuous-batching scheduler can drive slots of different ages
+        through ONE persistent kernel (parked slots simply keep a stale
+        position the host ignores)."""
+        lens = jnp.broadcast_to(
+            jnp.asarray(cache_len, jnp.int32).reshape(-1),
+            (self.batch,))
         if self.states is not None:
             (logits, self._arena, self.k_cache, self.v_cache,
              self.states) = self._step(
                 self._arena, self.k_cache, self.v_cache,
-                jnp.asarray(token_ids, jnp.int32),
-                jnp.asarray(cache_len, jnp.int32), self.block_table,
-                self.states)
+                jnp.asarray(token_ids, jnp.int32), lens,
+                self.block_table, self.states)
         else:
             logits, self._arena, self.k_cache, self.v_cache = self._step(
                 self._arena, self.k_cache, self.v_cache,
-                jnp.asarray(token_ids, jnp.int32),
-                jnp.asarray(cache_len, jnp.int32), self.block_table)
+                jnp.asarray(token_ids, jnp.int32), lens,
+                self.block_table)
         return self._finish(logits, "megakernel.decode_step")
 
     def prefill_chain(self, prompt_ids):
@@ -308,7 +326,8 @@ class MegaKernelEngine:
         logits, self._arena, self.k_cache, self.v_cache = (
             self._prefill_step(self._arena, self.k_cache, self.v_cache,
                                prompt_ids.reshape(-1),
-                               jnp.asarray(start_pos, jnp.int32),
+                               jnp.full((bsz * s,), start_pos,
+                                        jnp.int32),
                                self.block_table))
         logits = self._finish(logits, "megakernel.prefill")
         return logits.reshape(bsz, s, -1)[:, -1]

@@ -221,23 +221,6 @@ def weighted_add_body(cfg, args, refs):
     jax.lax.fori_loop(0, tiles, step, 0)
 
 
-def _rope_vec(x, pos, hd, theta):
-    """x: (rows, hd) fp32; rotate-half rope at scalar position pos.
-    Everything stays 2-D — Mosaic's iota/vector ops have no 1-D form."""
-    half = hd // 2
-    # broadcasted_iota instead of arange: pallas kernels cannot capture
-    # host constants.
-    # Integer iota + cast: tpu.iota only produces integer vectors.
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, half), 1
-                                   ).astype(jnp.float32) * 2.0
-    inv = 1.0 / (theta ** (idx / hd))            # (1, half)
-    ang = pos.astype(jnp.float32) * inv          # (1, half)
-    cos, sin = jnp.cos(ang), jnp.sin(ang)
-    x1, x2 = x[:, :half], x[:, half:]
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                           axis=1)
-
-
 def _rms_rows(x, w_row, eps):
     """Row-wise RMSNorm of (rows, hd) fp32 with (hd,) weight."""
     var = jnp.mean(x * x, axis=1, keepdims=True)
@@ -246,15 +229,28 @@ def _rms_rows(x, w_row, eps):
 
 def write_kv_body(cfg, args, refs, len_s):
     """Append the new token's K/V (with k-norm + rope on K) to the cache
-    at position cache_len. Builder guarantees hd | w."""
+    at EACH BATCH ROW'S OWN position ``len_s[bb]`` — the live-slot form
+    the serving layer drives (a uniform batch passes a broadcast
+    vector and degenerates to the old single-position append). Builder
+    guarantees hd | w."""
     arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
                                refs["v_cache"])
     va, vb, vhd = refs["va"], refs["vb"], refs["vhd"]
     k_off, v_off, layer, knorm_off = args[0], args[1], args[2], args[3]
     b, hd, kv_loc, w = cfg.batch, cfg.hd, cfg.kv_loc, cfg.w
-    pos = len_s[0]
     heads_per_tile = w // hd
     kv_tiles = pl.cdiv(kv_loc * hd, w)
+    # Per-row positions as a (b, 1) value vector (SMEM reads are
+    # scalar; b is tiny and the loop static).
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), len_s[bb], jnp.int32) for bb in range(b)],
+        axis=0)
+    # Uniform-batch predicate: the classic decode (scalar broadcast)
+    # keeps its ONE batched store per (tile, K/V) fast path; only a
+    # genuinely ragged serving batch pays the per-row copies.
+    uniform = jnp.bool_(True)
+    for bb in range(1, b):
+        uniform = jnp.logical_and(uniform, len_s[bb] == len_s[0])
 
     pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)],
                     vb.at[pl.ds(0, 1)])  # (1, w) k_norm
@@ -274,20 +270,30 @@ def write_kv_body(cfg, args, refs, len_s):
             def _():
                 head = kt[:, hh * hd:(hh + 1) * hd]
                 head = _rms_rows(head, wrow, cfg.rms_eps)
-                head = _rope_vec(head, pos, hd, cfg.rope_theta)
+                head = _rope_rows(head, pos_rows, hd, cfg.rope_theta)
                 vhd[...] = head.astype(vhd.dtype)
                 if not cfg.paged:
-                    # Dense layout stores all batches of one position
-                    # contiguously — one copy.
-                    pltpu.sync_copy(
-                        vhd,
-                        k_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                    @pl.when(uniform)
+                    def _():
+                        # Dense + uniform: all batches of one position
+                        # are contiguous — one copy.
+                        pltpu.sync_copy(
+                            vhd, k_cache.at[layer, pl.ds(0, b),
+                                            len_s[0], kv_head, :])
+
+                    @pl.when(jnp.logical_not(uniform))
+                    def _():
+                        for bb in range(b):  # per-row positions
+                            pltpu.sync_copy(
+                                vhd.at[pl.ds(bb, 1)],
+                                _kv_slice(k_cache, refs, cfg, layer,
+                                          bb, len_s[bb], 1, kv_head))
                 else:
                     for bb in range(b):  # per-batch pages
                         pltpu.sync_copy(
                             vhd.at[pl.ds(bb, 1)],
                             _kv_slice(k_cache, refs, cfg, layer, bb,
-                                      pos, 1, kv_head))
+                                      len_s[bb], 1, kv_head))
 
         pltpu.sync_copy(arena.at[pl.ds(v_off + j * b, b)], va)
         vt = va[...]
@@ -299,15 +305,25 @@ def write_kv_body(cfg, args, refs, len_s):
             def _():
                 vhd[...] = vt[:, hh * hd:(hh + 1) * hd].astype(vhd.dtype)
                 if not cfg.paged:
-                    pltpu.sync_copy(
-                        vhd,
-                        v_cache.at[layer, pl.ds(0, b), pos, kv_head, :])
+                    @pl.when(uniform)
+                    def _():
+                        pltpu.sync_copy(
+                            vhd, v_cache.at[layer, pl.ds(0, b),
+                                            len_s[0], kv_head, :])
+
+                    @pl.when(jnp.logical_not(uniform))
+                    def _():
+                        for bb in range(b):
+                            pltpu.sync_copy(
+                                vhd.at[pl.ds(bb, 1)],
+                                _kv_slice(v_cache, refs, cfg, layer,
+                                          bb, len_s[bb], 1, kv_head))
                 else:
                     for bb in range(b):
                         pltpu.sync_copy(
                             vhd.at[pl.ds(bb, 1)],
                             _kv_slice(v_cache, refs, cfg, layer, bb,
-                                      pos, 1, kv_head))
+                                      len_s[bb], 1, kv_head))
         return 0
 
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
@@ -318,7 +334,9 @@ def attn_decode_body(cfg, args, refs, len_s):
 
     q: (B, h_loc*hd) activation; out same shape. Loops heads × batch;
     each (head, batch) pair streams the cache in (T_TILE, hd) tiles with
-    online-softmax accumulation.
+    online-softmax accumulation — at EACH ROW'S OWN length ``len_s[bb]``
+    (the live-slot serving form; a uniform batch degenerates to the old
+    single-length decode, including the per-row tile-loop trip counts).
     """
     arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
                                         refs["v_cache"], refs["va"],
@@ -327,9 +345,9 @@ def attn_decode_body(cfg, args, refs, len_s):
     b, hd, w = cfg.batch, cfg.hd, cfg.w
     h_loc, kv_loc = cfg.h_loc, cfg.kv_loc
     t_tile = vkt.shape[0]
-    pos = len_s[0]
-    kv_len = pos + 1
-    n_tiles_t = pl.cdiv(kv_len, t_tile)
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), len_s[bb], jnp.int32) for bb in range(b)],
+        axis=0)
     group = h_loc // kv_loc
     heads_per_tile = w // hd
 
@@ -351,13 +369,17 @@ def attn_decode_body(cfg, args, refs, len_s):
             kv_head = jnp.minimum(h_idx // group, cfg.kv_loc - 1)
             q = qtile[:, hh * hd:(hh + 1) * hd]
             q = _rms_rows(q, qn_row, cfg.rms_eps)
-            q = _rope_vec(q, pos, hd, cfg.rope_theta)
+            q = _rope_rows(q, pos_rows, hd, cfg.rope_theta)
             q = q / jnp.sqrt(jnp.float32(hd))
             row_blocks = []
 
             for bb in range(b):
+                kv_len = len_s[bb] + 1
+                n_tiles_t = pl.cdiv(kv_len, t_tile)
+
                 # All-2-D online softmax: Mosaic has no 1-D vector ops.
-                def tstep(tt, carry, bb=bb, q=q, kv_head=kv_head):
+                def tstep(tt, carry, bb=bb, q=q, kv_head=kv_head,
+                          kv_len=kv_len):
                     m, l, acc = carry
                     pltpu.sync_copy(
                         _kv_slice(k_cache, refs, cfg, layer, bb,

@@ -79,6 +79,31 @@ class ModelConfig:
         heads', the in-framework family's shape)."""
         return self.gdn_num_key_heads or self.gdn_num_heads
 
+    def kv_cache_plan(self, *, max_len: int, page: int,
+                      num_slots: int, tp: int = 1,
+                      dtype_bytes: int = 4) -> dict:
+        """Serving pool sizing off the model geometry — what the
+        serving subsystem allocates from this config: pages per
+        block-table row, pool pages for full residency (+1 reserved
+        scratch page), and the per-rank HBM bytes of K+V pools.
+        ``tp`` divides the KV heads (each rank holds its heads' pages,
+        the same placement as the dense cache)."""
+        if max_len % page:
+            raise ValueError(f"page={page} must divide max_len="
+                             f"{max_len}")
+        kv_loc = max(self.num_key_value_heads // tp, 1)
+        p_max = max_len // page
+        num_pages = 1 + num_slots * p_max
+        page_bytes = (self.num_hidden_layers * kv_loc * page
+                      * self.head_dim * dtype_bytes)
+        return {
+            "page": page, "p_max": p_max, "num_pages": num_pages,
+            "kv_heads_loc": kv_loc,
+            "page_bytes_per_rank": 2 * page_bytes,      # K and V
+            "pool_bytes_per_rank": 2 * page_bytes * num_pages,
+            "tokens_per_page": page,
+        }
+
     def layer_is_full_attn(self, layer_idx: int) -> bool:
         """Hybrid schedule: layers (interval-1, 2·interval-1, …) are full
         attention, the rest GDN (Qwen3-Next places the softmax layer

@@ -199,6 +199,11 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
     replicated (B, d) residual (M is tiny) — the reference's
     AR/gemm_ar decode regime (``e2e_dense.md:25,34``).
 
+    Cache updates go through :meth:`KVCache.append_decode` — the same
+    project → append → attend → output contract the paged serving path
+    (:func:`decode_step_paged`) drives, so dense and paged caches stay
+    interchangeable at the model layer.
+
     ``ffn_fn(layer_params, h) -> h`` overrides the FFN block (the MoE
     model's hook); the dense default is tp_mlp in the AR regime.
     """
@@ -206,18 +211,19 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
     x = params["embed"][token_ids]
     pos = cache.length
     dec_mode = "xla" if mode == "xla" else "fused_ar"
+    positions = jnp.broadcast_to(pos, (b,)).astype(jnp.int32)
+    kv_len = jnp.full((b,), pos + 1, dtype=jnp.int32)
 
-    new_k, new_v = cache.k, cache.v
     for li, layer_params in enumerate(params["layers"]):
         h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
-        attn_out, (lk, lv) = tp_attn.fwd_decode(
-            layer_params["attn"], h, cfg, new_k[li], new_v[li], pos,
-            mode=dec_mode, axis=axis, ar_ctx=ctxs.ar)
-        new_k = jax.lax.dynamic_update_slice(
-            new_k, lk[None], (li, 0, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(
-            new_v, lv[None], (li, 0, 0, 0, 0))
-        x = x + attn_out
+        q, k_tok, v_tok = tp_attn.decode_project(
+            layer_params["attn"], h, cfg, positions, axis=axis)
+        cache = cache.append_decode(li, k_tok, v_tok)
+        o = tp_attn.sdpa(q, cache.k[li], cache.v[li], causal=False,
+                         kv_len=kv_len)
+        x = x + tp_attn.decode_output(
+            layer_params["attn"], o.reshape(b, -1), h, mode=dec_mode,
+            axis=axis, ar_ctx=ctxs.ar)
         h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
         if ffn_fn is None:
             mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
@@ -231,5 +237,87 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
     logits_loc = jnp.dot(x, params["lm_head"].T,
                          preferred_element_type=jnp.float32)
     logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
-    cache = KVCache(k=new_k, v=new_v, length=cache.length + 1)
-    return logits, cache
+    return logits, cache.advance()
+
+
+def paged_cache_specs(axis: str = "tp"):
+    """PartitionSpec pytree for the serving
+    :class:`~triton_dist_tpu.serving.blocks.PagedKVCache` (KV heads
+    sharded along ``axis``; page pool, table, and lengths replicated in
+    every other dim) — the ServingEngine's shard_map spec."""
+    from triton_dist_tpu.serving.blocks import PagedKVCache
+
+    return PagedKVCache(
+        k_pages=P(None, None, axis, None, None),
+        v_pages=P(None, None, axis, None, None),
+        block_table=P(None, None), lens=P(None), live=P(None))
+
+
+def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
+                      mode: str = "xla", axis: str = "tp",
+                      ctxs: FwdContexts = FwdContexts(),
+                      attn_impl: str = "ref", ffn_fn=None):
+    """One CONTINUOUS-BATCHING decode step over a
+    :class:`~triton_dist_tpu.serving.blocks.PagedKVCache`.
+
+    token_ids: (S,) replicated — one per batch slot; ``cache`` carries
+    per-slot block tables, lengths, and the live mask. Every slot ropes
+    and attends at its OWN length, so requests of different ages share
+    one fixed-shape dispatch (the continuous-batching decode step the
+    serving scheduler drives — no recompilation as requests join and
+    leave). Parked slots (live == 0) still flow through the math (the
+    shape is fixed) but their appends land in the manager's reserved
+    scratch page, their lengths do not advance, and their logits are
+    garbage the scheduler ignores.
+
+    ``attn_impl``: ``"ref"`` gathers each layer's pages to a dense
+    (S, cap, KV_loc, hd) view and reuses :func:`tp_attn.sdpa` — the
+    token-exact-with-``Engine.serve`` path (and the CPU default);
+    ``"kernel"`` streams pages through
+    :func:`~triton_dist_tpu.ops.paged_flash_decode.paged_flash_decode`
+    without materializing the dense view (the TPU path).
+
+    ``ffn_fn(layer_params, h) -> h`` overrides the FFN block (the MoE
+    model's hook), exactly as in :func:`decode_step`.
+    """
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids]
+    dec_mode = "xla" if mode == "xla" else "fused_ar"
+    lens = cache.lens
+    # Active slots attend including the token appended this step;
+    # parked slots clamp to 1 so a fully-masked row cannot NaN the
+    # softmax (their output is discarded anyway).
+    kv_len = jnp.maximum(lens + cache.live, 1).astype(jnp.int32)
+
+    for li, layer_params in enumerate(params["layers"]):
+        h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
+        q, k_tok, v_tok = tp_attn.decode_project(
+            layer_params["attn"], h, cfg, lens, axis=axis)
+        cache = cache.append_decode(li, k_tok, v_tok)
+        if attn_impl == "kernel":
+            from triton_dist_tpu.ops.paged_flash_decode import (
+                paged_flash_decode)
+
+            o = paged_flash_decode(q[:, 0], cache.k_pages[li],
+                                   cache.v_pages[li], cache.block_table,
+                                   kv_len, axis=None)
+        else:
+            kd, vd = cache.dense_layer(li)
+            o = tp_attn.sdpa(q, kd, vd, causal=False, kv_len=kv_len)
+        x = x + tp_attn.decode_output(
+            layer_params["attn"], o.reshape(b, -1), h, mode=dec_mode,
+            axis=axis, ar_ctx=ctxs.ar)
+        h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
+        if ffn_fn is None:
+            mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+            x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
+                               axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                               ar_ctx=ctxs.ar)
+        else:
+            x = x + ffn_fn(layer_params, h)
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits, cache.advance()

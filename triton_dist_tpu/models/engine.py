@@ -247,6 +247,17 @@ class Engine:
         self._host_len = length + 1
         return out
 
+    def serving(self, **kw):
+        """Wrap this engine in a continuous-batching
+        :class:`~triton_dist_tpu.serving.ServingEngine` (paged KV pool,
+        request queue, streaming) — the production request path;
+        :meth:`serve` below stays the fixed-batch loop it is token-
+        exact against. Keyword args pass through (num_slots, page,
+        policy, deadlines, ...)."""
+        from triton_dist_tpu.serving import ServingEngine
+
+        return ServingEngine(self, **kw)
+
     def serve(self, input_ids, gen_len: int = 32, *,
               temperature: float = 0.0, top_k: int = 0,
               seed: int = 0):

@@ -34,6 +34,35 @@ class KVCache:
             self.v, v[None].astype(self.v.dtype), (layer, 0, 0, 0, 0))
         return self
 
+    def append_decode(self, layer: int, k_tok, v_tok) -> "KVCache":
+        """Append one decode token's K/V to ``layer`` at ``length``.
+
+        k_tok/v_tok: (B, 1, KV_loc, hd). This is the dense half of the
+        shared cache-update contract (its paged sibling is
+        :meth:`~triton_dist_tpu.serving.blocks.PagedKVCache.append_decode`):
+        the model projects the token, the cache owns WHERE the bytes
+        land. Replaces the ad-hoc per-layer ``dynamic_update_slice``
+        round-trips the Engine's decode loop used to do (which copied a
+        full (B, T, KV, hd) layer cache per layer per step).
+
+        Position does NOT advance here — every layer of one decode step
+        writes the same slot; call :meth:`advance` once per step.
+        """
+        k5 = k_tok[None].astype(self.k.dtype)      # (1, B, 1, KV, hd)
+        v5 = v_tok[None].astype(self.v.dtype)
+        pos = self.length
+        return KVCache(
+            k=jax.lax.dynamic_update_slice(self.k, k5,
+                                           (layer, 0, pos, 0, 0)),
+            v=jax.lax.dynamic_update_slice(self.v, v5,
+                                           (layer, 0, pos, 0, 0)),
+            length=self.length)
+
+    def advance(self, steps: int = 1) -> "KVCache":
+        """Bump ``length`` after all layers of a decode step appended."""
+        return KVCache(k=self.k, v=self.v,
+                       length=self.length + jnp.asarray(steps, jnp.int32))
+
     def tree_flatten(self):
         return (self.k, self.v, self.length), None
 

@@ -208,3 +208,28 @@ def decode_step(params, token_ids, cache, cfg: ModelConfig, *,
                             axis=axis, ep_ctx=ep_ctx)
     return _dense.decode_step(params, token_ids, cache, cfg, mode=mode,
                               axis=axis, ctxs=ctxs, ffn_fn=ffn)
+
+
+def paged_cache_specs(axis: str = "tp"):
+    from triton_dist_tpu.models import dense as _dense
+
+    return _dense.paged_cache_specs(axis)
+
+
+def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
+                      mode: str = "xla", axis: str = "tp",
+                      ctxs: FwdContexts = FwdContexts(),
+                      attn_impl: str = "ref", moe_impl: str = "tp",
+                      ep_ctx=None):
+    """Continuous-batching decode over a PagedKVCache — the dense
+    serving step with the MoE small-batch FFN plugged in (the
+    ServingEngine's model contract)."""
+    import functools
+
+    from triton_dist_tpu.models import dense as _dense
+
+    ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
+                            axis=axis, ep_ctx=ep_ctx)
+    return _dense.decode_step_paged(params, token_ids, cache, cfg,
+                                    mode=mode, axis=axis, ctxs=ctxs,
+                                    attn_impl=attn_impl, ffn_fn=ffn)

@@ -69,7 +69,8 @@ from triton_dist_tpu.ops.moe_reduce import (  # noqa: F401
     moe_reduce_rs, moe_reduce_rs_ref, moe_reduce_ar, moe_reduce_ar_ref,
 )
 from triton_dist_tpu.ops.paged_flash_decode import (  # noqa: F401
-    paged_flash_decode, page_attend, sp_flash_decode_fused,
+    paged_flash_decode, paged_flash_decode_ref, page_attend,
+    sp_flash_decode_fused,
 )
 from triton_dist_tpu.ops.sp_ag_attention import (  # noqa: F401
     sp_ag_attention, sp_ag_attention_ref, sp_ag_attention_fused,

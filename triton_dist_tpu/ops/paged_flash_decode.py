@@ -236,6 +236,12 @@ def _decode_kernel(*refs, axes, ctx: MeshContext, page: int, p_max: int,
 
 def _normalize_axes(axis, ctx, sim_ranks):
     """→ (axes innermost-first [(name, n)], total n, sim flag)."""
+    if axis is None:
+        # Local attention: no partial exchange at all — the layout
+        # where positions are NOT sharded (e.g. the serving engine's
+        # TP-head-sharded pools, every rank holding the full sequence
+        # for its heads).
+        return [("_local", 1)], 1, False
     if sim_ranks and sim_ranks > 1:
         return [(axis if isinstance(axis, str) else axis[-1],
                  sim_ranks)], sim_ranks, True
@@ -272,12 +278,18 @@ def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
         import numpy as _np
 
         cap = shard_len if sim else n * shard_len
-        if int(_np.max(_np.asarray(kv_len))) > cap:
+        lens_np = _np.asarray(kv_len)
+        if int(_np.max(lens_np)) > cap:
+            # Name the offending batch slot: a serving layer maps slots
+            # to requests, so "slot s outgrew its row" is actionable
+            # where a bare max() is not.
+            bad = int(_np.argmax(lens_np))
             layout = (f"sim: local pool only, {p_max} pages x {page}"
                       if sim else f"{n} ranks x {p_max} pages x {page}")
             raise ValueError(
-                f"kv_len max {int(_np.max(_np.asarray(kv_len)))} exceeds "
-                f"pool capacity {cap} ({layout})")
+                f"kv_len {int(lens_np[bad])} of batch slot {bad} "
+                f"exceeds one block-table row's capacity {cap} "
+                f"({layout}); the request is longer than its table row")
 
     kernel = functools.partial(
         _decode_kernel, axes=axes, ctx=ctx, page=page, p_max=p_max,
@@ -357,6 +369,35 @@ def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
     return _decode_call(q, k_pages, v_pages, block_table, kv_len,
                         ctx=ctx, axis=axis, page=page, p_max=p_max,
                         paged=True)
+
+
+def paged_flash_decode_ref(q, k_pages, v_pages, block_table, kv_len):
+    """XLA oracle for the local (single-rank) paged decode: gather the
+    block table's pages into the dense position-major cache view and
+    run plain masked attention. Token-exact with the dense-cache path
+    by construction — the serving engine's ``attn_impl="ref"`` uses
+    the same gather, so this doubles as its unit oracle.
+
+    q: (B, H, hd); k_pages/v_pages: (num_pages, KV, page, hd);
+    block_table: (B, P_max) int32; kv_len: (B,) int32 (0 = empty slot —
+    the output row is zeros-attention garbage the caller masks).
+    Returns (B, H, hd).
+    """
+    from triton_dist_tpu.ops.flash_decode import flash_decode_ref
+
+    b, p_max = block_table.shape
+    _, kvh, page, hd = k_pages.shape
+
+    def gather(pool):
+        g = pool[block_table]               # (B, P_max, KV, page, hd)
+        g = g.transpose(0, 1, 3, 2, 4)      # (B, P_max, page, KV, hd)
+        return g.reshape(b, p_max * page, kvh, hd)
+
+    # Fully-masked rows (kv_len 0) would NaN the softmax; clamp to one
+    # position — the row is garbage either way and callers mask it.
+    safe_len = jnp.maximum(kv_len, 1)
+    return flash_decode_ref(q, gather(k_pages), gather(v_pages),
+                            safe_len)
 
 
 def sp_flash_decode_fused(q, k_cache, v_cache, kv_len, *,

@@ -1,0 +1,22 @@
+"""Serving subsystem: paged KV block manager + continuous batching.
+
+The production request path over the fused/megakernel engines (see
+``docs/serving.md``): :mod:`~triton_dist_tpu.serving.blocks` manages
+the paged KV pool, :mod:`~triton_dist_tpu.serving.scheduler` the
+request queue / slots / deadlines, and
+:mod:`~triton_dist_tpu.serving.server` the streaming front end.
+"""
+
+from triton_dist_tpu.serving.blocks import (  # noqa: F401
+    BlockManager,
+    BlockTableOverflowError,
+    OutOfPagesError,
+    PagedKVCache,
+)
+from triton_dist_tpu.serving.scheduler import (  # noqa: F401
+    QueueFullError,
+    Request,
+    RequestHandle,
+    Scheduler,
+)
+from triton_dist_tpu.serving.server import ServingEngine  # noqa: F401

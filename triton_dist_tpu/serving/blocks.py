@@ -1,0 +1,357 @@
+"""Paged KV block manager — the serving layer's memory system.
+
+Reference: the paged block_table/workspace host APIs of
+``flash_decode.py:763-1095`` (``gqa_fwd_batch_decode*``) manage pages
+implicitly per call; vLLM-style serving needs an explicit allocator so
+requests can join, append, and leave a persistent decode batch without
+ever materializing a dense (B, max_len) cache per request.
+
+Two halves:
+
+- :class:`PagedKVCache` — the DEVICE pytree: per-layer page pools
+  ``(L, num_pages, KV_loc, page, hd)`` (KV heads sharded along ``tp``,
+  same placement as the dense :class:`~triton_dist_tpu.models.KVCache`)
+  plus the per-slot ``block_table``, ``lens``, and ``live`` mask that
+  ride into every decode dispatch. Consumed by
+  :func:`~triton_dist_tpu.models.dense.decode_step_paged` and
+  :func:`~triton_dist_tpu.ops.paged_flash_decode.paged_flash_decode`.
+- :class:`BlockManager` — the HOST allocator: free-list of page ids,
+  per-slot page lists, append-time page growth, fragmentation stats,
+  and optional prefix-block reuse (identical full prompt pages are
+  refcounted and shared across requests — content-addressed, so the
+  hit is exact).
+
+Page id 0 is RESERVED as the scratch page: parked (non-live) slots keep
+an all-zero table row, so the fixed-shape decode step's appends for
+dead slots land there instead of corrupting a reused page.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+SCRATCH_PAGE = 0
+
+
+class OutOfPagesError(RuntimeError):
+    """The pool has no free page (and nothing evictable) — the caller
+    should apply backpressure (reject or queue the request)."""
+
+
+class BlockTableOverflowError(RuntimeError):
+    """A request needs more pages than one block-table row holds
+    (``p_max``) — i.e. it outgrew ``max_len``; fail the request, not
+    the server."""
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Device half of the paged cache (see module docstring).
+
+    ``k_pages``/``v_pages``: (L, num_pages, KV_loc, page, hd) pools;
+    ``block_table``: (num_slots, p_max) int32 page ids;
+    ``lens``: (num_slots,) int32 valid tokens per slot;
+    ``live``: (num_slots,) int32 0/1 — the live slot mask (parked slots
+    keep shape but neither advance nor persist their appends).
+    """
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+    block_table: jax.Array
+    lens: jax.Array
+    live: jax.Array
+
+    @classmethod
+    def empty(cls, num_layers: int, num_pages: int, page: int,
+              kv_heads_loc: int, head_dim: int, *, num_slots: int,
+              p_max: int, dtype=jnp.float32) -> "PagedKVCache":
+        shape = (num_layers, num_pages, kv_heads_loc, page, head_dim)
+        return cls(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            block_table=jnp.zeros((num_slots, p_max), jnp.int32),
+            lens=jnp.zeros((num_slots,), jnp.int32),
+            live=jnp.zeros((num_slots,), jnp.int32))
+
+    @property
+    def page(self) -> int:
+        return self.k_pages.shape[3]
+
+    @property
+    def capacity(self) -> int:
+        """Tokens one block-table row can address (p_max · page)."""
+        return self.block_table.shape[1] * self.page
+
+    def append_decode(self, layer: int, k_tok, v_tok) -> "PagedKVCache":
+        """Append one decode token's K/V per slot at each slot's own
+        length — the paged half of the shared cache-update contract
+        (:meth:`~triton_dist_tpu.models.kv_cache.KVCache.append_decode`
+        is the dense half). k_tok/v_tok: (num_slots, 1, KV_loc, hd).
+        Parked slots (all-zero table row) write the scratch page.
+        Lengths advance once per step via :meth:`advance`, not here.
+        """
+        page = self.page
+        row = self.lens // page
+        off = self.lens % page
+        pids = jnp.take_along_axis(self.block_table, row[:, None],
+                                   axis=1)[:, 0]
+        k_pages = self.k_pages.at[layer, pids, :, off, :].set(
+            k_tok[:, 0].astype(self.k_pages.dtype))
+        v_pages = self.v_pages.at[layer, pids, :, off, :].set(
+            v_tok[:, 0].astype(self.v_pages.dtype))
+        return dataclasses.replace(self, k_pages=k_pages,
+                                   v_pages=v_pages)
+
+    def advance(self) -> "PagedKVCache":
+        """Bump live slots' lengths after all layers appended."""
+        return dataclasses.replace(
+            self, lens=self.lens + self.live.astype(jnp.int32))
+
+    def dense_layer(self, layer: int) -> Tuple[jax.Array, jax.Array]:
+        """Gather one layer's pages to the dense position-major view
+        (num_slots, p_max·page, KV_loc, hd) — the reference-attention
+        path (token-exact with the dense cache; positions past a slot's
+        length are garbage the kv_len mask hides)."""
+        s, p_max = self.block_table.shape
+        _, _, kvh, page, hd = self.k_pages.shape
+
+        def gather(pool):
+            g = pool[layer][self.block_table]   # (S, p_max, KV, pg, hd)
+            g = g.transpose(0, 1, 3, 2, 4)      # (S, p_max, pg, KV, hd)
+            return g.reshape(s, p_max * page, kvh, hd)
+
+        return gather(self.k_pages), gather(self.v_pages)
+
+    def write_prompt(self, k_prompt, v_prompt, page_ids) -> "PagedKVCache":
+        """Blit a prefilled prompt's K/V into this cache's pages.
+
+        k_prompt/v_prompt: (L, S_pad, KV_loc, hd) with S_pad a multiple
+        of ``page`` (pad the tail with anything — positions past the
+        slot's length are masked); ``page_ids``: (S_pad // page,) int32
+        pool slots, one per page block of the prompt slice. The caller
+        passes only the NON-prefix-shared suffix of its allocation
+        (:meth:`BlockManager.prefix_hits`): shared pages keep the first
+        sharer's bytes.
+        """
+        num_l, s_pad, kvh, hd = k_prompt.shape
+        page = self.page
+        n_p = s_pad // page
+
+        def blit(pool, prompt):
+            blocks = prompt.reshape(num_l, n_p, page, kvh, hd)
+            blocks = blocks.transpose(0, 1, 3, 2, 4)
+            return pool.at[:, page_ids].set(blocks.astype(pool.dtype))
+
+        return dataclasses.replace(
+            self, k_pages=blit(self.k_pages, k_prompt),
+            v_pages=blit(self.v_pages, v_prompt))
+
+    def tree_flatten(self):
+        return (self.k_pages, self.v_pages, self.block_table, self.lens,
+                self.live), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache, PagedKVCache.tree_flatten, PagedKVCache.tree_unflatten)
+
+
+class BlockManager:
+    """Host-side page allocator over a fixed pool (see module
+    docstring). All bookkeeping is plain Python — no device syncs; the
+    scheduler mirrors slot lengths host-side exactly like the Engine's
+    ``_host_len`` overflow guard.
+
+    ``prefix_reuse=True`` content-addresses FULL prompt pages: a second
+    request whose prompt shares a page-aligned prefix re-uses those
+    page ids (refcounted) instead of new pages. Shared pages are always
+    full, so decode appends (which only ever touch a slot's last,
+    private page) can never mutate them. The cache itself holds one
+    reference per shared page; when the free list runs dry, unreferenced
+    prefix pages are evicted LRU-insertion-order before giving up.
+    """
+
+    def __init__(self, num_pages: int, page: int, p_max: int, *,
+                 prefix_reuse: bool = False):
+        if num_pages < 2:
+            raise ValueError(f"num_pages={num_pages} < 2 (page 0 is the "
+                             "reserved scratch page)")
+        self.num_pages = num_pages
+        self.page = page
+        self.p_max = p_max
+        self.prefix_reuse = prefix_reuse
+        self._free: deque = deque(range(1, num_pages))
+        self._refs: Dict[int, int] = {}
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._slot_tokens: Dict[int, int] = {}
+        self._slot_hits: Dict[int, int] = {}
+        # prefix cache: chained content key -> page id (insertion order
+        # doubles as the eviction order).
+        self._prefix: Dict[Tuple, int] = {}
+        self.stats = {"allocs": 0, "frees": 0, "prefix_hits": 0,
+                      "prefix_misses": 0, "evictions": 0}
+
+    # -- raw pool ----------------------------------------------------
+
+    def _take_page(self) -> int:
+        if not self._free:
+            self._evict_prefix()
+        if not self._free:
+            raise OutOfPagesError(
+                f"page pool exhausted ({self.num_pages - 1} usable "
+                f"pages, {len(self._prefix)} pinned by live prefixes)")
+        pid = self._free.popleft()
+        self._refs[pid] = self._refs.get(pid, 0) + 1
+        self.stats["allocs"] += 1
+        return pid
+
+    def _drop_ref(self, pid: int):
+        self._refs[pid] -= 1
+        if self._refs[pid] == 0:
+            del self._refs[pid]
+            self._free.append(pid)
+            self.stats["frees"] += 1
+
+    def _evict_prefix(self):
+        """Free ONE unreferenced prefix-cache page (insertion order) —
+        incremental, so a transient pool-dry tick reclaims exactly what
+        it needs instead of wiping the whole warm prefix cache."""
+        for key, pid in list(self._prefix.items()):
+            if self._free:
+                break
+            if self._refs.get(pid, 0) == 1:   # only the cache's ref
+                del self._prefix[key]
+                self._drop_ref(pid)
+                self.stats["evictions"] += 1
+
+    # -- per-slot API ------------------------------------------------
+
+    def alloc_prefill(self, slot: int, tokens: Sequence[int]) -> List[int]:
+        """Allocate the page list for a prompt entering ``slot``:
+        shared full-prefix pages (when ``prefix_reuse``) + private
+        pages for the remainder. Returns the slot's page ids in
+        position order. Raises :class:`BlockTableOverflowError` when
+        the prompt alone outgrows one table row, and
+        :class:`OutOfPagesError` (allocation rolled back) when the
+        pool is dry."""
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already allocated; free it "
+                             "before reuse")
+        n_tok = len(tokens)
+        n_pages = max((n_tok + self.page - 1) // self.page, 1)
+        if n_pages > self.p_max:
+            raise BlockTableOverflowError(
+                f"prompt of {n_tok} tokens needs {n_pages} pages > one "
+                f"block-table row ({self.p_max} x {self.page})")
+        pages: List[int] = []
+        hits = 0
+        try:
+            full = n_tok // self.page
+            key: Tuple = ()
+            for i in range(n_pages):
+                if self.prefix_reuse and i < full:
+                    key = (key, tuple(tokens[i * self.page:
+                                             (i + 1) * self.page]))
+                    pid = self._prefix.get(key)
+                    if pid is not None:
+                        self._refs[pid] += 1
+                        self.stats["prefix_hits"] += 1
+                        if hits == i:     # hits are always a prefix run
+                            hits += 1
+                        pages.append(pid)
+                        continue
+                    self.stats["prefix_misses"] += 1
+                    pid = self._take_page()
+                    self._refs[pid] += 1        # the cache's own ref
+                    self._prefix[key] = pid
+                    pages.append(pid)
+                else:
+                    pages.append(self._take_page())
+        except OutOfPagesError:
+            for pid in pages:
+                self._drop_ref(pid)
+            raise
+        self._slot_pages[slot] = pages
+        self._slot_tokens[slot] = n_tok
+        self._slot_hits[slot] = hits
+        return list(pages)   # copy: appends must not mutate the result
+
+    def prefix_hits(self, slot: int) -> int:
+        """Leading page count of ``slot``'s allocation that came from
+        the prefix cache (always a prefix RUN of the page list: a hit
+        after a miss is impossible — the chained key of the later page
+        embeds the earlier miss). The server skips blitting these: their
+        KV bytes were written by the first sharer, and rewriting them
+        from a differently-shaped prefill while another request attends
+        to them has no cross-shape bit-exactness guarantee."""
+        return self._slot_hits.get(slot, 0)
+
+    def append(self, slot: int, pos: Optional[int] = None) -> Optional[int]:
+        """Account one appended token for ``slot``; allocates (and
+        returns) a fresh page when the token starts a new page, else
+        returns None. Raises :class:`BlockTableOverflowError` when the
+        request outgrows its table row.
+
+        ``pos`` (the position being written) makes the call IDEMPOTENT
+        per position: a serving step that failed mid-dispatch (comm
+        timeout) re-appends the same position on retry, and the
+        bookkeeping must not drift."""
+        n = self._slot_tokens[slot]
+        if pos is not None and pos < n:
+            return None          # retry of an already-accounted token
+        if n % self.page == 0 and n // self.page >= len(
+                self._slot_pages[slot]):
+            if len(self._slot_pages[slot]) >= self.p_max:
+                raise BlockTableOverflowError(
+                    f"slot {slot} at {n} tokens needs page "
+                    f"{n // self.page + 1} > row capacity "
+                    f"{self.p_max} x {self.page}")
+            pid = self._take_page()
+            self._slot_pages[slot].append(pid)
+            self._slot_tokens[slot] = n + 1
+            return pid
+        self._slot_tokens[slot] = n + 1
+        return None
+
+    def free_slot(self, slot: int):
+        """Release a finished request's pages (shared pages survive in
+        the prefix cache until evicted)."""
+        for pid in self._slot_pages.pop(slot, []):
+            self._drop_ref(pid)
+        self._slot_tokens.pop(slot, None)
+        self._slot_hits.pop(slot, None)
+
+    def table_row(self, slot: int):
+        """This slot's block-table row, scratch-padded to p_max."""
+        row = [SCRATCH_PAGE] * self.p_max
+        for i, pid in enumerate(self._slot_pages.get(slot, [])):
+            row[i] = pid
+        return row
+
+    def fragmentation(self) -> dict:
+        """Pool health: page accounting + internal fragmentation
+        (used-token fraction of allocated page capacity)."""
+        used_pages = self.num_pages - 1 - len(self._free)
+        used_tokens = sum(self._slot_tokens.values())
+        held_pages = sum(len(p) for p in self._slot_pages.values())
+        shared = max(held_pages - len(
+            set(p for ps in self._slot_pages.values() for p in ps)), 0)
+        cap = max(held_pages, 1) * self.page
+        return {
+            "num_pages": self.num_pages, "page": self.page,
+            "free_pages": len(self._free), "used_pages": used_pages,
+            "prefix_pages": len(self._prefix),
+            "shared_page_refs": shared,
+            "used_tokens": used_tokens,
+            "utilization": used_tokens / cap if held_pages else 1.0,
+            **self.stats,
+        }

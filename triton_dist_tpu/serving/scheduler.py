@@ -1,0 +1,220 @@
+"""Continuous-batching scheduler: admission, slots, deadlines.
+
+Reference: the serving loop the source paper's inference Engine assumes
+but never ships (``Engine.serve`` is a fixed-batch greedy loop); the
+megakernel-decode serving analysis of arXiv 2605.00686 §serving makes
+the same assumption explicit — a PERSISTENT decode batch that requests
+join and leave without recompilation.
+
+This module is engine-agnostic bookkeeping: a bounded request queue
+(admission control / backpressure), a fixed set of batch slots requests
+are admitted into, per-request deadlines, and slot recycling on
+completion. The device work — prefill, the fixed-shape decode dispatch,
+page allocation — is driven by
+:class:`~triton_dist_tpu.serving.server.ServingEngine`, which consumes
+this scheduler's decisions.
+
+Policies:
+
+- ``"continuous"`` — admit into any free slot every tick (requests of
+  different ages share the decode batch; a finished slot is refilled
+  next tick).
+- ``"static"`` — gang admission: new requests wait until EVERY slot is
+  free, then a full batch enters together (the fixed-batch baseline;
+  kept as the bench/ablation reference, not for production).
+
+Deadlines use an injectable ``clock`` (tests drive a fake one — no
+wall-clock in the battery). A deadline miss fails THAT request; a hung
+collective (the watchdog's :class:`CommTimeoutError`) is mapped by the
+server onto :meth:`Scheduler.timeout_victims` so one wedged dispatch
+fails the expired (or eldest) request instead of the whole server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Request", "RequestHandle", "QueueFullError", "Scheduler"]
+
+
+class QueueFullError(RuntimeError):
+    """Admission control rejected the request — the wait queue is at
+    ``max_queue``. Back off and resubmit (backpressure, not a crash)."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``deadline`` is an ABSOLUTE time on the scheduler's clock (pass
+    ``scheduler.now() + budget``); ``None`` = unbounded. ``stream_cb``
+    (token_id, handle) fires for every generated token as soon as the
+    host sees it. Sampling fields mirror ``Engine.serve`` (temperature
+    0 = greedy); seeds fold per-request steps, so a request samples the
+    same tokens whether it is served alone or in a shared batch.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 32
+    request_id: Optional[str] = None
+    eos_id: Optional[int] = None
+    deadline: Optional[float] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    stream_cb: Optional[Callable[[int, "RequestHandle"], None]] = None
+
+
+@dataclasses.dataclass
+class RequestHandle:
+    """Mutable per-request state the server and callers observe.
+
+    ``status``: queued → prefill → running → one of
+    done | failed | timeout. ``tokens`` grows as the request decodes
+    (``stream_cb`` sees each append); ``error`` carries the failure.
+    """
+
+    request: Request
+    status: str = "queued"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[BaseException] = None
+    slot: Optional[int] = None
+    submitted_at: float = 0.0
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    decode_steps: int = 0
+    # prefill-lane cursor + sequence (megakernel path): the tokens the
+    # lane must stream through the decode batch. The lane is the prompt
+    # on a fresh admit, or prompt + already-generated tokens when a
+    # PREEMPTED request re-enters (its cache must be rebuilt).
+    prompt_pos: int = 0
+    lane: Optional[List[int]] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in ("done", "failed", "timeout")
+
+
+class Scheduler:
+    """Slot + queue bookkeeping for one serving engine (see module
+    docstring). Not thread-safe: the serving loop is single-threaded
+    host code, like the reference's model server."""
+
+    def __init__(self, num_slots: int, *, max_queue: int = 64,
+                 policy: str = "continuous",
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in ("continuous", "static"):
+            raise ValueError(f"policy must be 'continuous' or 'static', "
+                             f"got {policy!r}")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        self.policy = policy
+        self.clock = clock
+        self.queue: deque[RequestHandle] = deque()
+        self.slots: Dict[int, RequestHandle] = {}
+        self._ids = itertools.count()
+        self.counters = {
+            "submitted": 0, "rejected": 0, "completed": 0, "failed": 0,
+            "timed_out": 0, "queue_peak": 0,
+        }
+
+    def now(self) -> float:
+        return self.clock()
+
+    # -- admission ---------------------------------------------------
+
+    def submit(self, request: Request) -> RequestHandle:
+        """Admit into the wait queue, or raise :class:`QueueFullError`
+        (backpressure) when it is at ``max_queue``."""
+        if len(self.queue) >= self.max_queue:
+            self.counters["rejected"] += 1
+            raise QueueFullError(
+                f"wait queue full ({self.max_queue}); retry later")
+        if request.request_id is None:
+            request = dataclasses.replace(
+                request, request_id=f"req-{next(self._ids)}")
+        h = RequestHandle(request=request, submitted_at=self.now())
+        self.queue.append(h)
+        self.counters["submitted"] += 1
+        self.counters["queue_peak"] = max(self.counters["queue_peak"],
+                                          len(self.queue))
+        return h
+
+    # -- slot assignment --------------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots) if s not in self.slots]
+
+    def admit(self) -> List[RequestHandle]:
+        """Move queued requests into free slots per the policy; returns
+        the newly-placed handles (status ``"prefill"`` — the server
+        runs their prefill / starts their prefill lane)."""
+        free = self.free_slots()
+        if self.policy == "static" and len(free) < self.num_slots:
+            return []
+        placed = []
+        while free and self.queue:
+            h = self.queue.popleft()
+            h.slot = free.pop(0)
+            h.status = "prefill"
+            h.started_at = self.now()
+            self.slots[h.slot] = h
+            placed.append(h)
+        return placed
+
+    def running(self) -> List[RequestHandle]:
+        """Handles currently owning a slot, slot-ordered."""
+        return [self.slots[s] for s in sorted(self.slots)]
+
+    def retire(self, h: RequestHandle, status: str,
+               error: Optional[BaseException] = None):
+        """Finish a request and recycle its slot."""
+        h.status = status
+        h.error = error
+        h.finished_at = self.now()
+        if h.slot is not None:
+            self.slots.pop(h.slot, None)
+            h.slot = None
+        key = {"done": "completed", "timeout": "timed_out"}.get(
+            status, "failed")
+        self.counters[key] += 1
+
+    # -- deadlines ---------------------------------------------------
+
+    def expired(self, now: Optional[float] = None) -> List[RequestHandle]:
+        """Queued or running handles whose deadline has passed (the
+        caller retires them — queued ones never touch a slot)."""
+        t = self.now() if now is None else now
+        out = [h for h in self.queue
+               if h.request.deadline is not None
+               and t >= h.request.deadline]
+        for h in out:
+            self.queue.remove(h)
+        out += [h for h in self.running()
+                if h.request.deadline is not None
+                and t >= h.request.deadline]
+        return out
+
+    def timeout_victims(self) -> List[RequestHandle]:
+        """Who a hung collective (CommTimeoutError on the shared decode
+        dispatch) should fail: every running request past its deadline,
+        else the eldest running request — one victim guarantees
+        progress, the server and the other requests survive."""
+        victims = [h for h in self.running()
+                   if h.request.deadline is not None
+                   and self.now() >= h.request.deadline]
+        if not victims:
+            alive = self.running()
+            if alive:
+                victims = [min(alive, key=lambda h: h.started_at)]
+        return victims
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.slots

@@ -1,0 +1,573 @@
+"""ServingEngine: continuous batching over the layer / megakernel engines.
+
+Reference: the megakernel ``model_server.py`` / chat demo
+(``mega_triton_kernel/test/models``) serve a fixed batch; this engine
+adds the missing serving layer — a PERSISTENT fixed-shape decode batch
+that requests join and leave without recompilation, backed by the
+:mod:`~triton_dist_tpu.serving.blocks` page pool and driven by the
+:mod:`~triton_dist_tpu.serving.scheduler` policies.
+
+Two backends behind one API:
+
+- ``models.Engine`` (layer path): prompts prefill through the engine's
+  own (token-exact) prefill dispatch; the resulting KV blits into the
+  slot's pages; decode runs ONE jitted
+  :func:`~triton_dist_tpu.models.dense.decode_step_paged` dispatch of
+  fixed shape — per-slot lengths, block tables, and the live mask ride
+  in as data, so the jit cache stays at one entry after warmup.
+- ``MegaKernelEngine`` (megakernel path): no separate prefill — an
+  admitted prompt streams through the SAME persistent decode kernel
+  one token per tick (the prefill lane), each slot at its own cache
+  position via the per-slot ``cache_len`` vector (the live-slot form
+  of the megakernel decode step).
+
+Failure containment: per-request deadlines fail one request; a hung
+collective (the resilience watchdog's :class:`CommTimeoutError`) fails
+the scheduler's chosen victim(s) and the server keeps serving — the
+step's device results are dropped, host length mirrors do not advance,
+and the next dispatch deterministically rewrites the same cache
+positions, so survivors stay token-exact. (Exception: the hybrid-GDN
+megakernel's recurrent state is not position-addressed, so a retried
+step cannot be made exact — there a timeout fails every in-flight
+request and only the server survives.)
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from triton_dist_tpu.serving.blocks import (
+    BlockManager, BlockTableOverflowError, OutOfPagesError, PagedKVCache,
+)
+from triton_dist_tpu.serving.scheduler import (
+    Request, RequestHandle, Scheduler,
+)
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Continuous-batching server over a layer ``Engine`` or a
+    ``MegaKernelEngine`` (see module docstring).
+
+    ``num_slots``: decode-batch width (layer path; the megakernel path
+    is pinned to the engine's ``batch``). ``page``: tokens per KV page
+    (layer path; must divide the engine's ``max_len`` so the paged
+    view is position-exact with the dense baseline). ``num_pages``:
+    pool size incl. the reserved scratch page (default: full residency
+    for every slot). ``policy``: ``"continuous"`` | ``"static"`` (gang
+    batching — the bench ablation). ``attn_impl``: ``"ref"`` |
+    ``"kernel"`` (layer path; default ref — token-exact and
+    interpret-friendly). ``timeout_s`` arms a watchdog on every decode
+    dispatch; ``clock`` is injectable for deadline tests.
+    """
+
+    def __init__(self, engine, *, num_slots: Optional[int] = None,
+                 page: Optional[int] = None,
+                 num_pages: Optional[int] = None, max_queue: int = 64,
+                 policy: str = "continuous", attn_impl: str = "ref",
+                 prefix_reuse: bool = False, timeout_s=None,
+                 clock=time.monotonic):
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        self.engine = engine
+        self.mega = isinstance(engine, MegaKernelEngine)
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else getattr(engine, "timeout_s", None))
+        if isinstance(engine, MegaKernelEngine) and timeout_s is not None:
+            # The megakernel path bounds its own step dispatch; arm it.
+            engine.timeout_s = timeout_s
+        self.cfg = engine.cfg
+        self.max_len = engine.max_len
+        self.stats_counters = {
+            "decode_dispatches": 0, "tokens_generated": 0,
+            "prefill_tokens": 0, "prefill_calls": 0, "admit_stalls": 0,
+            "preemptions": 0, "comm_timeouts": 0, "decode_time_s": 0.0,
+            "decode_tokens": 0,
+        }
+
+        if self.mega:
+            num_slots = engine.batch
+            if engine.paged:
+                page = engine.builder.page
+                p_max = engine.builder.p_max
+                if engine.num_pages < num_slots * p_max + 1:
+                    raise ValueError(
+                        "paged megakernel serving reserves page 0 as "
+                        f"scratch: construct the engine with num_pages "
+                        f">= batch*p_max+1 (= {num_slots * p_max + 1}, "
+                        f"got {engine.num_pages})")
+                self.page, self.p_max = page, p_max
+                self.manager = BlockManager(engine.num_pages, page,
+                                            p_max,
+                                            prefix_reuse=prefix_reuse)
+            else:
+                # Dense megakernel cache: each slot owns a (max_len,)
+                # row — no pages to manage, only the live-slot mask.
+                self.page = self.max_len
+                self.p_max = 1
+                self.manager = None
+        else:
+            num_slots = num_slots or 4
+            page = page or math.gcd(self.max_len, 32)
+            if self.max_len % page:
+                raise ValueError(
+                    f"page={page} must divide max_len={self.max_len} "
+                    "(keeps the paged view position-exact with the "
+                    "dense baseline)")
+            self.page = page
+            self.p_max = self.max_len // page
+            # Pool sized off the MODEL CONFIG (full residency for every
+            # slot by default; undersize num_pages to exercise
+            # backpressure).
+            self.plan = self.cfg.kv_cache_plan(
+                max_len=self.max_len, page=page, num_slots=num_slots,
+                tp=engine.mesh.shape[engine.axis])
+            num_pages = num_pages or self.plan["num_pages"]
+            self.manager = BlockManager(num_pages, page, self.p_max,
+                                        prefix_reuse=prefix_reuse)
+            self._build_layer_path(num_slots, num_pages)
+
+        self.sched = Scheduler(num_slots, max_queue=max_queue,
+                               policy=policy, clock=clock)
+        self.num_slots = num_slots
+        self.attn_impl = attn_impl
+        # Host mirrors (numpy) of the per-slot device state — the
+        # scheduler never syncs the device to make a decision.
+        self._lens = np.zeros((num_slots,), np.int32)
+        self._live = np.zeros((num_slots,), np.int32)
+        self._toks = np.zeros((num_slots,), np.int32)
+
+    # -- layer-path construction ------------------------------------
+
+    def _build_layer_path(self, num_slots: int, num_pages: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        eng = self.engine
+        model = eng.model
+        if not hasattr(model, "decode_step_paged"):
+            raise NotImplementedError(
+                f"model {getattr(model, '__name__', model)!r} has no "
+                "decode_step_paged — serve it through the megakernel "
+                "engine instead")
+        cfg, mesh, axis = eng.cfg, eng.mesh, eng.axis
+        n = mesh.shape[axis]
+        # GLOBAL kv-head count here — the sharding below carves it into
+        # the per-shard kv_loc the decode step sees.
+        cache = PagedKVCache.empty(
+            cfg.num_hidden_layers, num_pages, self.page,
+            cfg.num_key_value_heads, cfg.head_dim, num_slots=num_slots,
+            p_max=self.p_max,
+            dtype=jax.tree.leaves(eng.params)[0].dtype)
+        kv_spec = model.paged_cache_specs(axis)
+        shardings = jax.tree.map(
+            lambda x, s: NamedSharding(mesh, s), cache, kv_spec,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        self.cache = jax.tree.map(jax.device_put, cache, shardings,
+                                  is_leaf=lambda x: isinstance(x, jax.Array))
+
+        def _decode(params, toks, c):
+            return model.decode_step_paged(
+                params, toks, c, cfg, mode=eng.mode, axis=axis,
+                ctxs=eng.ctxs, attn_impl=self.attn_impl,
+                **eng.model_kwargs)
+
+        self._decode = jax.jit(jax.shard_map(
+            _decode, mesh=mesh,
+            in_specs=(eng._specs, P(None), kv_spec),
+            out_specs=(P(None, None), kv_spec),
+            check_vma=False), donate_argnums=(2,))
+        # Pinned out_shardings: the writer's output must land with the
+        # exact shardings the decode dispatch was compiled for, or the
+        # first post-admit step would re-specialize the jit cache.
+        self._writer = jax.jit(
+            lambda c, k0, v0, pids: c.write_prompt(k0, v0, pids),
+            donate_argnums=(0,), out_shardings=shardings)
+        self._axis_n = n
+
+    # -- public API --------------------------------------------------
+
+    def submit(self, request, **kw) -> RequestHandle:
+        """Admit a request (a :class:`Request`, or a prompt sequence
+        plus :class:`Request` kwargs). Raises
+        :class:`~triton_dist_tpu.serving.scheduler.QueueFullError` on
+        backpressure and ``ValueError`` for requests that could never
+        fit (fail fast, mirroring ``Engine.serve``'s bound check)."""
+        if isinstance(request, Request):
+            if kw:
+                raise TypeError(
+                    f"keyword args {sorted(kw)} ignored when passing a "
+                    "Request — set them on the Request itself")
+        else:
+            request = Request(prompt=list(request), **kw)
+        if len(request.prompt) == 0:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(request.prompt) + request.max_new_tokens
+        cap = self.p_max * self.page
+        if total > cap or total > self.max_len:
+            raise ValueError(
+                f"prompt {len(request.prompt)} + gen "
+                f"{request.max_new_tokens} exceeds capacity "
+                f"{min(cap, self.max_len)}")
+        return self.sched.submit(request)
+
+    def step(self) -> int:
+        """One serving tick: deadlines → admission/prefill → one joint
+        decode dispatch → per-slot token handling. Returns how many
+        live slots decoded (0 = idle tick)."""
+        now = self.sched.now()
+        for h in self.sched.expired(now):
+            self._fail(h, "timeout", TimeoutError(
+                f"request {h.request.request_id} missed deadline "
+                f"{h.request.deadline} (now {now})"))
+        stalled: List[RequestHandle] = []
+        for h in self.sched.admit():
+            self._admit(h, stalled)
+        # Pool-starved admissions go back to the queue HEAD in their
+        # original submission order (reversed appendleft — two stalls
+        # in one tick must not leapfrog each other).
+        for h in reversed(stalled):
+            self.sched.queue.appendleft(h)
+        return self._decode_tick()
+
+    def run(self, *, max_steps: int = 100000) -> None:
+        """Drive :meth:`step` until queue and slots drain."""
+        for _ in range(max_steps):
+            if self.sched.idle:
+                return
+            self.step()
+        raise RuntimeError(f"serving loop did not drain in {max_steps} "
+                           "steps")
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, **kw) -> List[List[int]]:
+        """Batch convenience: submit every prompt, run to idle, return
+        per-prompt token lists (order preserved)."""
+        handles = [self.submit(p, max_new_tokens=max_new_tokens, **kw)
+                   for p in prompts]
+        self.run()
+        for h in handles:
+            if h.status != "done":
+                raise RuntimeError(
+                    f"request {h.request.request_id} ended "
+                    f"{h.status}: {h.error!r}") from h.error
+        return [h.tokens for h in handles]
+
+    def stats(self) -> dict:
+        """Serving counters + scheduler counters + pool fragmentation
+        (the request/latency/throughput surface the profiler hooks and
+        bench read)."""
+        out = dict(self.stats_counters)
+        out.update(self.sched.counters)
+        out["queue_depth"] = len(self.sched.queue)
+        out["live_slots"] = int(self._live.sum())
+        if self.manager is not None:
+            out["pool"] = self.manager.fragmentation()
+        if hasattr(self, "plan"):
+            out["plan"] = self.plan
+        if self.stats_counters["decode_time_s"] > 0:
+            # Decode-emitted tokens over decode-dispatch time only —
+            # the first token of each request comes from prefill and
+            # must not inflate the decode throughput number.
+            out["tokens_per_s"] = (
+                self.stats_counters["decode_tokens"]
+                / self.stats_counters["decode_time_s"])
+        return out
+
+    def decode_cache_size(self) -> int:
+        """Jit-cache entries of the shared decode dispatch — the
+        no-recompilation-after-warmup gate (1 after warmup: the decode
+        batch shape is fixed)."""
+        fn = self.engine._step if self.mega else self._decode
+        return fn._cache_size()
+
+    def trace(self, name: str = "serving", **kw):
+        """Profiler hook: a multi-device trace of the serving loop
+        (delegates to :func:`profiler_utils.group_profile`)."""
+        from triton_dist_tpu.profiler_utils import group_profile
+
+        return group_profile(name, **kw)
+
+    # -- admission / prefill ----------------------------------------
+
+    def _unadmit(self, h: RequestHandle, error: OutOfPagesError,
+                 stalled: List[RequestHandle]):
+        """Roll an admitted request back (pool dry — backpressure); the
+        caller requeues ``stalled`` at the head in submission order. If
+        NOTHING else holds a slot, no future retirement can free pages,
+        so waiting would spin forever: fail it instead."""
+        self.sched.slots.pop(h.slot, None)
+        h.slot = None
+        if not self.sched.slots:
+            self._fail(h, "failed", error)
+            return
+        h.status, h.started_at = "queued", None
+        stalled.append(h)
+        self.stats_counters["admit_stalls"] += 1
+
+    def _admit(self, h: RequestHandle,
+               stalled: List[RequestHandle]):
+        import jax.numpy as jnp
+
+        slot = h.slot
+        # Resume form (preempted requests): the cache must be rebuilt
+        # from the prompt PLUS every already-fed generated token; the
+        # last generated token was never fed and re-enters via decode.
+        seq = list(h.request.prompt) + [int(t) for t in h.tokens[:-1]]
+        if self.mega:
+            # Prefill lane: ``seq`` streams through the shared decode
+            # kernel one token per tick. Fresh slot state now.
+            if self.manager is not None:
+                try:
+                    self.manager.alloc_prefill(slot, seq)
+                except OutOfPagesError as e:
+                    self._unadmit(h, e, stalled)
+                    return
+            if hasattr(self.engine, "reset_slot"):
+                self.engine.reset_slot(slot)
+            h.lane = seq
+            h.prompt_pos = 0
+            h.status = "prefill"
+            self._lens[slot] = 0
+            self._live[slot] = 1
+            self._toks[slot] = seq[0]
+            return
+        try:
+            pages = self.manager.alloc_prefill(slot, seq)
+        except OutOfPagesError as e:
+            self._unadmit(h, e, stalled)
+            return
+        # Token-exact prefill through the engine's own dispatch: B=tp
+        # identical rows satisfies the token-sharding divisibility for
+        # ANY prompt length; row 0 is the answer (chat_server pattern).
+        # A wedged prefill (CommTimeoutError) fails THIS request only —
+        # slot and pages must not leak, and the loop must survive.
+        eng = self.engine
+        ids = np.tile(np.asarray([seq], np.int32), (self._axis_n, 1))
+        try:
+            logits, kv = eng.prefill(jnp.asarray(ids))
+        except Exception as e:  # noqa: BLE001 — route through policy
+            from triton_dist_tpu.resilience.watchdog import (
+                CommTimeoutError)
+
+            if isinstance(e, CommTimeoutError):
+                self.stats_counters["comm_timeouts"] += 1
+                self._fail(h, "timeout", e)
+                return
+            # Unexpected failure: still release the slot and pages
+            # (no leaked half-admitted state), then propagate.
+            self._fail(h, "failed", e)
+            raise
+        self.stats_counters["prefill_calls"] += 1
+        self.stats_counters["prefill_tokens"] += len(seq)
+        # Blit only the NON-shared suffix pages: prefix-hit pages hold
+        # KV already computed by the first sharer, and rewriting them
+        # with this (differently-shaped) prefill's floats could perturb
+        # a live request attending to them — XLA guarantees no bit-
+        # exactness across shapes. (Also skips the redundant writes.)
+        hits = self.manager.prefix_hits(slot)
+        if hits < len(pages):
+            s_pad = len(pages) * self.page
+            k0 = kv.k[:, 0, hits * self.page:s_pad]
+            v0 = kv.v[:, 0, hits * self.page:s_pad]
+            self.cache = self._writer(
+                self.cache, k0, v0,
+                jnp.asarray(pages[hits:], jnp.int32))
+        self._lens[slot] = len(seq)
+        self._live[slot] = 1
+        h.status = "running"
+        if not h.tokens:
+            first = self._pick(np.asarray(logits)[0], h.request, 0)
+            self._emit(h, first)
+        # resumed: the next decode tick feeds h.tokens[-1] at len(seq)
+
+    # -- the decode tick --------------------------------------------
+
+    def _decode_tick(self) -> int:
+        import jax.numpy as jnp
+
+        active = [h for h in self.sched.running()
+                  if h.status in ("prefill", "running")]
+        if not active:
+            return 0
+        preempted = []
+        for h in active:
+            slot = h.slot
+            if self.mega and h.status == "prefill":
+                self._toks[slot] = h.lane[h.prompt_pos]
+            else:
+                self._toks[slot] = h.tokens[-1]
+            if self.manager is not None and not (
+                    self.mega and h.status == "prefill"):
+                # Page-boundary growth for the (generated) token being
+                # written this step; prefill-lane tokens land in pages
+                # alloc_prefill already reserved. Passing the position
+                # keeps the accounting idempotent across a timed-out
+                # step's retry. A row overflow here is a caller bug
+                # (submit validates capacity) — propagate.
+                try:
+                    self.manager.append(slot, int(self._lens[slot]))
+                except OutOfPagesError as e:
+                    # Pool dry MID-DECODE: preempt this request —
+                    # release its pages, requeue it at the head, and
+                    # let it resume later via re-prefill of prompt +
+                    # generated-so-far (deterministic, so still
+                    # token-exact). One starving request must not
+                    # crash the server.
+                    self._preempt(h, e)
+                    preempted.append(h)
+        if preempted:
+            active = [h for h in active if h not in preempted]
+            if not active:
+                return 0
+        tbl = np.zeros((self.num_slots, self.p_max), np.int32)
+        if self.manager is not None:
+            for h in active:
+                tbl[h.slot] = self.manager.table_row(h.slot)
+
+        t0 = time.perf_counter()
+        try:
+            logits = self._dispatch(tbl)
+        except Exception as e:  # noqa: BLE001 — route through policy
+            from triton_dist_tpu.resilience.watchdog import (
+                CommTimeoutError)
+
+            if not isinstance(e, CommTimeoutError):
+                raise
+            self.stats_counters["comm_timeouts"] += 1
+            if self.mega and getattr(self.engine, "states",
+                                     None) is not None:
+                # Hybrid GDN: the recurrent state is NOT position-
+                # addressed, so a retried step would advance survivors'
+                # states twice for one token — no exact recovery
+                # exists. Fail every in-flight request; the server (and
+                # new requests, via reset_slot) stay healthy.
+                victims = list(self.sched.running())
+            else:
+                victims = self.sched.timeout_victims()
+            for victim in victims:
+                self._fail(victim, "timeout", e)
+            return 0
+        self.stats_counters["decode_time_s"] += time.perf_counter() - t0
+        self.stats_counters["decode_dispatches"] += 1
+
+        for h in active:
+            slot = h.slot
+            self._lens[slot] += 1
+            if self.mega and h.status == "prefill":
+                h.prompt_pos += 1
+                if h.prompt_pos < len(h.lane):
+                    continue
+                h.status = "running"   # last lane token's logits
+                if h.tokens:
+                    # Resumed lane: the next token to feed is already
+                    # known (h.tokens[-1]); do not re-pick it.
+                    continue
+            h.decode_steps += 1
+            self.stats_counters["decode_tokens"] += 1
+            tok = self._pick(logits[slot], h.request, len(h.tokens))
+            self._emit(h, tok)
+        return len(active)
+
+    def _dispatch(self, tbl: np.ndarray) -> np.ndarray:
+        """Run the joint decode under the (optional) watchdog; returns
+        host logits (num_slots, vocab)."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+        from triton_dist_tpu.resilience.watchdog import block_until_ready
+
+        lens = jnp.asarray(self._lens)
+        live = jnp.asarray(self._live)
+        toks = jnp.asarray(self._toks)
+        if self.mega:
+            if self.manager is not None:
+                # Paged megakernel: install THIS tick's allocator table
+                # (flat (batch·p_max,), the builder's prefetch layout) —
+                # the engine's identity table is only its standalone
+                # default, and parked rows must hit the scratch page.
+                self.engine.block_table = jnp.asarray(
+                    tbl.reshape(-1), jnp.int32)
+            out = self.engine.decode_step(toks, lens)
+        else:
+            cache = _dc.replace(self.cache,
+                                block_table=jnp.asarray(tbl),
+                                lens=lens, live=live)
+            out, self.cache = self._decode(self.engine.params, toks,
+                                           cache)
+            if self.timeout_s is not None:
+                out = block_until_ready(
+                    out, timeout_s=self.timeout_s, op="serving.decode",
+                    progress_fn=lambda: {
+                        "lens": self._lens.tolist(),
+                        "live": self._live.tolist(),
+                        **{k: self.stats_counters[k] for k in
+                           ("decode_dispatches", "tokens_generated")}})
+        return np.asarray(out)
+
+    # -- per-request token handling ---------------------------------
+
+    def _pick(self, logits_row: np.ndarray, req: Request,
+              step: int) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        import jax
+        import jax.numpy as jnp
+
+        lg = jnp.asarray(logits_row, jnp.float32) / req.temperature
+        if req.top_k > 0:
+            kth = jax.lax.top_k(lg, req.top_k)[0][-1]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed), step)
+        return int(jax.random.categorical(key, lg))
+
+    def _emit(self, h: RequestHandle, tok: int):
+        h.tokens.append(int(tok))
+        self.stats_counters["tokens_generated"] += 1
+        if h.request.stream_cb is not None:
+            h.request.stream_cb(int(tok), h)
+        hit_eos = (h.request.eos_id is not None
+                   and tok == h.request.eos_id)
+        if hit_eos or len(h.tokens) >= h.request.max_new_tokens:
+            self._retire(h, "done")
+
+    def _preempt(self, h: RequestHandle, error: OutOfPagesError):
+        """Evict a starving request mid-decode: free its pages, park
+        its slot, requeue it at the HEAD for a resume re-prefill. If it
+        was the only slot-holder, nothing can ever free pages for it —
+        fail it instead of spinning."""
+        slot = h.slot
+        self.sched.slots.pop(slot, None)
+        h.slot = None
+        self._live[slot] = 0
+        self._lens[slot] = 0
+        self._toks[slot] = 0
+        self.manager.free_slot(slot)
+        if not self.sched.slots:
+            h.slot = slot            # _fail/retire bookkeeping no-op path
+            self._fail(h, "failed", error)
+            return
+        h.status = "queued"
+        self.sched.queue.appendleft(h)
+        self.stats_counters["preemptions"] += 1
+
+    def _retire(self, h: RequestHandle, status: str, error=None):
+        slot = h.slot
+        self.sched.retire(h, status, error)
+        if slot is not None:
+            self._live[slot] = 0
+            self._lens[slot] = 0
+            self._toks[slot] = 0
+            if self.manager is not None:
+                self.manager.free_slot(slot)
+
+    def _fail(self, h: RequestHandle, status: str, error):
+        self._retire(h, status, error)
