@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke apicheck ci bench-all
+	serve-smoke ep-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -46,6 +46,12 @@ bench-megakernel: csrc
 # the fixed-decode-shape jit-cache check (docs/serving.md).
 serve-smoke: csrc
 	bash scripts/serve_smoke.sh
+
+# EP serving battery: skewed-routing token-exactness across decode
+# transports on the CPU mesh + a non-null bench.py ep_dispatch_ms gate
+# (docs/serving.md EP-decode section).
+ep-smoke: csrc
+	bash scripts/ep_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

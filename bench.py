@@ -184,6 +184,54 @@ def _probe_cache_path() -> str:
                         "backend_probe.json")
 
 
+def _partials_path() -> str:
+    """Per-config partial sweep results, persisted AS MEASURED so a
+    mid-sweep death (hung Mosaic compile, tunnel drop, hard kill)
+    still yields data — the BENCH_r02–r05 stale-copy pattern's fix:
+    the next run (or the stale-fallback record) salvages whatever
+    configs completed."""
+    return os.path.join(os.path.dirname(_last_result_path()),
+                        "bench_partials.json")
+
+
+def _load_partials():
+    try:
+        with open(_partials_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _note_partial(name: str, cfg, seconds: float) -> None:
+    """Append one swept config's timing to the partials file (load-
+    modify-replace; bench sweeps are single-process)."""
+    rec = _load_partials() or {"started_at_unix": int(time.time()),
+                               "sweeps": {}}
+    rec["sweeps"].setdefault(name, []).append(
+        {"config": cfg, "ms": round(seconds * 1e3, 3)})
+    rec["updated_at_unix"] = int(time.time())
+    tmp = _partials_path() + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, _partials_path())
+    except OSError:
+        pass
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _clear_partials() -> None:
+    try:
+        os.remove(_partials_path())
+    except OSError:
+        pass
+
+
 def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
     """Retry backend bring-up in SUBPROCESSES (jax caches a failed
     backend for the life of the process, so in-process retries are
@@ -237,55 +285,84 @@ def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
         return error
 
     probe_cap = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "30"))
-    err, t_end, first = None, time.monotonic() + budget_s, True
+
+    def _attempt(code: str, timeout_s: float):
+        """One probe subprocess → ("ok"|"cpu"|"retry", error|None).
+        The axon plugin pins jax_platforms="axon,cpu": a failed TPU
+        init can fall back to CPU, which would pass a bare device-count
+        probe and then "measure" Mosaic kernels on the CPU backend.
+        Require a non-CPU device — but report a completed CPU-only
+        probe distinctly from a crash."""
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return "retry", f"probe timeout ({timeout_s:.0f}s)"
+        if r.returncode != 0:
+            return "retry", (r.stderr.strip().splitlines()
+                             or ["unknown"])[-1][:300]
+        platform, cfg = "unknown", ""
+        for line in r.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                platform = line.split("=", 1)[1]
+            elif line.startswith("CONFIG="):
+                cfg = line.split("=", 1)[1]
+        if platform not in ("cpu", "none", "unknown"):
+            return "ok", None
+        non_cpu = [p for p in cfg.replace(" ", "").split(",")
+                   if p and p != "cpu"]
+        if non_cpu:
+            # A non-CPU platform is configured but init fell back to
+            # CPU — a transient tunnel blip, not a definite verdict:
+            # keep retrying and never cache it.
+            return "retry", (f"configured platform {non_cpu[0]!r} fell "
+                             "back to cpu (transient init failure?)")
+        # Definite: no non-CPU platform is even configured and the
+        # backend came up CPU-only. Retrying cannot change that.
+        return "cpu", f"cpu-only backend (platform={platform})"
+
+    _CONFIG = ("import os, jax; "
+               "cfg = (jax.config.jax_platforms "
+               "       or os.environ.get('JAX_PLATFORMS') or ''); "
+               "print('CONFIG=' + cfg); ")
+    # Tier 0: ONE TRIVIAL-KERNEL SMOKE with a short deadline before the
+    # long device-count probe. A healthy backend compiles and runs an
+    # 8x8 reduction in seconds; a wedged tunnel hangs — don't spend the
+    # 240 s-class probe budget finding that out (the BENCH_r02-r05
+    # failure shape). A definitive smoke verdict (device present and a
+    # kernel actually ran, or definitely CPU-only) skips tier 1.
+    smoke_cap = float(os.environ.get("BENCH_PROBE_SMOKE_TIMEOUT_S",
+                                     "20"))
+    smoke_code = (_CONFIG +
+                  "import jax.numpy as jnp; "
+                  "v = float(jnp.ones((8, 8)).sum()); "
+                  "assert v == 64.0, v; "
+                  "d = jax.devices(); "
+                  "print('PLATFORM=' + (d[0].platform if d else 'none'))")
+    verdict, err = _attempt(smoke_code,
+                            max(min(smoke_cap, budget_s), 5.0))
+    if verdict == "ok":
+        return _remember(None)
+    if verdict == "cpu":
+        return _remember(err)
+
+    # Tier 1: the device-count probe under the full wall-clock budget.
+    probe_code = (_CONFIG +
+                  "d = jax.devices(); "
+                  "print('PLATFORM=' + (d[0].platform if d else 'none'))")
+    t_end, first = time.monotonic() + budget_s, True
     while first or time.monotonic() < t_end:
         if not first:
             time.sleep(backoff_s)
         first = False
-        try:
-            # The axon plugin pins jax_platforms="axon,cpu": a failed
-            # TPU init can fall back to CPU, which would pass a bare
-            # device-count probe and then "measure" Mosaic kernels on
-            # the CPU backend. Require a non-CPU device — but report
-            # a completed CPU-only probe distinctly from a crash.
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import os, jax; "
-                 "cfg = (jax.config.jax_platforms "
-                 "       or os.environ.get('JAX_PLATFORMS') or ''); "
-                 "print('CONFIG=' + cfg); "
-                 "d = jax.devices(); "
-                 "print('PLATFORM=' + (d[0].platform if d else 'none'))"],
-                capture_output=True, text=True,
-                timeout=max(min(probe_cap, t_end - time.monotonic()),
-                            5.0))
-        except subprocess.TimeoutExpired:
-            err = f"probe timeout ({probe_cap:.0f}s)"
-            continue
-        if r.returncode == 0:
-            platform, cfg = "unknown", ""
-            for line in r.stdout.splitlines():
-                if line.startswith("PLATFORM="):
-                    platform = line.split("=", 1)[1]
-                elif line.startswith("CONFIG="):
-                    cfg = line.split("=", 1)[1]
-            if platform not in ("cpu", "none", "unknown"):
-                return _remember(None)
-            non_cpu = [p for p in cfg.replace(" ", "").split(",")
-                       if p and p != "cpu"]
-            if non_cpu:
-                # A non-CPU platform is configured (the axon plugin
-                # pins "axon,cpu") but init fell back to CPU — a
-                # transient tunnel blip, not a definite verdict: keep
-                # retrying and never cache it.
-                err = (f"configured platform {non_cpu[0]!r} fell back "
-                       "to cpu (transient init failure?)")
-                continue
-            # Definite verdict: no non-CPU platform is even configured
-            # and the backend came up CPU-only. Retrying cannot change
-            # that — stop now.
-            return _remember(f"cpu-only backend (platform={platform})")
-        err = (r.stderr.strip().splitlines() or ["unknown"])[-1][:300]
+        verdict, err = _attempt(
+            probe_code,
+            max(min(probe_cap, t_end - time.monotonic()), 5.0))
+        if verdict == "ok":
+            return _remember(None)
+        if verdict == "cpu":
+            return _remember(err)
     return _remember(err)
 
 
@@ -372,6 +449,67 @@ def _interpret_serving_times() -> dict:
     return out
 
 
+def _interpret_ep_times() -> dict:
+    """Decode-batch EP dispatch round-trip, ragged vs low-latency, on
+    the interpret mesh — the ``detail.ep_dispatch_ms`` surface a
+    CPU-only host must still fill (non-null gate in scripts/
+    ep_smoke.sh). ``ragged`` times the exact-splits
+    ep_dispatch/ep_combine pair; ``ll`` times the count-free
+    wire-quantized ll_a2a there-and-back at the same (B·K, d) payload
+    (force_kernel: the single-chip mesh must still run the full slot-
+    parity kernel, not the short-circuit). Interpreter-step overhead,
+    not silicon — meaningful as presence + relative shape only."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.ops.ep_a2a import (create_ep_context,
+                                            ep_dispatch, ep_combine)
+    from triton_dist_tpu.ops.low_latency import ll_a2a
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.utils.testing import spmd
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    mctx = MeshContext.from_mesh(mesh)
+    b, k, d, e = 4, 2, 32, 8
+    ctx = create_ep_context(mctx, num_experts=e, topk=k, axis="tp")
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, d), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, k), 0, e)
+    w = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(2), (b, k)),
+                       axis=-1)
+
+    def ragged(tok, ids_, w_):
+        recv, _, st = ep_dispatch(tok, ids_, ctx)
+        return ep_combine(recv, st, w_, ctx)
+
+    def ll(tok, ids_, w_):
+        del ids_, w_
+        payload = jnp.repeat(tok, k, axis=0)[None]      # (1, BK, d)
+        out = ll_a2a(payload, ctx=mctx, axis="tp", step=0,
+                     force_kernel=True)
+        back = ll_a2a(out, ctx=mctx, axis="tp", step=1,
+                      force_kernel=True)
+        return back[0]
+
+    specs = (P(None, None), P(None, None), P(None, None))
+    steps = {
+        "ragged": spmd(mesh, ragged, specs, P(None, None)),
+        "ll": spmd(mesh, ll, specs, P(None, None)),
+    }
+    out = {}
+    for name, step in steps.items():
+        np.asarray(step(x, ids, w))                     # warmup
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(step(x, ids, w))
+            best = min(best, time.perf_counter() - t0)
+        out[name] = round(best * 1e3, 3)
+    return {"ep_dispatch_ms": out,
+            "ep_dispatch_shape": {"batch": b, "topk": k, "hidden": d,
+                                  "experts": e}}
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -436,6 +574,10 @@ def _interpret_bench(reason: str) -> None:
     except Exception as e:  # serving bench must not sink the record
         sv = {"serving_tokens_per_s": None,
               "serving_error": str(e)[:200]}
+    try:
+        ep = _interpret_ep_times()
+    except Exception as e:  # ep bench must not sink the record
+        ep = {"ep_dispatch_ms": None, "ep_error": str(e)[:200]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -456,6 +598,10 @@ def _interpret_bench(reason: str) -> None:
             "shape_m_k_n": [256, 32, 64],
             **mk,
             **sv,
+            **ep,
+            # Hardware partials from an earlier run that died mid-sweep
+            # (kept: this interpret record is no substitute for them).
+            "partial_sweeps": _load_partials(),
             "stale_source": src,
             "stale_value": (last or {}).get("value"),
             "stale_vs_baseline": (last or {}).get("vs_baseline"),
@@ -484,6 +630,9 @@ def _emit_unavailable(error: str, attempts) -> None:
             "stale_vs_baseline": (last or {}).get("vs_baseline"),
             "init_attempts": attempts,
             "init_error": error,
+            # Salvaged mid-sweep measurements from a prior run that
+            # died before printing a record — real data, not a replay.
+            "partial_sweeps": _load_partials(),
             "last_detail": (last or {}).get("detail"),
         },
     }
@@ -582,6 +731,9 @@ def main():
             except Exception as e:
                 errs.append(f"{cfg}: {type(e).__name__}: {str(e)[:200]}")
                 continue
+            # Persist AS MEASURED: a later config hanging the process
+            # must not erase this one's number.
+            _note_partial(name, cfg, t)
             results.append((t, cfg, step))
         assert results, f"no {name} config compiled:\n" + "\n".join(errs)
         results.sort(key=lambda e: e[0])
@@ -824,6 +976,9 @@ def main():
         if dp:
             result["detail"]["decode_perf"] = dp
         _persist(result)
+    # The sweeps completed and the record carries their timings — the
+    # crash-salvage partials are superseded.
+    _clear_partials()
     print(json.dumps(result))
 
 

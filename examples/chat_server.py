@@ -36,6 +36,16 @@ def main():
                          "--max-len)")
     ap.add_argument("--hf-dir", default=None,
                     help="local HF checkpoint directory")
+    ap.add_argument("--moe-ep", action="store_true",
+                    help="serve the tiny MoE model with experts "
+                         "sharded over the mesh (EP decode dispatch)")
+    ap.add_argument("--transport", default=None,
+                    choices=["ar", "ragged", "ll", "auto"],
+                    help="EP decode dispatch transport (--moe-ep / MoE "
+                         "checkpoints; see docs/serving.md)")
+    ap.add_argument("--replica-slots", type=int, default=0,
+                    help="hot-expert replica slots per MoE layer "
+                         "(EP decode, transport=ll)")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -60,15 +70,37 @@ def main():
     if args.hf_dir and args.megakernel:
         sys.exit("--megakernel serves the built-in tiny model only; "
                  "drop one of --hf-dir/--megakernel")
+    if args.megakernel and (args.transport or args.replica_slots):
+        sys.exit("--transport/--replica-slots route the layer path's "
+                 "EP decode dispatch; the megakernel serves experts "
+                 "in-kernel (use --moe-ep without --megakernel)")
     if args.hf_dir:
         from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
 
         cfg, params = load_hf_checkpoint(args.hf_dir, dtype=jnp.float32)
+        if not cfg.is_moe and (args.moe_ep or args.transport
+                               or args.replica_slots):
+            sys.exit(f"{args.hf_dir} is not a MoE checkpoint; "
+                     "--moe-ep/--transport/--replica-slots need one")
         mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
         model_kw = ({"model": qwen_moe} if cfg.is_moe else {})
+        if cfg.is_moe and (args.moe_ep or args.transport
+                           or args.replica_slots):
+            model_kw.update(moe_impl="ep", ep_transport=args.transport)
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
                      params=params, **model_kw)
-        srv = ServingEngine(eng, num_slots=args.slots, page=args.page)
+        srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
+                            replica_slots=args.replica_slots)
+    elif args.moe_ep or args.transport or args.replica_slots:
+        # --transport / --replica-slots imply the EP-MoE tiny model:
+        # silently serving the dense model would drop the knobs.
+        cfg = ModelConfig.tiny_moe(vocab_size=128, num_experts=8)
+        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
+        eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
+                     model=qwen_moe, moe_impl="ep",
+                     ep_transport=args.transport)
+        srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
+                            replica_slots=args.replica_slots)
     elif args.megakernel:
         from jax.sharding import Mesh
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
@@ -120,6 +152,28 @@ def main():
             continue
         srv.run()
         print(flush=True)
+
+    # One-line serving summary on exit — the load data used to be
+    # collected and silently dropped.
+    st = srv.stats()
+    line = (f"served {st['completed']} request(s), "
+            f"{st['tokens_generated']} tokens, "
+            f"{st['decode_dispatches']} decode dispatches")
+    if st.get("dispatch_transport"):
+        line += f", transport={st['dispatch_transport']}"
+    if st.get("expert_load") is not None:
+        load = st["expert_load"]
+        hot = max(range(len(load)), key=load.__getitem__)
+        tot = st["expert_totals"]
+        share = tot[hot] / max(sum(tot), 1)
+        line += (f"; expert-load: hot=e{hot} "
+                 f"({share:.2f} of routed traffic), "
+                 f"totals={tot}")
+        if st.get("replicated_experts"):
+            line += (", replicas=" + ",".join(
+                f"e{e}->r{r}"
+                for e, r in sorted(st["replicated_experts"].items())))
+    print(line, flush=True)
 
 
 if __name__ == "__main__":

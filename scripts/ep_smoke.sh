@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# EP serving smoke battery on the CPU interpret mesh (no TPU):
+#
+#  1. tests/test_ep_serving.py — decode transport (ragged/ll/auto)
+#     token-exactness under uniform AND adversarially skewed routing on
+#     both engines, hot-expert replication exactness, expert-load
+#     telemetry, and the dynamic scoreboard's expert-load claim
+#     priority;
+#  2. the chat server end-to-end over the EP-MoE layer path with
+#     transport=ll, gating the exit-time expert-load summary line;
+#  3. a bench.py (interpret) pass gating NON-NULL
+#     detail.ep_dispatch_ms for both ragged and ll — a CPU-only host
+#     must still yield the decode-dispatch comparison.
+#
+# Sibling of scripts/serve_smoke.sh: tier-1-adjacent, wired as
+# `make ep-smoke`. A broken dispatch route, a replica perturbing
+# tokens, or a decode-shape leak fails here in minutes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PY=${PY:-python}
+
+echo "== EP serving battery (CPU mesh) =="
+$PY -m pytest tests/test_ep_serving.py -q
+
+echo "== EP chat server e2e (transport=ll) + load summary =="
+out=$(printf '1 2 3\n9 8 7\n' | timeout 300 $PY examples/chat_server.py \
+      --tp 2 --gen-len 4 --moe-ep --transport ll)
+echo "$out"
+echo "$out" | grep -q "transport=ll" \
+  || { echo "missing transport in exit summary"; exit 1; }
+echo "$out" | grep -q "expert-load: hot=e" \
+  || { echo "missing expert-load summary line"; exit 1; }
+
+echo "== bench.py ep_dispatch_ms non-null gate (interpret) =="
+bench_out=$(mktemp)
+BENCH_BACKEND=cpu timeout 600 $PY bench.py 2>/dev/null > "$bench_out"
+$PY - "$bench_out" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    rec = json.loads(f.read().strip().splitlines()[-1])
+ep = rec["detail"].get("ep_dispatch_ms")
+assert isinstance(ep, dict), \
+    f"ep_dispatch_ms missing: {rec['detail'].get('ep_error')}"
+for k in ("ragged", "ll"):
+    assert isinstance(ep.get(k), (int, float)) and ep[k] > 0, (k, ep)
+print("ep_dispatch_ms:", ep)
+EOF
+rm -f "$bench_out"
+
+echo "ep-smoke OK"

@@ -3,11 +3,30 @@
 Reference: ``layers/nvidia/ep_moe.py:65`` ``EP_MoE`` (+ ``EPAll2AllLayer``
 ``ep_a2a_layer.py:220`` and the low-latency variant): router → dispatch
 all-to-all → grouped expert MLP → combine all-to-all.
+
+Decode-path transports (:func:`fwd_decode`): the serving decode batch is
+replicated across the ep axis, and the ``transport`` knob picks how its
+tokens reach their experts —
+
+- ``"ar"`` (legacy default): no dispatch at all — every rank runs its
+  local expert shard over the whole (tiny) batch and one psum completes
+  the combine.
+- ``"ragged"``: the generic exact-splits :func:`~triton_dist_tpu.ops
+  .ep_a2a.ep_dispatch`/``ep_combine`` round-trip (counts exchange +
+  ragged transport).
+- ``"ll"``: the low-latency path — a count-free, wire-quantized
+  :func:`~triton_dist_tpu.ops.low_latency.ll_a2a` exchange statically
+  sized at B·K slots per peer (the decode batch's fixed assignment
+  count), the reference's ``fast_all_to_all``/``dispatch_kernel_v2``
+  shape. Supports hot-expert :func:`replica <init_replicas>` rerouting.
+- ``"auto"``: the :mod:`~triton_dist_tpu.tune`-persisted winner for
+  this (mesh, batch, hidden, dtype) key (:func:`tune_transport`), else
+  ``"ll"``.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +35,8 @@ from jax.sharding import PartitionSpec as P
 from triton_dist_tpu.ops.ep_a2a import EPContext, ep_dispatch, ep_combine
 from triton_dist_tpu.ops.ep_fused import EPFusedContext, ep_moe_fused
 from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
+
+DECODE_TRANSPORTS = ("ar", "ragged", "ll", "auto")
 
 
 def init(key, cfg, dtype=jnp.float32) -> Dict:
@@ -135,21 +156,68 @@ def fwd_2d(params, x, ep2d_ctx, *, topk: int,
 
 
 def fwd_decode(params, x, *, topk: int, axis: str = "ep",
-               norm_topk_prob: bool = True):
-    """Replicated-token EP decode (the small-batch AR regime): every
-    rank computes only its LOCAL expert shard's contributions for the
-    whole (tiny) batch and one AllReduce completes the combine — zero
-    dispatch round-trips. This is the TPU latency-optimal analogue of
-    the reference's low-latency EP a2a decode
-    (``low_latency_all_to_all_v2.py``): at decode M, two a2a hops cost
-    more than the masked local compute (each rank runs E/n experts over
-    B rows; B is a handful at decode, so FLOPs are noise and the psum
-    rides the layer's existing collective slot).
+               norm_topk_prob: bool = True, transport: str = "ar",
+               ep_ctx: Optional[EPContext] = None, replicas=None,
+               layer: int = 0, counts: Optional[List] = None):
+    """Replicated-token EP decode: one fixed-shape (B, d) batch,
+    identical on all ranks in, identical out.
 
-    x: (B, d) identical on all ranks → (B, d) identical on all ranks.
+    ``transport`` picks the expert path (module docstring):
+
+    - ``"ar"`` (default): masked local experts + psum — zero dispatch
+      round-trips; at decode M two a2a hops cost more than computing
+      E/n experts over a handful of rows.
+    - ``"ragged"``: the exact-splits dispatch/combine round-trip
+      (:func:`~triton_dist_tpu.ops.ep_a2a.ep_dispatch`); needs
+      ``ep_ctx``.
+    - ``"ll"``: count-free wire-quantized :func:`~triton_dist_tpu.ops
+      .low_latency.ll_a2a` exchange over B·K static slots per peer;
+      needs ``ep_ctx``. Consults ``replicas`` (hot-expert weight
+      copies, :func:`init_replicas`) for rerouting — replica choice is
+      data, not trace, so refreshing it never recompiles. NOTE: ``ll``
+      ALWAYS rides a quantized wire — int8 unless ``ctx.wire_dtype``
+      picks fp8 — unlike dispatch/combine, where ``wire_dtype=None``
+      means full precision; pick ``"ragged"`` when wire-quantization
+      tolerance is unacceptable.
+    - ``"auto"``: host-side tune-cache resolution
+      (:func:`resolve_transport`).
+
+    ``layer`` keys the ll slot parity (two a2a calls per MoE layer get
+    distinct static parities). ``counts``, when a list, receives this
+    layer's per-expert routed-assignment counts (E,) int32 — the
+    on-device expert-load telemetry the serving layer aggregates.
     """
     topk_ids, topk_w = route(params["router"], x, topk,
                              norm_topk_prob=norm_topk_prob)
+    if counts is not None:
+        num_experts = (ep_ctx.num_experts if ep_ctx is not None
+                       else params["router"].shape[1])
+        counts.append(jnp.bincount(
+            topk_ids.reshape(-1), length=num_experts).astype(jnp.int32))
+
+    if transport == "auto":
+        transport = resolve_transport(
+            "auto", ctx=ep_ctx, batch=x.shape[0], hidden=x.shape[1],
+            dtype=x.dtype, topk=topk)
+    if transport not in ("ar", "ragged", "ll"):
+        raise ValueError(f"transport must be one of {DECODE_TRANSPORTS},"
+                         f" got {transport!r}")
+    if transport in ("ragged", "ll"):
+        if ep_ctx is None or not isinstance(ep_ctx, EPContext):
+            raise ValueError(
+                f"transport={transport!r} needs a flat EPContext "
+                "(hierarchical 2D decode dispatch stays on the 'ar' "
+                "path)")
+        if transport == "ragged":
+            out = _fwd_decode_ragged(params, x, topk_ids, topk_w,
+                                     ctx=ep_ctx)
+        else:
+            out = _fwd_decode_ll(params, x, topk_ids, topk_w,
+                                 ctx=ep_ctx, replicas=replicas,
+                                 layer=layer)
+        sh = shared_expert_out(params, x)
+        return out if sh is None else (out + sh.astype(out.dtype))
+
     from triton_dist_tpu.parallel.mesh import flat_axis_rank
 
     if isinstance(axis, (tuple, list)):
@@ -174,6 +242,287 @@ def fwd_decode(params, x, *, topk: int, axis: str = "ep",
     # AFTER the reduce (inside it, n ranks would count it n times).
     sh = shared_expert_out(params, x)
     return out if sh is None else (out + sh.astype(out.dtype))
+
+
+def _fwd_decode_ragged(params, x, topk_ids, topk_w, *, ctx: EPContext):
+    """Decode via the generic exact-splits round-trip: every rank
+    dispatches the (replicated) batch's assignments, owners run the
+    grouped SwiGLU, combine returns each rank its own copies — output
+    replicated without a reduce."""
+    recv_tok, recv_exp, state = ep_dispatch(x, topk_ids, ctx)
+    sorted_tok, group_sizes, inv = sort_by_expert(
+        recv_tok, recv_exp, ctx.experts_per_rank)
+    expert_out = grouped_swiglu(sorted_tok, params["w_gate"],
+                                params["w_up"], params["w_down"],
+                                group_sizes)
+    return ep_combine(expert_out[inv], state, topk_w, ctx)
+
+
+def _fwd_decode_ll(params, x, topk_ids, topk_w, *, ctx: EPContext,
+                   replicas=None, layer: int = 0):
+    """Low-latency decode dispatch: COUNT-FREE fixed-slot exchange.
+
+    Every (token, k) assignment owns static slot ``j = t·K + k`` in a
+    (n, B·K, d) wire buffer; rank ``dest[j]`` finds token ``j // K`` in
+    slot j and every other destination sees a zero row — no splits
+    exchange, no cumsum, no ragged transport: the slot count IS the
+    protocol (reference ``dispatch_kernel_v2`` /
+    ``low_latency_all_to_all_v2.py:156``). Payload rows are
+    wire-quantized inside :func:`~triton_dist_tpu.ops.low_latency
+    .ll_a2a` (per-row absmax int8/fp8 + scales); the return hop
+    broadcasts each owner's outputs back through the same transport at
+    the opposite slot parity.
+
+    ``replicas`` (``None`` = off) reroutes alternate assignments of a
+    replicated expert to the replica's rank: ``replica_rank`` (E,)
+    names the rank holding a copy, ``slot_expert`` (R,) maps replica
+    weight slots to expert ids. Routing is a pure function of
+    (topk_ids, replicas), identical on every rank, and the replica
+    weights are exact copies — greedy tokens cannot change.
+    """
+    mesh, axis = ctx.mesh, ctx.axis
+    n = mesh.size(axis)
+    b, d = x.shape
+    k = topk_ids.shape[1]
+    e_loc = params["w_gate"].shape[0]
+    wire = ctx.wire_dtype if ctx.wire_dtype is not None else jnp.int8
+
+    flat_e = topk_ids.reshape(-1).astype(jnp.int32)       # (BK,)
+    owner = flat_e // e_loc
+    n_rep = 0 if replicas is None else replicas["slot_expert"].shape[0]
+    if n_rep:
+        rep_rank = replicas["replica_rank"][flat_e]       # (BK,)
+        # Deterministic 50/50 split: an assignment's position among its
+        # expert's assignments decides owner vs replica — replicated
+        # inputs make every rank compute the same route.
+        one_hot = jax.nn.one_hot(flat_e, ctx.num_experts,
+                                 dtype=jnp.int32)
+        pos = jnp.cumsum(one_hot, axis=0) - 1             # (BK, E)
+        pos_e = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+        use_rep = jnp.logical_and(rep_rank >= 0, pos_e % 2 == 1)
+        dest = jnp.where(use_rep, rep_rank, owner)
+        # Replica-slot id of each assignment's expert (-1 = none).
+        slot_match = (replicas["slot_expert"][None, :]
+                      == flat_e[:, None])                 # (BK, R)
+        rep_slot = jnp.argmax(slot_match, axis=1)
+    else:
+        use_rep = jnp.zeros(flat_e.shape, bool)
+        rep_slot = jnp.zeros(flat_e.shape, jnp.int32)
+        dest = owner
+
+    from triton_dist_tpu.ops.low_latency import ll_a2a
+
+    rep_tok = jnp.repeat(x, k, axis=0)                    # (BK, d)
+    slots = jnp.arange(b * k)
+    send = jnp.zeros((n, b * k, d), x.dtype).at[dest, slots].set(rep_tok)
+    recv = ll_a2a(send, ctx=mesh, axis=axis, step=2 * layer,
+                  wire_dtype=wire)                        # (n, BK, d)
+
+    me = jax.lax.axis_index(axis)
+    # Replicated routing ⇒ every source staged the same slot content;
+    # my copy of the batch is the chunk addressed through me.
+    tok = jnp.take(recv, me, axis=0)                      # (BK, d)
+    # Local group id per slot: owner-routed rows use the local expert
+    # shard, replica-routed rows use the replica slots appended after
+    # it; rows bound elsewhere sort to the tail (-1).
+    loc = jnp.where(use_rep, e_loc + rep_slot, flat_e % e_loc)
+    mine = dest == me
+    loc = jnp.where(mine, loc, -1).astype(jnp.int32)
+    if n_rep:
+        w_gate = jnp.concatenate(
+            [params["w_gate"],
+             replicas["w_gate"].astype(params["w_gate"].dtype)], axis=0)
+        w_up = jnp.concatenate(
+            [params["w_up"],
+             replicas["w_up"].astype(params["w_up"].dtype)], axis=0)
+        w_down = jnp.concatenate(
+            [params["w_down"],
+             replicas["w_down"].astype(params["w_down"].dtype)], axis=0)
+    else:
+        w_gate, w_up, w_down = (params["w_gate"], params["w_up"],
+                                params["w_down"])
+    sorted_tok, group_sizes, inv = sort_by_expert(tok, loc,
+                                                  e_loc + n_rep)
+    y = grouped_swiglu(sorted_tok, w_gate, w_up, w_down,
+                       group_sizes)[inv]
+    y = jnp.where(mine[:, None], y, 0).astype(x.dtype)    # (BK, d)
+
+    # Return hop: every owner broadcasts its rows to all peers through
+    # the opposite-parity slots; back[r, j] = slot j as computed at r.
+    back = ll_a2a(jnp.broadcast_to(y[None], (n, b * k, d)),
+                  ctx=mesh, axis=axis, step=2 * layer + 1,
+                  wire_dtype=wire)
+    gathered = back[dest, slots].reshape(b, k, d)
+    return jnp.einsum("bkd,bk->bd", gathered.astype(jnp.float32),
+                      topk_w.astype(jnp.float32)).astype(x.dtype)
+
+
+# --- decode-transport autotune + hot-expert replica state -------------------
+
+def _transport_key(ctx: EPContext, *, batch: int, hidden: int, dtype,
+                   topk: int) -> str:
+    from triton_dist_tpu import tune
+
+    return tune.make_key(
+        "ep_decode_transport", mesh=tune.mesh_key(ctx.mesh),
+        axis=ctx.axis, batch=batch, hidden=hidden,
+        # Canonicalize: jnp.float32 (a type) and np.dtype("float32")
+        # must key identically or a tuned winner is never found.
+        dtype=str(jnp.dtype(dtype)),
+        topk=topk, experts=ctx.num_experts)
+
+
+def resolve_transport(transport: str, *, ctx: Optional[EPContext],
+                      batch: int, hidden: int, dtype,
+                      topk: int) -> str:
+    """Host-side resolution of the decode ``transport`` knob.
+
+    Explicit values pass through; ``"auto"`` loads the
+    :func:`tune_transport` winner persisted for this
+    (mesh, batch, hidden, dtype) key and falls back to ``"ll"`` (the
+    latency-optimized default the paper's decode path targets) when
+    never tuned — or ``"ar"`` when no EP context exists to dispatch
+    over."""
+    if transport != "auto":
+        return transport
+    if ctx is None or not isinstance(ctx, EPContext):
+        return "ar"
+    from triton_dist_tpu import tune
+
+    cached = tune.load_autotune_data(_transport_key(
+        ctx, batch=batch, hidden=hidden, dtype=dtype, topk=topk))
+    if cached and cached.get("transport") in ("ar", "ragged", "ll"):
+        return cached["transport"]
+    return "ll"
+
+
+def tune_transport(mesh, params, ctx: EPContext, *, batch: int,
+                   topk: int, norm_topk_prob: bool = True, reps: int = 3,
+                   use_cache: bool = True) -> str:
+    """OFFLINE ragged-vs-ll sweep for one decode shape: time each
+    transport's jitted replicated-batch dispatch on ``mesh`` and
+    persist the winner under the (mesh, batch, hidden, dtype) key
+    ``transport="auto"`` resolves (the ``tune_schedule`` pattern).
+
+    ``params`` is one MoE layer's param dict (expert-sharded on the
+    mesh or replicated — timing only). Returns the winning transport.
+    """
+    import time as _time
+
+    import numpy as np
+    from triton_dist_tpu import tune
+
+    d = params["router"].shape[0]
+    dtype = params["w_gate"].dtype
+    key = _transport_key(ctx, batch=batch, hidden=d, dtype=dtype,
+                         topk=topk)
+    if use_cache:
+        cached = tune.load_autotune_data(key)
+        if cached and cached.get("transport") in ("ar", "ragged", "ll"):
+            return cached["transport"]
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch, d), dtype)
+    # Specs keyed off the ACTUAL param tree: layers with a shared
+    # expert carry four extra (replicated-under-EP) leaves that a bare
+    # param_specs(axis) call would omit, crashing the shard_map.
+    shared = {"w_shared_gate": P(None, None),
+              "w_shared_up": P(None, None),
+              "w_shared_down": P(None, None), "shared_gate": P(None)}
+    full = {**param_specs(ctx.axis), **shared}
+    specs = {k: full[k] for k in params}
+    times = {}
+    for tr in ("ragged", "ll"):
+        step = jax.jit(jax.shard_map(
+            lambda p, v, _tr=tr: fwd_decode(
+                p, v, topk=topk, axis=ctx.axis,
+                norm_topk_prob=norm_topk_prob, transport=_tr,
+                ep_ctx=ctx),
+            mesh=mesh, in_specs=(specs, P(None, None)),
+            out_specs=P(None, None), check_vma=False))
+        np.asarray(step(params, x))            # compile + warmup
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            np.asarray(step(params, x))
+            best = min(best, _time.perf_counter() - t0)
+        times[tr] = best
+    winner = min(times, key=times.get)
+    tune.store_autotune_data(
+        key, {"transport": winner,
+              "times_ms": {t: round(v * 1e3, 3)
+                           for t, v in times.items()}},
+        times[winner])
+    return winner
+
+
+def init_replicas(cfg, *, slots: int, num_layers: Optional[int] = None,
+                  dtype=jnp.float32) -> Dict:
+    """Empty hot-expert replica state consulted by the ``"ll"`` decode
+    transport: ``slots`` replica weight slots per MoE layer, all free.
+
+    Layout (all replicated across the mesh — replica slots are few and
+    small next to the sharded expert banks): ``w_gate``/``w_up``
+    (L, R, d, f), ``w_down`` (L, R, f, d), ``slot_expert`` (L, R)
+    global expert id held by each slot (-1 free), ``replica_rank``
+    (L, E) rank serving a replica of expert e (-1 none). Contents are
+    DATA: the serving layer refreshes them between steps from host-side
+    load stats with zero recompilation."""
+    L = (num_layers if num_layers is not None
+         else getattr(cfg, "num_hidden_layers", 1))
+    d, f, e = (cfg.hidden_size, cfg.moe_intermediate_size,
+               cfg.num_experts)
+    return {
+        "w_gate": jnp.zeros((L, slots, d, f), dtype),
+        "w_up": jnp.zeros((L, slots, d, f), dtype),
+        "w_down": jnp.zeros((L, slots, f, d), dtype),
+        "slot_expert": jnp.full((L, slots), -1, jnp.int32),
+        "replica_rank": jnp.full((L, e), -1, jnp.int32),
+    }
+
+
+def replica_specs() -> Dict:
+    """PartitionSpecs for :func:`init_replicas` state (replicated)."""
+    return {"w_gate": P(None, None, None, None),
+            "w_up": P(None, None, None, None),
+            "w_down": P(None, None, None, None),
+            "slot_expert": P(None, None),
+            "replica_rank": P(None, None)}
+
+
+def replica_layer(replicas: Dict, layer: int) -> Dict:
+    """One layer's slice of the replica state (what
+    :func:`fwd_decode` consumes)."""
+    return {k: v[layer] for k, v in replicas.items()}
+
+
+def install_replica_layers(replicas: Dict, slot: int, expert: int,
+                           rank: int, w_gate, w_up, w_down) -> Dict:
+    """Host-side batched install: copy ONE expert's weights into slot
+    ``slot`` across EVERY layer in one pass. ``w_*`` are (L, d, f) /
+    (L, f, d) stacks (layer-major). One ``.at[:, slot].set`` per
+    buffer — a per-layer install loop would materialize the full
+    replica slab L times. Evicted experts (per layer, whatever held
+    the slot) have their routing entries cleared first. Pure —
+    returns the updated pytree."""
+    L = replicas["slot_expert"].shape[0]
+    old = replicas["slot_expert"][:, slot]                # (L,)
+    rows = jnp.arange(L)
+    rr = replicas["replica_rank"]
+    rr = rr.at[rows, jnp.maximum(old, 0)].set(
+        jnp.where(old >= 0, -1, rr[rows, jnp.maximum(old, 0)]))
+    return {
+        "w_gate": replicas["w_gate"].at[:, slot].set(
+            w_gate.astype(replicas["w_gate"].dtype)),
+        "w_up": replicas["w_up"].at[:, slot].set(
+            w_up.astype(replicas["w_up"].dtype)),
+        "w_down": replicas["w_down"].at[:, slot].set(
+            w_down.astype(replicas["w_down"].dtype)),
+        "slot_expert": replicas["slot_expert"].at[:, slot].set(
+            int(expert)),
+        "replica_rank": rr.at[:, int(expert)].set(int(rank)),
+    }
+
+
 
 
 def fwd_fused(params, x, ep_ctx: EPFusedContext, *, topk: int,

@@ -92,7 +92,8 @@ class ModelBuilder:
                  schedule: str = "static",
                  seq: int = 1, paged: bool = False,
                  page: Optional[int] = None, profile: bool = False,
-                 cost_table: Optional[dict] = None):
+                 cost_table: Optional[dict] = None,
+                 expert_load=None):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -157,6 +158,13 @@ class ModelBuilder:
         # atomic queue head exists here, so balance moves to schedule
         # time but from silicon numbers).
         self.cost_table = dict(cost_table) if cost_table else None
+        # expert_load: per-expert weights (the serving layer's load
+        # EWMA) biasing the DYNAMIC claim order toward hot experts'
+        # group-GEMM/combine chains (graph.comm_priority expert_load).
+        # Refresh between steps via reprioritize() — claim tables are
+        # host data, so no graph rebuild is needed.
+        self.expert_load = (list(expert_load) if expert_load is not None
+                            else None)
         # seq > 1: batched prefill — ``batch`` counts ROWS (B*S, b-major)
         # and the attention/cache tasks use the causal prefill bodies.
         self.seq = seq
@@ -266,14 +274,15 @@ class ModelBuilder:
 
     # ---------------- recording helpers --------------------------------
     def _linear(self, in_off, w_off, out_off, k_tiles, n_tiles, *,
-                layer, in_rows, w_rows):
+                layer, in_rows, w_rows, expert: int = -1):
         b = self.batch
         for j in range(n_tiles):
             self.graph.add(
                 TaskType.LINEAR,
                 (in_off, w_off, out_off, k_tiles, n_tiles, j),
                 reads=[(in_off, in_rows), (w_off, w_rows)],
-                writes=[(out_off + j * b, b)], layer=layer)
+                writes=[(out_off + j * b, b)], layer=layer,
+                expert=expert)
 
     def _build(self):
         cfg, b, w = self.cfg, self.batch, self.w
@@ -343,6 +352,15 @@ class ModelBuilder:
         self.ar_max_tiles = ar_max_tiles
         x_off = self._alloc_act("x", d_t)
         self.x_off = x_off
+        # MoE expert-load counters: one (batch, w) arena region the
+        # router epilogue ACCUMULATES its top-k selection mask into,
+        # every layer, every step — the decode dispatch's on-device
+        # expert telemetry (read back by engine.expert_counts(); the
+        # serving layer diffs snapshots per tick). Monotonic: arena
+        # packs zeroed, so no per-step reset task is needed.
+        self.moe_counts_off = 0
+        if self.moe:
+            self.moe_counts_off = self._alloc("moe_counts", b)
 
         # Embedding lookup inside the kernel (token ids via prefetch),
         # then an allreduce to sum the vocab-shard contributions.
@@ -468,8 +486,15 @@ class ModelBuilder:
                 self._linear(t1, o[f"l{li}.router"], rl, d_t, 1,
                              layer=li, in_rows=d_t * b,
                              w_rows=d_t * w)
-                g.add(TaskType.MOE_WEIGHTS, (rl, wbe, E),
-                      reads=[(rl, b)], writes=[(wbe, b)], layer=li)
+                # The router epilogue also accumulates its selection
+                # mask into the shared counts region — the read+write
+                # chains the per-layer MOE_WEIGHTS tasks, which the
+                # residual stream serializes anyway.
+                g.add(TaskType.MOE_WEIGHTS,
+                      (rl, wbe, E, self.moe_counts_off),
+                      reads=[(rl, b), (self.moe_counts_off, b)],
+                      writes=[(wbe, b), (self.moe_counts_off, b)],
+                      layer=li)
                 for e in range(E):
                     ge = self._alloc_act(f"l{li}.e{e}.g", ffe_t)
                     ue = self._alloc_act(f"l{li}.e{e}.u", ffe_t)
@@ -477,16 +502,16 @@ class ModelBuilder:
                     pe = self._alloc_act(f"l{li}.e{e}.part", d_t)
                     self._linear(t1, o[f"l{li}.e{e}.w_gate"], ge, d_t,
                                  ffe_t, layer=li, in_rows=d_t * b,
-                                 w_rows=d_t * ffe_t * w)
+                                 w_rows=d_t * ffe_t * w, expert=e)
                     self._linear(t1, o[f"l{li}.e{e}.w_up"], ue, d_t,
                                  ffe_t, layer=li, in_rows=d_t * b,
-                                 w_rows=d_t * ffe_t * w)
+                                 w_rows=d_t * ffe_t * w, expert=e)
                     g.add(TaskType.SILU_MUL, (ge, ue, he, ffe_t),
                           reads=[(ge, ffe_t * b), (ue, ffe_t * b)],
-                          writes=[(he, ffe_t * b)], layer=li)
+                          writes=[(he, ffe_t * b)], layer=li, expert=e)
                     self._linear(he, o[f"l{li}.e{e}.w_down"], pe, ffe_t,
                                  d_t, layer=li, in_rows=ffe_t * b,
-                                 w_rows=ffe_t * d_t * w)
+                                 w_rows=ffe_t * d_t * w, expert=e)
                     # init on e==0 writes; later experts accumulate —
                     # the shared (mpart, wbe) read/write regions chain
                     # the experts' combines in order.
@@ -494,7 +519,8 @@ class ModelBuilder:
                           (mpart, pe, wbe, e, d_t, 1 if e == 0 else 0),
                           reads=[(pe, d_t * b), (wbe, b),
                                  (mpart, d_t * b)],
-                          writes=[(mpart, d_t * b)], layer=li)
+                          writes=[(mpart, d_t * b)], layer=li,
+                          expert=e)
             else:
                 self._linear(t1, o[f"l{li}.w_gate"], gx, d_t, ff_t,
                              layer=li, in_rows=d_t * b,
@@ -558,10 +584,28 @@ class ModelBuilder:
         else:
             psrc = pdst = np.zeros(0, np.int32)
         self._pruned_edges = (psrc, pdst)
+        self._pin, self._cost = pin, cost
         if self.schedule == "dynamic":
             self._schedule_dynamic(psrc, pdst, pin, cost)
         else:
             self._schedule_static(psrc, pdst, pin, cost)
+
+    def reprioritize(self, expert_load) -> None:
+        """Recompute the DYNAMIC claim order under a fresh per-expert
+        load vector (graph.comm_priority ``expert_load``) — the
+        between-steps hot-expert rebalance hook. Host-only: the graph,
+        arena, and task bodies are untouched; only the claim tables and
+        scoreboard edge plan are re-emitted. The engine must rebuild
+        its jitted step so the new tables take effect
+        (:meth:`MegaKernelEngine.set_expert_load` does both)."""
+        if self.schedule != "dynamic":
+            raise ValueError(
+                "reprioritize() adjusts the dynamic claim order; this "
+                f"builder runs schedule={self.schedule!r}")
+        self.expert_load = (list(expert_load)
+                            if expert_load is not None else None)
+        psrc, pdst = self._pruned_edges
+        self._schedule_dynamic(psrc, pdst, self._pin, self._cost)
 
     def _schedule_static(self, src, dst, pin, cost):
         """Precomputed per-core slot lists (round_robin / zig_zag /
@@ -596,8 +640,9 @@ class ModelBuilder:
         padding: the claim order is topological, so idle (NOOP) slots
         shrink to pinning holes + tail round-up."""
         g = self.graph
-        prio, bkt, n_buckets = comm_priority(g.tasks, n_ranks=self.n,
-                                             task_cost=cost)
+        prio, bkt, n_buckets = comm_priority(
+            g.tasks, n_ranks=self.n, task_cost=cost,
+            expert_load=self.expert_load)
         dyn = schedule_dyn(len(g.tasks), src, dst,
                            num_cores=self.num_cores, priority=prio,
                            bucket=bkt, task_cost=cost, pin_core=pin,

@@ -142,23 +142,7 @@ class MegaKernelEngine:
         # doubles weight HBM (useful only for tests/oracles).
         self.params = placed if keep_params else None
 
-        step = self.builder.step_fn()
-        if cfg.is_hybrid:
-            stspec = P(None, None, axis, None, None)
-            self._step = jax.jit(jax.shard_map(
-                step, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None),
-                          P(None), tblspec, stspec),
-                out_specs=(P(None, axis), P(axis, None), kvspec, kvspec,
-                           stspec),
-                check_vma=False), donate_argnums=(0, 1, 2, 6))
-        else:
-            self._step = jax.jit(jax.shard_map(
-                step, mesh=mesh,
-                in_specs=(P(axis, None), kvspec, kvspec, P(None),
-                          P(None), tblspec),
-                out_specs=(P(None, axis), P(axis, None), kvspec, kvspec),
-                check_vma=False), donate_argnums=(0, 1, 2))
+        self._build_step()
 
         n = mesh.shape[axis]
         kv = cfg.num_key_value_heads
@@ -198,6 +182,62 @@ class MegaKernelEngine:
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
         self.v_cache = jax.device_put(
             jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
+
+    def _build_step(self):
+        """(Re)jit the decode step from the builder's CURRENT slot
+        tables. Called at construction and again by
+        :meth:`set_expert_load` after a claim-order refresh — the
+        tables are closed over by the step, so new tables need a new
+        jit."""
+        kvspec = P(None, None, None, self.axis, None)
+        tblspec = P(None)
+        step = self.builder.step_fn()
+        if self.cfg.is_hybrid:
+            stspec = P(None, None, self.axis, None, None)
+            self._step = jax.jit(jax.shard_map(
+                step, mesh=self.mesh,
+                in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
+                          P(None), tblspec, stspec),
+                out_specs=(P(None, self.axis), P(self.axis, None),
+                           kvspec, kvspec, stspec),
+                check_vma=False), donate_argnums=(0, 1, 2, 6))
+        else:
+            self._step = jax.jit(jax.shard_map(
+                step, mesh=self.mesh,
+                in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
+                          P(None), tblspec),
+                out_specs=(P(None, self.axis), P(self.axis, None),
+                           kvspec, kvspec),
+                check_vma=False), donate_argnums=(0, 1, 2))
+
+    def expert_counts(self) -> np.ndarray:
+        """Cumulative per-expert routed-token counts from the arena's
+        in-kernel router counters (MoE builds): the router epilogue
+        accumulates its top-k selection mask every layer, every decode
+        step (kernels.moe_weights_body). Returns (num_experts,) int64
+        — monotonic; diff two snapshots for a window. Forces the
+        in-flight step to complete (it reads the arena). Counts cover
+        the full fixed decode batch, parked serving slots included,
+        and are only meaningful for decode-only traffic (a batched
+        prefill builder reuses the activation region)."""
+        if not self.cfg.is_moe:
+            raise ValueError("expert_counts() needs a MoE megakernel")
+        b = self.builder
+        rows = np.asarray(self._arena[
+            b.moe_counts_off:b.moe_counts_off + b.batch])
+        return rows.sum(axis=0)[:self.cfg.num_experts].round(
+        ).astype(np.int64)
+
+    def set_expert_load(self, load) -> None:
+        """Hot-expert rebalance hook: recompute the dynamic claim order
+        under a fresh per-expert load vector (see
+        ``graph.comm_priority`` expert_load) and rebuild the jitted
+        step around the new tables. Infrequent by design — the rebuild
+        recompiles on the next decode step, so callers (the serving
+        layer's ``rebalance_every``) apply hysteresis and only refresh
+        when the hot-set ranking actually changed."""
+        self.builder.reprioritize(load)
+        self._build_step()
 
     def progress(self) -> dict:
         """Last-completed progress counters (CommTimeoutError payload):

@@ -22,10 +22,13 @@ class Graph:
         self._readers: Dict[Tuple[int, int], List[int]] = {}
 
     def add(self, task_type: TaskType, args, *, reads, writes,
-            layer: int = -1) -> Task:
-        """reads/writes: list of (offset, size) arena regions."""
+            layer: int = -1, expert: int = -1) -> Task:
+        """reads/writes: list of (offset, size) arena regions.
+        ``expert`` tags MoE per-expert FFN work for the expert-load
+        claim priority (:func:`comm_priority`)."""
         t = Task(task_id=len(self.tasks), task_type=task_type,
-                 args=tuple(int(a) for a in args), layer=layer)
+                 args=tuple(int(a) for a in args), layer=layer,
+                 expert=expert)
         deps = set()
         for region in reads:
             for key, writer in self._overlapping(self._last_writer, region):
@@ -69,7 +72,8 @@ N_PRIORITY_BUCKETS = 3
 
 
 def comm_priority(tasks: Sequence[Task], *, n_ranks: int = 1,
-                  task_cost: Sequence[int] = None):
+                  task_cost: Sequence[int] = None,
+                  expert_load: Sequence[float] = None):
     """Comm-aware claim priority for the dynamic scheduler, computed
     host-side from the task graph.
 
@@ -97,12 +101,32 @@ def comm_priority(tasks: Sequence[Task], *, n_ranks: int = 1,
     (builder.calibrate_cost_table) sharpens the dynamic claim order
     exactly as it sharpens ``cost_lpt``.
 
+    ``expert_load`` (per-expert weights, e.g. the serving layer's load
+    EWMA) reweights the cost of tasks tagged with ``Task.expert``
+    before the critical-path walk: a hot expert's group-GEMM and
+    combine chain grows a longer (scaled) path to the sink and is
+    claimed earlier — the megakernel answer to decode-time expert skew
+    (the source of the hidden serialization arXiv 2605.00686 measures
+    when comm slots are statically scheduled).
+
     Returns ``(priority, bucket, n_buckets)`` as int32 lists.
     Task ids must be topologically ordered (Graph.add guarantees it:
     dependencies only ever point at earlier ids).
     """
     n = len(tasks)
     cost = list(task_cost) if task_cost is not None else [1] * n
+    if expert_load is not None:
+        load = [max(float(v), 0.0) for v in expert_load]
+        mean = (sum(load) / len(load)) if load else 0.0
+        if mean > 0:
+            for t in tasks:
+                e = getattr(t, "expert", -1)
+                if 0 <= e < len(load):
+                    # 1 + load/mean: a uniform load is the identity;
+                    # a 100%-hot expert scales its chain by ~1+E.
+                    scale = 1.0 + load[e] / mean
+                    cost[t.task_id] = max(
+                        int(round(cost[t.task_id] * scale)), 1)
     succ: List[List[int]] = [[] for _ in range(n)]
     for t in tasks:
         for d in t.deps:

@@ -169,8 +169,10 @@ def moe_weights_body(cfg, args, refs):
     renormalization; writes the (B, W) combine-weight tile (reference:
     the megakernel's routing happens host-side; in-kernel routing keeps
     the whole MoE decode step one launch)."""
-    arena, va, vc = refs["arena"], refs["va"], refs["vc"]
+    arena, va, vb, vc = (refs["arena"], refs["va"], refs["vb"],
+                         refs["vc"])
     rl_off, wout_off, e_n = args[0], args[1], args[2]
+    cnt_off = args[3]
     b = cfg.batch
 
     pltpu.sync_copy(arena.at[pl.ds(rl_off, b)], va)
@@ -192,6 +194,14 @@ def moe_weights_body(cfg, args, refs):
                                 1e-30)
     vc[...] = wbe
     pltpu.sync_copy(vc, arena.at[pl.ds(wout_off, b)])
+    # Expert-load telemetry: accumulate this layer's top-k selection
+    # mask into the shared counts region (column e = expert e; rows
+    # summed host-side). Monotonic across steps — the arena packs
+    # zeroed and the host diffs snapshots; float32 stays count-exact
+    # to 2^24 selections.
+    pltpu.sync_copy(arena.at[pl.ds(cnt_off, b)], vb)
+    vb[...] = vb[...] + mask.astype(jnp.float32)
+    pltpu.sync_copy(vb, arena.at[pl.ds(cnt_off, b)])
 
 
 def weighted_add_body(cfg, args, refs):

@@ -25,7 +25,7 @@ class TaskType(enum.IntEnum):
     NOOP = 8           # queue padding slot (multi-core schedules)
     WRITE_KV_PREFILL = 9   # args like WRITE_KV; rows are (b, s) pairs
     ATTN_PREFILL = 10      # args like ATTN_DECODE; causal over new rows
-    MOE_WEIGHTS = 11       # args: rl_off, wout_off, n_experts
+    MOE_WEIGHTS = 11       # args: rl_off, wout_off, n_experts, cnt_off
     WEIGHTED_ADD = 12      # args: acc_off, part_off, wbe_off, e, tiles, init
     GDN_DECODE = 13        # args: q,k,v,graw,braw,gbias,out offs, gdn_idx
 
@@ -45,6 +45,10 @@ class Task:
     args: Tuple[int, ...]
     deps: List[int] = dataclasses.field(default_factory=list)
     layer: int = -1
+    # MoE provenance: which expert's FFN chain this task belongs to
+    # (-1 = not expert work). Feeds the dynamic scheduler's expert-load
+    # claim priority (graph.comm_priority expert_load).
+    expert: int = -1
 
     @property
     def unblocks_remote(self) -> bool:

@@ -50,6 +50,7 @@ class Engine:
                  block_k: int = 512, model=None,
                  moe_impl: Optional[str] = None, ep_axis=None,
                  ep_capacity: Optional[int] = None,
+                 ep_transport: Optional[str] = None,
                  fallback: Optional[str] = None, probe: bool = False,
                  timeout_s: Optional[float] = None):
         """``moe_impl`` selects the MoE regime for ``models.qwen_moe``
@@ -59,6 +60,12 @@ class Engine:
         hierarchical ICI-by-DCN dispatch (``create_ep2d_context``);
         ``ep_capacity`` opts into the capped-drop dispatch (see
         ``create_ep_context`` for the drop-free mode's memory scaling).
+        ``ep_transport`` picks the DECODE dispatch path
+        ("ar" | "ragged" | "ll" | "auto" — see
+        :func:`triton_dist_tpu.layers.ep_moe.fwd_decode`); prefill
+        always rides the full dispatch/combine. ``"auto"`` resolves
+        against the tune cache at trace time with the actual decode
+        batch shape.
 
         Resilience knobs:
 
@@ -142,6 +149,19 @@ class Engine:
                         topk=cfg.num_experts_per_tok,
                         capacity=ep_capacity, axis=ep_axis or axis)
             model_kwargs = {"moe_impl": moe_impl, "ep_ctx": ep_ctx}
+            if ep_transport is not None:
+                from triton_dist_tpu.layers.ep_moe import (
+                    DECODE_TRANSPORTS)
+
+                if ep_transport not in DECODE_TRANSPORTS:
+                    raise ValueError(
+                        f"ep_transport={ep_transport!r} not in "
+                        f"{DECODE_TRANSPORTS}")
+                if moe_impl != "ep":
+                    raise ValueError(
+                        "ep_transport is an EP decode knob; it needs "
+                        f"moe_impl='ep' (got {moe_impl!r})")
+                model_kwargs["transport"] = ep_transport
             spec_ep_axis = (tuple(ep_axis) if isinstance(
                 ep_axis, (tuple, list)) else (ep_axis or axis))
             specs = model.param_specs(cfg, moe_impl=moe_impl, axis=axis,
@@ -149,6 +169,8 @@ class Engine:
         else:
             specs = model.param_specs(cfg, axis)
         self.model_kwargs = model_kwargs
+        self.ep_transport = (model_kwargs.get("transport")
+                             if moe_impl == "ep" else None)
         if params is None:
             params = model.init_params(jax.random.PRNGKey(seed), cfg, dtype)
         self.params = jax.tree.map(

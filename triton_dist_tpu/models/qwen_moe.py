@@ -100,11 +100,18 @@ def moe_ffn(moe, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
                       norm_topk_prob=cfg.norm_topk_prob)
 
 
-def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
+def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx,
+                   transport=None, replicas=None, layer: int = 0,
+                   counts=None):
     """Small-batch (decode) MoE FFN: TP experts via ``tp_moe.fwd_ar``
-    (the GEMM+AR pairing), EP experts via ``ep_moe.fwd_decode``
-    (masked-local-experts + psum — see its docstring for why this
-    beats a dispatch round-trip at decode M)."""
+    (the GEMM+AR pairing), EP experts via ``ep_moe.fwd_decode`` with
+    the decode ``transport`` knob (``"ar"`` masked-local + psum,
+    ``"ragged"`` exact-splits round-trip, ``"ll"`` low-latency
+    count-free quantized exchange, ``"auto"`` tune-cache winner — see
+    :mod:`triton_dist_tpu.layers.ep_moe`). ``replicas`` is the FULL
+    hot-expert replica state (:func:`ep_moe.init_replicas`); ``layer``
+    selects its slice and the ll slot parity. ``counts`` (a list)
+    collects this layer's per-expert routed counts."""
     from triton_dist_tpu.ops.ep_a2a import EP2DContext
 
     if moe_impl == "tp":
@@ -117,9 +124,16 @@ def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
         ep_axis = ep_ctx.axis
     else:
         ep_axis = axis
+    rep_layer = (ep_moe.replica_layer(replicas, layer)
+                 if replicas is not None else None)
     return ep_moe.fwd_decode(moe, h, topk=cfg.num_experts_per_tok,
                              axis=ep_axis,
-                             norm_topk_prob=cfg.norm_topk_prob)
+                             norm_topk_prob=cfg.norm_topk_prob,
+                             transport=transport or "ar",
+                             ep_ctx=(ep_ctx if isinstance(
+                                 ep_ctx, EPContext) else None),
+                             replicas=rep_layer, layer=layer,
+                             counts=counts)
 
 
 def _moe_block(lp, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
@@ -131,10 +145,20 @@ def _moe_block(lp, h, cfg: ModelConfig, *, moe_impl, mode, axis, ctxs,
                    moe_block_m=moe_block_m)
 
 
-def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx):
-    """Dense-trunk decode hook form."""
+def _moe_ffn_decode(lp, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx,
+                    transport=None, replicas=None, counts=None,
+                    _layer_cursor=None):
+    """Dense-trunk decode hook form. ``_layer_cursor`` (a one-element
+    list) tracks the layer index across the trunk's in-order ffn calls
+    — the hook receives only the layer's params, but the replica slice
+    and the ll slot parity are per-layer."""
+    li = 0
+    if _layer_cursor is not None:
+        li = _layer_cursor[0]
+        _layer_cursor[0] += 1
     return moe_ffn_decode(lp["moe"], h, cfg, moe_impl=moe_impl,
-                          axis=axis, ep_ctx=ep_ctx)
+                          axis=axis, ep_ctx=ep_ctx, transport=transport,
+                          replicas=replicas, layer=li, counts=counts)
 
 
 def forward_tokens(params, input_ids, cfg: ModelConfig, *,
@@ -179,10 +203,16 @@ def cache_specs(axis: str = "tp"):
 def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
             axis: str = "tp", ctxs: FwdContexts = FwdContexts(),
             max_len: Optional[int] = None, moe_impl: str = "tp",
-            ep_ctx: Optional[EPContext] = None, moe_block_m: Optional[int] = None):
+            ep_ctx: Optional[EPContext] = None,
+            moe_block_m: Optional[int] = None, transport=None,
+            replicas=None):
     """Per-shard prefill → (last-position logits (B, vocab), KVCache).
     Same contract as ``dense.prefill`` (the Engine's model protocol,
-    reference ``Engine._init_model`` + ``DenseLLM.inference``)."""
+    reference ``Engine._init_model`` + ``DenseLLM.inference``).
+    ``transport``/``replicas`` are decode-path knobs accepted here so
+    one model_kwargs dict serves both dispatches; prefill always rides
+    the full dispatch/combine path."""
+    del transport, replicas
     import functools
 
     from triton_dist_tpu.models import dense as _dense
@@ -197,17 +227,36 @@ def prefill(params, input_ids, cfg: ModelConfig, *, mode: str = "xla",
 def decode_step(params, token_ids, cache, cfg: ModelConfig, *,
                 mode: str = "xla", axis: str = "tp",
                 ctxs: FwdContexts = FwdContexts(), moe_impl: str = "tp",
-                ep_ctx=None):
+                ep_ctx=None, transport=None, replicas=None,
+                with_expert_counts: bool = False):
     """One decode step on a replicated (B,) token batch — the dense
-    decode loop with the MoE small-batch FFN plugged in."""
+    decode loop with the MoE small-batch FFN plugged in.
+    ``with_expert_counts=True`` appends the step's per-expert routed
+    assignment counts (E,) int32, summed over layers, to the return
+    tuple (the serving layer's load telemetry)."""
     import functools
 
     from triton_dist_tpu.models import dense as _dense
 
+    counts = [] if with_expert_counts else None
     ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
-                            axis=axis, ep_ctx=ep_ctx)
-    return _dense.decode_step(params, token_ids, cache, cfg, mode=mode,
-                              axis=axis, ctxs=ctxs, ffn_fn=ffn)
+                            axis=axis, ep_ctx=ep_ctx,
+                            transport=transport, replicas=replicas,
+                            counts=counts, _layer_cursor=[0])
+    out = _dense.decode_step(params, token_ids, cache, cfg, mode=mode,
+                             axis=axis, ctxs=ctxs, ffn_fn=ffn)
+    if not with_expert_counts:
+        return out
+    return out + (_sum_counts(counts, cfg),)
+
+
+def _sum_counts(counts, cfg: ModelConfig):
+    """Stack per-layer expert counts into one (E,) int32 vector (zeros
+    when the TP regime collected nothing)."""
+    if counts:
+        return jnp.sum(jnp.stack(counts, axis=0), axis=0
+                       ).astype(jnp.int32)
+    return jnp.zeros((cfg.num_experts,), jnp.int32)
 
 
 def paged_cache_specs(axis: str = "tp"):
@@ -220,16 +269,27 @@ def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                       mode: str = "xla", axis: str = "tp",
                       ctxs: FwdContexts = FwdContexts(),
                       attn_impl: str = "ref", moe_impl: str = "tp",
-                      ep_ctx=None):
+                      ep_ctx=None, transport=None, replicas=None,
+                      with_expert_counts: bool = False):
     """Continuous-batching decode over a PagedKVCache — the dense
     serving step with the MoE small-batch FFN plugged in (the
-    ServingEngine's model contract)."""
+    ServingEngine's model contract). ``transport`` routes the EP
+    dispatch (see :func:`moe_ffn_decode`); ``replicas`` is the full
+    hot-expert replica state (data, refreshed between steps);
+    ``with_expert_counts=True`` appends the step's (E,) int32 expert
+    counts to the return tuple."""
     import functools
 
     from triton_dist_tpu.models import dense as _dense
 
+    counts = [] if with_expert_counts else None
     ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
-                            axis=axis, ep_ctx=ep_ctx)
-    return _dense.decode_step_paged(params, token_ids, cache, cfg,
-                                    mode=mode, axis=axis, ctxs=ctxs,
-                                    attn_impl=attn_impl, ffn_fn=ffn)
+                            axis=axis, ep_ctx=ep_ctx,
+                            transport=transport, replicas=replicas,
+                            counts=counts, _layer_cursor=[0])
+    out = _dense.decode_step_paged(params, token_ids, cache, cfg,
+                                   mode=mode, axis=axis, ctxs=ctxs,
+                                   attn_impl=attn_impl, ffn_fn=ffn)
+    if not with_expert_counts:
+        return out
+    return out + (_sum_counts(counts, cfg),)

@@ -71,11 +71,55 @@ class ServingEngine:
                  num_pages: Optional[int] = None, max_queue: int = 64,
                  policy: str = "continuous", attn_impl: str = "ref",
                  prefix_reuse: bool = False, timeout_s=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, transport: Optional[str] = None,
+                 replica_slots: int = 0, rebalance_every: int = 8,
+                 hot_expert_factor: float = 2.0,
+                 load_alpha: float = 0.25):
+        """EP-MoE decode knobs (no-ops for dense models):
+
+        - ``transport``: EP decode dispatch path ("ar" | "ragged" |
+          "ll" | "auto"); default = the engine's ``ep_transport``.
+          "auto" is resolved ONCE here against the tune cache for the
+          actual (mesh, num_slots, hidden, dtype) decode shape, so the
+          jitted decode dispatch never re-specializes. The megakernel
+          path serves experts in-kernel (TP regime); the knob is
+          recorded but dispatch stays in-kernel.
+        - ``replica_slots``: hot-expert replica weight slots per MoE
+          layer (layer path, ``"ll"`` transport). When an expert's
+          load EWMA crosses ``hot_expert_factor``× the mean, its
+          weights are copied onto the least-loaded rank and alternate
+          assignments reroute there — replica choice is data, never a
+          recompile.
+        - ``rebalance_every``: decode dispatches between replication /
+          scheduler-priority refreshes (0 = telemetry only).
+        - ``load_alpha``: EWMA smoothing for per-expert load.
+        """
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
         self.engine = engine
         self.mega = isinstance(engine, MegaKernelEngine)
+        self.replica_slots = int(replica_slots)
+        self.rebalance_every = int(rebalance_every)
+        self.hot_expert_factor = float(hot_expert_factor)
+        self.load_alpha = float(load_alpha)
+        if transport is not None:
+            from triton_dist_tpu.layers.ep_moe import DECODE_TRANSPORTS
+
+            if transport not in DECODE_TRANSPORTS:
+                raise ValueError(f"transport={transport!r} not in "
+                                 f"{DECODE_TRANSPORTS}")
+        self.transport = transport
+        self.ep = False                  # layer-path EP-MoE decode
+        self.replicas = None
+        self.expert_hist: List[np.ndarray] = []
+        self._hist_active = False
+        self._replicated = {}            # expert id -> replica rank
+        self._replica_free = list(range(self.replica_slots))
+        self._mk_counts_base = None
+        self._mk_load_sig = None
+        ne = getattr(engine.cfg, "num_experts", 0) or 0
+        self.expert_totals = np.zeros((ne,), np.int64)
+        self.expert_ewma = np.zeros((ne,), np.float64)
         self.timeout_s = (timeout_s if timeout_s is not None
                           else getattr(engine, "timeout_s", None))
         if isinstance(engine, MegaKernelEngine) and timeout_s is not None:
@@ -91,6 +135,12 @@ class ServingEngine:
         }
 
         if self.mega:
+            if self.replica_slots:
+                raise ValueError(
+                    "replica_slots is a layer-path EP knob; the "
+                    "megakernel serves every expert in-kernel (TP "
+                    "regime) and rebalances via the dynamic "
+                    "scoreboard's expert-load claim priority instead")
             num_slots = engine.batch
             if engine.paged:
                 page = engine.builder.page
@@ -172,17 +222,97 @@ class ServingEngine:
         self.cache = jax.tree.map(jax.device_put, cache, shardings,
                                   is_leaf=lambda x: isinstance(x, jax.Array))
 
-        def _decode(params, toks, c):
-            return model.decode_step_paged(
-                params, toks, c, cfg, mode=eng.mode, axis=axis,
-                ctxs=eng.ctxs, attn_impl=self.attn_impl,
-                **eng.model_kwargs)
+        # EP-MoE decode: resolve the transport knob ONCE (host-side,
+        # against the tune cache, with the true decode batch shape) so
+        # the jitted dispatch below never re-specializes; thread it and
+        # the replica state through decode_step_paged alongside the
+        # on-device expert-counts output.
+        from triton_dist_tpu.layers import ep_moe as _ep_moe
+        from triton_dist_tpu.ops.ep_a2a import EPContext as _EPCtx
 
-        self._decode = jax.jit(jax.shard_map(
-            _decode, mesh=mesh,
-            in_specs=(eng._specs, P(None), kv_spec),
-            out_specs=(P(None, None), kv_spec),
-            check_vma=False), donate_argnums=(2,))
+        mk = dict(eng.model_kwargs)
+        ep_ctx = mk.get("ep_ctx")
+        self.ep = (mk.get("moe_impl") == "ep"
+                   and isinstance(ep_ctx, _EPCtx))
+        if self.ep:
+            # Key the tune lookup on the EXPERT weight dtype — the
+            # same key tune_transport persists under (a mixed-dtype
+            # checkpoint's first param leaf may be the fp32 router).
+            dtype = eng.params["layers"][0]["moe"]["w_gate"].dtype
+            tr = self.transport or getattr(eng, "ep_transport",
+                                           None) or "ar"
+            tr = _ep_moe.resolve_transport(
+                tr, ctx=ep_ctx, batch=num_slots,
+                hidden=cfg.hidden_size, dtype=dtype,
+                topk=cfg.num_experts_per_tok)
+            self.transport = tr
+            mk["transport"] = tr
+            mk["with_expert_counts"] = True
+            if self.replica_slots and tr != "ll":
+                raise ValueError(
+                    "replica_slots needs transport='ll' (replica "
+                    f"rerouting rides the count-free dispatch), "
+                    f"resolved transport is {tr!r}")
+            if self.replica_slots:
+                from jax.sharding import NamedSharding
+
+                # Pin the replica state's (replicated) shardings once:
+                # a refresh must hand the decode dispatch arrays with
+                # IDENTICAL shardings or the jit cache would grow on
+                # the first post-replication step.
+                self._replica_shardings = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    _ep_moe.replica_specs())
+                self.replicas = jax.tree.map(
+                    jax.device_put,
+                    _ep_moe.init_replicas(
+                        cfg, slots=self.replica_slots,
+                        num_layers=cfg.num_hidden_layers, dtype=dtype),
+                    self._replica_shardings)
+        elif self.replica_slots or self.transport:
+            from triton_dist_tpu.ops.ep_a2a import EP2DContext as _EP2D
+
+            if (isinstance(ep_ctx, _EP2D) and not self.replica_slots
+                    and self.transport in ("ar", "auto")):
+                # Hierarchical (2D) EP decode rides the 'ar' path —
+                # the only transport the two-hop geometry supports.
+                self.transport = "ar"
+            elif isinstance(ep_ctx, _EP2D):
+                raise ValueError(
+                    f"transport={self.transport!r}/replica_slots="
+                    f"{self.replica_slots}: hierarchical (EP2D) decode "
+                    "supports only transport='ar' and no replication "
+                    "(ragged/ll need a flat EPContext)")
+            else:
+                raise ValueError(
+                    "transport/replica_slots are EP-MoE decode knobs; "
+                    "this engine serves a non-EP model")
+
+        if self.ep and self.replicas is not None:
+            def _decode(params, toks, c, reps):
+                return model.decode_step_paged(
+                    params, toks, c, cfg, mode=eng.mode, axis=axis,
+                    ctxs=eng.ctxs, attn_impl=self.attn_impl,
+                    replicas=reps, **mk)
+
+            self._decode = jax.jit(jax.shard_map(
+                _decode, mesh=mesh,
+                in_specs=(eng._specs, P(None), kv_spec,
+                          _ep_moe.replica_specs()),
+                out_specs=(P(None, None), kv_spec, P(None)),
+                check_vma=False), donate_argnums=(2,))
+        else:
+            def _decode(params, toks, c):
+                return model.decode_step_paged(
+                    params, toks, c, cfg, mode=eng.mode, axis=axis,
+                    ctxs=eng.ctxs, attn_impl=self.attn_impl, **mk)
+
+            self._decode = jax.jit(jax.shard_map(
+                _decode, mesh=mesh,
+                in_specs=(eng._specs, P(None), kv_spec),
+                out_specs=((P(None, None), kv_spec, P(None))
+                           if self.ep else (P(None, None), kv_spec)),
+                check_vma=False), donate_argnums=(2,))
         # Pinned out_shardings: the writer's output must land with the
         # exact shardings the decode dispatch was compiled for, or the
         # first post-admit step would re-specialize the jit cache.
@@ -269,6 +399,20 @@ class ServingEngine:
         out.update(self.sched.counters)
         out["queue_depth"] = len(self.sched.queue)
         out["live_slots"] = int(self._live.sum())
+        # EP-MoE decode surface: which dispatch transport the decode
+        # rides, and where the routed tokens actually went.
+        if self.mega:
+            out["dispatch_transport"] = (
+                "in-kernel-tp" if getattr(self.cfg, "is_moe", False)
+                else None)
+        else:
+            # self.transport is also set for EP2D engines pinned to
+            # the 'ar' path (self.ep covers flat-EPContext telemetry).
+            out["dispatch_transport"] = self.transport
+        if self._telemetry_active or self.expert_totals.any():
+            out["expert_load"] = self.expert_ewma.tolist()
+            out["expert_totals"] = self.expert_totals.tolist()
+            out["replicated_experts"] = dict(self._replicated)
         if self.manager is not None:
             out["pool"] = self.manager.fragmentation()
         if hasattr(self, "plan"):
@@ -289,12 +433,28 @@ class ServingEngine:
         fn = self.engine._step if self.mega else self._decode
         return fn._cache_size()
 
-    def trace(self, name: str = "serving", **kw):
+    def trace(self, name: str = "serving", *,
+              expert_histograms: bool = True, **kw):
         """Profiler hook: a multi-device trace of the serving loop
-        (delegates to :func:`profiler_utils.group_profile`)."""
+        (delegates to :func:`profiler_utils.group_profile`). While the
+        context is active, each decode step's per-expert routed-token
+        histogram is appended to :attr:`expert_hist` (when the model
+        exposes expert telemetry) — the per-step routing record the
+        load EWMA in :meth:`stats` smooths over."""
+        import contextlib
+
         from triton_dist_tpu.profiler_utils import group_profile
 
-        return group_profile(name, **kw)
+        @contextlib.contextmanager
+        def _traced():
+            self._hist_active = expert_histograms
+            try:
+                with group_profile(name, **kw) as g:
+                    yield g
+            finally:
+                self._hist_active = False
+
+        return _traced()
 
     # -- admission / prefill ----------------------------------------
 
@@ -458,6 +618,7 @@ class ServingEngine:
             return 0
         self.stats_counters["decode_time_s"] += time.perf_counter() - t0
         self.stats_counters["decode_dispatches"] += 1
+        self._maybe_rebalance()
 
         for h in active:
             slot = h.slot
@@ -496,22 +657,193 @@ class ServingEngine:
                 # default, and parked rows must hit the scratch page.
                 self.engine.block_table = jnp.asarray(
                     tbl.reshape(-1), jnp.int32)
+            if (self._mk_counts_base is None
+                    and hasattr(self.engine, "expert_counts")
+                    and getattr(self.cfg, "is_moe", False)):
+                # In-kernel counters accumulate monotonically in the
+                # arena; snapshot BEFORE the first serving dispatch so
+                # pre-serving warmup traffic never pollutes the load.
+                self._mk_counts_base = self.engine.expert_counts()
             out = self.engine.decode_step(toks, lens)
+            if self._mk_counts_base is not None:
+                total = self.engine.expert_counts()
+                self._note_expert_counts(total - self._mk_counts_base)
+                self._mk_counts_base = total
         else:
             cache = _dc.replace(self.cache,
                                 block_table=jnp.asarray(tbl),
                                 lens=lens, live=live)
-            out, self.cache = self._decode(self.engine.params, toks,
-                                           cache)
+            if self.ep and self.replicas is not None:
+                out, self.cache, ecounts = self._decode(
+                    self.engine.params, toks, cache, self.replicas)
+            elif self.ep:
+                out, self.cache, ecounts = self._decode(
+                    self.engine.params, toks, cache)
+            else:
+                ecounts = None
+                out, self.cache = self._decode(self.engine.params,
+                                               toks, cache)
             if self.timeout_s is not None:
-                out = block_until_ready(
-                    out, timeout_s=self.timeout_s, op="serving.decode",
+                # The counts output rides the SAME dispatch: it must
+                # sit inside the watchdog-bounded wait, or a wedged
+                # collective would hang the host in the counts
+                # conversion below before the deadline ever fires.
+                guarded = (out if ecounts is None else (out, ecounts))
+                guarded = block_until_ready(
+                    guarded, timeout_s=self.timeout_s,
+                    op="serving.decode",
                     progress_fn=lambda: {
                         "lens": self._lens.tolist(),
                         "live": self._live.tolist(),
                         **{k: self.stats_counters[k] for k in
                            ("decode_dispatches", "tokens_generated")}})
+                out, ecounts = (guarded if ecounts is not None
+                                else (guarded, None))
+            if ecounts is not None:
+                self._note_expert_counts(
+                    np.asarray(ecounts).astype(np.int64))
         return np.asarray(out)
+
+    # -- expert-load telemetry + hot-expert rebalancing --------------
+
+    def _note_expert_counts(self, counts: np.ndarray):
+        """Fold one decode step's per-expert routed-token counts into
+        the running totals + load EWMA (and the active trace's
+        histogram log). Counts come from the decode dispatch itself —
+        the layer path's on-device counts output, or the megakernel's
+        in-arena router counters."""
+        counts = np.asarray(counts, np.int64).reshape(-1)
+        if counts.size != self.expert_totals.size or counts.sum() <= 0:
+            return
+        self.expert_totals += counts
+        a = self.load_alpha
+        self.expert_ewma = ((1.0 - a) * self.expert_ewma
+                            + a * (counts / counts.sum()))
+        if self._hist_active:
+            self.expert_hist.append(counts.copy())
+
+    @property
+    def _telemetry_active(self) -> bool:
+        return bool(self.ep or (self.mega and self._mk_counts_base
+                                is not None))
+
+    def _maybe_rebalance(self):
+        """Between-steps reaction to the load EWMA: replicate hot
+        experts (layer path, ``"ll"`` transport) and refresh the
+        megakernel's expert-load claim priorities. Pure host work on
+        DATA (replica buffers, claim tables) — the decode dispatch is
+        never re-specialized."""
+        if (self.rebalance_every <= 0
+                or self.stats_counters["decode_dispatches"]
+                % self.rebalance_every):
+            return
+        ewma = self.expert_ewma
+        if ewma.size == 0 or ewma.sum() <= 0:
+            return
+        if self.mega:
+            self._rebalance_megakernel(ewma)
+            return
+        if self.replicas is None:
+            return
+        self._rebalance_replicas(ewma)
+
+    def _rank_loads(self, ewma: np.ndarray):
+        """Per-ep-rank load: owned experts' EWMA mass plus hosted
+        replicas' (half of a replicated expert's traffic reroutes)."""
+        ep_ctx = self.engine.model_kwargs["ep_ctx"]
+        n = ep_ctx.mesh.size(ep_ctx.axis)
+        e_loc = ep_ctx.num_experts // n
+        loads = np.zeros((n,), np.float64)
+        for e in range(ep_ctx.num_experts):
+            share = 0.5 if e in self._replicated else 1.0
+            loads[e // e_loc] += ewma[e] * share
+            if e in self._replicated:
+                loads[self._replicated[e]] += ewma[e] * 0.5
+        return loads, n, e_loc
+
+    def _rebalance_replicas(self, ewma: np.ndarray):
+        from triton_dist_tpu.layers import ep_moe as _ep_moe
+
+        loads, n, e_loc = self._rank_loads(ewma)
+        if n < 2:
+            return
+        mean = ewma.mean()
+        for e in np.argsort(ewma)[::-1]:
+            e = int(e)
+            if ewma[e] <= self.hot_expert_factor * mean:
+                break
+            if e in self._replicated:
+                continue
+            if not self._replica_free:
+                # Evict the coldest replica iff this expert is hotter.
+                coldest = min(self._replicated, key=lambda x: ewma[x])
+                if ewma[coldest] >= ewma[e]:
+                    break
+                slot = self._evict_replica(coldest)
+            else:
+                slot = self._replica_free.pop(0)
+            owner = e // e_loc
+            cand = [r for r in range(n) if r != owner]
+            target = int(min(cand, key=lambda r: loads[r]))
+            import jax.numpy as jnp
+
+            layers = self.engine.params["layers"]
+            stack = {k: jnp.stack([lp["moe"][k][e] for lp in layers])
+                     for k in ("w_gate", "w_up", "w_down")}
+            self.replicas = _ep_moe.install_replica_layers(
+                self.replicas, slot, e, target, stack["w_gate"],
+                stack["w_up"], stack["w_down"])
+            self._replicated[e] = target
+            loads[owner] -= ewma[e] * 0.5
+            loads[target] += ewma[e] * 0.5
+        self._commit_replicas()
+
+    def _commit_replicas(self):
+        """Re-pin the refreshed replica pytree to the shardings the
+        decode dispatch was compiled for (jit keys on shardings, so an
+        uncommitted update would re-specialize the cache)."""
+        import jax
+
+        self.replicas = jax.tree.map(jax.device_put, self.replicas,
+                                     self._replica_shardings)
+
+    def _evict_replica(self, expert: int) -> int:
+        """Clear one expert's replica routing; returns its freed slot.
+        The routing entry flips to -1 (data), so the very next dispatch
+        stops rerouting — weights in the slot are dead until reused."""
+        import jax.numpy as jnp
+
+        slot = int(np.asarray(
+            self.replicas["slot_expert"][0] == expert).argmax())
+        self.replicas = dict(
+            self.replicas,
+            slot_expert=self.replicas["slot_expert"].at[:, slot].set(-1),
+            replica_rank=self.replicas["replica_rank"]
+            .at[:, expert].set(-1))
+        self._commit_replicas()
+        del self._replicated[expert]
+        return slot
+
+    def _rebalance_megakernel(self, ewma: np.ndarray):
+        """Feed the load EWMA into the dynamic scoreboard: hot-expert
+        group-GEMM/combine chains get claimed first. Hysteresis on the
+        SET of genuinely hot experts (EWMA > factor × mean) — a
+        re-prioritize rebuilds claim tables and re-jits the step, so
+        neither near-tied ranking churn under uniform load nor an
+        unchanged hot set may trigger it. An emptied hot set restores
+        the uniform claim order once."""
+        eng = self.engine
+        if (getattr(eng, "schedule", None) != "dynamic"
+                or not hasattr(eng, "set_expert_load")):
+            return
+        hot = frozenset(
+            int(e) for e in
+            np.nonzero(ewma > self.hot_expert_factor * ewma.mean())[0])
+        if hot == self._mk_load_sig or (not hot
+                                        and self._mk_load_sig is None):
+            return
+        eng.set_expert_load(ewma.tolist() if hot else None)
+        self._mk_load_sig = hot or None
 
     # -- per-request token handling ---------------------------------
 
