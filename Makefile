@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke apicheck ci bench-all
+	serve-smoke ep-smoke disagg-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -52,6 +52,13 @@ serve-smoke: csrc
 # (docs/serving.md EP-decode section).
 ep-smoke: csrc
 	bash scripts/ep_smoke.sh
+
+# Disaggregated-serving battery: chunked-prefill bucket gates + page
+# migration on the CPU mesh, a split-role chat e2e, and the non-null
+# chunked-vs-monolithic bench gate (docs/serving.md disaggregation
+# section).
+disagg-smoke: csrc
+	bash scripts/disagg_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

@@ -446,6 +446,38 @@ def _interpret_serving_times() -> dict:
             "decode_dispatches"]
         out["serving_decode_cache_entries"][policy] = (
             srv.decode_cache_size())
+
+    # Chunked vs monolithic prefill on a PREFILL-HEAVY mixed-length
+    # trace (every prompt a distinct length — the serving reality
+    # ROADMAP Open item 1 names): monolithic prefill compiles once per
+    # length, chunked once per bucket, so the wall-clock ratio here is
+    # dominated by exactly the compile tax the bucketing removes.
+    # Wall time INCLUDES prefill (unlike tokens_per_s above) — that is
+    # the number disaggregation/chunking moves. Fresh engine per
+    # variant: the jit caches must not be shared.
+    rng = np.random.RandomState(0)
+    trace = [[int(t) for t in rng.randint(0, 64, n)]
+             for n in (3, 5, 7, 9, 11, 14, 17, 21)]
+
+    def run_trace(buckets):
+        e = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+        s = ServingEngine(e, num_slots=2, page=8,
+                          prefill_buckets=buckets)
+        t0 = time.perf_counter()
+        s.generate(trace, max_new_tokens=4)
+        dt = time.perf_counter() - t0
+        return dt, s.stats()["tokens_generated"], s.prefill_cache_size()
+
+    dt_m, toks_m, pre_m = run_trace(None)
+    dt_c, toks_c, pre_c = run_trace((8,))
+    out["prefill_chunked_vs_monolithic_ms"] = {
+        "monolithic": round(dt_m * 1e3, 1),
+        "chunked": round(dt_c * 1e3, 1)}
+    out["serving_tokens_per_s_prefill_heavy"] = {
+        "monolithic": round(toks_m / max(dt_m, 1e-9), 2),
+        "chunked": round(toks_c / max(dt_c, 1e-9), 2)}
+    out["serving_prefill_cache_entries"] = {
+        "monolithic": pre_m, "chunked": pre_c}
     return out
 
 
@@ -573,6 +605,8 @@ def _interpret_bench(reason: str) -> None:
         sv = _interpret_serving_times()
     except Exception as e:  # serving bench must not sink the record
         sv = {"serving_tokens_per_s": None,
+              "prefill_chunked_vs_monolithic_ms": None,
+              "serving_tokens_per_s_prefill_heavy": None,
               "serving_error": str(e)[:200]}
     try:
         ep = _interpret_ep_times()

@@ -46,6 +46,17 @@ def main():
     ap.add_argument("--replica-slots", type=int, default=0,
                     help="hot-expert replica slots per MoE layer "
                          "(EP decode, transport=ll)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode serving: the "
+                         "first half of the tp devices becomes the "
+                         "prefill worker, the second half the decode "
+                         "worker (one colocated role at --tp 1); "
+                         "completed prefills migrate KV pages to the "
+                         "decode pool (see docs/serving.md)")
+    ap.add_argument("--buckets", default="8,32",
+                    help="--disagg/chunked prefill: comma-separated "
+                         "chunk-length buckets (the prefill jit cache "
+                         "is bounded by their count)")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -70,10 +81,39 @@ def main():
     if args.hf_dir and args.megakernel:
         sys.exit("--megakernel serves the built-in tiny model only; "
                  "drop one of --hf-dir/--megakernel")
+    if args.disagg and (args.megakernel or args.moe_ep
+                        or args.transport or args.replica_slots):
+        sys.exit("--disagg splits the layer path's dense/HF serving; "
+                 "it does not combine with --megakernel or the EP "
+                 "decode knobs")
     if args.megakernel and (args.transport or args.replica_slots):
         sys.exit("--transport/--replica-slots route the layer path's "
                  "EP decode dispatch; the megakernel serves experts "
                  "in-kernel (use --moe-ep without --megakernel)")
+    def build_disagg(cfg, params, model_kw):
+        """Two engines over split tp halves (or one colocated role at
+        tp=1) sharing ONE weight pytree, wrapped in the disaggregated
+        serving engine — chunked prefill + KV page migration."""
+        from triton_dist_tpu.serving import DisaggServingEngine
+
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+        devs = jax.devices()
+        if args.tp >= 2:
+            half = args.tp // 2
+            pf_mesh = tdt.make_mesh(tp=half, devices=devs[:half])
+            dec_mesh = tdt.make_mesh(tp=args.tp - half,
+                                     devices=devs[half:args.tp])
+        else:
+            pf_mesh = dec_mesh = tdt.make_mesh(tp=1, devices=devs[:1])
+        kw = dict(mode="xla", max_len=args.max_len, params=params,
+                  **model_kw)
+        pf_eng = Engine(cfg, pf_mesh, **kw)
+        dec_eng = (pf_eng if pf_mesh is dec_mesh
+                   else Engine(cfg, dec_mesh, **kw))
+        return DisaggServingEngine(
+            dec_eng, prefill_engine=pf_eng, num_slots=args.slots,
+            page=args.page, prefill_buckets=buckets)
+
     if args.hf_dir:
         from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
 
@@ -82,15 +122,21 @@ def main():
                                or args.replica_slots):
             sys.exit(f"{args.hf_dir} is not a MoE checkpoint; "
                      "--moe-ep/--transport/--replica-slots need one")
-        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
         model_kw = ({"model": qwen_moe} if cfg.is_moe else {})
-        if cfg.is_moe and (args.moe_ep or args.transport
-                           or args.replica_slots):
-            model_kw.update(moe_impl="ep", ep_transport=args.transport)
-        eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
-                     params=params, **model_kw)
-        srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
-                            replica_slots=args.replica_slots)
+        if args.disagg:
+            srv = build_disagg(cfg, params, model_kw)
+        else:
+            mesh = tdt.make_mesh(tp=args.tp,
+                                 devices=jax.devices()[:args.tp])
+            if cfg.is_moe and (args.moe_ep or args.transport
+                               or args.replica_slots):
+                model_kw.update(moe_impl="ep",
+                                ep_transport=args.transport)
+            eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
+                         params=params, **model_kw)
+            srv = ServingEngine(eng, num_slots=args.slots,
+                                page=args.page,
+                                replica_slots=args.replica_slots)
     elif args.moe_ep or args.transport or args.replica_slots:
         # --transport / --replica-slots imply the EP-MoE tiny model:
         # silently serving the dense model would drop the knobs.
@@ -119,6 +165,12 @@ def main():
         mk = MegaKernelEngine(cfg, mesh1d, batch=args.tp,
                               max_len=args.max_len, tile_w=16, t_tile=16)
         srv = ServingEngine(mk)
+    elif args.disagg:
+        from triton_dist_tpu.models import dense
+
+        cfg = ModelConfig.tiny(vocab_size=128)
+        params = dense.init_params(jax.random.PRNGKey(0), cfg)
+        srv = build_disagg(cfg, params, {})
     else:
         cfg = ModelConfig.tiny(vocab_size=128)
         mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
@@ -161,6 +213,14 @@ def main():
             f"{st['decode_dispatches']} decode dispatches")
     if st.get("dispatch_transport"):
         line += f", transport={st['dispatch_transport']}"
+    if st.get("prefill_buckets"):
+        line += (f", prefill_chunks={st['prefill_chunks']} "
+                 f"(buckets {st['prefill_buckets']}, "
+                 f"jit entries {st['prefill_cache_size']})")
+    if st.get("migration_transport"):
+        line += (f", roles={st['roles']}, "
+                 f"migration={st['migration_transport']}, "
+                 f"migrated_pages={st['migrated_pages']}")
     if st.get("expert_load") is not None:
         load = st["expert_load"]
         hot = max(range(len(load)), key=load.__getitem__)

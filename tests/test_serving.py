@@ -86,7 +86,14 @@ def test_block_manager_row_overflow():
 def test_block_manager_prefix_reuse():
     m = BlockManager(num_pages=10, page=4, p_max=6, prefix_reuse=True)
     p0 = m.alloc_prefill(0, [1, 2, 3, 4, 5, 6, 7, 8, 9])  # 2 full + 1
+    # Two-phase publication: until the content-resident commit, a
+    # same-prefix alloc must MISS (the pages hold no KV yet).
+    probe = m.alloc_prefill(7, [1, 2, 3, 4, 5, 6, 7, 8])
+    assert probe[:2] != p0[:2], "uncommitted prefix pages were shared"
+    m.free_slot(7)
+    m.commit_prefix(0)
     p1 = m.alloc_prefill(1, [1, 2, 3, 4, 5, 6, 7, 8, 42])
+    m.commit_prefix(1)
     assert p0[:2] == p1[:2], "full prefix pages must be shared"
     assert p0[2] != p1[2], "ragged tails stay private"
     assert m.stats["prefix_hits"] == 2

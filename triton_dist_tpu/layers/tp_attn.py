@@ -235,14 +235,19 @@ def fwd_prefill(params, x, cfg, *, batch: int, mode: str = "xla",
 
 
 def decode_project(params, x, cfg, positions, *, axis: str = "tp"):
-    """Project one decode token per row: QKV + q/k norm + rope.
+    """Project one token per row: QKV + q/k norm + rope.
 
     x: (B, d) replicated; ``positions``: (B,) int32 — PER-ROW cache
-    positions, so a continuous-batching step can rope each slot at its
-    own length (the single-request form passes a broadcast scalar).
+    positions. Two callers, one contract: the continuous-batching
+    decode step ropes each SLOT at its own length (one token per slot;
+    the single-request form passes a broadcast scalar), and the
+    chunked-prefill step ropes a CHUNK of consecutive tokens of one
+    slot (rows = positions ``start + arange(C)``) — the projection is
+    row-independent, so the same kernel serves both.
     Returns (q (B, 1, H_loc, hd), k_tok (B, 1, KV_loc, hd),
-    v_tok (B, 1, KV_loc, hd)); the caller appends k/v through the
-    cache's ``append_decode`` contract before attending.
+    v_tok (B, 1, KV_loc, hd)); the caller places k/v through the
+    cache's ``append_decode`` / ``write_chunk`` contract before
+    attending.
     """
     n = jax.lax.axis_size(axis)
     hd = cfg.head_dim

@@ -253,6 +253,77 @@ def paged_cache_specs(axis: str = "tp"):
         block_table=P(None, None), lens=P(None), live=P(None))
 
 
+def prefill_chunk_paged(params, chunk_toks, cache, table_row,
+                        cfg: ModelConfig, *, start, wfrom, valid,
+                        mode: str = "xla", axis: str = "tp",
+                        ctxs: FwdContexts = FwdContexts(), ffn_fn=None):
+    """One FIXED-SHAPE chunk of a bucketed paged prefill (per-shard).
+
+    The chunked half of the serving split: instead of one monolithic
+    prefill dispatch per prompt length (which XLA specializes per
+    length), the prompt streams through this step in bucketed chunks —
+    the trace signature depends only on the chunk length ``C``, so the
+    prefill jit cache is bounded by the bucket count.
+
+    chunk_toks: (C,) int32 replicated, padded past ``valid``;
+    ``table_row``: (p_max,) int32 — the slot's block-table row (data);
+    ``start``: scalar — global position of the chunk's first token;
+    ``wfrom``: scalar — positions below it are already resident
+    (prefix-shared pages; computed but never rewritten); ``valid``:
+    scalar — real tokens in this chunk. All three ride as data.
+
+    Per layer: project the chunk through the decode contract
+    (:func:`tp_attn.decode_project` at per-row positions), write K/V
+    into the slot's pages (:meth:`PagedKVCache.write_chunk`), then
+    attend the chunk's queries over the slot's gathered position-major
+    page view with the global causal mask
+    (:func:`~triton_dist_tpu.ops.chunked_prefill.chunk_attend`) — so
+    earlier chunks and the shared prefix are attended exactly and
+    chunk boundaries are invisible to the math. The residual stays
+    replicated (the decode AR regime — no token-sharding divisibility
+    constraint ties C to the mesh).
+
+    Returns ``(logits (vocab,) of the LAST VALID token, cache)`` — the
+    final chunk's logits seed the first generated token; earlier
+    chunks' logits are discarded.
+    """
+    from triton_dist_tpu.ops.chunked_prefill import chunk_attend
+
+    c = chunk_toks.shape[0]
+    x = params["embed"][chunk_toks]          # (C, d) replicated
+    dec_mode = "xla" if mode == "xla" else "fused_ar"
+    positions = (jnp.asarray(start, jnp.int32)
+                 + jnp.arange(c, dtype=jnp.int32))
+
+    for li, layer_params in enumerate(params["layers"]):
+        h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
+        q, k_tok, v_tok = tp_attn.decode_project(
+            layer_params["attn"], h, cfg, positions, axis=axis)
+        cache = cache.write_chunk(li, k_tok, v_tok, table_row,
+                                  positions, valid, wfrom)
+        kd, vd = cache.dense_row(li, table_row)
+        o = chunk_attend(q[:, 0], kd, vd, positions)
+        x = x + tp_attn.decode_output(
+            layer_params["attn"], o.reshape(c, -1), h, mode=dec_mode,
+            axis=axis, ar_ctx=ctxs.ar)
+        h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
+        if ffn_fn is None:
+            mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+            x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
+                               axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                               ar_ctx=ctxs.ar)
+        else:
+            x = x + ffn_fn(layer_params, h)
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    last = jax.lax.dynamic_slice_in_dim(
+        x, jnp.maximum(jnp.asarray(valid, jnp.int32) - 1, 0), 1, axis=0)
+    logits_loc = jnp.dot(last, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits[0], cache
+
+
 def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                       mode: str = "xla", axis: str = "tp",
                       ctxs: FwdContexts = FwdContexts(),

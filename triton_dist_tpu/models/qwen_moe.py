@@ -265,6 +265,33 @@ def paged_cache_specs(axis: str = "tp"):
     return _dense.paged_cache_specs(axis)
 
 
+def prefill_chunk_paged(params, chunk_toks, cache, table_row,
+                        cfg: ModelConfig, *, start, wfrom, valid,
+                        mode: str = "xla", axis: str = "tp",
+                        ctxs: FwdContexts = FwdContexts(),
+                        moe_impl: str = "tp", ep_ctx=None, transport=None,
+                        replicas=None, with_expert_counts: bool = False):
+    """One bucketed chunk of a paged prefill with the MoE FFN in the
+    AR decode regime (the chunk residual is replicated, so the
+    masked-local + psum expert path is the transport that fits any
+    chunk length exactly). ``transport``/``replicas``/counts are
+    decode-dispatch knobs — prefill chunks ignore them; decode keeps
+    its own resolved transport."""
+    del transport, replicas, with_expert_counts
+    import functools
+
+    from triton_dist_tpu.models import dense as _dense
+
+    ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
+                            axis=axis, ep_ctx=ep_ctx, transport="ar",
+                            counts=None, _layer_cursor=[0])
+    return _dense.prefill_chunk_paged(params, chunk_toks, cache,
+                                      table_row, cfg, start=start,
+                                      wfrom=wfrom, valid=valid,
+                                      mode=mode, axis=axis, ctxs=ctxs,
+                                      ffn_fn=ffn)
+
+
 def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                       mode: str = "xla", axis: str = "tp",
                       ctxs: FwdContexts = FwdContexts(),

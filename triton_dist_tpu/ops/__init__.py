@@ -20,7 +20,10 @@ from triton_dist_tpu.ops.allreduce import (  # noqa: F401
     all_reduce, all_reduce_2d, all_reduce_ref, AllReduceMethod,
 )
 from triton_dist_tpu.ops.p2p import (  # noqa: F401
-    p2p_put, p2p_put_host, ppermute_ref,
+    migrate_pages_host, p2p_put, p2p_put_host, ppermute_ref,
+)
+from triton_dist_tpu.ops.chunked_prefill import (  # noqa: F401
+    chunk_attend, chunk_write_ids, plan_chunks,
 )
 from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401
     AGGemmContext, create_ag_gemm_context, ag_gemm, ag_gemm_ref,

@@ -1,0 +1,114 @@
+"""Fixed-shape chunked-prefill building blocks (paged KV).
+
+Reference: the serving split of the source paper's Engine (PAPER.md
+L7/L7′) assumes prefill work can be fed to a persistent decode batch
+without respecializing it; the megakernel-decode serving analysis of
+arXiv 2605.00686 makes the cost of violating that explicit. The layer
+path used to run one monolithic prefill dispatch per request, which
+XLA specializes per prompt length — so a mixed-length trace burns its
+time in compiles. Chunked prefill fixes the shape instead: prompts are
+split into a small set of BUCKETED chunk lengths (padded to bucket),
+each chunk streamed into the slot's ``PagedKVCache`` pages through one
+jitted per-bucket step, so the prefill jit cache is bounded by the
+bucket count — never by the distinct-prompt-length count.
+
+This module holds the pure math both the dense and MoE chunk steps
+share (:func:`triton_dist_tpu.models.dense.prefill_chunk_paged` is the
+model-level driver):
+
+- :func:`chunk_write_ids` — which pool page / offset each chunk token
+  writes, with padding and already-resident (prefix-shared) positions
+  routed to the reserved scratch page, so a chunk can never corrupt a
+  page a live reader holds.
+- :func:`chunk_attend` — causal attention of the chunk's queries over
+  the slot's gathered position-major page view (the
+  ``paged_flash_decode_ref`` gather path generalized from one query
+  per slot to a chunk of queries), masked by each query's GLOBAL
+  position so earlier chunks and the shared prefix are attended
+  exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+SCRATCH_PAGE = 0
+
+
+def plan_chunks(n_tokens: int, buckets) -> list:
+    """Deterministic bucket cover of ``n_tokens``: greedily the largest
+    bucket that fits, then the smallest bucket covering the remainder
+    (padded). Returns ``[(bucket, valid), ...]`` with
+    ``sum(valid) == n_tokens``. Pure host planning — the resume path
+    re-prefills through the SAME sequence for the same length, which is
+    what makes preemption recovery deterministic."""
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+    bs = sorted(set(int(b) for b in buckets))
+    if not bs or bs[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets}")
+    out = []
+    rem = int(n_tokens)
+    while rem > 0:
+        fit = [b for b in bs if b <= rem]
+        if fit:
+            b = max(fit)
+            out.append((b, b))
+            rem -= b
+        else:                    # tail: smallest bucket covers it, padded
+            b = min(x for x in bs if x >= rem)
+            out.append((b, rem))
+            rem = 0
+    return out
+
+
+def chunk_write_ids(positions, table_row, valid, wfrom, *, page: int):
+    """Scatter targets for one chunk's K/V tokens.
+
+    ``positions``: (C,) int32 global positions of the chunk tokens;
+    ``table_row``: (p_max,) int32 — the slot's block-table row;
+    ``valid``: scalar — tokens past it are bucket padding;
+    ``wfrom``: scalar — positions below it are already resident
+    (prefix-shared pages another request may be attending; rewriting
+    them with this prefill's floats has no cross-shape bit-exactness
+    guarantee, so they are never re-blitted).
+
+    Returns ``(pids, offsets)``: padding / resident positions map to
+    the reserved scratch page (id 0) — their writes are garbage the
+    masks hide; real positions map to ``table_row[pos // page]``.
+    """
+    c = positions.shape[0]
+    i = jnp.arange(c, dtype=jnp.int32)
+    row = jnp.clip(positions // page, 0, table_row.shape[0] - 1)
+    writable = jnp.logical_and(i < valid, positions >= wfrom)
+    pids = jnp.where(writable, table_row[row], SCRATCH_PAGE)
+    return pids, positions % page
+
+
+def chunk_attend(q, k_dense, v_dense, positions):
+    """Causal chunk attention over a gathered position-major KV view.
+
+    q: (C, H, hd) — the chunk's queries (head-major, this rank's
+    heads); k_dense/v_dense: (T, KV, hd) — the slot's pages gathered
+    position-major (T = p_max·page; positions past the written region
+    are garbage the mask hides); positions: (C,) int32 global query
+    positions. Query ``i`` attends keys at positions
+    ``<= positions[i]`` — exactly the monolithic causal mask restricted
+    to this chunk's rows, so chunk boundaries are invisible to the
+    math. GQA by head repeat; fp32 softmax (the :func:`tp_attn.sdpa`
+    numerics). Returns (C, H, hd).
+    """
+    c, h, hd = q.shape
+    t, kvh, _ = k_dense.shape
+    rep = h // kvh
+    k = jnp.repeat(k_dense, rep, axis=1)      # (T, H, hd)
+    v = jnp.repeat(v_dense, rep, axis=1)
+    scores = jnp.einsum("chd,thd->hct", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    mask = jnp.arange(t, dtype=jnp.int32)[None, :] <= positions[:, None]
+    scores = jnp.where(mask[None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("hct,thd->chd", probs, v)

@@ -149,6 +149,41 @@ from triton_dist_tpu.utils.jit_cache import CompiledCache, cached_dim0_spmd
 _P2P_HOST_CACHE = CompiledCache(16)
 
 
+def migrate_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
+                       src: int = 0, dst: int = 1):
+    """KV page migration for disaggregated serving: one-sided put of a
+    whole-page payload from the ``src`` role rank to ``dst`` along a
+    bridge mesh's ``axis`` (prefill worker → decode worker).
+
+    ``k_payload``/``v_payload``: (L, n, KV, page, hd) page payloads —
+    the natural transfer unit of the paged pool (the caller pads ``n``
+    to its fixed migration batch with scratch pages, so this dispatch
+    never re-specializes per prompt length). The payloads are staged
+    onto the bridge mesh host-side (this is a single-controller
+    container; on a multi-controller deployment the stage is the
+    worker's own device buffer) and ride the :func:`p2p_put` remote-DMA
+    edge — the same one-sided transport the pipeline layers use, fault
+    plans and the XLA fallback policy included. Returns the (k, v)
+    payloads as received at ``dst`` (numpy).
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_roles = mesh.shape[axis]
+    perm = ((int(src), int(dst)),)
+    # K and V ride ONE put (stacked leading dim): the handoff sits on
+    # the serving loop's critical path, so one dispatch + one staging
+    # buffer, not two. Only the dst slab is pulled back to host.
+    p = np.stack([np.asarray(k_payload), np.asarray(v_payload)])
+    x = np.zeros((n_roles,) + p.shape, p.dtype)
+    x[src] = p
+    xd = jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, P(axis, *([None] * p.ndim))))
+    out = p2p_put_host(xd, perm, mesh, axis=axis)
+    got = np.asarray(out[dst])
+    return got[0], got[1]
+
+
 def p2p_put_host(x, perm: Sequence[Tuple[int, int]], mesh, *,
                  axis: str = "pp"):
     """Host-level :func:`p2p_put`: ``x`` sharded on dim 0 along

@@ -20,3 +20,9 @@ from triton_dist_tpu.serving.scheduler import (  # noqa: F401
     Scheduler,
 )
 from triton_dist_tpu.serving.server import ServingEngine  # noqa: F401
+from triton_dist_tpu.serving.chunked import (  # noqa: F401
+    DEFAULT_BUCKETS, ChunkedPrefill,
+)
+from triton_dist_tpu.serving.disagg import (  # noqa: F401
+    DisaggServingEngine, PrefillWorker,
+)

@@ -39,6 +39,27 @@ import jax.numpy as jnp
 SCRATCH_PAGE = 0
 
 
+def pool_shardings(mesh, spec_tree):
+    """NamedShardings for a :class:`PagedKVCache` spec pytree, with
+    trailing-``None`` dims dropped from every spec — the spelling jit
+    canonicalizes OUTPUT shardings to. Pinning writers (prompt blit,
+    chunk steps, migration scatter) to THESE shardings makes their
+    output pools compare jit-cache-equal to pools emitted by unpinned
+    dispatches (``P(None, None, 'tp', None, None)`` and
+    ``P(None, None, 'tp')`` place identically but are different cache
+    keys — a one-entry-per-producer leak otherwise)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def canon(spec):
+        parts = tuple(spec)
+        while parts and parts[-1] is None:
+            parts = parts[:-1]
+        return NamedSharding(mesh, PartitionSpec(*parts))
+
+    return jax.tree.map(canon, spec_tree,
+                        is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
 class OutOfPagesError(RuntimeError):
     """The pool has no free page (and nothing evictable) — the caller
     should apply backpressure (reject or queue the request)."""
@@ -112,6 +133,69 @@ class PagedKVCache:
         """Bump live slots' lengths after all layers appended."""
         return dataclasses.replace(
             self, lens=self.lens + self.live.astype(jnp.int32))
+
+    def write_chunk(self, layer: int, k_tok, v_tok, table_row,
+                    positions, valid, wfrom) -> "PagedKVCache":
+        """Write one prefill CHUNK's K/V into a slot's pages — the
+        chunked-prefill half of the cache-update contract
+        (:meth:`append_decode` is the one-token decode half).
+
+        k_tok/v_tok: (C, 1, KV_loc, hd) — one row per chunk token;
+        ``table_row``: (p_max,) int32 — the slot's block-table row;
+        ``positions``: (C,) int32 global positions; ``valid``/``wfrom``
+        route bucket padding and already-resident (prefix-shared)
+        positions to the scratch page (see
+        :func:`~triton_dist_tpu.ops.chunked_prefill.chunk_write_ids`)
+        so a chunk can never corrupt a page a live reader holds.
+        """
+        from triton_dist_tpu.ops.chunked_prefill import chunk_write_ids
+
+        pids, off = chunk_write_ids(positions, table_row, valid, wfrom,
+                                    page=self.page)
+        k_pages = self.k_pages.at[layer, pids, :, off, :].set(
+            k_tok[:, 0].astype(self.k_pages.dtype))
+        v_pages = self.v_pages.at[layer, pids, :, off, :].set(
+            v_tok[:, 0].astype(self.v_pages.dtype))
+        return dataclasses.replace(self, k_pages=k_pages,
+                                   v_pages=v_pages)
+
+    def dense_row(self, layer: int, table_row) -> Tuple[jax.Array,
+                                                        jax.Array]:
+        """Gather ONE slot's pages to the dense position-major view
+        (p_max·page, KV_loc, hd) — the per-slot form of
+        :meth:`dense_layer`, consumed by the chunked-prefill attention
+        (positions past the slot's written region are garbage the
+        causal mask hides)."""
+        p_max = table_row.shape[0]
+        _, _, kvh, page, hd = self.k_pages.shape
+
+        def gather(pool):
+            g = pool[layer][table_row]      # (p_max, KV, page, hd)
+            g = g.transpose(0, 2, 1, 3)     # (p_max, page, KV, hd)
+            return g.reshape(p_max * page, kvh, hd)
+
+        return gather(self.k_pages), gather(self.v_pages)
+
+    def gather_pages(self, page_ids) -> Tuple[jax.Array, jax.Array]:
+        """Extract whole pages as a migration payload: page_ids (n,)
+        int32 pool slots (pad with the scratch page for a fixed-shape
+        transfer) → (K, V) each (L, n, KV_loc, page, hd). The
+        disaggregated serving handoff's source half."""
+        return self.k_pages[:, page_ids], self.v_pages[:, page_ids]
+
+    def scatter_pages(self, k_payload, v_payload,
+                      page_ids) -> "PagedKVCache":
+        """Blit a migration payload into this pool's pages: the
+        receiver half of the disaggregated KV handoff. ``page_ids``
+        rows the caller wants dropped (padding, prefix-resident pages a
+        live reader holds) should point at the scratch page — duplicate
+        scratch writes are benign garbage."""
+        return dataclasses.replace(
+            self,
+            k_pages=self.k_pages.at[:, page_ids].set(
+                k_payload.astype(self.k_pages.dtype)),
+            v_pages=self.v_pages.at[:, page_ids].set(
+                v_payload.astype(self.v_pages.dtype)))
 
     def dense_layer(self, layer: int) -> Tuple[jax.Array, jax.Array]:
         """Gather one layer's pages to the dense position-major view
@@ -195,8 +279,15 @@ class BlockManager:
         self._slot_tokens: Dict[int, int] = {}
         self._slot_hits: Dict[int, int] = {}
         # prefix cache: chained content key -> page id (insertion order
-        # doubles as the eviction order).
+        # doubles as the eviction order). Entries are PUBLISHED in two
+        # phases: alloc_prefill stages a slot's prefix-eligible pages
+        # in _pending_prefix, and commit_prefix moves them into _prefix
+        # once their KV content is actually resident — a hit hands
+        # other requests these bytes, so registering at allocation time
+        # would share unwritten pages (the multi-tick chunk stream and
+        # the migration handoff both write AFTER allocating).
         self._prefix: Dict[Tuple, int] = {}
+        self._pending_prefix: Dict[int, List[Tuple[Tuple, int]]] = {}
         self.stats = {"allocs": 0, "frees": 0, "prefix_hits": 0,
                       "prefix_misses": 0, "evictions": 0}
 
@@ -271,12 +362,14 @@ class BlockManager:
                         continue
                     self.stats["prefix_misses"] += 1
                     pid = self._take_page()
-                    self._refs[pid] += 1        # the cache's own ref
-                    self._prefix[key] = pid
+                    # Staged, not published: the page holds no KV yet.
+                    self._pending_prefix.setdefault(slot, []).append(
+                        (key, pid))
                     pages.append(pid)
                 else:
                     pages.append(self._take_page())
         except OutOfPagesError:
+            self._pending_prefix.pop(slot, None)
             for pid in pages:
                 self._drop_ref(pid)
             raise
@@ -284,6 +377,22 @@ class BlockManager:
         self._slot_tokens[slot] = n_tok
         self._slot_hits[slot] = hits
         return list(pages)   # copy: appends must not mutate the result
+
+    def commit_prefix(self, slot: int):
+        """Publish ``slot``'s staged prefix pages into the
+        content-addressed cache — call exactly when their KV content is
+        RESIDENT (end of the monolithic blit, the last chunk of a chunk
+        stream, the megakernel lane's final token, or the migration
+        scatter on a receiving pool). Until then a same-prefix request
+        simply misses and computes its own copy — losing the sharing
+        for the overlap window, never reading unwritten pages. If
+        another sharer committed the same content first, its entry
+        wins and this slot's copy stays private."""
+        for key, pid in self._pending_prefix.pop(slot, []):
+            if key in self._prefix:
+                continue
+            self._refs[pid] += 1            # the cache's own ref
+            self._prefix[key] = pid
 
     def prefix_hits(self, slot: int) -> int:
         """Leading page count of ``slot``'s allocation that came from
@@ -323,8 +432,12 @@ class BlockManager:
         return None
 
     def free_slot(self, slot: int):
-        """Release a finished request's pages (shared pages survive in
-        the prefix cache until evicted)."""
+        """Release a finished request's pages (COMMITTED shared pages
+        survive in the prefix cache until evicted; staged-but-never-
+        committed ones — a request that failed before its content
+        landed — are dropped, so a later same-prefix request can never
+        hit an unwritten page)."""
+        self._pending_prefix.pop(slot, None)
         for pid in self._slot_pages.pop(slot, []):
             self._drop_ref(pid)
         self._slot_tokens.pop(slot, None)

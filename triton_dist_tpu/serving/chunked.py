@@ -1,0 +1,126 @@
+"""Per-bucket jitted chunked-prefill driver (the prefill-worker core).
+
+One :class:`ChunkedPrefill` owns the jitted chunk dispatch for one
+(engine, page pool) pair: prompts stream through it in bucketed
+fixed-shape chunks (:func:`ops.chunked_prefill.plan_chunks`), each
+chunk one call of :func:`models.dense.prefill_chunk_paged` under
+``jit(shard_map)`` with the pool DONATED and its output shardings
+PINNED — so the decode dispatch compiled against the same pool never
+re-specializes, and the prefill jit cache is bounded by the bucket
+count instead of the distinct-prompt-length count (the PR-4 known
+limit this subsystem removes).
+
+Used two ways: in-place by :class:`~triton_dist_tpu.serving.server.
+ServingEngine` (``prefill_buckets=...`` — chunks write straight into
+the serving pool), and by the disaggregated prefill worker
+(:mod:`~triton_dist_tpu.serving.disagg` — chunks write into the
+worker's staging pool, whole pages migrate to the decode worker
+afterwards).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from triton_dist_tpu.ops.chunked_prefill import plan_chunks
+
+__all__ = ["ChunkedPrefill", "DEFAULT_BUCKETS"]
+
+# Production default (the e.g. of ROADMAP Open item 1); tests and tiny
+# models pass their own. Sizing guidance in docs/serving.md.
+DEFAULT_BUCKETS = (128, 512, 2048)
+
+
+class ChunkedPrefill:
+    """Bucketed chunk dispatch over one engine + paged pool.
+
+    ``engine`` is a layer :class:`~triton_dist_tpu.models.Engine` whose
+    model exposes ``prefill_chunk_paged``; ``cache_shardings`` is the
+    pool's NamedSharding pytree (the decode dispatch's compiled
+    expectation — chunk outputs are pinned to it); ``buckets`` the
+    chunk lengths. The jit cache of :attr:`_chunk` holds at most one
+    entry per bucket — :meth:`step` asserts that invariant after every
+    dispatch (the prefill half of the serving no-recompilation gate).
+    """
+
+    def __init__(self, engine, cache_shardings, buckets: Sequence[int]):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"prefill buckets must be positive ints, "
+                             f"got {buckets!r}")
+        model = engine.model
+        if not hasattr(model, "prefill_chunk_paged"):
+            raise NotImplementedError(
+                f"model {getattr(model, '__name__', model)!r} has no "
+                "prefill_chunk_paged — chunked prefill needs the paged "
+                "chunk contract (models.dense / models.qwen_moe)")
+        self.engine = engine
+        self.buckets = buckets
+        cfg, mesh, axis = engine.cfg, engine.mesh, engine.axis
+        # Chunk steps take only the regime kwargs — transport/replica/
+        # counts are decode-dispatch knobs the chunk contract ignores.
+        mk = {k: v for k, v in engine.model_kwargs.items()
+              if k in ("moe_impl", "ep_ctx")}
+        kv_spec = model.paged_cache_specs(axis)
+
+        def _chunk(params, toks, cache, table_row, start, wfrom, valid):
+            return model.prefill_chunk_paged(
+                params, toks, cache, table_row, cfg, start=start,
+                wfrom=wfrom, valid=valid, mode=engine.mode, axis=axis,
+                ctxs=engine.ctxs, **mk)
+
+        self._chunk = jax.jit(
+            jax.shard_map(
+                _chunk, mesh=mesh,
+                in_specs=(engine._specs, P(None), kv_spec, P(None),
+                          P(), P(), P()),
+                out_specs=(P(None), kv_spec),
+                check_vma=False),
+            donate_argnums=(2,),
+            out_shardings=(NamedSharding(mesh, P(None)),
+                           cache_shardings))
+
+    def plan(self, n_tokens: int) -> List[Tuple[int, int]]:
+        """Deterministic ``[(bucket, valid), ...]`` cover of
+        ``n_tokens`` (see :func:`ops.chunked_prefill.plan_chunks`)."""
+        return plan_chunks(n_tokens, self.buckets)
+
+    def next_chunk(self, remaining: int) -> Tuple[int, int]:
+        """The next (bucket, valid) for ``remaining`` tokens."""
+        return self.plan(remaining)[0]
+
+    def step(self, params, toks: np.ndarray, cache, table_row,
+             start: int, wfrom: int, valid: int):
+        """Dispatch one chunk; returns ``(logits (vocab,), cache)``.
+        ``toks`` is (bucket,) int32 padded; scalars ride as int32 data
+        so the trace signature depends only on the bucket length."""
+        import jax.numpy as jnp
+
+        logits, cache = self._chunk(
+            params, jnp.asarray(toks, jnp.int32), cache,
+            jnp.asarray(table_row, jnp.int32), np.int32(start),
+            np.int32(wfrom), np.int32(valid))
+        # The no-growth gate, enforced inline: every chunk shape comes
+        # from `buckets`, so more cache entries than buckets means a
+        # shape leak (exactly the recompile-per-length failure this
+        # subsystem exists to prevent). A real raise, not an assert —
+        # this is the production-side half of the contract and must
+        # survive python -O.
+        n = self.cache_size()
+        if n > len(self.buckets):
+            raise RuntimeError(
+                f"chunked-prefill jit cache grew to {n} entries > "
+                f"{len(self.buckets)} buckets {self.buckets} — the "
+                "chunk dispatch re-specialized on something other "
+                "than the bucket length")
+        return logits, cache
+
+    def cache_size(self) -> int:
+        """Jit-cache entries of the chunk dispatch (≤ bucket count) —
+        the prefill half of the serving no-recompilation gate."""
+        return self._chunk._cache_size()

@@ -1,0 +1,340 @@
+"""Disaggregated prefill/decode serving with KV page migration.
+
+The serving split the source paper's Engine/MegaTritonKernel pairing
+implies (PAPER.md L7/L7′) and the megakernel-decode serving analysis
+of arXiv 2605.00686 argues for explicitly: keep decode on a
+never-respecializing hot path, and move prefill's variable-shape work
+onto a separate worker so prefill-heavy traffic can never stall the
+fixed-shape decode batch. Two roles in one process group:
+
+- :class:`PrefillWorker` — a layer engine on its own mesh slice with a
+  private staging page pool; prompts stream through it in bucketed
+  fixed-shape chunks (:mod:`~triton_dist_tpu.serving.chunked`), so its
+  jit cache is bounded by the bucket count.
+- decode worker — the plain continuous-batching
+  :class:`~triton_dist_tpu.serving.server.ServingEngine` machinery
+  (``DisaggServingEngine`` *is* one), driving the fixed-shape decode
+  dispatch on its own mesh slice.
+
+Completed prefills hand their KV over as WHOLE PAGES — the pool's
+natural transfer unit: the decode worker's
+:class:`~triton_dist_tpu.serving.blocks.BlockManager` allocates fresh
+page ids and the block table is rewritten on the receiver, so page ids
+never need to agree across roles; refcounted prefix pages migrate once
+(a decode-side prefix hit skips the transfer AND protects pages a live
+reader holds from being re-blitted). When the roles sit on disjoint
+device sets the payload rides the one-sided
+:func:`~triton_dist_tpu.ops.p2p.migrate_pages_host` remote-DMA edge
+over a 2-rank bridge mesh; the single-role degenerate mode (both roles
+on one mesh) blits locally through the same fixed-shape scatter. The
+migration is issued asynchronously when the final chunk completes and
+collected at the START of the next tick, so the transfer overlaps the
+next chunk's compute and the decode dispatch in between.
+
+Failure containment mirrors the decode path: the migration is wrapped
+in ``faults.on_op_call("page_migration")`` (fault plans can drop it)
+and the resilience watchdog (``timeout_s``) — a wedged or dropped
+migration fails ONE request, never the server.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from triton_dist_tpu.serving.blocks import (
+    SCRATCH_PAGE, BlockManager, OutOfPagesError, PagedKVCache,
+    pool_shardings,
+)
+from triton_dist_tpu.serving.chunked import DEFAULT_BUCKETS, ChunkedPrefill
+from triton_dist_tpu.serving.scheduler import RequestHandle
+from triton_dist_tpu.serving.server import ServingEngine
+
+__all__ = ["PrefillWorker", "DisaggServingEngine"]
+
+
+class PrefillWorker:
+    """The prefill role: one layer engine + a private staging page
+    pool + the bucketed chunk dispatch. Duck-types the ``_prefiller``
+    contract the base :class:`ServingEngine` chunk loop drives
+    (``engine`` / ``manager`` / ``cache`` / ``chunker``), plus the
+    fixed-shape page EXTRACT the migration reads (always ``p_max``
+    pages, scratch-padded — one jit entry regardless of prompt
+    length)."""
+
+    def __init__(self, engine, *, page: int, p_max: int, num_slots: int,
+                 num_pages: Optional[int] = None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefix_reuse: bool = False):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        if isinstance(engine, MegaKernelEngine):
+            raise ValueError("the prefill worker is a layer-path role; "
+                             "the megakernel's prefill lane already "
+                             "rides its decode batch")
+        self.engine = engine
+        self.page, self.p_max = page, p_max
+        cfg, mesh, axis = engine.cfg, engine.mesh, engine.axis
+        plan = cfg.kv_cache_plan(max_len=p_max * page, page=page,
+                                 num_slots=num_slots,
+                                 tp=mesh.shape[axis])
+        self.num_pages = num_pages or plan["num_pages"]
+        self.manager = BlockManager(self.num_pages, page, p_max,
+                                    prefix_reuse=prefix_reuse)
+        cache = PagedKVCache.empty(
+            cfg.num_hidden_layers, self.num_pages, page,
+            cfg.num_key_value_heads, cfg.head_dim, num_slots=num_slots,
+            p_max=p_max,
+            dtype=jax.tree.leaves(engine.params)[0].dtype)
+        self.shardings = pool_shardings(
+            mesh, engine.model.paged_cache_specs(axis))
+        self.cache = jax.tree.map(
+            jax.device_put, cache, self.shardings,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        self.chunker = ChunkedPrefill(engine, self.shardings, buckets)
+        # Fixed-shape payload extract: (L, p_max, KV_full, page, hd),
+        # gathered replicated so the payload can leave this mesh.
+        rep = NamedSharding(mesh, P())
+        self._extract = jax.jit(
+            lambda c, ids: c.gather_pages(ids),
+            out_shardings=((rep, rep)))
+
+    def extract(self, page_ids: np.ndarray):
+        """Dispatch the (async) payload gather for ``page_ids``
+        ((p_max,) int32, scratch-padded). Returns device arrays on the
+        prefill mesh — the caller overlaps their readout against later
+        chunk compute."""
+        import jax.numpy as jnp
+
+        return self._extract(self.cache, jnp.asarray(page_ids,
+                                                     jnp.int32))
+
+    def release(self, slot: int):
+        """Free a slot's staging pages (no-op if none staged)."""
+        self.manager.free_slot(slot)
+
+
+class DisaggServingEngine(ServingEngine):
+    """Disaggregated serving front end: the decode-worker
+    :class:`ServingEngine` plus a :class:`PrefillWorker`, same public
+    API (``submit`` / ``step`` / ``run`` / ``generate`` / ``stats``).
+
+    ``engine`` is the DECODE role's layer engine; ``prefill_engine``
+    the prefill role's (same config and weights — pass the same host
+    ``params`` to both ``Engine`` constructors). Omitting it is the
+    single-role degenerate mode: one engine plays both roles on one
+    mesh, chunked prefill and page migration still exercised (local
+    scatter instead of the bridge put). ``migration`` picks the
+    payload transport: ``"p2p"`` (one-sided put over a 2-rank bridge
+    mesh — requires disjoint role device sets), ``"local"``, or
+    ``"auto"`` (p2p iff the roles are disjoint).
+    """
+
+    def __init__(self, engine, *, prefill_engine=None,
+                 prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefill_num_pages: Optional[int] = None,
+                 migration: str = "auto", prefix_reuse: bool = False,
+                 **kw):
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        if isinstance(engine, MegaKernelEngine):
+            raise ValueError(
+                "disaggregated serving splits the LAYER path; the "
+                "megakernel is already a single fused decode role")
+        super().__init__(engine, prefix_reuse=prefix_reuse, **kw)
+        pf_eng = prefill_engine if prefill_engine is not None else engine
+        if pf_eng.cfg != engine.cfg:
+            raise ValueError("prefill and decode engines must share one "
+                             "ModelConfig (and the same weights)")
+        if pf_eng.max_len != engine.max_len:
+            raise ValueError(
+                f"prefill max_len {pf_eng.max_len} != decode max_len "
+                f"{engine.max_len}: the chunked writer addresses pages "
+                "by global position, the bounds must agree")
+        self.prefill_worker = PrefillWorker(
+            pf_eng, page=self.page, p_max=self.p_max,
+            num_slots=self.num_slots, num_pages=prefill_num_pages,
+            buckets=prefill_buckets, prefix_reuse=prefix_reuse)
+        self._prefiller = self.prefill_worker
+
+        if migration not in ("auto", "p2p", "local"):
+            raise ValueError(f"migration must be 'auto'|'p2p'|'local', "
+                             f"got {migration!r}")
+        pf_devs = set(d.id for d in pf_eng.mesh.devices.flat)
+        dec_devs = set(d.id for d in engine.mesh.devices.flat)
+        disjoint = not (pf_devs & dec_devs)
+        if migration == "p2p" and not disjoint:
+            raise ValueError(
+                "migration='p2p' needs disjoint prefill/decode mesh "
+                "slices (the bridge put is a remote DMA edge); "
+                "colocated roles use migration='local'")
+        self.migration = ("p2p" if migration == "auto" and disjoint
+                          else migration if migration != "auto"
+                          else "local")
+        import jax
+
+        self._bridge = None
+        if self.migration == "p2p":
+            from jax.sharding import Mesh
+
+            # 2-rank bridge: one device per role carries the page
+            # payload over the one-sided put edge (the DCN/ICI hop of
+            # a real deployment).
+            self._bridge = Mesh(
+                np.array([pf_eng.mesh.devices.flat[0],
+                          engine.mesh.devices.flat[0]]), ("role",))
+
+        # Fixed-shape receiver scatter into the decode pool — donated,
+        # pinned to the pool's one sharding spelling (the decode
+        # dispatch never re-specializes on a migration).
+        self._scatter = jax.jit(
+            lambda c, k, v, ids: c.scatter_pages(k, v, ids),
+            donate_argnums=(0,), out_shardings=self._cache_shardings)
+        self._pending: List[tuple] = []
+        self._handoff_stalled: List[RequestHandle] = []
+
+    # -- admission: route to the prefill worker ----------------------
+
+    # Admission rides the inherited ServingEngine._admit: with
+    # ``_prefiller`` set it routes to _admit_chunked, which allocates
+    # in the prefill worker's STAGING pool; decode-pool pages are only
+    # claimed at handoff time (_finish_prefill below).
+
+    # -- handoff: allocate decode pages, migrate, activate -----------
+
+    def _finish_prefill(self, h: RequestHandle, logits):
+        """Final chunk done: claim decode-side pages, issue the page
+        extract (async — collected next tick so the transfer overlaps
+        whatever dispatches next), and park the handle as
+        ``"migrating"``."""
+        pw = self.prefill_worker
+        slot, seq = h.slot, h.lane
+        # The staging pool's pages are fully written — publish them to
+        # the prefill side's prefix cache (the decode pool's entries
+        # are committed by _activate, AFTER the scatter lands).
+        pw.manager.commit_prefix(slot)
+        try:
+            pages = self.manager.alloc_prefill(slot, seq)
+        except OutOfPagesError as e:
+            # Decode pool dry: release the staging pages and requeue at
+            # the head (or fail if nothing can ever free pages). The
+            # requeue is DEFERRED to end-of-step so two stalls in one
+            # tick keep their order — the same invariant step() holds
+            # for admission stalls.
+            pw.release(slot)
+            self.sched.slots.pop(slot, None)
+            h.slot = None
+            if not self.sched.slots:
+                self._fail(h, "failed", e)
+                return
+            h.status = "queued"
+            self._handoff_stalled.append(h)
+            self.stats_counters["admit_stalls"] += 1
+            return
+        hits = self.manager.prefix_hits(slot)
+        src_ids = np.asarray(pw.manager.table_row(slot), np.int32)
+        dst_ids = np.full((self.p_max,), SCRATCH_PAGE, np.int32)
+        # Rows below the decode-side prefix hit keep the resident
+        # pages a live reader may hold (never re-blitted); rows past
+        # the allocation are payload padding — both land in scratch.
+        dst_ids[hits:len(pages)] = pages[hits:]
+        k_pay, v_pay = pw.extract(src_ids)
+        h.status = "migrating"
+        self._pending.append((h, logits, k_pay, v_pay, dst_ids,
+                              len(pages) - hits))
+
+    def step(self) -> int:
+        # Collect LAST tick's migrations first: their extracts (and
+        # the bridge put) have been in flight across this gap —
+        # overlapped with the chunks and the decode dispatch issued
+        # since.
+        self._complete_migrations()
+        n = super().step()
+        # Handoff stalls requeue at the HEAD in their processing order
+        # (reversed appendleft — no leapfrogging between two stalls of
+        # one tick).
+        for h in reversed(self._handoff_stalled):
+            self.sched.queue.appendleft(h)
+        self._handoff_stalled.clear()
+        return n
+
+    def _complete_migrations(self):
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import (
+            CommTimeoutError, block_until_ready)
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pending, self._pending = self._pending, []
+        for h, logits, k_pay, v_pay, dst_ids, n_mig in pending:
+            if h.status != "migrating":
+                continue               # failed meanwhile (deadline)
+            slot = h.slot
+            try:
+                with faults.on_op_call("page_migration"):
+                    if self.migration == "p2p":
+                        from triton_dist_tpu.ops.p2p import (
+                            migrate_pages_host)
+
+                        k_pay, v_pay = migrate_pages_host(
+                            k_pay, v_pay, self._bridge, axis="role",
+                            src=0, dst=1)
+                    rep = NamedSharding(self.engine.mesh, P())
+                    k_pay = jax.device_put(k_pay, rep)
+                    v_pay = jax.device_put(v_pay, rep)
+                    self.cache = self._scatter(
+                        self.cache, k_pay, v_pay,
+                        jnp.asarray(dst_ids, jnp.int32))
+                    if self.timeout_s is not None:
+                        block_until_ready(
+                            self.cache, timeout_s=self.timeout_s,
+                            op="serving.page_migration",
+                            progress_fn=lambda: {
+                                "slot": slot,
+                                "migrated_pages":
+                                    self.stats_counters[
+                                        "migrated_pages"]})
+            except (CommTimeoutError, faults.InjectedFault) as e:
+                # One wedged / dropped migration fails ONE request:
+                # decode pages + slot released by _retire, staging
+                # pages by the _retire override below.
+                if isinstance(e, CommTimeoutError):
+                    self.stats_counters["comm_timeouts"] += 1
+                self._fail(h, "timeout"
+                           if isinstance(e, CommTimeoutError)
+                           else "failed", e)
+                continue
+            except Exception as e:  # noqa: BLE001 — release, surface
+                self._fail(h, "failed", e)
+                raise
+            self.prefill_worker.release(slot)
+            self.stats_counters["migrated_pages"] += n_mig
+            self._activate(h, logits)
+
+    # -- bookkeeping overrides ---------------------------------------
+
+    def _retire(self, h: RequestHandle, status: str, error=None):
+        slot = h.slot
+        super()._retire(h, status, error)
+        if slot is not None:
+            # Staging pages a mid-prefill/mid-migration failure leaves
+            # behind (no-op once handed off).
+            self.prefill_worker.release(slot)
+
+    def _drained(self) -> bool:
+        return self.sched.idle and not self._pending
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["roles"] = ("prefill+decode/colocated"
+                        if self.prefill_worker.engine is self.engine
+                        else "prefill|decode/disjoint")
+        out["migration_transport"] = self.migration
+        out["prefill_pool"] = self.prefill_worker.manager.fragmentation()
+        return out

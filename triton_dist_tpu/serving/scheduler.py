@@ -87,12 +87,19 @@ class RequestHandle:
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
     decode_steps: int = 0
-    # prefill-lane cursor + sequence (megakernel path): the tokens the
-    # lane must stream through the decode batch. The lane is the prompt
-    # on a fresh admit, or prompt + already-generated tokens when a
-    # PREEMPTED request re-enters (its cache must be rebuilt).
+    # prefill cursor + sequence. Megakernel path: the lane the prompt
+    # streams through the decode batch, one token per tick. Chunked
+    # layer path: the same fields at CHUNK granularity — ``prompt_pos``
+    # is the absolute compute cursor, ``resident`` the prefix-shared
+    # token count whose pages are already written (never re-blitted),
+    # and ``chunks`` logs each dispatched (start, bucket, valid) — the
+    # determinism record the preemption-resume test replays. The lane
+    # is the prompt on a fresh admit, or prompt + already-generated
+    # tokens when a PREEMPTED request re-enters (cache rebuilt).
     prompt_pos: int = 0
     lane: Optional[List[int]] = None
+    resident: int = 0
+    chunks: List = dataclasses.field(default_factory=list)
 
     @property
     def done(self) -> bool:

@@ -74,7 +74,8 @@ class ServingEngine:
                  clock=time.monotonic, transport: Optional[str] = None,
                  replica_slots: int = 0, rebalance_every: int = 8,
                  hot_expert_factor: float = 2.0,
-                 load_alpha: float = 0.25):
+                 load_alpha: float = 0.25,
+                 prefill_buckets: Optional[Sequence[int]] = None):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -93,6 +94,16 @@ class ServingEngine:
         - ``rebalance_every``: decode dispatches between replication /
           scheduler-priority refreshes (0 = telemetry only).
         - ``load_alpha``: EWMA smoothing for per-expert load.
+
+        ``prefill_buckets`` (layer path): switch prefill from the
+        monolithic per-length dispatch to FIXED-SHAPE chunked prefill —
+        prompts stream into the page pool in bucketed chunks (padded to
+        bucket), one chunk per serving tick, interleaved with decode.
+        The prefill jit cache is then bounded by the bucket count
+        (:meth:`prefill_cache_size`) instead of growing per distinct
+        prompt/resume length, and a long prompt no longer monopolizes
+        the dispatch. ``None`` keeps the monolithic path. (The
+        megakernel path has its own prefill lane — pass ``None``.)
         """
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
@@ -131,10 +142,24 @@ class ServingEngine:
             "decode_dispatches": 0, "tokens_generated": 0,
             "prefill_tokens": 0, "prefill_calls": 0, "admit_stalls": 0,
             "preemptions": 0, "comm_timeouts": 0, "decode_time_s": 0.0,
-            "decode_tokens": 0,
+            "decode_tokens": 0, "prefill_chunks": 0, "migrated_pages": 0,
         }
+        self.prefill_buckets = (tuple(sorted(set(int(b) for b in
+                                                 prefill_buckets)))
+                                if prefill_buckets else None)
+        # The chunk driver this engine streams prefills through:
+        # ``self`` for in-place chunked prefill (chunks write straight
+        # into the serving pool), the disaggregated subclass points it
+        # at its PrefillWorker, None = monolithic prefill.
+        self._prefiller = None
+        self.chunker = None
 
         if self.mega:
+            if self.prefill_buckets:
+                raise ValueError(
+                    "prefill_buckets is a layer-path knob; the "
+                    "megakernel streams prompts through its own "
+                    "prefill lane (already fixed-shape)")
             if self.replica_slots:
                 raise ValueError(
                     "replica_slots is a layer-path EP knob; the "
@@ -215,12 +240,22 @@ class ServingEngine:
             cfg.num_key_value_heads, cfg.head_dim, num_slots=num_slots,
             p_max=self.p_max,
             dtype=jax.tree.leaves(eng.params)[0].dtype)
+        from triton_dist_tpu.serving.blocks import pool_shardings
+
         kv_spec = model.paged_cache_specs(axis)
-        shardings = jax.tree.map(
-            lambda x, s: NamedSharding(mesh, s), cache, kv_spec,
-            is_leaf=lambda x: isinstance(x, jax.Array))
+        shardings = pool_shardings(mesh, kv_spec)
         self.cache = jax.tree.map(jax.device_put, cache, shardings,
                                   is_leaf=lambda x: isinstance(x, jax.Array))
+        # The pool's pinned shardings — every writer into it (prompt
+        # blit, chunk steps, page-migration scatter) must return leaves
+        # with EXACTLY these, or the decode dispatch re-specializes.
+        self._cache_shardings = shardings
+        if self.prefill_buckets:
+            from triton_dist_tpu.serving.chunked import ChunkedPrefill
+
+            self.chunker = ChunkedPrefill(eng, shardings,
+                                          self.prefill_buckets)
+            self._prefiller = self
 
         # EP-MoE decode: resolve the transport knob ONCE (host-side,
         # against the tune cache, with the true decode batch shape) so
@@ -288,6 +323,14 @@ class ServingEngine:
                     "transport/replica_slots are EP-MoE decode knobs; "
                     "this engine serves a non-EP model")
 
+        # Pinned cache out_shardings on the decode dispatch too: every
+        # producer of the pool (init device_put, prompt writer, chunk
+        # steps, decode itself, migration scatter) must emit ONE
+        # sharding spelling, or each producer pair costs a jit entry in
+        # every consumer (PartitionSpec() and PartitionSpec(None, None)
+        # place identically but key differently).
+        logits_sh = NamedSharding(mesh, P(None, None))
+        counts_sh = NamedSharding(mesh, P(None))
         if self.ep and self.replicas is not None:
             def _decode(params, toks, c, reps):
                 return model.decode_step_paged(
@@ -300,7 +343,8 @@ class ServingEngine:
                 in_specs=(eng._specs, P(None), kv_spec,
                           _ep_moe.replica_specs()),
                 out_specs=(P(None, None), kv_spec, P(None)),
-                check_vma=False), donate_argnums=(2,))
+                check_vma=False), donate_argnums=(2,),
+                out_shardings=(logits_sh, shardings, counts_sh))
         else:
             def _decode(params, toks, c):
                 return model.decode_step_paged(
@@ -312,7 +356,9 @@ class ServingEngine:
                 in_specs=(eng._specs, P(None), kv_spec),
                 out_specs=((P(None, None), kv_spec, P(None))
                            if self.ep else (P(None, None), kv_spec)),
-                check_vma=False), donate_argnums=(2,))
+                check_vma=False), donate_argnums=(2,),
+                out_shardings=((logits_sh, shardings, counts_sh)
+                               if self.ep else (logits_sh, shardings)))
         # Pinned out_shardings: the writer's output must land with the
         # exact shardings the decode dispatch was compiled for, or the
         # first post-admit step would re-specialize the jit cache.
@@ -366,12 +412,19 @@ class ServingEngine:
         # in one tick must not leapfrog each other).
         for h in reversed(stalled):
             self.sched.queue.appendleft(h)
+        if self._prefiller is not None:
+            self._advance_chunks()
         return self._decode_tick()
+
+    def _drained(self) -> bool:
+        """Nothing left to serve (subclasses add their in-flight
+        state — e.g. pending migrations)."""
+        return self.sched.idle
 
     def run(self, *, max_steps: int = 100000) -> None:
         """Drive :meth:`step` until queue and slots drain."""
         for _ in range(max_steps):
-            if self.sched.idle:
+            if self._drained():
                 return
             self.step()
         raise RuntimeError(f"serving loop did not drain in {max_steps} "
@@ -399,6 +452,10 @@ class ServingEngine:
         out.update(self.sched.counters)
         out["queue_depth"] = len(self.sched.queue)
         out["live_slots"] = int(self._live.sum())
+        out["prefill_cache_size"] = self.prefill_cache_size()
+        out["prefill_buckets"] = (list(self._prefiller.chunker.buckets)
+                                  if self._prefiller is not None
+                                  else None)
         # EP-MoE decode surface: which dispatch transport the decode
         # rides, and where the routed tokens actually went.
         if self.mega:
@@ -432,6 +489,20 @@ class ServingEngine:
         batch shape is fixed)."""
         fn = self.engine._step if self.mega else self._decode
         return fn._cache_size()
+
+    def prefill_cache_size(self) -> Optional[int]:
+        """Jit-cache entries of the PREFILL path — the other half of
+        the no-recompilation gate. Chunked: the chunk dispatch's
+        entries, bounded by the bucket count (asserted inline after
+        every chunk). Monolithic layer path: the engine's prefill
+        entries — grows per distinct prompt/resume length (the PR-4
+        known limit this surfaces). Megakernel: ``None`` (the prefill
+        lane IS the decode dispatch)."""
+        if self._prefiller is not None:
+            return self._prefiller.chunker.cache_size()
+        if self.mega:
+            return None
+        return self.engine._prefill._cache_size()
 
     def trace(self, name: str = "serving", *,
               expert_histograms: bool = True, **kw):
@@ -500,6 +571,9 @@ class ServingEngine:
             self._live[slot] = 1
             self._toks[slot] = seq[0]
             return
+        if self._prefiller is not None:
+            self._admit_chunked(h, seq, stalled)
+            return
         try:
             pages = self.manager.alloc_prefill(slot, seq)
         except OutOfPagesError as e:
@@ -541,6 +615,8 @@ class ServingEngine:
             self.cache = self._writer(
                 self.cache, k0, v0,
                 jnp.asarray(pages[hits:], jnp.int32))
+        # Pages written — NOW they may be shared with later requests.
+        self.manager.commit_prefix(slot)
         self._lens[slot] = len(seq)
         self._live[slot] = 1
         h.status = "running"
@@ -549,13 +625,122 @@ class ServingEngine:
             self._emit(h, first)
         # resumed: the next decode tick feeds h.tokens[-1] at len(seq)
 
+    # -- chunked prefill (layer path) -------------------------------
+
+    def _admit_chunked(self, h: RequestHandle, seq,
+                       stalled: List[RequestHandle]):
+        """Admit into the chunk stream: allocate the slot's pages in
+        the prefiller's pool now (backpressure = the same requeue as
+        monolithic admission), then leave the handle in ``"prefill"``
+        status — :meth:`_advance_chunks` streams one bucketed chunk
+        per tick, interleaved with decode, until the prompt is
+        resident. Prefix hits skip straight past already-resident
+        pages: the compute cursor starts at the first non-shared page
+        (clamped so the last prompt token always runs — its logits
+        seed the first generated token), and those pages are never
+        re-blitted (``wfrom``)."""
+        p = self._prefiller
+        slot = h.slot
+        try:
+            p.manager.alloc_prefill(slot, seq)
+        except OutOfPagesError as e:
+            self._unadmit(h, e, stalled)
+            return
+        h.resident = p.manager.prefix_hits(slot) * self.page
+        h.lane = seq
+        h.prompt_pos = min(h.resident, len(seq) - 1)
+        h.chunks = []
+        h.status = "prefill"
+        # Parked until the prompt is resident: the decode dispatch
+        # sees live=0 and a scratch table row for this slot.
+        self._lens[slot] = 0
+        self._live[slot] = 0
+        self._toks[slot] = 0
+
+    def _advance_chunks(self):
+        """One bucketed chunk per prefilling slot per tick — long
+        prompts interleave with the decode batch instead of
+        monopolizing the dispatch."""
+        for h in list(self.sched.running()):
+            if h.status == "prefill":
+                self._advance_chunk(h)
+
+    def _advance_chunk(self, h: RequestHandle):
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import (
+            CommTimeoutError, block_until_ready)
+
+        p = self._prefiller
+        slot, seq, start = h.slot, h.lane, h.prompt_pos
+        bucket, valid = p.chunker.next_chunk(len(seq) - start)
+        toks = np.zeros((bucket,), np.int32)
+        toks[:valid] = seq[start:start + valid]
+        row = np.asarray(p.manager.table_row(slot), np.int32)
+        try:
+            with faults.on_op_call("chunked_prefill"):
+                logits, p.cache = p.chunker.step(
+                    p.engine.params, toks, p.cache, row, start,
+                    h.resident, valid)
+                if self.timeout_s is not None:
+                    logits = block_until_ready(
+                        logits, timeout_s=self.timeout_s,
+                        op="serving.chunked_prefill",
+                        progress_fn=lambda: {
+                            "slot": slot, "chunk_start": start,
+                            "chunks": list(h.chunks)})
+        except (CommTimeoutError, faults.InjectedFault) as e:
+            # A wedged / dropped chunk fails THIS request only (slot
+            # and pages released); the loop keeps serving.
+            if isinstance(e, CommTimeoutError):
+                self.stats_counters["comm_timeouts"] += 1
+            self._fail(h, "timeout" if isinstance(e, CommTimeoutError)
+                       else "failed", e)
+            return
+        except Exception as e:  # noqa: BLE001 — release, then surface
+            self._fail(h, "failed", e)
+            raise
+        self.stats_counters["prefill_chunks"] += 1
+        self.stats_counters["prefill_tokens"] += valid
+        h.chunks.append((start, bucket, valid))
+        h.prompt_pos = start + valid
+        if h.prompt_pos >= len(seq):
+            self.stats_counters["prefill_calls"] += 1
+            self._finish_prefill(h, logits)
+
+    def _finish_prefill(self, h: RequestHandle, logits):
+        """Prompt fully resident: activate the slot (in-place chunked
+        mode — the disaggregated subclass migrates pages first)."""
+        self._activate(h, logits)
+
+    def _activate(self, h: RequestHandle, logits):
+        """Flip a fully-prefilled slot live; seed the first generated
+        token from the final chunk's last-valid-token logits (resumed
+        requests already know their next token)."""
+        slot = h.slot
+        # Every page's content is resident in THIS engine's pool (the
+        # last chunk just landed — or, disaggregated, the migration
+        # scatter): publish the slot's staged prefix pages.
+        self.manager.commit_prefix(slot)
+        self._lens[slot] = len(h.lane)
+        self._live[slot] = 1
+        self._toks[slot] = h.lane[-1]
+        h.status = "running"
+        if not h.tokens:
+            first = self._pick(np.asarray(logits), h.request, 0)
+            self._emit(h, first)
+
     # -- the decode tick --------------------------------------------
 
     def _decode_tick(self) -> int:
         import jax.numpy as jnp
 
+        # Layer-path slots still mid-chunk-stream (or mid-migration in
+        # the disaggregated subclass) are parked: they join the decode
+        # batch only once their prompt is resident. The megakernel's
+        # prefill lane rides the decode dispatch itself.
         active = [h for h in self.sched.running()
-                  if h.status in ("prefill", "running")]
+                  if h.status == "running"
+                  or (self.mega and h.status == "prefill")]
         if not active:
             return 0
         preempted = []
@@ -628,6 +813,10 @@ class ServingEngine:
                 if h.prompt_pos < len(h.lane):
                     continue
                 h.status = "running"   # last lane token's logits
+                if self.manager is not None:
+                    # The lane's final token just wrote its page —
+                    # the prompt's pages are shareable from here.
+                    self.manager.commit_prefix(slot)
                 if h.tokens:
                     # Resumed lane: the next token to feed is already
                     # known (h.tokens[-1]); do not re-pick it.
