@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke disagg-smoke apicheck ci bench-all
+	serve-smoke ep-smoke disagg-smoke spec-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -59,6 +59,13 @@ ep-smoke: csrc
 # section).
 disagg-smoke: csrc
 	bash scripts/disagg_smoke.sh
+
+# Quantized-KV + speculative-decode battery: bounded-divergence and
+# capacity gates, spec determinism/rollback, a quantized+speculative
+# chat e2e, and the non-null spec/quant bench-key gate
+# (docs/serving.md quantization + speculation sections).
+spec-smoke: csrc
+	bash scripts/spec_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

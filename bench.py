@@ -478,6 +478,61 @@ def _interpret_serving_times() -> dict:
         "chunked": round(toks_c / max(dt_c, 1e-9), 2)}
     out["serving_prefill_cache_entries"] = {
         "monolithic": pre_m, "chunked": pre_c}
+
+    # Speculative decode on/off over the SAME repetitive decode-heavy
+    # trace (the workload speculation exists for: greedy decode of
+    # looping/templated continuations, where the n-gram self-draft
+    # predicts several tokens per dispatch). Ratio = dispatches
+    # amortized; absolute numbers track the CPU dispatch overhead.
+    spec_trace = [[1, 2, 3, 1, 2, 3, 1, 2], [7, 8, 7, 8, 7, 8],
+                  [5, 5, 5, 5], [9, 10, 11, 9, 10, 11]]
+    out["serving_tokens_per_s_spec"] = {}
+    out["serving_spec_accept_rate"] = None
+    for name, k in (("nospec", 0), ("spec", 4)):
+        e = Engine(cfg, mesh, mode="xla", max_len=96, seed=0)
+        s = ServingEngine(e, num_slots=1, page=8, spec_k=k)
+        # Warm with the SAME trace: prefill compiles once per distinct
+        # prompt length — the timed pass measures the steady-state
+        # decode loop (the surface speculation moves), not the
+        # per-length compile tax the chunked-prefill key already owns.
+        s.generate(spec_trace, max_new_tokens=32)
+        for c in s.stats_counters:
+            s.stats_counters[c] = type(s.stats_counters[c])(0)
+        t0 = time.perf_counter()
+        s.generate(spec_trace, max_new_tokens=32)
+        dt = time.perf_counter() - t0
+        st = s.stats()
+        out["serving_tokens_per_s_spec"][name] = round(
+            st["tokens_generated"] / max(dt, 1e-9), 2)
+        if k:
+            out["serving_spec_accept_rate"] = (
+                None if st["spec"]["accept_rate"] is None
+                else round(st["spec"]["accept_rate"], 4))
+            assert s.decode_cache_size() == 1, (
+                "spec verify dispatch re-specialized")
+
+    # Quantized paged KV: HBM cost per token at each kv_dtype (from
+    # the model plan) and the paged decode step's wall time bf16 vs
+    # int8/fp8 through the SAME ServingEngine decode dispatch (ref
+    # attention on this CPU host — dequant-on-gather; the TPU kernel
+    # fuses the dequant into the page prefetch).
+    out["kv_bytes_per_token"] = {}
+    out["paged_decode_quant_ms"] = {}
+    for kvd in ("bf16", "int8", "fp8"):
+        e = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+        s = ServingEngine(e, num_slots=2, page=8, kv_dtype=kvd)
+        out["kv_bytes_per_token"][kvd] = round(
+            s.plan["bytes_per_token"], 2)
+        s.generate([[1, 2, 3]], max_new_tokens=2)   # compile warmup
+        s.submit([4, 5, 6], max_new_tokens=8)
+        s.submit([7, 8], max_new_tokens=8)
+        n0 = s.stats()["decode_dispatches"]
+        t0 = time.perf_counter()
+        s.run()
+        dt = time.perf_counter() - t0
+        n = s.stats()["decode_dispatches"] - n0
+        out["paged_decode_quant_ms"][kvd] = round(
+            dt * 1e3 / max(n, 1), 3)
     return out
 
 
@@ -607,6 +662,10 @@ def _interpret_bench(reason: str) -> None:
         sv = {"serving_tokens_per_s": None,
               "prefill_chunked_vs_monolithic_ms": None,
               "serving_tokens_per_s_prefill_heavy": None,
+              "serving_tokens_per_s_spec": None,
+              "serving_spec_accept_rate": None,
+              "kv_bytes_per_token": None,
+              "paged_decode_quant_ms": None,
               "serving_error": str(e)[:200]}
     try:
         ep = _interpret_ep_times()
@@ -1583,6 +1642,65 @@ def battery(quiet=False, deadline=None):
             gdn_num_heads=8, gdn_head_dim_k=128, gdn_head_dim_v=128,
             full_attn_interval=2))
 
+    def run_real_checkpoint_decode():
+        """decode_tok_s for a REAL public checkpoint through BOTH
+        engines (ROADMAP item 3's missing number). Harness flag:
+        ``BENCH_HF_DIR=<local checkpoint dir>`` — when absent (this
+        CPU-only container) the entry records the skip reason instead
+        of a number, so the next on-chip run captures it by exporting
+        one variable. Decode rate is the slope between two generation
+        lengths (prefill + tunnel RTT cancel)."""
+        hf_dir = os.environ.get("BENCH_HF_DIR")
+        if not hf_dir:
+            return {"skipped": "set BENCH_HF_DIR=<hf checkpoint dir> "
+                               "to record real-checkpoint decode_tok_s"}
+        from triton_dist_tpu.models import Engine, qwen_moe
+        from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
+        from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+        cfg, params = load_hf_checkpoint(hf_dir, dtype=jnp.bfloat16)
+        b, pre, lo, hi = 4, 32, 8, 64
+        ids = jax.random.randint(jax.random.PRNGKey(0), (b, pre), 0,
+                                 cfg.vocab_size)
+        model_kw = {"model": qwen_moe} if cfg.is_moe else {}
+        eng = Engine(cfg, mesh, mode="xla", max_len=pre + hi + 8,
+                     params=params, **model_kw)
+
+        def timed_serve(gen):
+            np.asarray(eng.serve(ids, gen_len=gen))   # compile + warm
+            t0 = time.perf_counter()
+            np.asarray(eng.serve(ids, gen_len=gen))
+            return time.perf_counter() - t0
+
+        t_layer = (timed_serve(hi) - timed_serve(lo)) / (hi - lo)
+        out = {"checkpoint": os.path.basename(os.path.normpath(hf_dir)),
+               "decode_tok_s": {"layer": round(b / max(t_layer, 1e-9),
+                                               1)}}
+        try:
+            mk = MegaKernelEngine(cfg, mesh, batch=b,
+                                  max_len=pre + hi + 8, params=params,
+                                  prefill_seq=pre)
+            tok = jnp.argmax(mk.prefill(ids), -1).astype(jnp.int32)
+            np.asarray(mk.decode_step(tok, pre))      # compile + warm
+
+            def timed_mk(gen):
+                t = tok
+                t0 = time.perf_counter()
+                for i in range(gen):
+                    lg = mk.decode_step(t, pre + 1 + i)
+                    t = jnp.argmax(lg, -1).astype(jnp.int32)
+                np.asarray(t)
+                return time.perf_counter() - t0
+
+            t_mk = (timed_mk(hi) - timed_mk(lo)) / (hi - lo)
+            out["decode_tok_s"]["megakernel"] = round(
+                b / max(t_mk, 1e-9), 1)
+        except Exception as e:  # record the layer number regardless
+            out["decode_tok_s"]["megakernel"] = None
+            out["megakernel_error"] = (f"{type(e).__name__}: "
+                                       f"{str(e)[:160]}")
+        return out
+
     entries = [
         ("gemm_ar", run_gemm_ar),
         ("allreduce_one_shot", run_allreduce("one_shot")),
@@ -1611,6 +1729,7 @@ def battery(quiet=False, deadline=None):
         ("megakernel_paged", run_megakernel(True)),
         ("megakernel_moe", run_megakernel_moe),
         ("megakernel_hybrid_gdn", run_megakernel_hybrid),
+        ("real_checkpoint_decode", run_real_checkpoint_decode),
     ]
     results = []
     for name, fn in entries:

@@ -57,6 +57,19 @@ def main():
                     help="--disagg/chunked prefill: comma-separated "
                          "chunk-length buckets (the prefill jit cache "
                          "is bounded by their count)")
+    ap.add_argument("--kv-quant", default="bf16",
+                    choices=["bf16", "int8", "fp8"],
+                    help="layer-path KV pool storage: int8/fp8 stores "
+                         "pages quantized with per-page scales (2-4x "
+                         "capacity, bounded divergence; see "
+                         "docs/serving.md)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding (layer path): n-gram "
+                         "self-draft + one K-token verification "
+                         "dispatch, token-exact greedy outputs")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="--spec: candidates per verification "
+                         "dispatch (static K; jit cache stays flat)")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -90,6 +103,14 @@ def main():
         sys.exit("--transport/--replica-slots route the layer path's "
                  "EP decode dispatch; the megakernel serves experts "
                  "in-kernel (use --moe-ep without --megakernel)")
+    if args.megakernel and (args.kv_quant != "bf16" or args.spec):
+        sys.exit("--kv-quant/--spec are layer-path knobs; the "
+                 "megakernel decode lane has no per-page scale or "
+                 "verification plumbing (see docs/serving.md)")
+    # Layer-path serving knobs shared by every engine construction
+    # below: quantized KV pools and/or speculative decode.
+    serve_kw = dict(kv_dtype=args.kv_quant,
+                    spec_k=args.spec_k if args.spec else 0)
     def build_disagg(cfg, params, model_kw):
         """Two engines over split tp halves (or one colocated role at
         tp=1) sharing ONE weight pytree, wrapped in the disaggregated
@@ -112,7 +133,7 @@ def main():
                    else Engine(cfg, dec_mesh, **kw))
         return DisaggServingEngine(
             dec_eng, prefill_engine=pf_eng, num_slots=args.slots,
-            page=args.page, prefill_buckets=buckets)
+            page=args.page, prefill_buckets=buckets, **serve_kw)
 
     if args.hf_dir:
         from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
@@ -136,7 +157,8 @@ def main():
                          params=params, **model_kw)
             srv = ServingEngine(eng, num_slots=args.slots,
                                 page=args.page,
-                                replica_slots=args.replica_slots)
+                                replica_slots=args.replica_slots,
+                                **serve_kw)
     elif args.moe_ep or args.transport or args.replica_slots:
         # --transport / --replica-slots imply the EP-MoE tiny model:
         # silently serving the dense model would drop the knobs.
@@ -146,7 +168,8 @@ def main():
                      model=qwen_moe, moe_impl="ep",
                      ep_transport=args.transport)
         srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
-                            replica_slots=args.replica_slots)
+                            replica_slots=args.replica_slots,
+                            **serve_kw)
     elif args.megakernel:
         from jax.sharding import Mesh
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
@@ -175,7 +198,8 @@ def main():
         cfg = ModelConfig.tiny(vocab_size=128)
         mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len)
-        srv = ServingEngine(eng, num_slots=args.slots, page=args.page)
+        srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
+                            **serve_kw)
 
     print(f"serving {cfg.model_name} (vocab {cfg.vocab_size}); one "
           "prompt of space-separated token ids per line:", flush=True)
@@ -221,6 +245,15 @@ def main():
         line += (f", roles={st['roles']}, "
                  f"migration={st['migration_transport']}, "
                  f"migrated_pages={st['migrated_pages']}")
+    if st.get("kv_dtype") not in (None, "bf16"):
+        line += (f", kv_dtype={st['kv_dtype']} "
+                 f"({st['kv_bytes_per_token']:.0f} B/token)")
+    if st.get("spec"):
+        sp = st["spec"]
+        rate = sp["accept_rate"]
+        line += (f", spec k={sp['k']} "
+                 f"(accept={'n/a' if rate is None else f'{rate:.2f}'}, "
+                 f"{sp['tokens_per_dispatch']:.2f} tok/dispatch)")
     if st.get("expert_load") is not None:
         load = st["expert_load"]
         hot = max(range(len(load)), key=load.__getitem__)

@@ -186,6 +186,121 @@ def test_paged_decode_ref_matches_kernel():
                                rtol=2e-4, atol=2e-4)
 
 
+# ---------------------------------------------------------------------------
+# quantized pools (int8/fp8 per-page scales)
+# ---------------------------------------------------------------------------
+
+def _quantize_pool(kp, vp, qdtype, qmax):
+    """Whole-page max-abs quantization of a (N, KV, page, hd) pool →
+    (k_q, v_q, k_scale, v_scale) — the write_prompt blit's math."""
+    ks = np.abs(kp).max(axis=(2, 3)) / qmax
+    vs = np.abs(vp).max(axis=(2, 3)) / qmax
+    ks = np.where(ks > 0, ks, 1.0).astype(np.float32)
+    vs = np.where(vs > 0, vs, 1.0).astype(np.float32)
+    kq = kp / ks[:, :, None, None]
+    vq = vp / vs[:, :, None, None]
+    if qdtype == jnp.int8:
+        kq, vq = np.round(kq), np.round(vq)
+    return (jnp.asarray(kq).astype(qdtype), jnp.asarray(vq).astype(qdtype),
+            jnp.asarray(ks), jnp.asarray(vs))
+
+
+@pytest.mark.parametrize("qdtype,qmax,tol", [
+    (jnp.int8, 127.0, 5e-2),
+    (jnp.float8_e4m3fn, 448.0, 2e-1),
+])
+def test_paged_decode_quantized_fused_dequant(qdtype, qmax, tol):
+    """int8/fp8 pools through the kernel's FUSED page-prefetch dequant
+    == the dequantizing gather oracle (float-exact), and both within
+    the quantization tolerance of the fp32 ground truth."""
+    k_dense, v_dense, kp, vp, tbl = _build(30, 1)
+    kq, vq, ks, vs = _quantize_pool(kp[0], vp[0], qdtype, qmax)
+    q = jax.random.normal(jax.random.PRNGKey(31), (B, H, HD))
+    kv_len = jnp.array([SHARD - 3, PAGE + 1], jnp.int32)
+    out = jax.jit(lambda *a: paged_flash_decode(
+        *a, k_scale=ks, v_scale=vs))(q, kq, vq, jnp.asarray(tbl[0]),
+                                     kv_len)
+    ref = paged_flash_decode_ref(q, kq, vq, jnp.asarray(tbl[0]),
+                                 kv_len, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    exact = flash_decode_ref(q, jnp.asarray(k_dense[:, :SHARD]),
+                             jnp.asarray(v_dense[:, :SHARD]), kv_len)
+    assert np.abs(np.asarray(out) - np.asarray(exact)).max() < tol
+
+
+def test_quantized_ragged_final_page_scale():
+    """A slot ending mid-page: the ragged final page's scale comes
+    from its VALID tokens (zero padding never inflates it), so the
+    partial page reconstructs as accurately as a full one."""
+    from triton_dist_tpu.serving.blocks import PagedKVCache
+
+    rng = np.random.RandomState(40)
+    c = PagedKVCache.empty(1, 4, PAGE, KVH, HD, num_slots=1, p_max=2,
+                           kv_dtype="int8")
+    import dataclasses
+    c = dataclasses.replace(
+        c, block_table=jnp.asarray([[1, 2]], jnp.int32),
+        live=jnp.ones((1,), jnp.int32))
+    # 3 tokens of a tiny magnitude — if padding (or stale garbage)
+    # leaked into the scale, round(x/scale) would collapse to zero.
+    toks = 1e-3 * rng.randn(3, KVH, HD).astype(np.float32)
+    for t in range(3):
+        c = c.append_decode(0, jnp.asarray(toks[t][None, None]),
+                            jnp.asarray(toks[t][None, None]))
+        c = c.advance()
+    kd, _ = c.dense_layer(0)
+    err = np.abs(np.asarray(kd)[0, :3] - toks).max()
+    assert err < 1e-3 * 2 / 127, f"ragged-page scale inflated: {err}"
+
+
+def test_quantized_freed_and_reused_page_fresh_scale():
+    """Pool-slot recycling: a page that held LARGE values, freed and
+    reused by a small-valued sequence, must re-quantize under a fresh
+    scale — no precision inherited from the dead request."""
+    from triton_dist_tpu.serving.blocks import PagedKVCache
+
+    rng = np.random.RandomState(41)
+    import dataclasses
+    c = PagedKVCache.empty(1, 3, PAGE, KVH, HD, num_slots=1, p_max=1,
+                           kv_dtype="int8")
+    c = dataclasses.replace(
+        c, block_table=jnp.asarray([[1]], jnp.int32),
+        live=jnp.ones((1,), jnp.int32))
+    big = 100.0 * rng.randn(1, 1, KVH, HD).astype(np.float32)
+    c = c.append_decode(0, jnp.asarray(big), jnp.asarray(big)).advance()
+    big_scale = float(np.asarray(c.k_scale)[0, 1].max())
+    # "Free" the slot: lens reset to 0, same pool page reused.
+    c = dataclasses.replace(c, lens=jnp.zeros((1,), jnp.int32))
+    small = 1e-2 * rng.randn(1, 1, KVH, HD).astype(np.float32)
+    c = c.append_decode(0, jnp.asarray(small),
+                        jnp.asarray(small)).advance()
+    new_scale = float(np.asarray(c.k_scale)[0, 1].max())
+    assert new_scale < big_scale / 100, (new_scale, big_scale)
+    kd, _ = c.dense_layer(0)
+    err = np.abs(np.asarray(kd)[0, 0] - small[0, 0]).max()
+    assert err < 1e-2 * 2 / 127, f"stale scale survived reuse: {err}"
+
+
+def test_quantized_pool_scaleless_reader_fails_loudly():
+    """A quantized pool handed to a bf16-era reader (no scales — e.g.
+    a prefix page shared across mismatched kv_dtype configs) raises
+    instead of attending raw quantized bytes."""
+    _, _, kp, vp, tbl = _build(42, 1)
+    kq, vq, ks, vs = _quantize_pool(kp[0], vp[0], jnp.int8, 127.0)
+    q = jax.random.normal(jax.random.PRNGKey(43), (B, H, HD))
+    kv_len = jnp.array([PAGE, 2], jnp.int32)
+    with pytest.raises(ValueError, match="QUANTIZED pool"):
+        paged_flash_decode(q, kq, vq, jnp.asarray(tbl[0]), kv_len)
+    with pytest.raises(ValueError, match="QUANTIZED pool"):
+        paged_flash_decode_ref(q, kq, vq, jnp.asarray(tbl[0]), kv_len)
+    # And the reverse mismatch: scales with an unquantized pool.
+    with pytest.raises(ValueError, match="unquantized"):
+        paged_flash_decode(q, jnp.asarray(kp[0]), jnp.asarray(vp[0]),
+                           jnp.asarray(tbl[0]), kv_len,
+                           k_scale=ks, v_scale=vs)
+
+
 def test_paged_decode_page_shuffle_invariance():
     """The block table fully decouples pool layout from positions: two
     different pool permutations give identical results."""

@@ -159,6 +159,11 @@ def _norm_rope(q, k, params, cfg, positions):
 def sdpa(q, k, v, *, causal: bool, kv_len=None, use_flash=None):
     """q: (B, Sq, H, hd); k/v: (B, Skv, KV, hd). GQA by head repeat.
 
+    ``kv_len`` may be (B,) — one ragged length per batch row — or
+    (B, Sq) — a PER-QUERY length, the speculative-verification form
+    where query j of a slot attends the paged history plus its own
+    candidate block prefix (lens + j + 1).
+
     On real TPUs with long sequences the bundled Pallas flash-attention
     kernel handles the softmax online (O(S) memory); the jnp path is the
     portable oracle (and handles ragged kv_len masking).
@@ -192,8 +197,12 @@ def sdpa(q, k, v, *, causal: bool, kv_len=None, use_flash=None):
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     if kv_len is not None:
         ki = jnp.arange(skv)[None, None, None, :]
-        scores = jnp.where(ki < kv_len[:, None, None, None], scores,
-                           -jnp.inf)
+        if kv_len.ndim == 2:       # per-query lengths (B, Sq)
+            scores = jnp.where(ki < kv_len[:, None, :, None], scores,
+                               -jnp.inf)
+        else:
+            scores = jnp.where(ki < kv_len[:, None, None, None],
+                               scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 

@@ -81,28 +81,52 @@ class ModelConfig:
 
     def kv_cache_plan(self, *, max_len: int, page: int,
                       num_slots: int, tp: int = 1,
-                      dtype_bytes: int = 4) -> dict:
+                      dtype_bytes: int = 4,
+                      kv_dtype: str = "bf16") -> dict:
         """Serving pool sizing off the model geometry — what the
         serving subsystem allocates from this config: pages per
         block-table row, pool pages for full residency (+1 reserved
         scratch page), and the per-rank HBM bytes of K+V pools.
         ``tp`` divides the KV heads (each rank holds its heads' pages,
-        the same placement as the dense cache)."""
+        the same placement as the dense cache).
+
+        ``kv_dtype="int8"|"fp8"`` plans a PER-PAGE QUANTIZED pool:
+        storage at 1 byte/element plus one fp32 scale per (layer,
+        page, kv_head) per K/V pool. The plan then also reports
+        ``native_page_bytes_per_rank`` (what the page would cost
+        unquantized at ``dtype_bytes``), ``bytes_per_token``, and
+        ``capacity_ratio_vs_native`` — the 2–4x more-pages-per-HBM-GB
+        the quantization buys at fixed pool bytes."""
         if max_len % page:
             raise ValueError(f"page={page} must divide max_len="
                              f"{max_len}")
+        from triton_dist_tpu.serving.blocks import kv_quant_spec
+
+        qdtype, _ = kv_quant_spec(kv_dtype)
         kv_loc = max(self.num_key_value_heads // tp, 1)
         p_max = max_len // page
         num_pages = 1 + num_slots * p_max
-        page_bytes = (self.num_hidden_layers * kv_loc * page
-                      * self.head_dim * dtype_bytes)
-        return {
+        native_bytes = (self.num_hidden_layers * kv_loc * page
+                        * self.head_dim * dtype_bytes)
+        if qdtype is None:
+            page_bytes = native_bytes
+        else:
+            # 1 byte/element storage + the per-page per-head scale.
+            page_bytes = (self.num_hidden_layers * kv_loc
+                          * (page * self.head_dim + 4))
+        plan = {
             "page": page, "p_max": p_max, "num_pages": num_pages,
             "kv_heads_loc": kv_loc,
+            "kv_dtype": "bf16" if qdtype is None else kv_dtype,
             "page_bytes_per_rank": 2 * page_bytes,      # K and V
+            "native_page_bytes_per_rank": 2 * native_bytes,
             "pool_bytes_per_rank": 2 * page_bytes * num_pages,
+            "bytes_per_token": 2 * page_bytes / page,
+            "capacity_ratio_vs_native": round(
+                native_bytes / page_bytes, 4),
             "tokens_per_page": page,
         }
+        return plan
 
     def layer_is_full_attn(self, layer_idx: int) -> bool:
         """Hybrid schedule: layers (interval-1, 2·interval-1, …) are full

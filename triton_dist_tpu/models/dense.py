@@ -240,17 +240,91 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
     return logits, cache.advance()
 
 
-def paged_cache_specs(axis: str = "tp"):
+def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
+                      budget=None, mode: str = "xla", axis: str = "tp",
+                      ctxs: FwdContexts = FwdContexts(), ffn_fn=None):
+    """One SPECULATIVE-VERIFICATION step over a
+    :class:`~triton_dist_tpu.serving.blocks.PagedKVCache`: K candidate
+    tokens per slot through one fixed-shape dispatch.
+
+    token_ids: (S, K) replicated — slot s's candidates are fed at
+    positions ``lens[s]..lens[s]+K-1`` (K is STATIC, so the jit cache
+    stays at one entry regardless of how many candidates end up
+    accepted); ``budget`` (S,) int32 caps how many candidates may
+    WRITE real pages per slot (over-budget rows near a request's
+    token limit land in scratch — data, not shape).
+    Per layer: project all S·K rows through the decode
+    contract (:func:`tp_attn.decode_project` at per-row positions),
+    write every candidate's K/V via :meth:`PagedKVCache.append_block`
+    (parked slots land in the scratch page), then attend each
+    candidate over the slot's gathered page view with the per-query
+    causal mask (:func:`~triton_dist_tpu.ops.chunked_prefill.
+    block_attend`) — candidate j sees exactly what a sequential decode
+    of the accepted prefix would see, which is what makes accepted
+    tokens token-exact with non-speculative greedy decode.
+
+    Returns ``(logits (S, K, vocab), cache)``. ``logits[s, j]`` is the
+    next-token distribution AFTER feeding candidates 0..j. The cache's
+    ``lens`` are NOT advanced — the host commits the accepted prefix
+    by advancing its length mirrors (rejected suffixes simply stay
+    masked garbage the next block overwrites), and rolls page
+    accounting back via ``BlockManager.truncate_to``.
+    """
+    from triton_dist_tpu.ops.chunked_prefill import block_attend
+
+    s, k = token_ids.shape
+    x = params["embed"][token_ids.reshape(s * k)]     # (S·K, d)
+    dec_mode = "xla" if mode == "xla" else "fused_ar"
+    lens = cache.lens
+    positions = (lens[:, None]
+                 + jnp.arange(k, dtype=jnp.int32)[None]).reshape(s * k)
+
+    for li, layer_params in enumerate(params["layers"]):
+        h = rms_norm(x, layer_params["ln_attn"], cfg.rms_norm_eps)
+        q, k_tok, v_tok = tp_attn.decode_project(
+            layer_params["attn"], h, cfg, positions, axis=axis)
+        hl, hd = q.shape[2], q.shape[3]
+        kvl = k_tok.shape[2]
+        cache = cache.append_block(
+            li, k_tok[:, 0].reshape(s, k, kvl, hd),
+            v_tok[:, 0].reshape(s, k, kvl, hd), budget=budget)
+        kd, vd = cache.dense_layer(li)
+        o = block_attend(q[:, 0].reshape(s, k, hl, hd), kd, vd,
+                         lens, cache.live)
+        x = x + tp_attn.decode_output(
+            layer_params["attn"], o.reshape(s * k, -1), h,
+            mode=dec_mode, axis=axis, ar_ctx=ctxs.ar)
+        h = rms_norm(x, layer_params["ln_mlp"], cfg.rms_norm_eps)
+        if ffn_fn is None:
+            mlp_mode = "xla_ar" if dec_mode == "xla" else dec_mode
+            x = x + tp_mlp.fwd(layer_params["mlp"], h, mode=mlp_mode,
+                               axis=axis, ag_ctx=ctxs.ag, rs_ctx=ctxs.rs,
+                               ar_ctx=ctxs.ar)
+        else:
+            x = x + ffn_fn(layer_params, h)
+
+    x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+    logits_loc = jnp.dot(x, params["lm_head"].T,
+                         preferred_element_type=jnp.float32)
+    logits = jax.lax.all_gather(logits_loc, axis, axis=1, tiled=True)
+    return logits.reshape(s, k, -1), cache
+
+
+def paged_cache_specs(axis: str = "tp", quantized: bool = False):
     """PartitionSpec pytree for the serving
     :class:`~triton_dist_tpu.serving.blocks.PagedKVCache` (KV heads
     sharded along ``axis``; page pool, table, and lengths replicated in
-    every other dim) — the ServingEngine's shard_map spec."""
+    every other dim) — the ServingEngine's shard_map spec.
+    ``quantized=True`` adds the per-page scale arrays' specs (their KV
+    dim shards with the heads whose pages they dequantize)."""
     from triton_dist_tpu.serving.blocks import PagedKVCache
 
+    scale = P(None, None, axis) if quantized else None
     return PagedKVCache(
         k_pages=P(None, None, axis, None, None),
         v_pages=P(None, None, axis, None, None),
-        block_table=P(None, None), lens=P(None), live=P(None))
+        block_table=P(None, None), lens=P(None), live=P(None),
+        k_scale=scale, v_scale=scale)
 
 
 def prefill_chunk_paged(params, chunk_toks, cache, table_row,
@@ -369,9 +443,13 @@ def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
             from triton_dist_tpu.ops.paged_flash_decode import (
                 paged_flash_decode)
 
-            o = paged_flash_decode(q[:, 0], cache.k_pages[li],
-                                   cache.v_pages[li], cache.block_table,
-                                   kv_len, axis=None)
+            o = paged_flash_decode(
+                q[:, 0], cache.k_pages[li], cache.v_pages[li],
+                cache.block_table, kv_len, axis=None,
+                k_scale=(cache.k_scale[li] if cache.quantized
+                         else None),
+                v_scale=(cache.v_scale[li] if cache.quantized
+                         else None))
         else:
             kd, vd = cache.dense_layer(li)
             o = tp_attn.sdpa(q, kd, vd, causal=False, kv_len=kv_len)

@@ -259,10 +259,34 @@ def _sum_counts(counts, cfg: ModelConfig):
     return jnp.zeros((cfg.num_experts,), jnp.int32)
 
 
-def paged_cache_specs(axis: str = "tp"):
+def paged_cache_specs(axis: str = "tp", quantized: bool = False):
     from triton_dist_tpu.models import dense as _dense
 
-    return _dense.paged_cache_specs(axis)
+    return _dense.paged_cache_specs(axis, quantized=quantized)
+
+
+def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
+                      budget=None, mode: str = "xla", axis: str = "tp",
+                      ctxs: FwdContexts = FwdContexts(),
+                      moe_impl: str = "tp", ep_ctx=None, transport=None,
+                      replicas=None, with_expert_counts: bool = False):
+    """Speculative K-token verification with the MoE FFN in the AR
+    decode regime — like the prefill chunk, the verification block's
+    S·K replicated rows fit the masked-local + psum expert path for
+    any K, so the verify dispatch needs no transport of its own.
+    ``transport``/``replicas``/counts are decode-dispatch knobs the
+    verification contract ignores."""
+    del transport, replicas, with_expert_counts
+    import functools
+
+    from triton_dist_tpu.models import dense as _dense
+
+    ffn = functools.partial(_moe_ffn_decode, cfg=cfg, moe_impl=moe_impl,
+                            axis=axis, ep_ctx=ep_ctx, transport="ar",
+                            counts=None, _layer_cursor=[0])
+    return _dense.verify_step_paged(params, token_ids, cache, cfg,
+                                    budget=budget, mode=mode, axis=axis,
+                                    ctxs=ctxs, ffn_fn=ffn)
 
 
 def prefill_chunk_paged(params, chunk_toks, cache, table_row,

@@ -112,3 +112,35 @@ def chunk_attend(q, k_dense, v_dense, positions):
     scores = jnp.where(mask[None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("hct,thd->chd", probs, v)
+
+
+def block_attend(q, k_dense, v_dense, lens, live):
+    """Causal K-token VERIFICATION attention over gathered page views —
+    :func:`chunk_attend` generalized from one slot's chunk to the whole
+    fixed-shape decode batch, one K-candidate block per slot (the
+    speculative-decode verification dispatch's attention).
+
+    q: (S, K, H, hd) — K candidate queries per slot (head-major, this
+    rank's heads); k_dense/v_dense: (S, T, KV, hd) — each slot's pages
+    gathered position-major, candidate K/V already appended at
+    ``lens[s]..lens[s]+K-1`` (positions past that are garbage the mask
+    hides); lens: (S,) int32 pre-block lengths; live: (S,) int32 0/1.
+    Query j of a live slot attends positions ``< lens[s]+j+1`` — its
+    paged history plus the candidate prefix through itself, exactly
+    the mask a sequential decode of those candidates would apply, so
+    accepted tokens are token-exact with non-speculative decode.
+    Parked slots clamp to 1 (garbage the scheduler ignores).
+    Returns (S, K, H, hd).
+
+    Delegates to :func:`tp_attn.sdpa`'s per-query ``(B, Sq)`` kv_len
+    form — the one masked-attention implementation the decode step
+    already uses, so verification shares its numerics exactly.
+    """
+    from triton_dist_tpu.layers.tp_attn import sdpa
+
+    kq = q.shape[1]
+    kv_len = jnp.maximum(
+        lens[:, None] + live[:, None]
+        * (jnp.arange(kq, dtype=jnp.int32)[None] + 1), 1)
+    return sdpa(q, k_dense, v_dense, causal=False, kv_len=kv_len,
+                use_flash=False)

@@ -37,18 +37,28 @@ from triton_dist_tpu.lang import core_call
 from triton_dist_tpu.parallel.mesh import MeshContext
 
 
-def page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int):
+def page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int,
+                kscale=None, vscale=None):
     """One online-softmax step over a KV page.
 
     q2: (H, hd) fp32 queries (head-major); kpage/vpage: (KV, page, hd)
     head-major pages; m/l: (H, 1) running max / normalizer; acc:
     (H, hd); mask: (1, page) validity; rep = H // KV (GQA ratio).
+    ``kscale``/``vscale``: (KV,) fp32 per-head dequant scales of a
+    QUANTIZED (int8/fp8) page — the dequant fuses into the page's
+    f32 upcast, so quantized pools stream through the same flash
+    recurrence with no dense dequantized materialization.
     Everything stays 2-D/batched-3-D — Mosaic has no legal layout cast
     for the grouped (KV, rep, ...) forms. Pure function on values —
     shared with the megakernel attention task."""
     scale = q2.shape[-1] ** -0.5
-    krep = jnp.repeat(kpage.astype(jnp.float32), rep, axis=0)  # (H,p,hd)
-    vrep = jnp.repeat(vpage.astype(jnp.float32), rep, axis=0)
+    kf = kpage.astype(jnp.float32)
+    vf = vpage.astype(jnp.float32)
+    if kscale is not None:
+        kf = kf * kscale.reshape(-1, 1, 1)
+        vf = vf * vscale.reshape(-1, 1, 1)
+    krep = jnp.repeat(kf, rep, axis=0)                         # (H,p,hd)
+    vrep = jnp.repeat(vf, rep, axis=0)
     # Batched MAT-mat (unit M dim): a batched vec-mat has no lhs
     # non-contracting dim and Mosaic's dot attr cannot express it.
     s = jnp.einsum("hqd,hpd->hqp", q2[:, None, :], krep)[:, 0, :] * scale
@@ -62,6 +72,11 @@ def page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int):
     acc_new = acc * corr + jnp.einsum(
         "hqp,hpd->hqd", p[:, None, :], vrep)[:, 0, :]
     return m_new, l_new, acc_new
+
+
+def _is_quantized_pool(arr) -> bool:
+    return jnp.dtype(arr.dtype) in (jnp.dtype(jnp.int8),
+                                    jnp.dtype(jnp.float8_e4m3fn))
 
 
 def _lse_reduce(parts, hd: int):
@@ -83,15 +98,23 @@ def _lse_reduce(parts, hd: int):
 
 def _decode_kernel(*refs, axes, ctx: MeshContext, page: int, p_max: int,
                    kvh: int, rep: int, hd: int, shard_len: int,
-                   paged: bool, sim: bool):
+                   paged: bool, sim: bool, quantized: bool = False):
     """``axes``: list of (axis_name, n_ax) exchange stages, innermost
     first (1 entry = flat; 2 = hierarchical outer x inner, where the
     flat shard order is outer-major). ``paged=False`` reads a dense
     head-major (B, KV, T_loc, hd) cache with pages carved from T_loc.
     ``sim=True``: self-targeted puts at full schedule/traffic (every
     gather slot receives my own partial; the LSE-combine of n identical
-    partials is exact) — the single-chip bench proxy."""
-    if paged:
+    partials is exact) — the single-chip bench proxy.
+    ``quantized=True``: the pools are int8/fp8 and two extra
+    (B, P_max, KV) fp32 scale tables ride in VMEM — the dequant fuses
+    into each page's compute step (:func:`page_attend`)."""
+    ks_ref = vs_ref = None
+    if paged and quantized:
+        (table_ref, len_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+         o_ref, part_gather) = refs[:9]
+        scratch = refs[9:]
+    elif paged:
         (table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
          part_gather) = refs[:7]
         scratch = refs[7:]
@@ -175,9 +198,15 @@ def _decode_kernel(*refs, axes, ctx: MeshContext, page: int, p_max: int,
         q2 = q_ref[0, b].astype(jnp.float32)
         pos = p * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
         mask = pos < local_end
+        ksc = vsc = None
+        if quantized:
+            # Per-page per-head dequant scales, gathered host-side
+            # through the block table — the fused-dequant hook.
+            ksc = ks_ref[b, p]
+            vsc = vs_ref[b, p]
         m, l, acc = page_attend(q2, kpage[par], vpage[par],
                                 m_l[:, 0:1], m_l[:, 1:2], acc_s[...],
-                                mask, rep)
+                                mask, rep, kscale=ksc, vscale=vsc)
         m_l[:, 0:1] = m
         m_l[:, 1:2] = l
         acc_s[...] = acc
@@ -267,11 +296,13 @@ def _normalize_axes(axis, ctx, sim_ranks):
 
 
 def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
-                 page, p_max, paged, sim_ranks=0):
+                 page, p_max, paged, sim_ranks=0, k_scale=None,
+                 v_scale=None):
     """Shared host plumbing for the paged and dense decode kernels."""
     b, h, hd = q.shape
     kvh = k_arr.shape[1]
     rep = h // kvh
+    quantized = k_scale is not None
     axes, n, sim = _normalize_axes(axis, ctx, sim_ranks)
     shard_len = p_max * page
     if not isinstance(kv_len, jax.core.Tracer):
@@ -294,7 +325,7 @@ def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
     kernel = functools.partial(
         _decode_kernel, axes=axes, ctx=ctx, page=page, p_max=p_max,
         kvh=kvh, rep=rep, hd=hd, shard_len=shard_len, paged=paged,
-        sim=sim)
+        sim=sim, quantized=quantized)
 
     n_sem = max(sum(n_ax - 1 for _, n_ax in axes), 1)
     n_slots = max(max(n_ax for _, n_ax in axes), 1)
@@ -306,6 +337,16 @@ def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
         pl.BlockSpec(memory_space=pl.ANY),         # v pool / cache
     ]
     operands = [kv_len.astype(jnp.int32), q[None], k_arr, v_arr]
+    if quantized:
+        # Scales enter PRE-GATHERED through the block table as small
+        # (B, P_max, KV) fp32 tables resident in VMEM — the kernel
+        # reads its page's (KV,) scale at compute time and fuses the
+        # dequant into the page's f32 upcast.
+        sc_spec = pl.BlockSpec((b, p_max, kvh), lambda bb, pp: (0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale[block_table].astype(jnp.float32),
+                     v_scale[block_table].astype(jnp.float32)]
     if paged:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.insert(0, block_table.astype(jnp.int32))
@@ -346,12 +387,17 @@ def _decode_call(q, k_arr, v_arr, block_table, kv_len, *, ctx, axis,
 
 
 def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
-                       ctx: MeshContext = None, axis="sp"):
+                       ctx: MeshContext = None, axis="sp",
+                       k_scale=None, v_scale=None):
     """Distributed paged-KV GQA decode step (call inside shard_map).
 
     q: (B, H, hd) replicated along ``axis``;
     k_pages/v_pages: (num_pages, KV, page, hd) — this rank's page pool
-    (head-major pages);
+    (head-major pages); int8/fp8 pools additionally REQUIRE
+    ``k_scale``/``v_scale`` (num_pages, KV) fp32 per-page per-head
+    dequant scales (fused into the page prefetch compute) — reading a
+    quantized pool without them fails loudly rather than attending
+    raw quantized bytes;
     block_table: (B, P_max) int32 page ids into the local pool (rank r's
     pages hold the global positions [r·P_max·page, (r+1)·P_max·page));
     kv_len: (B,) int32 *global* valid lengths (ragged per batch).
@@ -366,17 +412,31 @@ def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
     """
     _, kvh, page, _ = k_pages.shape
     p_max = block_table.shape[1]
+    if _is_quantized_pool(k_pages) and k_scale is None:
+        raise ValueError(
+            f"k_pages is a QUANTIZED pool ({k_pages.dtype}) but no "
+            "k_scale/v_scale was passed — a scaleless reader would "
+            "attend raw quantized bytes (kv_dtype mismatch between "
+            "the pool's writer and this reader?)")
+    if k_scale is not None and not _is_quantized_pool(k_pages):
+        raise ValueError(
+            f"k_scale passed for an unquantized ({k_pages.dtype}) "
+            "pool — scales only pair with int8/fp8 storage")
     return _decode_call(q, k_pages, v_pages, block_table, kv_len,
                         ctx=ctx, axis=axis, page=page, p_max=p_max,
-                        paged=True)
+                        paged=True, k_scale=k_scale, v_scale=v_scale)
 
 
-def paged_flash_decode_ref(q, k_pages, v_pages, block_table, kv_len):
+def paged_flash_decode_ref(q, k_pages, v_pages, block_table, kv_len,
+                           k_scale=None, v_scale=None):
     """XLA oracle for the local (single-rank) paged decode: gather the
     block table's pages into the dense position-major cache view and
     run plain masked attention. Token-exact with the dense-cache path
     by construction — the serving engine's ``attn_impl="ref"`` uses
-    the same gather, so this doubles as its unit oracle.
+    the same gather, so this doubles as its unit oracle. For a
+    QUANTIZED pool the gather dequantizes with the per-page scales —
+    the kernel's fused-dequant numerics oracle; a scaleless read of a
+    quantized pool fails loudly (same contract as the kernel).
 
     q: (B, H, hd); k_pages/v_pages: (num_pages, KV, page, hd);
     block_table: (B, P_max) int32; kv_len: (B,) int32 (0 = empty slot —
@@ -387,17 +447,25 @@ def paged_flash_decode_ref(q, k_pages, v_pages, block_table, kv_len):
 
     b, p_max = block_table.shape
     _, kvh, page, hd = k_pages.shape
+    if _is_quantized_pool(k_pages) and k_scale is None:
+        raise ValueError(
+            f"k_pages is a QUANTIZED pool ({k_pages.dtype}) but no "
+            "k_scale/v_scale was passed — a scaleless reader would "
+            "attend raw quantized bytes")
 
-    def gather(pool):
+    def gather(pool, scale):
         g = pool[block_table]               # (B, P_max, KV, page, hd)
+        if scale is not None:
+            g = g.astype(jnp.float32) * scale[block_table][
+                ..., None, None]
         g = g.transpose(0, 1, 3, 2, 4)      # (B, P_max, page, KV, hd)
         return g.reshape(b, p_max * page, kvh, hd)
 
     # Fully-masked rows (kv_len 0) would NaN the softmax; clamp to one
     # position — the row is garbage either way and callers mask it.
     safe_len = jnp.maximum(kv_len, 1)
-    return flash_decode_ref(q, gather(k_pages), gather(v_pages),
-                            safe_len)
+    return flash_decode_ref(q, gather(k_pages, k_scale),
+                            gather(v_pages, v_scale), safe_len)
 
 
 def sp_flash_decode_fused(q, k_cache, v_cache, kv_len, *,

@@ -8,10 +8,15 @@ request queue / slots / deadlines, and
 """
 
 from triton_dist_tpu.serving.blocks import (  # noqa: F401
+    KV_DTYPES,
     BlockManager,
     BlockTableOverflowError,
     OutOfPagesError,
     PagedKVCache,
+)
+from triton_dist_tpu.serving.spec import (  # noqa: F401
+    NgramDraft,
+    accept_greedy,
 )
 from triton_dist_tpu.serving.scheduler import (  # noqa: F401
     QueueFullError,

@@ -38,6 +38,92 @@ import jax.numpy as jnp
 
 SCRATCH_PAGE = 0
 
+# Per-page KV quantization (the ll_a2a wire-quantization move applied
+# to the pools): pools stored at a narrow dtype with one fp32 scale per
+# (layer, page, kv_head) alongside. Symmetric max-abs: scale =
+# amax/QMAX, stored = round/cast(x/scale), dequant = stored·scale.
+# "bf16" is the UNQUANTIZED native path (pool at the engine's param
+# dtype, no scales, bit-identical to the pre-quantization code).
+KV_DTYPES = ("bf16", "int8", "fp8")
+_KV_QUANT = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
+
+
+def kv_quant_spec(kv_dtype: str):
+    """→ (storage dtype | None, qmax | None) for a ``kv_dtype`` knob
+    value; None means the unquantized native path."""
+    if kv_dtype in (None, "bf16", "native"):
+        return None, None
+    if kv_dtype not in _KV_QUANT:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got "
+                         f"{kv_dtype!r}")
+    return _KV_QUANT[kv_dtype]
+
+
+def _quantize(x, scale, qdtype, qmax):
+    """x fp32 → storage dtype under per-broadcast ``scale`` (fp32,
+    broadcastable). int8 rounds-to-nearest; fp8 is a saturating cast."""
+    y = x / scale
+    if jnp.dtype(qdtype) == jnp.dtype(jnp.int8):
+        return jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    return jnp.clip(y, -qmax, qmax).astype(qdtype)
+
+
+def _safe_scale(amax, qmax):
+    """amax → scale with the zero guard (an all-zero page stores zeros
+    under scale 1 instead of dividing by zero)."""
+    return jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+
+
+def _quant_range_write(pool, scales, layer, pids, loc, toks, tok_mask,
+                       had_prior, qmax):
+    """Merge a consecutive token range into QUANTIZED pages under fresh
+    per-page max-abs scales — the shared core of every partial-page
+    quantized write (decode append, the speculative K-token block, the
+    prefill chunk).
+
+    pool: (L, N, KV, page, hd) storage; scales: (L, N, KV) fp32;
+    pids: (S, n_t) touched page ids per slot (scratch-substituted rows
+    write garbage by contract); loc: (S, K) each token's position
+    inside the touched window [0, n_t·page) (tokens with ``tok_mask``
+    False are dumped past the window); toks: (S, K, KV, hd);
+    had_prior: (S, n_t) — pages holding earlier valid tokens keep
+    their running amax (scale·qmax) through the merge, pages whose
+    first token lands now get a FRESH scale (stale garbage from a
+    freed-and-reused pool slot never leaks into the new scale).
+    Returns (pool, scales). Pages a token never lands in requantize to
+    themselves exactly (unchanged scale ⇒ dequant·requant identity).
+    """
+    s, n_t = pids.shape
+    _, _, kvh, page, hd = pool.shape
+    toks = toks.astype(jnp.float32)
+    old_scale = scales[layer][pids]                  # (S, n_t, KV)
+    gathered = pool[layer][pids]                     # (S, n_t, KV, pg, hd)
+    deq = gathered.astype(jnp.float32) * old_scale[..., None, None]
+    dense = deq.transpose(0, 1, 3, 2, 4).reshape(s, n_t * page, kvh, hd)
+    # One dump row past the window swallows masked (padding/resident)
+    # tokens without branching.
+    dense = jnp.concatenate(
+        [dense, jnp.zeros((s, 1, kvh, hd), jnp.float32)], axis=1)
+    loc_w = jnp.where(tok_mask, loc, n_t * page)
+    dense = dense.at[jnp.arange(s)[:, None], loc_w].set(toks)
+    dense = dense[:, :n_t * page]
+    tok_amax = jnp.max(jnp.abs(toks), axis=-1)       # (S, K, KV)
+    tok_amax = jnp.where(tok_mask[..., None], tok_amax, 0.0)
+    tpage = jnp.clip(loc // page, 0, n_t - 1)
+    amax_new = jnp.zeros((s, n_t, kvh), jnp.float32).at[
+        jnp.arange(s)[:, None], tpage].max(tok_amax)
+    amax = jnp.maximum(
+        jnp.where(had_prior[..., None], old_scale * qmax, 0.0),
+        amax_new)
+    new_scale = _safe_scale(amax, qmax)
+    blocks = dense.reshape(s, n_t, page, kvh, hd).transpose(0, 1, 3, 2, 4)
+    q = _quantize(blocks, new_scale[..., None, None], pool.dtype, qmax)
+    return (pool.at[layer, pids].set(q),
+            scales.at[layer, pids].set(new_scale))
+
 
 def pool_shardings(mesh, spec_tree):
     """NamedShardings for a :class:`PagedKVCache` spec pytree, with
@@ -80,6 +166,16 @@ class PagedKVCache:
     ``lens``: (num_slots,) int32 valid tokens per slot;
     ``live``: (num_slots,) int32 0/1 — the live slot mask (parked slots
     keep shape but neither advance nor persist their appends).
+
+    Quantized pools (``kv_dtype="int8"|"fp8"``) additionally carry
+    ``k_scale``/``v_scale``: (L, num_pages, KV_loc) fp32 per-page
+    per-head dequant scales. Every write path quantizes in place
+    (partial-page writes dequant→merge→requant the touched pages under
+    a fresh max-abs scale; a page's scale RESETS when its first token
+    lands, so a freed-and-reused pool slot never inherits a stale
+    scale) and every read path (``dense_row``/``dense_layer``, the
+    fused kernel prefetch) dequantizes. The unquantized path keeps the
+    scales ``None`` and runs the original code bit-identically.
     """
 
     k_pages: jax.Array
@@ -87,18 +183,35 @@ class PagedKVCache:
     block_table: jax.Array
     lens: jax.Array
     live: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @classmethod
     def empty(cls, num_layers: int, num_pages: int, page: int,
               kv_heads_loc: int, head_dim: int, *, num_slots: int,
-              p_max: int, dtype=jnp.float32) -> "PagedKVCache":
+              p_max: int, dtype=jnp.float32,
+              kv_dtype: str = "bf16") -> "PagedKVCache":
         shape = (num_layers, num_pages, kv_heads_loc, page, head_dim)
+        qdtype, _ = kv_quant_spec(kv_dtype)
+        pool_dtype = dtype if qdtype is None else qdtype
+        scale = (None if qdtype is None else jnp.ones(
+            (num_layers, num_pages, kv_heads_loc), jnp.float32))
         return cls(
-            k_pages=jnp.zeros(shape, dtype),
-            v_pages=jnp.zeros(shape, dtype),
+            k_pages=jnp.zeros(shape, pool_dtype),
+            v_pages=jnp.zeros(shape, pool_dtype),
             block_table=jnp.zeros((num_slots, p_max), jnp.int32),
             lens=jnp.zeros((num_slots,), jnp.int32),
-            live=jnp.zeros((num_slots,), jnp.int32))
+            live=jnp.zeros((num_slots,), jnp.int32),
+            k_scale=scale, v_scale=(None if scale is None
+                                    else jnp.ones_like(scale)))
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def qmax(self) -> float:
+        return 127.0 if self.k_pages.dtype == jnp.int8 else 448.0
 
     @property
     def page(self) -> int:
@@ -117,6 +230,8 @@ class PagedKVCache:
         Parked slots (all-zero table row) write the scratch page.
         Lengths advance once per step via :meth:`advance`, not here.
         """
+        if self.quantized:
+            return self._quant_append(layer, k_tok, v_tok)
         page = self.page
         row = self.lens // page
         off = self.lens % page
@@ -128,6 +243,76 @@ class PagedKVCache:
             v_tok[:, 0].astype(self.v_pages.dtype))
         return dataclasses.replace(self, k_pages=k_pages,
                                    v_pages=v_pages)
+
+    def append_block(self, layer: int, k_tok, v_tok,
+                     budget=None) -> "PagedKVCache":
+        """Write K consecutive tokens per slot at each slot's own
+        length — the speculative-verification form of
+        :meth:`append_decode` (positions ``lens[s]..lens[s]+K-1``; the
+        host commits only the accepted prefix by not advancing the
+        length mirrors past it). k_tok/v_tok: (num_slots, K, KV_loc,
+        hd). Parked slots' writes land in the scratch page, and so do
+        tokens past a slot's block-table row or past its ``budget``
+        (S,) — a fixed-K dispatch near a request's token budget must
+        not let its over-budget candidates corrupt a real page's
+        contents (or, quantized, inflate its scale)."""
+        if self.quantized:
+            return self._quant_append(layer, k_tok, v_tok, budget)
+        page = self.page
+        k = k_tok.shape[1]
+        pos = self.lens[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+        rows_raw = pos // page
+        valid = rows_raw < self.block_table.shape[1]
+        if budget is not None:
+            valid = jnp.logical_and(
+                valid, jnp.arange(k, dtype=jnp.int32)[None]
+                < budget[:, None])
+        rows = jnp.clip(rows_raw, 0, self.block_table.shape[1] - 1)
+        pids = jnp.where(
+            valid, jnp.take_along_axis(self.block_table, rows, axis=1),
+            SCRATCH_PAGE)
+        off = pos % page
+        k_pages = self.k_pages.at[layer, pids, :, off, :].set(
+            k_tok.astype(self.k_pages.dtype))
+        v_pages = self.v_pages.at[layer, pids, :, off, :].set(
+            v_tok.astype(self.v_pages.dtype))
+        return dataclasses.replace(self, k_pages=k_pages,
+                                   v_pages=v_pages)
+
+    def _quant_append(self, layer: int, k_tok, v_tok,
+                      budget=None) -> "PagedKVCache":
+        """Quantized slot-range write shared by :meth:`append_decode`
+        (K=1) and :meth:`append_block`: dequant→merge→requant the
+        touched pages; a page whose first token lands now (its start
+        position reaches ``lens``) gets a fresh scale."""
+        page = self.page
+        s, k = k_tok.shape[:2]
+        p_max = self.block_table.shape[1]
+        n_t = (k - 1) // page + 2
+        row0 = self.lens // page
+        rows = row0[:, None] + jnp.arange(n_t, dtype=jnp.int32)[None]
+        rows_c = jnp.clip(rows, 0, p_max - 1)
+        pids = jnp.where(
+            rows < p_max,
+            jnp.take_along_axis(self.block_table, rows_c, axis=1),
+            SCRATCH_PAGE)
+        loc = (self.lens % page)[:, None] + jnp.arange(
+            k, dtype=jnp.int32)[None]
+        pos = self.lens[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+        mask = pos // page < p_max
+        if budget is not None:
+            mask = jnp.logical_and(
+                mask, jnp.arange(k, dtype=jnp.int32)[None]
+                < budget[:, None])
+        had_prior = rows * page < self.lens[:, None]
+        kp, ks = _quant_range_write(self.k_pages, self.k_scale, layer,
+                                    pids, loc, k_tok, mask, had_prior,
+                                    self.qmax)
+        vp, vs = _quant_range_write(self.v_pages, self.v_scale, layer,
+                                    pids, loc, v_tok, mask, had_prior,
+                                    self.qmax)
+        return dataclasses.replace(self, k_pages=kp, v_pages=vp,
+                                   k_scale=ks, v_scale=vs)
 
     def advance(self) -> "PagedKVCache":
         """Bump live slots' lengths after all layers appended."""
@@ -150,6 +335,10 @@ class PagedKVCache:
         """
         from triton_dist_tpu.ops.chunked_prefill import chunk_write_ids
 
+        if self.quantized:
+            return self._quant_write_chunk(layer, k_tok, v_tok,
+                                           table_row, positions, valid,
+                                           wfrom)
         pids, off = chunk_write_ids(positions, table_row, valid, wfrom,
                                     page=self.page)
         k_pages = self.k_pages.at[layer, pids, :, off, :].set(
@@ -158,6 +347,39 @@ class PagedKVCache:
             v_tok[:, 0].astype(self.v_pages.dtype))
         return dataclasses.replace(self, k_pages=k_pages,
                                    v_pages=v_pages)
+
+    def _quant_write_chunk(self, layer, k_tok, v_tok, table_row,
+                           positions, valid, wfrom) -> "PagedKVCache":
+        """Quantized chunk write. Positions are consecutive
+        (``start + arange(C)`` — the chunk contract), so the touched
+        pages are a bounded window. Prefix-resident pages (below the
+        page-aligned ``wfrom``) are scratch-substituted — their bytes
+        AND scales a live reader holds are never rewritten; a page
+        whose first token lands in an earlier chunk keeps its running
+        amax through this merge."""
+        page = self.page
+        c = positions.shape[0]
+        start = positions[0]
+        n_t = (c - 1) // page + 2
+        row0 = start // page
+        rows = row0 + jnp.arange(n_t, dtype=jnp.int32)
+        rows_c = jnp.clip(rows, 0, table_row.shape[0] - 1)
+        writable_page = rows >= wfrom // page
+        pids = jnp.where(writable_page, table_row[rows_c],
+                         SCRATCH_PAGE)[None]
+        i = jnp.arange(c, dtype=jnp.int32)
+        tok_mask = jnp.logical_and(i < valid, positions >= wfrom)[None]
+        loc = (positions - row0 * page)[None]
+        had_prior = jnp.logical_and(rows * page < start,
+                                    writable_page)[None]
+        kp, ks = _quant_range_write(self.k_pages, self.k_scale, layer,
+                                    pids, loc, k_tok[:, 0][None],
+                                    tok_mask, had_prior, self.qmax)
+        vp, vs = _quant_range_write(self.v_pages, self.v_scale, layer,
+                                    pids, loc, v_tok[:, 0][None],
+                                    tok_mask, had_prior, self.qmax)
+        return dataclasses.replace(self, k_pages=kp, v_pages=vp,
+                                   k_scale=ks, v_scale=vs)
 
     def dense_row(self, layer: int, table_row) -> Tuple[jax.Array,
                                                         jax.Array]:
@@ -169,33 +391,59 @@ class PagedKVCache:
         p_max = table_row.shape[0]
         _, _, kvh, page, hd = self.k_pages.shape
 
-        def gather(pool):
+        def gather(pool, scale):
             g = pool[layer][table_row]      # (p_max, KV, page, hd)
+            if scale is not None:           # fused dequant on gather
+                g = g.astype(jnp.float32) * scale[layer][table_row][
+                    ..., None, None]
             g = g.transpose(0, 2, 1, 3)     # (p_max, page, KV, hd)
             return g.reshape(p_max * page, kvh, hd)
 
-        return gather(self.k_pages), gather(self.v_pages)
+        return (gather(self.k_pages, self.k_scale),
+                gather(self.v_pages, self.v_scale))
 
-    def gather_pages(self, page_ids) -> Tuple[jax.Array, jax.Array]:
+    def gather_pages(self, page_ids):
         """Extract whole pages as a migration payload: page_ids (n,)
         int32 pool slots (pad with the scratch page for a fixed-shape
-        transfer) → (K, V) each (L, n, KV_loc, page, hd). The
+        transfer) → (K, V) each (L, n, KV_loc, page, hd) — plus
+        (K_scale, V_scale) each (L, n, KV_loc) on a quantized pool
+        (pages migrate as their STORED bytes; the scales ride along so
+        the receiver's dequant is bit-exact with the source). The
         disaggregated serving handoff's source half."""
-        return self.k_pages[:, page_ids], self.v_pages[:, page_ids]
+        k, v = self.k_pages[:, page_ids], self.v_pages[:, page_ids]
+        if not self.quantized:
+            return k, v
+        return (k, v, self.k_scale[:, page_ids],
+                self.v_scale[:, page_ids])
 
-    def scatter_pages(self, k_payload, v_payload,
-                      page_ids) -> "PagedKVCache":
+    def scatter_pages(self, k_payload, v_payload, page_ids,
+                      k_scale=None, v_scale=None) -> "PagedKVCache":
         """Blit a migration payload into this pool's pages: the
         receiver half of the disaggregated KV handoff. ``page_ids``
         rows the caller wants dropped (padding, prefix-resident pages a
         live reader holds) should point at the scratch page — duplicate
-        scratch writes are benign garbage."""
-        return dataclasses.replace(
-            self,
+        scratch writes are benign garbage. A quantized pool requires
+        the payload's scales (a scaleless scatter would silently pair
+        this pool's stale scales with the new bytes)."""
+        repl = dict(
             k_pages=self.k_pages.at[:, page_ids].set(
                 k_payload.astype(self.k_pages.dtype)),
             v_pages=self.v_pages.at[:, page_ids].set(
                 v_payload.astype(self.v_pages.dtype)))
+        if self.quantized:
+            if k_scale is None or v_scale is None:
+                raise ValueError(
+                    "scatter_pages into a quantized pool needs the "
+                    "payload's k_scale/v_scale (gather_pages returns "
+                    "them) — bytes without scales are unreadable")
+            repl.update(
+                k_scale=self.k_scale.at[:, page_ids].set(k_scale),
+                v_scale=self.v_scale.at[:, page_ids].set(v_scale))
+        elif k_scale is not None or v_scale is not None:
+            raise ValueError(
+                "scatter_pages got quantization scales but this pool "
+                "is unquantized (kv_dtype mismatch between roles?)")
+        return dataclasses.replace(self, **repl)
 
     def dense_layer(self, layer: int) -> Tuple[jax.Array, jax.Array]:
         """Gather one layer's pages to the dense position-major view
@@ -205,12 +453,16 @@ class PagedKVCache:
         s, p_max = self.block_table.shape
         _, _, kvh, page, hd = self.k_pages.shape
 
-        def gather(pool):
+        def gather(pool, scale):
             g = pool[layer][self.block_table]   # (S, p_max, KV, pg, hd)
+            if scale is not None:               # fused dequant on gather
+                g = g.astype(jnp.float32) * scale[layer][
+                    self.block_table][..., None, None]
             g = g.transpose(0, 1, 3, 2, 4)      # (S, p_max, pg, KV, hd)
             return g.reshape(s, p_max * page, kvh, hd)
 
-        return gather(self.k_pages), gather(self.v_pages)
+        return (gather(self.k_pages, self.k_scale),
+                gather(self.v_pages, self.v_scale))
 
     def write_prompt(self, k_prompt, v_prompt, page_ids) -> "PagedKVCache":
         """Blit a prefilled prompt's K/V into this cache's pages.
@@ -227,18 +479,32 @@ class PagedKVCache:
         page = self.page
         n_p = s_pad // page
 
-        def blit(pool, prompt):
+        def blit(pool, scales, prompt):
             blocks = prompt.reshape(num_l, n_p, page, kvh, hd)
             blocks = blocks.transpose(0, 1, 3, 2, 4)
-            return pool.at[:, page_ids].set(blocks.astype(pool.dtype))
+            if scales is None:
+                return pool.at[:, page_ids].set(
+                    blocks.astype(pool.dtype)), None
+            # Whole-page quantize: one fresh max-abs scale per
+            # (layer, page, kv_head). The blit's tail padding is the
+            # prefill cache's zeros, so it never inflates the ragged
+            # final page's scale.
+            b32 = blocks.astype(jnp.float32)
+            sc = _safe_scale(jnp.max(jnp.abs(b32), axis=(3, 4)),
+                             self.qmax)
+            q = _quantize(b32, sc[..., None, None], pool.dtype,
+                          self.qmax)
+            return (pool.at[:, page_ids].set(q),
+                    scales.at[:, page_ids].set(sc))
 
-        return dataclasses.replace(
-            self, k_pages=blit(self.k_pages, k_prompt),
-            v_pages=blit(self.v_pages, v_prompt))
+        kp, ks = blit(self.k_pages, self.k_scale, k_prompt)
+        vp, vs = blit(self.v_pages, self.v_scale, v_prompt)
+        return dataclasses.replace(self, k_pages=kp, v_pages=vp,
+                                   k_scale=ks, v_scale=vs)
 
     def tree_flatten(self):
         return (self.k_pages, self.v_pages, self.block_table, self.lens,
-                self.live), None
+                self.live, self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -265,7 +531,9 @@ class BlockManager:
     """
 
     def __init__(self, num_pages: int, page: int, p_max: int, *,
-                 prefix_reuse: bool = False):
+                 prefix_reuse: bool = False,
+                 page_bytes: Optional[int] = None,
+                 native_page_bytes: Optional[int] = None):
         if num_pages < 2:
             raise ValueError(f"num_pages={num_pages} < 2 (page 0 is the "
                              "reserved scratch page)")
@@ -273,6 +541,12 @@ class BlockManager:
         self.page = page
         self.p_max = p_max
         self.prefix_reuse = prefix_reuse
+        # Capacity accounting (from ModelConfig.kv_cache_plan): bytes
+        # one page costs at the pool's storage dtype, and what it
+        # would cost at the engine's native dtype — the pair the
+        # quantization capacity win is measured against in stats.
+        self.page_bytes = page_bytes
+        self.native_page_bytes = native_page_bytes
         self._free: deque = deque(range(1, num_pages))
         self._refs: Dict[int, int] = {}
         self._slot_pages: Dict[int, List[int]] = {}
@@ -431,6 +705,28 @@ class BlockManager:
         self._slot_tokens[slot] = n + 1
         return None
 
+    def truncate_to(self, slot: int, n_tokens: int):
+        """Roll ``slot``'s token accounting back to ``n_tokens`` and
+        free now-unused TRAILING pages — the speculative-decode
+        rollback (a rejected draft suffix releases the page growth its
+        pre-allocation claimed). Page-level only: the partially-filled
+        final page stays; a PREFIX-SHARED page is never freed — the
+        keep-floor is the slot's prefix-hit run, and even past it a
+        drop only releases this slot's ref (the cache's own ref keeps
+        a published page's bytes alive for its other readers)."""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise KeyError(f"slot {slot} has no allocation to truncate")
+        cur = self._slot_tokens[slot]
+        if n_tokens > cur:
+            raise ValueError(f"truncate_to({n_tokens}) beyond slot "
+                             f"{slot}'s {cur} accounted tokens")
+        keep = max((n_tokens + self.page - 1) // self.page, 1,
+                   self._slot_hits.get(slot, 0))
+        while len(pages) > keep:
+            self._drop_ref(pages.pop())
+        self._slot_tokens[slot] = n_tokens
+
     def free_slot(self, slot: int):
         """Release a finished request's pages (COMMITTED shared pages
         survive in the prefix cache until evicted; staged-but-never-
@@ -459,7 +755,7 @@ class BlockManager:
         shared = max(held_pages - len(
             set(p for ps in self._slot_pages.values() for p in ps)), 0)
         cap = max(held_pages, 1) * self.page
-        return {
+        out = {
             "num_pages": self.num_pages, "page": self.page,
             "free_pages": len(self._free), "used_pages": used_pages,
             "prefix_pages": len(self._prefix),
@@ -468,3 +764,15 @@ class BlockManager:
             "utilization": used_tokens / cap if held_pages else 1.0,
             **self.stats,
         }
+        if self.page_bytes:
+            # The quantization capacity surface: HBM cost per resident
+            # token, and how many MORE pages the same pool bytes buy
+            # vs the native dtype (int8 ≈ 2–4x depending on the
+            # native width and the per-page scale overhead).
+            out["bytes_per_token"] = self.page_bytes / self.page
+            if self.native_page_bytes:
+                ratio = self.native_page_bytes / self.page_bytes
+                out["capacity_ratio_vs_native"] = round(ratio, 4)
+                out["pages_at_native_bytes"] = int(
+                    (self.num_pages - 1) * ratio)
+        return out

@@ -66,7 +66,10 @@ class ChunkedPrefill:
         # counts are decode-dispatch knobs the chunk contract ignores.
         mk = {k: v for k, v in engine.model_kwargs.items()
               if k in ("moe_impl", "ep_ctx")}
-        kv_spec = model.paged_cache_specs(axis)
+        # Quantized pools carry per-page scale leaves — the chunk
+        # dispatch's cache spec must match the pool it writes.
+        kv_spec = model.paged_cache_specs(
+            axis, quantized=cache_shardings.k_scale is not None)
 
         def _chunk(params, toks, cache, table_row, start, wfrom, valid):
             return model.prefill_chunk_paged(

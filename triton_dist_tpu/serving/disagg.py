@@ -66,7 +66,7 @@ class PrefillWorker:
     def __init__(self, engine, *, page: int, p_max: int, num_slots: int,
                  num_pages: Optional[int] = None,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 prefix_reuse: bool = False):
+                 prefix_reuse: bool = False, kv_dtype: str = "bf16"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -78,30 +78,46 @@ class PrefillWorker:
                              "rides its decode batch")
         self.engine = engine
         self.page, self.p_max = page, p_max
+        self.kv_dtype = kv_dtype
         cfg, mesh, axis = engine.cfg, engine.mesh, engine.axis
+        dtype_bytes = np.dtype(
+            jax.tree.leaves(engine.params)[0].dtype).itemsize
         plan = cfg.kv_cache_plan(max_len=p_max * page, page=page,
                                  num_slots=num_slots,
-                                 tp=mesh.shape[axis])
+                                 tp=mesh.shape[axis],
+                                 dtype_bytes=dtype_bytes,
+                                 kv_dtype=kv_dtype)
         self.num_pages = num_pages or plan["num_pages"]
-        self.manager = BlockManager(self.num_pages, page, p_max,
-                                    prefix_reuse=prefix_reuse)
+        self.manager = BlockManager(
+            self.num_pages, page, p_max, prefix_reuse=prefix_reuse,
+            page_bytes=plan["page_bytes_per_rank"],
+            native_page_bytes=plan["native_page_bytes_per_rank"])
+        # The staging pool quantizes with the SAME kv_dtype as the
+        # decode pool: pages migrate as their stored bytes (+ scales),
+        # so the handoff is bit-exact and the decode side never
+        # re-quantizes.
         cache = PagedKVCache.empty(
             cfg.num_hidden_layers, self.num_pages, page,
             cfg.num_key_value_heads, cfg.head_dim, num_slots=num_slots,
             p_max=p_max,
-            dtype=jax.tree.leaves(engine.params)[0].dtype)
+            dtype=jax.tree.leaves(engine.params)[0].dtype,
+            kv_dtype=kv_dtype)
+        self.quantized = cache.quantized
         self.shardings = pool_shardings(
-            mesh, engine.model.paged_cache_specs(axis))
+            mesh, engine.model.paged_cache_specs(
+                axis, quantized=cache.quantized))
         self.cache = jax.tree.map(
             jax.device_put, cache, self.shardings,
             is_leaf=lambda x: isinstance(x, jax.Array))
         self.chunker = ChunkedPrefill(engine, self.shardings, buckets)
         # Fixed-shape payload extract: (L, p_max, KV_full, page, hd),
-        # gathered replicated so the payload can leave this mesh.
+        # gathered replicated so the payload can leave this mesh
+        # (quantized pools add the two (L, p_max, KV) scale planes).
         rep = NamedSharding(mesh, P())
         self._extract = jax.jit(
             lambda c, ids: c.gather_pages(ids),
-            out_shardings=((rep, rep)))
+            out_shardings=((rep, rep, rep, rep) if cache.quantized
+                           else (rep, rep)))
 
     def extract(self, page_ids: np.ndarray):
         """Dispatch the (async) payload gather for ``page_ids``
@@ -158,7 +174,8 @@ class DisaggServingEngine(ServingEngine):
         self.prefill_worker = PrefillWorker(
             pf_eng, page=self.page, p_max=self.p_max,
             num_slots=self.num_slots, num_pages=prefill_num_pages,
-            buckets=prefill_buckets, prefix_reuse=prefix_reuse)
+            buckets=prefill_buckets, prefix_reuse=prefix_reuse,
+            kv_dtype=self.kv_dtype)
         self._prefiller = self.prefill_worker
 
         if migration not in ("auto", "p2p", "local"):
@@ -190,10 +207,19 @@ class DisaggServingEngine(ServingEngine):
 
         # Fixed-shape receiver scatter into the decode pool — donated,
         # pinned to the pool's one sharding spelling (the decode
-        # dispatch never re-specializes on a migration).
-        self._scatter = jax.jit(
-            lambda c, k, v, ids: c.scatter_pages(k, v, ids),
-            donate_argnums=(0,), out_shardings=self._cache_shardings)
+        # dispatch never re-specializes on a migration). Quantized
+        # pools scatter the payload's scales alongside its bytes.
+        if self.prefill_worker.quantized:
+            self._scatter = jax.jit(
+                lambda c, k, v, ks, vs, ids: c.scatter_pages(
+                    k, v, ids, ks, vs),
+                donate_argnums=(0,),
+                out_shardings=self._cache_shardings)
+        else:
+            self._scatter = jax.jit(
+                lambda c, k, v, ids: c.scatter_pages(k, v, ids),
+                donate_argnums=(0,),
+                out_shardings=self._cache_shardings)
         self._pending: List[tuple] = []
         self._handoff_stalled: List[RequestHandle] = []
 
@@ -242,9 +268,9 @@ class DisaggServingEngine(ServingEngine):
         # pages a live reader may hold (never re-blitted); rows past
         # the allocation are payload padding — both land in scratch.
         dst_ids[hits:len(pages)] = pages[hits:]
-        k_pay, v_pay = pw.extract(src_ids)
+        payload = pw.extract(src_ids)   # (K, V[, K_scale, V_scale])
         h.status = "migrating"
-        self._pending.append((h, logits, k_pay, v_pay, dst_ids,
+        self._pending.append((h, logits, payload, dst_ids,
                               len(pages) - hits))
 
     def step(self) -> int:
@@ -272,10 +298,12 @@ class DisaggServingEngine(ServingEngine):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         pending, self._pending = self._pending, []
-        for h, logits, k_pay, v_pay, dst_ids, n_mig in pending:
+        for h, logits, payload, dst_ids, n_mig in pending:
             if h.status != "migrating":
                 continue               # failed meanwhile (deadline)
             slot = h.slot
+            k_pay, v_pay = payload[:2]
+            scales = payload[2:]       # () or (k_scale, v_scale)
             try:
                 with faults.on_op_call("page_migration"):
                     if self.migration == "p2p":
@@ -288,8 +316,14 @@ class DisaggServingEngine(ServingEngine):
                     rep = NamedSharding(self.engine.mesh, P())
                     k_pay = jax.device_put(k_pay, rep)
                     v_pay = jax.device_put(v_pay, rep)
+                    # Quantized handoff: the tiny (L, p_max, KV) scale
+                    # planes ride the host-staged hop alongside the
+                    # page bytes (the bridge put carries the bulk
+                    # payload; scales are <1% of it).
+                    scales = tuple(jax.device_put(s, rep)
+                                   for s in scales)
                     self.cache = self._scatter(
-                        self.cache, k_pay, v_pay,
+                        self.cache, k_pay, v_pay, *scales,
                         jnp.asarray(dst_ids, jnp.int32))
                     if self.timeout_s is not None:
                         block_until_ready(

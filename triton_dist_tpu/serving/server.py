@@ -75,7 +75,9 @@ class ServingEngine:
                  replica_slots: int = 0, rebalance_every: int = 8,
                  hot_expert_factor: float = 2.0,
                  load_alpha: float = 0.25,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 kv_dtype: str = "bf16", spec_k: int = 0,
+                 spec_ngram: int = 3):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -104,8 +106,35 @@ class ServingEngine:
         prompt/resume length, and a long prompt no longer monopolizes
         the dispatch. ``None`` keeps the monolithic path. (The
         megakernel path has its own prefill lane — pass ``None``.)
+
+        ``kv_dtype`` (layer path): ``"bf16"`` keeps the pool at the
+        engine's native dtype (bit-identical to ``Engine.serve``);
+        ``"int8"``/``"fp8"`` store the K/V pools per-page QUANTIZED
+        with fp32 scales alongside — 2–4x more resident tokens per
+        HBM byte at a bounded logit divergence (see docs/serving.md).
+
+        ``spec_k`` (layer path): 0/1 = plain one-token decode; K ≥ 2
+        enables SPECULATIVE decoding — an n-gram self-draft proposes
+        K-1 continuations and one fixed-shape K-token verification
+        dispatch scores them; accepted tokens (greedy requests) commit
+        several tokens per dispatch, token-exact with the non-spec
+        greedy run by construction. ``spec_ngram`` bounds the draft's
+        n-gram length. Note: the verification dispatch attends via the
+        gather path regardless of ``attn_impl`` — there is no K-query
+        paged-flash kernel yet (docs/serving.md, ROADMAP item 4), so
+        weigh spec_k against pool size on ``attn_impl="kernel"``
+        deployments.
         """
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+        from triton_dist_tpu.serving.blocks import kv_quant_spec
+        from triton_dist_tpu.serving.spec import NgramDraft
+
+        kv_quant_spec(kv_dtype)        # validate the knob early
+        self.kv_dtype = kv_dtype
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self._draft = NgramDraft(spec_ngram)
 
         self.engine = engine
         self.mega = isinstance(engine, MegaKernelEngine)
@@ -143,6 +172,8 @@ class ServingEngine:
             "prefill_tokens": 0, "prefill_calls": 0, "admit_stalls": 0,
             "preemptions": 0, "comm_timeouts": 0, "decode_time_s": 0.0,
             "decode_tokens": 0, "prefill_chunks": 0, "migrated_pages": 0,
+            "spec_drafted": 0, "spec_accepted": 0,
+            "greedy_agree_tokens": 0, "greedy_ref_tokens": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -155,6 +186,17 @@ class ServingEngine:
         self.chunker = None
 
         if self.mega:
+            if kv_dtype not in (None, "bf16", "native"):
+                raise ValueError(
+                    "kv_dtype is a layer-path knob: the megakernel "
+                    "decode lane's write_kv/attn_decode read the raw "
+                    "arena pools and have no per-page scale plumbing "
+                    "yet (docs/serving.md, 'KV quantization')")
+            if self.spec_k:
+                raise ValueError(
+                    "spec_k is a layer-path knob; the megakernel's "
+                    "persistent step is single-token (its prefill "
+                    "lane already amortizes dispatch overhead)")
             if self.prefill_buckets:
                 raise ValueError(
                     "prefill_buckets is a layer-path knob; the "
@@ -198,13 +240,24 @@ class ServingEngine:
             self.p_max = self.max_len // page
             # Pool sized off the MODEL CONFIG (full residency for every
             # slot by default; undersize num_pages to exercise
-            # backpressure).
+            # backpressure). The plan carries the quantization's
+            # bytes-per-token / capacity-ratio surface into stats.
+            import numpy as _np
+
+            import jax as _jax
+
+            dtype_bytes = _np.dtype(
+                _jax.tree.leaves(engine.params)[0].dtype).itemsize
             self.plan = self.cfg.kv_cache_plan(
                 max_len=self.max_len, page=page, num_slots=num_slots,
-                tp=engine.mesh.shape[engine.axis])
+                tp=engine.mesh.shape[engine.axis],
+                dtype_bytes=dtype_bytes, kv_dtype=self.kv_dtype)
             num_pages = num_pages or self.plan["num_pages"]
-            self.manager = BlockManager(num_pages, page, self.p_max,
-                                        prefix_reuse=prefix_reuse)
+            self.manager = BlockManager(
+                num_pages, page, self.p_max, prefix_reuse=prefix_reuse,
+                page_bytes=self.plan["page_bytes_per_rank"],
+                native_page_bytes=self.plan[
+                    "native_page_bytes_per_rank"])
             self._build_layer_path(num_slots, num_pages)
 
         self.sched = Scheduler(num_slots, max_queue=max_queue,
@@ -239,10 +292,12 @@ class ServingEngine:
             cfg.num_hidden_layers, num_pages, self.page,
             cfg.num_key_value_heads, cfg.head_dim, num_slots=num_slots,
             p_max=self.p_max,
-            dtype=jax.tree.leaves(eng.params)[0].dtype)
+            dtype=jax.tree.leaves(eng.params)[0].dtype,
+            kv_dtype=self.kv_dtype)
         from triton_dist_tpu.serving.blocks import pool_shardings
 
-        kv_spec = model.paged_cache_specs(axis)
+        kv_spec = model.paged_cache_specs(
+            axis, quantized=cache.quantized)
         shardings = pool_shardings(mesh, kv_spec)
         self.cache = jax.tree.map(jax.device_put, cache, shardings,
                                   is_leaf=lambda x: isinstance(x, jax.Array))
@@ -367,6 +422,35 @@ class ServingEngine:
             donate_argnums=(0,), out_shardings=shardings)
         self._axis_n = n
 
+        self._verify = None
+        if self.spec_k:
+            if not hasattr(model, "verify_step_paged"):
+                raise NotImplementedError(
+                    f"model {getattr(model, '__name__', model)!r} has "
+                    "no verify_step_paged — speculative decoding needs "
+                    "the K-token verification contract (models.dense / "
+                    "models.qwen_moe)")
+            # The verification dispatch REPLACES the one-token decode
+            # dispatch wholesale (K is static, acceptance is data), so
+            # the serving jit cache still holds exactly one decode-side
+            # entry after warmup. MoE models verify in the AR expert
+            # regime (like prefill chunks) — transport stays a
+            # plain-decode knob.
+            vk = {k: v for k, v in mk.items()
+                  if k in ("moe_impl", "ep_ctx")}
+
+            def _vrf(params, toks, budget, c):
+                return model.verify_step_paged(
+                    params, toks, c, cfg, budget=budget, mode=eng.mode,
+                    axis=axis, ctxs=eng.ctxs, **vk)
+
+            self._verify = jax.jit(jax.shard_map(
+                _vrf, mesh=mesh,
+                in_specs=(eng._specs, P(None, None), P(None), kv_spec),
+                out_specs=(P(None, None, None), kv_spec),
+                check_vma=False), donate_argnums=(3,),
+                out_shardings=(NamedSharding(mesh, P()), shardings))
+
     # -- public API --------------------------------------------------
 
     def submit(self, request, **kw) -> RequestHandle:
@@ -474,6 +558,32 @@ class ServingEngine:
             out["pool"] = self.manager.fragmentation()
         if hasattr(self, "plan"):
             out["plan"] = self.plan
+        # KV quantization surface: which storage the pools ride and
+        # what a resident token costs (capacity math in the pool dict).
+        out["kv_dtype"] = "bf16" if self.mega else self.kv_dtype
+        if hasattr(self, "plan"):
+            out["kv_bytes_per_token"] = self.plan["bytes_per_token"]
+        # Speculative-decode surface: draft volume vs accepted volume
+        # (tokens beyond the per-dispatch guaranteed one).
+        if self.spec_k:
+            drafted = self.stats_counters["spec_drafted"]
+            out["spec"] = {
+                "k": self.spec_k,
+                "drafted": drafted,
+                "accepted": self.stats_counters["spec_accepted"],
+                "accept_rate": (
+                    self.stats_counters["spec_accepted"] / drafted
+                    if drafted else None),
+                "tokens_per_dispatch": (
+                    self.stats_counters["decode_tokens"]
+                    / max(self.stats_counters["decode_dispatches"], 1)),
+            }
+        # Greedy-token agreement vs a reference run (folded in via
+        # compare_greedy) — the quantized path's divergence surface.
+        if self.stats_counters["greedy_ref_tokens"]:
+            out["greedy_agreement"] = (
+                self.stats_counters["greedy_agree_tokens"]
+                / self.stats_counters["greedy_ref_tokens"])
         if self.stats_counters["decode_time_s"] > 0:
             # Decode-emitted tokens over decode-dispatch time only —
             # the first token of each request comes from prefill and
@@ -486,9 +596,28 @@ class ServingEngine:
     def decode_cache_size(self) -> int:
         """Jit-cache entries of the shared decode dispatch — the
         no-recompilation-after-warmup gate (1 after warmup: the decode
-        batch shape is fixed)."""
-        fn = self.engine._step if self.mega else self._decode
+        batch shape is fixed). With speculation on, the K-token
+        verification dispatch IS the decode dispatch (K is static,
+        acceptance is data), so the same gate covers it."""
+        fn = (self.engine._step if self.mega
+              else self._verify if self.spec_k else self._decode)
         return fn._cache_size()
+
+    def compare_greedy(self, pairs) -> float:
+        """Fold greedy-token agreement against a REFERENCE run into
+        the stats counters (surfaced as ``stats()["greedy_agreement"]``)
+        — the quantized path's accuracy telemetry: serve the same
+        prompts through a bf16 pool (or ``Engine.serve``) and hand the
+        (got_tokens, reference_tokens) pairs here. Returns the running
+        agreement fraction."""
+        for got, want in pairs:
+            n = min(len(got), len(want))
+            self.stats_counters["greedy_ref_tokens"] += n
+            self.stats_counters["greedy_agree_tokens"] += sum(
+                1 for a, b in zip(got[:n], want[:n]) if a == b)
+        ref = self.stats_counters["greedy_ref_tokens"]
+        return (self.stats_counters["greedy_agree_tokens"] / ref
+                if ref else 1.0)
 
     def prefill_cache_size(self) -> Optional[int]:
         """Jit-cache entries of the PREFILL path — the other half of
@@ -734,6 +863,8 @@ class ServingEngine:
     def _decode_tick(self) -> int:
         import jax.numpy as jnp
 
+        if self.spec_k:
+            return self._spec_tick()
         # Layer-path slots still mid-chunk-stream (or mid-migration in
         # the disaggregated subclass) are parked: they join the decode
         # batch only once their prompt is resident. The megakernel's
@@ -825,6 +956,141 @@ class ServingEngine:
             self.stats_counters["decode_tokens"] += 1
             tok = self._pick(logits[slot], h.request, len(h.tokens))
             self._emit(h, tok)
+        return len(active)
+
+    # -- the speculative tick (spec_k >= 1, layer path) --------------
+
+    def _spec_tick(self) -> int:
+        """One serving tick through the K-token VERIFICATION dispatch:
+        draft → one fixed-shape dispatch → greedy acceptance → commit
+        the accepted prefix, roll the rejected suffix's page growth
+        back (``truncate_to``). Token-exact with the non-spec greedy
+        loop by construction; non-greedy (sampled) requests ride the
+        same dispatch but commit exactly one token from position 0's
+        exact logits."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import (
+            CommTimeoutError, block_until_ready)
+        from triton_dist_tpu.serving.spec import accept_greedy
+
+        active = [h for h in self.sched.running()
+                  if h.status == "running"]
+        if not active:
+            return 0
+        kk = self.spec_k
+        preempted = []
+        drafts: dict = {}
+        budget = np.zeros((self.num_slots,), np.int32)
+        for h in active:
+            slot = h.slot
+            base = int(self._lens[slot])
+            # Feed budget: how many candidates may commit (and write
+            # real pages) — bounded by the request's remaining token
+            # budget, so a fixed-K dispatch never grows pages past
+            # what submit() validated.
+            rem = h.request.max_new_tokens - len(h.tokens)
+            n_pre = max(1, min(kk, rem))
+            budget[slot] = n_pre
+            try:
+                for j in range(n_pre):
+                    self.manager.append(slot, base + j)
+            except OutOfPagesError as e:
+                # Pool dry MID-DRAFT: preempt — pages freed, requeued
+                # at the head, resumed via the deterministic re-prefill
+                # (the draft replays from the same history).
+                self._preempt(h, e)
+                preempted.append(h)
+                continue
+            hist = list(h.request.prompt) + [int(t) for t in h.tokens]
+            d = [int(h.tokens[-1])]
+            if kk > 1:
+                if h.request.temperature <= 0.0:
+                    d += self._draft.propose(hist, kk - 1)
+                    # Count only candidates that COULD commit (the
+                    # budget caps acceptance near a request's tail) —
+                    # accept_rate measures draft quality, not budget
+                    # clipping.
+                    self.stats_counters["spec_drafted"] += n_pre - 1
+                else:
+                    d += [d[-1]] * (kk - 1)   # sampled: 1 commit max
+            drafts[slot] = d
+        if preempted:
+            active = [h for h in active if h not in preempted]
+            if not active:
+                return 0
+        tbl = np.zeros((self.num_slots, self.p_max), np.int32)
+        toks = np.zeros((self.num_slots, kk), np.int32)
+        for h in active:
+            tbl[h.slot] = self.manager.table_row(h.slot)
+            toks[h.slot] = drafts[h.slot]
+
+        t0 = time.perf_counter()
+        try:
+            with faults.on_op_call("spec_verify"):
+                cache = _dc.replace(self.cache,
+                                    block_table=jnp.asarray(tbl),
+                                    lens=jnp.asarray(self._lens),
+                                    live=jnp.asarray(self._live))
+                logits, self.cache = self._verify(
+                    self.engine.params, jnp.asarray(toks),
+                    jnp.asarray(budget), cache)
+                if self.timeout_s is not None:
+                    logits = block_until_ready(
+                        logits, timeout_s=self.timeout_s,
+                        op="serving.spec_verify",
+                        progress_fn=lambda: {
+                            "lens": self._lens.tolist(),
+                            "live": self._live.tolist(),
+                            "spec_k": kk,
+                            **{k: self.stats_counters[k] for k in
+                               ("decode_dispatches",
+                                "spec_accepted")}})
+            logits = np.asarray(logits)
+        except (CommTimeoutError, faults.InjectedFault) as e:
+            # A wedged collective or a dropped verification fails the
+            # scheduler's victim(s), never the server: no length
+            # mirror advanced, so survivors redo the identical
+            # dispatch token-exactly.
+            if isinstance(e, CommTimeoutError):
+                self.stats_counters["comm_timeouts"] += 1
+            for victim in self.sched.timeout_victims():
+                self._fail(victim,
+                           "timeout" if isinstance(e, CommTimeoutError)
+                           else "failed", e)
+            return 0
+        self.stats_counters["decode_time_s"] += time.perf_counter() - t0
+        self.stats_counters["decode_dispatches"] += 1
+
+        for h in active:
+            slot = h.slot
+            d = drafts[slot]
+            h.decode_steps += 1
+            greedy = h.request.temperature <= 0.0
+            if greedy:
+                picks = [int(np.argmax(logits[slot, j]))
+                         for j in range(kk)]
+                m = accept_greedy(d, picks)
+            else:
+                m = 1
+            m = min(m, int(budget[slot]))
+            if kk > 1 and greedy:
+                self.stats_counters["spec_accepted"] += m - 1
+            # Commit the accepted prefix BEFORE emitting (an emission
+            # may retire the request and free the slot's pages).
+            base = int(self._lens[slot])
+            self._lens[slot] = base + m
+            self.manager.truncate_to(slot, base + m)
+            self.stats_counters["decode_tokens"] += m
+            for j in range(m):
+                if h.done:
+                    break
+                tok = (picks[j] if greedy else
+                       self._pick(logits[slot, j], h.request,
+                                  len(h.tokens)))
+                self._emit(h, tok)
         return len(active)
 
     def _dispatch(self, tbl: np.ndarray) -> np.ndarray:

@@ -1,0 +1,87 @@
+"""Speculative decoding: draft proposal + greedy acceptance.
+
+The serving decode loop pays one fixed-shape dispatch per generated
+token. Speculative decoding amortizes that dispatch over several
+tokens: a cheap host-side DRAFT proposes K-1 candidate continuations,
+one K-token verification dispatch
+(:func:`~triton_dist_tpu.models.dense.verify_step_paged`) scores all
+of them at once, and the greedy acceptance rule commits exactly the
+tokens a sequential non-speculative greedy decode would have produced
+— speculation changes THROUGHPUT, never tokens.
+
+Greedy acceptance (the self-speculative / n-gram regime — no separate
+draft model, so no probability-ratio rejection sampling is needed):
+the dispatch feeds candidates ``d_1..d_K`` (``d_1`` is the pending
+token the non-spec loop would feed anyway) and returns per-position
+logits. ``t_1 = argmax(logits_1)`` is always exact and always emitted;
+``t_j`` (j ≥ 2) is emitted iff ``t_{j-1} == d_j`` — i.e. the draft
+predicted the token the model itself just produced, so position j's
+K/V and logits were computed on the true prefix. The first mismatch
+invalidates the draft's suffix: its K/V entries stay as masked garbage
+(lengths never advance over them; the next block overwrites the same
+offsets) and its page growth rolls back via
+``BlockManager.truncate_to``.
+
+The draft here is an N-GRAM self-proposer: look up the most recent
+earlier occurrence of the sequence's trailing n-gram and propose the
+tokens that followed it (falling back to shorter n-grams, then to
+repeating the last token). Free of any model state, deterministic,
+and effective exactly where decode is cheapest to accelerate — the
+repetitive spans (code, templated text, greedy loops) where one
+dispatch can commit several tokens.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["NgramDraft", "accept_greedy"]
+
+
+class NgramDraft:
+    """Self-speculative n-gram proposer over a request's own history
+    (prompt + generated tokens). ``n`` is the longest n-gram tried;
+    shorter grams are fallbacks, and when nothing matches the last
+    token repeats (the cheapest guess that still wins on loops)."""
+
+    def __init__(self, n: int = 3):
+        if n < 1:
+            raise ValueError(f"ngram n must be >= 1, got {n}")
+        self.n = n
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        """Propose ``k`` continuation tokens for ``history`` (which
+        already ends with the pending token the verify dispatch feeds
+        first). Deterministic: the MOST RECENT earlier match wins."""
+        if k <= 0:
+            return []
+        hist = list(history)
+        if not hist:
+            return [0] * k
+        for n in range(min(self.n, len(hist)), 0, -1):
+            tail = hist[-n:]
+            # Scan right-to-left for the most recent earlier match
+            # whose continuation exists; a short continuation CYCLES
+            # (the matched suffix is treated as a loop — exactly the
+            # structure greedy decode falls into).
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    seg = hist[i + n:]
+                    if seg:
+                        return [seg[j % len(seg)] for j in range(k)]
+        return [hist[-1]] * k
+
+
+def accept_greedy(draft: Sequence[int], greedy: Sequence[int]) -> int:
+    """How many tokens of a verification dispatch commit.
+
+    ``draft``: the K fed candidates ``d_1..d_K``; ``greedy``: the K
+    per-position argmax tokens ``t_1..t_K``. Returns ``m`` — the
+    number of EMITTED tokens (``t_1..t_m``), which equals the number
+    of fed candidates whose K/V stays valid: ``t_1`` always counts,
+    and each later ``t_j`` counts iff ``t_{j-1} == d_j``."""
+    k = len(draft)
+    m = 1
+    while m < k and greedy[m - 1] == draft[m]:
+        m += 1
+    return m
