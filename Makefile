@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke disagg-smoke spec-smoke apicheck ci bench-all
+	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke apicheck \
+	ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -66,6 +67,14 @@ disagg-smoke: csrc
 # (docs/serving.md quantization + speculation sections).
 spec-smoke: csrc
 	bash scripts/spec_smoke.sh
+
+# Fault-tolerance battery: retry/backoff + failover + checkpoint/
+# restore units, the seeded 200-tick chaos acceptance soak (invariant
+# checker every tick, survivors token-exact vs the fault-free oracle),
+# a chat-server kill/resume e2e, and the non-null
+# chaos_survived_faults bench gate (docs/resilience.md).
+chaos-smoke: csrc
+	bash scripts/chaos_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

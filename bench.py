@@ -232,6 +232,12 @@ def _clear_partials() -> None:
         pass
 
 
+# How many probe subprocesses the LAST _probe_backend call launched
+# (tier-0 smoke + tier-1 retries) — surfaced as detail.probe_attempts
+# so a record shows whether bring-up was clean or fought the tunnel.
+_PROBE_ATTEMPTS = 0
+
+
 def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
     """Retry backend bring-up in SUBPROCESSES (jax caches a failed
     backend for the life of the process, so in-process retries are
@@ -293,6 +299,8 @@ def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
         probe and then "measure" Mosaic kernels on the CPU backend.
         Require a non-CPU device — but report a completed CPU-only
         probe distinctly from a crash."""
+        global _PROBE_ATTEMPTS
+        _PROBE_ATTEMPTS += 1
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
@@ -347,23 +355,40 @@ def _probe_backend(budget_s: float, backoff_s: float) -> str | None:
     if verdict == "cpu":
         return _remember(err)
 
-    # Tier 1: the device-count probe under the full wall-clock budget.
+    # Tier 1: the device-count probe under the full wall-clock budget,
+    # retried through the shared RetryPolicy (resilience.policy): a
+    # down tunnel gets exponential backoff + seeded jitter across the
+    # 900 s budget instead of a fixed-cadence hammer, and one transient
+    # probe timeout no longer burns straight to a stale BENCH record.
+    from triton_dist_tpu.resilience.policy import RetryPolicy
+
+    class _ProbeRetry(Exception):
+        pass
+
     probe_code = (_CONFIG +
                   "d = jax.devices(); "
                   "print('PLATFORM=' + (d[0].platform if d else 'none'))")
-    t_end, first = time.monotonic() + budget_s, True
-    while first or time.monotonic() < t_end:
-        if not first:
-            time.sleep(backoff_s)
-        first = False
+    t_end = time.monotonic() + budget_s
+
+    def one_probe():
         verdict, err = _attempt(
             probe_code,
             max(min(probe_cap, t_end - time.monotonic()), 5.0))
-        if verdict == "ok":
-            return _remember(None)
-        if verdict == "cpu":
-            return _remember(err)
-    return _remember(err)
+        if verdict == "retry":
+            raise _ProbeRetry(err)
+        return verdict, err
+
+    policy = RetryPolicy(
+        max_attempts=max(int(budget_s / max(backoff_s, 1.0)) + 1, 2),
+        base_delay_s=backoff_s, multiplier=1.5,
+        max_delay_s=max(backoff_s * 8, backoff_s), jitter=0.25, seed=0)
+    try:
+        (verdict, err), _ = policy.call(
+            one_probe, op="bench.backend_probe",
+            retry_on=(_ProbeRetry,), deadline_s=budget_s)
+    except _ProbeRetry as e:
+        return _remember(str(e) or "probe retries exhausted")
+    return _remember(None if verdict == "ok" else err)
 
 
 def _interpret_megakernel_times() -> dict:
@@ -597,6 +622,48 @@ def _interpret_ep_times() -> dict:
                                   "experts": e}}
 
 
+def _interpret_chaos() -> dict:
+    """A short seeded chaos soak through the fault-tolerant serving
+    stack on the CPU mesh — the ``detail.chaos_survived_faults``
+    surface (non-null gate in scripts/chaos_smoke.sh): seeded mixed
+    traffic + injected dropped/wedged migrations, chunk faults, decode
+    faults and a worker kill, with the invariant checker after every
+    tick and token-exactness vs the fault-free oracle. A completed
+    soak IS the result — any violation raises and nulls the keys."""
+    import jax
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.resilience import chaos
+    from triton_dist_tpu.resilience.policy import RetryPolicy
+    from triton_dist_tpu.serving import DisaggServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=4,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+    def factory():
+        eng = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+        return DisaggServingEngine(
+            eng, num_slots=2, page=8, prefill_buckets=(4, 8),
+            prefix_reuse=True, retry=RetryPolicy(max_attempts=2),
+            worker_fail_threshold=2)
+
+    rep = chaos.run_soak(factory, seed=11, ticks=40, n_faults=5,
+                         restore_at=18)
+    return {
+        "chaos_survived_faults": rep.survived_faults,
+        "chaos_ticks": rep.ticks,
+        "chaos_requests": rep.requests,
+        "chaos_retries": rep.counters["retries"],
+        "chaos_failovers": rep.counters["failovers"],
+        "chaos_restored_requests": rep.counters["restored_requests"],
+        "chaos_invariant_checks": rep.invariant_checks,
+    }
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -671,6 +738,11 @@ def _interpret_bench(reason: str) -> None:
         ep = _interpret_ep_times()
     except Exception as e:  # ep bench must not sink the record
         ep = {"ep_dispatch_ms": None, "ep_error": str(e)[:200]}
+    try:
+        ch = _interpret_chaos()
+    except Exception as e:  # chaos soak must not sink the record
+        ch = {"chaos_survived_faults": None,
+              "chaos_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -681,6 +753,7 @@ def _interpret_bench(reason: str) -> None:
             "interpret_mode": True,
             "backend_unavailable": True,
             "probe_verdict": reason,
+            "probe_attempts": _PROBE_ATTEMPTS,
             "measured_at_unix": int(time.time()),
             "sim_ranks": sim,
             "ag_gemm_ms": round(times["ag_gemm"] * 1e3, 3),
@@ -692,6 +765,7 @@ def _interpret_bench(reason: str) -> None:
             **mk,
             **sv,
             **ep,
+            **ch,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),
@@ -718,6 +792,7 @@ def _emit_unavailable(error: str, attempts) -> None:
         "detail": {
             "backend_unavailable": True,
             "stale": True,
+            "probe_attempts": _PROBE_ATTEMPTS,
             "stale_source": src,
             "stale_value": (last or {}).get("value"),
             "stale_vs_baseline": (last or {}).get("vs_baseline"),
@@ -1015,6 +1090,7 @@ def main():
             # actually measured — a mid-round measurement is fresh
             # evidence, not round-1 leftovers.
             "measured_at_unix": int(time.time()),
+            "probe_attempts": _PROBE_ATTEMPTS,
             "devices": n,
             "sim_ranks": (SIM_RANKS if sim else None),
             "gemm_rs_sim": bool(rs_sim_used),

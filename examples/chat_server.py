@@ -70,6 +70,18 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="--spec: candidates per verification "
                          "dispatch (static K; jit cache stays flat)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="layer path: snapshot the full serving state "
+                         "(paged pools + scales, allocator, queue, "
+                         "counters) here on SIGTERM, and RESUME from "
+                         "an existing snapshot on startup — restored "
+                         "requests finish token-exact mid-stream "
+                         "(docs/serving.md, checkpoint/restore)")
+    ap.add_argument("--checkpoint-after", type=int, default=0,
+                    help="drill flag for the SIGTERM path: checkpoint "
+                         "and exit through the same code path after N "
+                         "tokens generated this process (deterministic "
+                         "— scripts/chaos_smoke.sh uses it)")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -107,6 +119,13 @@ def main():
         sys.exit("--kv-quant/--spec are layer-path knobs; the "
                  "megakernel decode lane has no per-page scale or "
                  "verification plumbing (see docs/serving.md)")
+    if args.megakernel and (args.checkpoint_dir or args.checkpoint_after):
+        sys.exit("--checkpoint-dir is a layer-path feature; the "
+                 "megakernel's KV lives in its in-kernel arena "
+                 "(see docs/serving.md)")
+    if args.checkpoint_after and not args.checkpoint_dir:
+        sys.exit("--checkpoint-after needs --checkpoint-dir (it is the "
+                 "deterministic drill for that snapshot path)")
     # Layer-path serving knobs shared by every engine construction
     # below: quantized KV pools and/or speculative decode.
     serve_kw = dict(kv_dtype=args.kv_quant,
@@ -201,6 +220,71 @@ def main():
         srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
                             **serve_kw)
 
+    # Checkpoint/restore wiring (layer path): a SIGTERM mid-serve
+    # snapshots the full serving state between ticks; a restart with
+    # the same flags resumes every in-flight request token-exact.
+    ckpt_path = None
+    stop = {"flag": False, "serving": False}
+
+    def _snapshot_and_exit():
+        from triton_dist_tpu.serving.server import save_checkpoint
+
+        save_checkpoint(srv.checkpoint(), ckpt_path)
+        inflight = len(srv.sched.queue) + len(srv.sched.slots)
+        print(f"\ncheckpointed {inflight} in-flight "
+              f"request(s) to {ckpt_path}", flush=True)
+        sys.exit(0)
+
+    if args.checkpoint_dir:
+        import signal
+
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(args.checkpoint_dir, "serving.ckpt")
+
+        def _on_term(signum, frame):
+            # Mid-serve: only set the flag — the snapshot happens at
+            # the next tick boundary where the state is consistent.
+            # Idle (blocked on stdin): the engine IS at a boundary, so
+            # snapshot and exit right here — otherwise Python's EINTR
+            # retry resumes the readline and the signal is swallowed.
+            stop["flag"] = True
+            if not stop["serving"]:
+                _snapshot_and_exit()
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    def _checkpoint_tick():
+        done_here = (srv.stats_counters["tokens_generated"]
+                     - tokens_at_start)
+        if ckpt_path and (stop["flag"] or (
+                args.checkpoint_after
+                and done_here >= args.checkpoint_after)):
+            _snapshot_and_exit()
+
+    def run_serving():
+        stop["serving"] = True
+        try:
+            srv.run(on_tick=_checkpoint_tick)
+        finally:
+            stop["serving"] = False
+
+    restored_handles = []
+    if ckpt_path and os.path.exists(ckpt_path):
+        from triton_dist_tpu.serving.server import load_checkpoint
+
+        restored_handles = srv.restore(load_checkpoint(ckpt_path))
+        os.remove(ckpt_path)   # consumed; SIGTERM writes a fresh one
+        print(f"restored {len(restored_handles)} in-flight "
+              f"request(s) from {ckpt_path}", flush=True)
+    tokens_at_start = srv.stats_counters["tokens_generated"]
+    if restored_handles:
+        run_serving()
+        for h in restored_handles:
+            # FULL token list (pre-kill + post-restore) — the
+            # token-exactness gate diffs this against a clean run.
+            print(f"[restored {h.request.request_id}] "
+                  + " ".join(str(t) for t in h.tokens), flush=True)
+
     print(f"serving {cfg.model_name} (vocab {cfg.vocab_size}); one "
           "prompt of space-separated token ids per line:", flush=True)
     for lineno, line in enumerate(sys.stdin, 1):
@@ -226,7 +310,7 @@ def main():
             # keep the server alive (old behaviour, same message spot).
             print(f" [skipped: {e}]", flush=True)
             continue
-        srv.run()
+        run_serving()
         print(flush=True)
 
     # One-line serving summary on exit — the load data used to be
@@ -248,6 +332,11 @@ def main():
     if st.get("kv_dtype") not in (None, "bf16"):
         line += (f", kv_dtype={st['kv_dtype']} "
                  f"({st['kv_bytes_per_token']:.0f} B/token)")
+    if (st["retries"] or st["failovers"] or st["restored_requests"]
+            or args.checkpoint_dir):
+        line += (f", ft: retries={st['retries']} "
+                 f"failovers={st['failovers']} "
+                 f"restored={st['restored_requests']}")
     if st.get("spec"):
         sp = st["spec"]
         rate = sp["accept_rate"]

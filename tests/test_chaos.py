@@ -1,0 +1,197 @@
+"""Chaos soak battery: seeded fault schedules over live serving
+traffic, gated on the invariant checker.
+
+The fast deterministic subset runs in tier-1 (seconds); the full
+acceptance soak — 200+ ticks, >= 10 injected faults across every
+fault family incl. a worker kill and a mid-run checkpoint/restore —
+is marked ``slow`` (it is the `make chaos-smoke` / release gate).
+A failing soak replays bit-for-bit from its seed.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig, dense
+from triton_dist_tpu.resilience import chaos
+from triton_dist_tpu.resilience.policy import RetryPolicy
+from triton_dist_tpu.serving import DisaggServingEngine, ServingEngine
+
+TINY = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                        intermediate_size=32, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=4,
+                        head_dim=8)
+CFG = ModelConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def tiny_factory():
+    """Colocated two-role serving over the tiny model on one device —
+    the cheap soak target (chunked prefill + local migration + retry +
+    failover all reachable)."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+    def factory():
+        eng = Engine(TINY, mesh, mode="xla", max_len=32, seed=0)
+        return DisaggServingEngine(
+            eng, num_slots=2, page=8, prefill_buckets=(4, 8),
+            prefix_reuse=True, retry=RetryPolicy(max_attempts=2),
+            worker_fail_threshold=2)
+
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# Invariant checker units: a checker that cannot fail gates nothing.
+# ---------------------------------------------------------------------------
+
+def _live_engine():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(TINY, mesh, mode="xla", max_len=32, seed=0)
+    srv = ServingEngine(eng, num_slots=2, page=8, prefix_reuse=True)
+    srv.submit([1, 2, 3], max_new_tokens=6)
+    srv.submit([4, 5], max_new_tokens=6)
+    for _ in range(2):
+        srv.step()
+    return srv
+
+
+def test_checker_passes_on_healthy_engine():
+    srv = _live_engine()
+    chaos.check_invariants(srv)
+    srv.run()
+    chaos.check_invariants(srv)
+
+
+def test_checker_catches_leaked_page():
+    srv = _live_engine()
+    # simulate a leak: a page vanishes from the free list with no ref
+    srv.manager._free.pop()
+    with pytest.raises(chaos.InvariantViolation, match="LEAKED"):
+        chaos.check_invariants(srv)
+
+
+def test_checker_catches_refcount_drift():
+    srv = _live_engine()
+    slot = next(iter(srv.manager._slot_pages))
+    pid = srv.manager._slot_pages[slot][0]
+    srv.manager._refs[pid] += 1
+    with pytest.raises(chaos.InvariantViolation, match="refcount"):
+        chaos.check_invariants(srv)
+
+
+def test_checker_catches_mirror_drift():
+    srv = _live_engine()
+    slot = next(iter(srv.sched.slots))
+    srv._lens[slot] += 3
+    with pytest.raises(chaos.InvariantViolation, match="mirror"):
+        chaos.check_invariants(srv)
+
+
+def test_checker_catches_staged_published_overlap():
+    srv = _live_engine()
+    mgr = srv.manager
+    slot = next(iter(mgr._slot_pages))
+    pid = mgr._slot_pages[slot][0]
+    key = next(iter(mgr._prefix)) if mgr._prefix else ("k",)
+    mgr._pending_prefix[slot] = [(key, pid)]
+    mgr._prefix[key] = pid
+    mgr._refs[pid] += 1
+    with pytest.raises(chaos.InvariantViolation):
+        chaos.check_invariants(srv)
+
+
+# ---------------------------------------------------------------------------
+# Seeded soaks (fast tier-1 subset)
+# ---------------------------------------------------------------------------
+
+def test_soak_replays_bit_for_bit(tiny_factory):
+    a = chaos.run_soak(tiny_factory, seed=3, ticks=25, n_faults=3)
+    b = chaos.run_soak(tiny_factory, seed=3, ticks=25, n_faults=3)
+    assert [dataclasses.astuple(e) for e in a.events] == [
+        dataclasses.astuple(e) for e in b.events]
+    assert a.requests == b.requests
+    assert a.counters == b.counters
+
+
+def test_soak_fast_mixed_faults(tiny_factory):
+    rep = chaos.run_soak(tiny_factory, seed=7, ticks=60, n_faults=6)
+    assert rep.faults_injected == 6
+    assert rep.survived_faults == 6
+    assert rep.requests["submitted"] > 0
+    total = sum(rep.requests[k] for k in ("done", "failed", "timeout"))
+    assert total == rep.requests["submitted"], "all terminal"
+    assert rep.token_exact_requests == rep.requests["done"]
+    assert rep.invariant_checks >= rep.ticks
+
+
+def test_soak_with_midrun_restore(tiny_factory):
+    rep = chaos.run_soak(tiny_factory, seed=7, ticks=60, n_faults=6,
+                         restore_at=25)
+    assert rep.restored_at == 25
+    assert rep.survived_faults == 6
+    assert rep.token_exact_requests == rep.requests["done"]
+
+
+def test_soak_worker_kill_only(tiny_factory):
+    """Pin the schedule to the dead-prefill-worker event — failover
+    must fire and the run still resolves token-exact."""
+    kinds = [("kill_prefill_worker", None, None)]
+    rep = chaos.run_soak(tiny_factory, seed=5, ticks=40, n_faults=2,
+                         kinds=kinds)
+    assert rep.counters["failovers"] >= 1
+    assert rep.token_exact_requests == rep.requests["done"]
+
+
+def test_soak_rejects_megakernel():
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4,
+                           num_key_value_heads=2, head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+    def factory():
+        return ServingEngine(MegaKernelEngine(cfg, mesh, batch=2,
+                                              max_len=32, tile_w=16,
+                                              t_tile=16))
+
+    with pytest.raises(NotImplementedError):
+        chaos.run_soak(factory, seed=0, ticks=2, n_faults=0)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance soak (slow tier): 200+ ticks, >= 10 faults, split
+# roles, mid-run kill/restore.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_acceptance_200_ticks_disjoint_roles():
+    params = dense.init_params(jax.random.PRNGKey(3), CFG)
+    devs = jax.devices()
+
+    def factory():
+        pf = Engine(CFG, Mesh(np.array(devs[:2]), ("tp",)),
+                    mode="xla", max_len=64, params=params)
+        dec = Engine(CFG, Mesh(np.array(devs[2:4]), ("tp",)),
+                     mode="xla", max_len=64, params=params)
+        return DisaggServingEngine(
+            dec, prefill_engine=pf, num_slots=2, page=8,
+            prefill_buckets=(4, 16), prefix_reuse=True,
+            retry=RetryPolicy(max_attempts=2),
+            worker_fail_threshold=2)
+
+    rep = chaos.run_soak(factory, seed=17, ticks=200, n_faults=12,
+                         restore_at=90)
+    assert rep.faults_injected >= 10
+    assert rep.survived_faults >= 10
+    assert rep.restored_at == 90
+    total = sum(rep.requests[k] for k in ("done", "failed", "timeout"))
+    assert total == rep.requests["submitted"]
+    assert rep.token_exact_requests == rep.requests["done"]
+    assert rep.invariant_checks >= 200

@@ -150,7 +150,7 @@ _P2P_HOST_CACHE = CompiledCache(16)
 
 
 def migrate_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
-                       src: int = 0, dst: int = 1):
+                       src: int = 0, dst: int = 1, retry=None):
     """KV page migration for disaggregated serving: one-sided put of a
     whole-page payload from the ``src`` role rank to ``dst`` along a
     bridge mesh's ``axis`` (prefill worker → decode worker).
@@ -165,23 +165,45 @@ def migrate_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
     edge — the same one-sided transport the pipeline layers use, fault
     plans and the XLA fallback policy included. Returns the (k, v)
     payloads as received at ``dst`` (numpy).
+
+    ``retry``: an optional :class:`~triton_dist_tpu.resilience.policy.
+    RetryPolicy` replaying the put-and-readback under deterministic
+    backoff before surfacing a failure — safe because the transfer is
+    idempotent (same bytes, same edge, fresh staging each attempt).
+    The serving engine drives its own wider retry scope (fault hooks
+    included) and leaves this ``None``; direct callers get the same
+    containment here.
     """
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_roles = mesh.shape[axis]
     perm = ((int(src), int(dst)),)
-    # K and V ride ONE put (stacked leading dim): the handoff sits on
-    # the serving loop's critical path, so one dispatch + one staging
-    # buffer, not two. Only the dst slab is pulled back to host.
-    p = np.stack([np.asarray(k_payload), np.asarray(v_payload)])
-    x = np.zeros((n_roles,) + p.shape, p.dtype)
-    x[src] = p
-    xd = jax.device_put(
-        jnp.asarray(x), NamedSharding(mesh, P(axis, *([None] * p.ndim))))
-    out = p2p_put_host(xd, perm, mesh, axis=axis)
-    got = np.asarray(out[dst])
-    return got[0], got[1]
+
+    def _once():
+        # K and V ride ONE put (stacked leading dim): the handoff sits
+        # on the serving loop's critical path, so one dispatch + one
+        # staging buffer, not two. Only the dst slab is pulled to host.
+        p = np.stack([np.asarray(k_payload), np.asarray(v_payload)])
+        x = np.zeros((n_roles,) + p.shape, p.dtype)
+        x[src] = p
+        xd = jax.device_put(
+            jnp.asarray(x),
+            NamedSharding(mesh, P(axis, *([None] * p.ndim))))
+        out = p2p_put_host(xd, perm, mesh, axis=axis)
+        got = np.asarray(out[dst])
+        return got[0], got[1]
+
+    if retry is None:
+        return _once()
+    from triton_dist_tpu.resilience import faults
+    from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+    # Transients only: a shape/mesh logic error must propagate on the
+    # first attempt, not replay through the full backoff schedule.
+    return retry.run(_once, op="p2p.migrate_pages_host",
+                     retry_on=(CommTimeoutError, faults.InjectedFault,
+                               TimeoutError))
 
 
 def p2p_put_host(x, perm: Sequence[Tuple[int, int]], mesh, *,

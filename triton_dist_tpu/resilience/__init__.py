@@ -19,6 +19,11 @@ Three layers:
 
 ``harness`` runs deadlock-prone fault plans in a subprocess with a hard
 deadline (a wedged interpreter thread cannot be cancelled in-process).
+:mod:`~triton_dist_tpu.resilience.chaos` composes the registry into a
+seeded SOAK over live serving traffic — randomized fault schedules
+with an invariant sweep after every tick and token-exactness vs the
+fault-free oracle (imported lazily: ``from triton_dist_tpu.resilience
+import chaos``).
 """
 
 from triton_dist_tpu.resilience.faults import (  # noqa: F401
@@ -34,11 +39,13 @@ from triton_dist_tpu.resilience.faults import (  # noqa: F401
 )
 from triton_dist_tpu.resilience.watchdog import (  # noqa: F401
     CommTimeoutError,
+    HealthTracker,
     Watchdog,
     block_until_ready,
 )
 from triton_dist_tpu.resilience.policy import (  # noqa: F401
     FallbackPolicy,
+    RetryPolicy,
     health_probe,
     note_failure,
     reset as reset_policy,

@@ -1,0 +1,396 @@
+"""Seeded chaos soak for the serving stack: randomized (but
+seed-reproducible) fault schedules over a long mixed-traffic run, with
+a full invariant sweep after every tick.
+
+The fault-plan registry (:mod:`~triton_dist_tpu.resilience.faults`)
+makes single failures injectable; this module composes them into a
+SOAK — the test shape production incidents actually have: transients
+and hard faults arriving at random points of a live workload, workers
+dying mid-stream, the process checkpointing and restarting in the
+middle. One ``seed`` fixes the arrival trace, every fault's tick and
+kind, and every retry-backoff jitter, so a failing soak replays
+bit-for-bit.
+
+What a passing soak proves (the checker raises
+:class:`InvariantViolation` otherwise):
+
+- **no leaked pages** — every page is free xor referenced, refcounts
+  equal the observable holders (slot lists + the prefix cache's own
+  ref), free list has no duplicates, the scratch page is never
+  allocated;
+- **prefix publication is sound** — committed (published) entries are
+  content-resident by construction of the two-phase protocol, and no
+  page is simultaneously staged and published;
+- **host mirrors cohere** — slot/handle bijection, live mask, and the
+  length mirrors agree with the allocator's token accounting (up to
+  the bounded skew a failed tick's idempotent pre-append leaves);
+- **every submitted request terminally resolves** — done, failed, or
+  timeout; nothing wedges or leaks a slot;
+- **survivors are token-exact** — every ``done`` request's tokens
+  equal the fault-free oracle (``Engine.serve`` on the same weights).
+
+Usage (the tier-1 subset in ``tests/test_chaos.py`` and the
+``chaos_survived_faults`` bench key both drive this)::
+
+    from triton_dist_tpu.resilience import chaos
+    report = chaos.run_soak(make_engine, seed=7, ticks=200,
+                            n_faults=12, restore_at=90)
+    assert report.survived_faults >= 10
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from triton_dist_tpu.resilience import faults
+
+__all__ = ["ChaosEvent", "ChaosReport", "InvariantViolation",
+           "DEFAULT_FAULT_KINDS", "check_invariants", "run_soak"]
+
+
+class InvariantViolation(AssertionError):
+    """A serving invariant broke under the soak — the bug class this
+    harness exists to catch (leaked page, drifted refcount, corrupted
+    mirror, unresolved request, token divergence)."""
+
+
+# (name, op, fault_kind): the injectable menu. ``fail_call`` models a
+# dropped transfer/dispatch; ``timeout_call`` a wedged one (the
+# deterministic watchdog-miss stand-in — see faults.py); transient
+# events target only the FIRST call of the tick (k=0: absorbed by one
+# retry), hard events every call of the tick (k=None: retries exhaust,
+# containment/failover takes over). ``kill_prefill_worker`` is the
+# dead-role event (DisaggServingEngine.fail_prefill_worker).
+DEFAULT_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
+                           ...] = (
+    ("drop_migration", "page_migration", "fail_call"),
+    ("wedge_migration", "page_migration", "timeout_call"),
+    ("drop_chunk", "chunked_prefill", "fail_call"),
+    ("delay_chunk", "chunked_prefill", "timeout_call"),
+    ("drop_decode", "serving_decode", "fail_call"),
+    ("wedge_decode", "serving_decode", "timeout_call"),
+    ("kill_prefill_worker", None, None),
+)
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One scheduled fault: where, what, and what it observably did."""
+
+    tick: int
+    name: str
+    op: Optional[str]
+    kind: Optional[str]       # fail_call | timeout_call | None (kill)
+    transient: bool
+    fired: bool = False       # the fault had a chance to act this tick
+    observed: bool = False    # a failure/retry counter moved this tick
+
+
+@dataclasses.dataclass
+class ChaosReport:
+    """What a completed soak measured (a completed soak already means:
+    server alive, invariants held every tick, all requests terminal,
+    survivors token-exact — violations raise instead)."""
+
+    seed: int
+    ticks: int
+    events: List[ChaosEvent]
+    faults_injected: int
+    survived_faults: int
+    requests: Dict[str, int]
+    counters: Dict[str, int]
+    invariant_checks: int
+    token_exact_requests: int
+    restored_at: Optional[int]
+
+
+# ---------------------------------------------------------------------------
+# Invariant checker
+# ---------------------------------------------------------------------------
+
+def _check_manager(mgr, name: str) -> None:
+    from triton_dist_tpu.serving.blocks import SCRATCH_PAGE
+
+    free = list(mgr._free)
+    if len(set(free)) != len(free):
+        raise InvariantViolation(
+            f"[{name}] duplicate page ids on the free list: {free}")
+    if SCRATCH_PAGE in free:
+        raise InvariantViolation(
+            f"[{name}] the reserved scratch page leaked into the free "
+            "list")
+    held = Counter(pid for pages in mgr._slot_pages.values()
+                   for pid in pages)
+    if SCRATCH_PAGE in held:
+        raise InvariantViolation(
+            f"[{name}] the scratch page was allocated to a slot")
+    prefix_pids = set(mgr._prefix.values())
+    free_set = set(free)
+    for pid in range(1, mgr.num_pages):
+        want = held.get(pid, 0) + (1 if pid in prefix_pids else 0)
+        have = mgr._refs.get(pid, 0)
+        if have != want:
+            raise InvariantViolation(
+                f"[{name}] page {pid} refcount {have} != observable "
+                f"holders {want} (slots={held.get(pid, 0)}, "
+                f"prefix={pid in prefix_pids})")
+        if (pid in free_set) == (want > 0):
+            raise InvariantViolation(
+                f"[{name}] page {pid} {'free but referenced' if want else 'unreferenced but not free — LEAKED'}")
+    if len(free) + len(mgr._refs) != mgr.num_pages - 1:
+        raise InvariantViolation(
+            f"[{name}] page accounting broke: {len(free)} free + "
+            f"{len(mgr._refs)} referenced != {mgr.num_pages - 1} "
+            "usable pages")
+    staged = {pid for pairs in mgr._pending_prefix.values()
+              for _, pid in pairs}
+    if staged & prefix_pids:
+        raise InvariantViolation(
+            f"[{name}] page(s) {staged & prefix_pids} both staged and "
+            "published — the two-phase prefix protocol broke")
+    for slot, pairs in mgr._pending_prefix.items():
+        owned = set(mgr._slot_pages.get(slot, []))
+        for _, pid in pairs:
+            if pid not in owned:
+                raise InvariantViolation(
+                    f"[{name}] staged prefix page {pid} not owned by "
+                    f"its staging slot {slot}")
+    for slot, n_tok in mgr._slot_tokens.items():
+        cap = len(mgr._slot_pages.get(slot, [])) * mgr.page
+        if n_tok > cap:
+            raise InvariantViolation(
+                f"[{name}] slot {slot} accounts {n_tok} tokens over "
+                f"{cap} allocated-page capacity")
+
+
+def check_invariants(srv) -> None:
+    """One full sweep of the serving invariants (see module
+    docstring). Call between ticks — the structures are host-side, so
+    this never syncs the device."""
+    if srv.manager is not None:
+        _check_manager(srv.manager, "decode-pool")
+    workers = getattr(srv, "prefill_workers", None) or []
+    for i, w in enumerate(workers):
+        if not w.dead and w.manager is not srv.manager:
+            _check_manager(w.manager, f"prefill-pool[{i}]")
+    spec_slack = max(1, getattr(srv, "spec_k", 0) or 0)
+    for s in range(srv.num_slots):
+        h = srv.sched.slots.get(s)
+        if h is None:
+            if srv._live[s] != 0:
+                raise InvariantViolation(
+                    f"slot {s} live={srv._live[s]} with no handle")
+            continue
+        if h.slot != s:
+            raise InvariantViolation(
+                f"slot {s} handle claims slot {h.slot}")
+        if h.status == "running":
+            if srv._live[s] != 1:
+                raise InvariantViolation(
+                    f"running slot {s} has live={srv._live[s]}")
+            want = len(h.request.prompt) + len(h.tokens) - 1
+            if srv._lens[s] != want:
+                raise InvariantViolation(
+                    f"slot {s} length mirror {srv._lens[s]} != "
+                    f"prompt+generated-fed {want}")
+            if srv.manager is not None:
+                n = srv.manager._slot_tokens.get(s)
+                if n is None or not (srv._lens[s] <= n
+                                     <= srv._lens[s] + spec_slack):
+                    raise InvariantViolation(
+                        f"slot {s} allocator tokens {n} drifted from "
+                        f"length mirror {srv._lens[s]} (allowed slack "
+                        f"{spec_slack})")
+        elif h.status in ("prefill", "migrating"):
+            if srv._live[s] != 0 and not srv.mega:
+                raise InvariantViolation(
+                    f"parked ({h.status}) slot {s} is marked live")
+        else:
+            raise InvariantViolation(
+                f"slot {s} holds a terminal handle ({h.status})")
+    for h in srv.sched.queue:
+        if h.slot is not None:
+            raise InvariantViolation(
+                f"queued request {h.request.request_id} still holds "
+                f"slot {h.slot}")
+
+
+# ---------------------------------------------------------------------------
+# The soak
+# ---------------------------------------------------------------------------
+
+def _oracle_tokens(engine, prompt: Sequence[int], gen_len: int,
+                   cache: Dict) -> List[int]:
+    import jax.numpy as jnp
+
+    key = (tuple(prompt), gen_len)
+    if key not in cache:
+        n = engine.mesh.shape[engine.axis]
+        ids = np.tile(np.asarray([list(prompt)], np.int32), (n, 1))
+        cache[key] = np.asarray(
+            engine.serve(jnp.asarray(ids),
+                         gen_len=gen_len))[0].tolist()
+    return cache[key]
+
+
+def _plan_for(ev: ChaosEvent) -> faults.FaultPlan:
+    k = 0 if ev.transient else None
+    return faults.FaultPlan(
+        name=f"chaos-{ev.name}",
+        faults=(faults.Fault(ev.kind, op=ev.op, k=k),))
+
+
+def run_soak(factory: Callable[[], object], *, seed: int = 0,
+             ticks: int = 200, n_faults: int = 10,
+             arrival_p: float = 0.35,
+             kinds: Sequence = DEFAULT_FAULT_KINDS,
+             transient_p: float = 0.5,
+             gen_choices: Sequence[int] = (2, 3, 4, 6, 8),
+             prompt_reuse_p: float = 0.3,
+             restore_at: Optional[int] = None,
+             max_drain_steps: Optional[int] = None) -> ChaosReport:
+    """Drive ``ticks`` serving steps of seeded mixed traffic under
+    ``n_faults`` seeded fault events, checking every invariant after
+    every tick, then drain fault-free and verify terminal resolution +
+    token-exactness of all survivors against the fault-free oracle.
+
+    ``factory`` builds the serving engine (a fresh, identically-
+    configured one each call — ``restore_at`` uses it again for the
+    mid-soak kill/checkpoint/restore drill). Greedy traffic only (the
+    exactness oracle is ``Engine.serve``). Raises
+    :class:`InvariantViolation` (or the server's own crash) on any
+    violation; returns a :class:`ChaosReport` otherwise.
+    """
+    rng = np.random.RandomState(seed)
+    srv = factory()
+    if srv.mega:
+        raise NotImplementedError(
+            "the chaos soak drives the layer serving path")
+    vocab = srv.cfg.vocab_size
+    cap = min(srv.p_max * srv.page, srv.max_len)
+    max_gen = max(g for g in gen_choices)
+    max_prompt = max(1, min(12, cap - max_gen - 1))
+    kinds = list(kinds)
+    fault_ticks = sorted(
+        int(t) for t in rng.choice(np.arange(1, max(ticks, 2)),
+                                   size=min(n_faults, ticks - 1),
+                                   replace=False))
+    schedule: Dict[int, ChaosEvent] = {}
+    for t in fault_ticks:
+        name, op, kind = kinds[int(rng.randint(len(kinds)))]
+        schedule[t] = ChaosEvent(
+            tick=t, name=name, op=op, kind=kind,
+            transient=bool(rng.rand() < transient_p))
+
+    tracked: List[Tuple[Tuple[int, ...], int, object]] = []
+    prior_prompts: List[List[int]] = []
+    oracle_cache: Dict = {}
+    invariant_checks = 0
+    restored_tick = None
+
+    def _submit_maybe():
+        nonlocal prior_prompts
+        if rng.rand() >= arrival_p:
+            return
+        if prior_prompts and rng.rand() < prompt_reuse_p:
+            prompt = list(prior_prompts[
+                int(rng.randint(len(prior_prompts)))])
+        else:
+            n = int(rng.randint(1, max_prompt + 1))
+            prompt = [int(x) for x in rng.randint(0, vocab, n)]
+            prior_prompts.append(prompt)
+        gen = int(gen_choices[int(rng.randint(len(gen_choices)))])
+        from triton_dist_tpu.serving.scheduler import QueueFullError
+
+        try:
+            h = srv.submit(prompt, max_new_tokens=gen)
+        except QueueFullError:
+            return      # backpressure is correct behaviour, not a bug
+        tracked.append((tuple(prompt), gen, h))
+
+    def _tick_counters():
+        return {k: srv.stats_counters[k] for k in
+                ("retries", "comm_timeouts", "failovers")} | {
+                    k: srv.sched.counters[k] for k in
+                    ("failed", "timed_out")}
+
+    for tick in range(ticks):
+        if restore_at is not None and tick == restore_at:
+            # The mid-run kill/restore drill: snapshot, throw the
+            # engine away, restore into a fresh one (same weights by
+            # construction of the factory), rebind tracked handles.
+            snap = srv.checkpoint()
+            srv = factory()
+            revived = {h.request.request_id: h
+                       for h in srv.restore(snap)}
+            tracked = [(p, g, revived.get(h.request.request_id, h))
+                       for p, g, h in tracked]
+            restored_tick = tick
+        _submit_maybe()
+        ev = schedule.get(tick)
+        if ev is None:
+            srv.step()
+        elif ev.name == "kill_prefill_worker":
+            killed = bool(getattr(srv, "fail_prefill_worker",
+                                  lambda: False)())
+            ev.fired, ev.observed = True, killed
+            srv.step()
+        else:
+            before = _tick_counters()
+            with faults.inject(_plan_for(ev)):
+                srv.step()
+            ev.fired = True
+            ev.observed = _tick_counters() != before
+        check_invariants(srv)
+        invariant_checks += 1
+
+    # Drain fault-free: everything still in flight must resolve.
+    budget = max_drain_steps or (ticks * 4 + 200)
+    for _ in range(budget):
+        if srv._drained():
+            break
+        srv.step()
+        check_invariants(srv)
+        invariant_checks += 1
+    else:
+        raise InvariantViolation(
+            f"serving loop failed to drain within {budget} post-soak "
+            f"steps (queue={len(srv.sched.queue)}, "
+            f"slots={sorted(srv.sched.slots)})")
+
+    statuses = Counter(h.status for _, _, h in tracked)
+    unresolved = [h.request.request_id for _, _, h in tracked
+                  if not h.done]
+    if unresolved:
+        raise InvariantViolation(
+            f"request(s) never terminally resolved: {unresolved}")
+    token_exact = 0
+    for prompt, gen, h in tracked:
+        if h.status != "done":
+            continue
+        want = _oracle_tokens(srv.engine, prompt, gen, oracle_cache)
+        if list(h.tokens) != list(want):
+            raise InvariantViolation(
+                f"survivor {h.request.request_id} diverged from the "
+                f"fault-free oracle: {h.tokens} != {want} "
+                f"(prompt={list(prompt)})")
+        token_exact += 1
+
+    events = [schedule[t] for t in fault_ticks]
+    return ChaosReport(
+        seed=seed, ticks=ticks, events=events,
+        faults_injected=len(events),
+        survived_faults=sum(1 for e in events if e.fired),
+        requests={"submitted": len(tracked), **{
+            k: statuses.get(k, 0)
+            for k in ("done", "failed", "timeout")}},
+        counters={k: srv.stats_counters[k] for k in
+                  ("retries", "failovers", "comm_timeouts",
+                   "preemptions", "restored_requests")},
+        invariant_checks=invariant_checks,
+        token_exact_requests=token_exact,
+        restored_at=restored_tick)

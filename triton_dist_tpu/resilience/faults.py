@@ -44,6 +44,14 @@ Fault kinds (``Fault.kind``):
   (the builder suppresses exactly one edge's signal per plan).
 - ``"fail_call"``  — the ``k``-th host-level call of ``op`` raises
   :class:`InjectedFault` (drives the watchdog / fallback machinery).
+- ``"timeout_call"`` — the ``k``-th host-level call of ``op`` raises a
+  :class:`~triton_dist_tpu.resilience.watchdog.CommTimeoutError`
+  directly: the deterministic stand-in for "the transfer wedged and
+  the watchdog fired" (a real wedge leaks an uncancellable worker
+  thread — see the watchdog caveat — so soak-style tests inject the
+  *detected* outcome instead; the genuine-deadlock plans stay in the
+  subprocess harness). The serving retry/backoff and containment
+  paths treat it exactly like a watchdog miss.
 """
 
 from __future__ import annotations
@@ -175,6 +183,15 @@ def on_op_call(op: str):
     for f in plan.faults_of("fail_call", op):
         if f.k is None or f.k == idx:
             raise InjectedFault(op, idx)
+    for f in plan.faults_of("timeout_call", op):
+        if f.k is None or f.k == idx:
+            from triton_dist_tpu.resilience.watchdog import (
+                CommTimeoutError)
+
+            raise CommTimeoutError(
+                op=op, timeout_s=0.0, progress={"call_index": idx},
+                detail="injected wedge (timeout_call fault): the "
+                       "deterministic stand-in for a watchdog miss")
     return _op_scope(op)
 
 
@@ -350,9 +367,16 @@ def _fail_kth_call(op="*", k=0, **_):
         faults=(Fault("fail_call", op=op, k=k),))
 
 
+def _wedge_kth_call(op="*", k=0, **_):
+    return FaultPlan(
+        name="wedge_kth_call",
+        faults=(Fault("timeout_call", op=op, k=k),))
+
+
 register_plan("delayed_dma", _delayed_dma)
 register_plan("dropped_signal", _dropped_signal)
 register_plan("dup_signal", _dup_signal)
 register_plan("skewed_barrier", _skewed_barrier)
 register_plan("dropped_edge", _dropped_edge)
 register_plan("fail_kth_call", _fail_kth_call)
+register_plan("wedge_kth_call", _wedge_kth_call)

@@ -27,15 +27,118 @@ kernel failure degrades the serving path instead of killing it.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
+import random
 import threading
-from typing import Dict, Optional
+import time
+from typing import Callable, Dict, Optional, Tuple
 
 logger = logging.getLogger("triton_dist_tpu.resilience")
 
-__all__ = ["FallbackPolicy", "should_fallback", "note_failure",
-           "health_probe", "reset"]
+__all__ = ["FallbackPolicy", "RetryPolicy", "should_fallback",
+           "note_failure", "health_probe", "reset"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Deterministic retry-with-exponential-backoff for transient
+    comm/op failures — the layer BETWEEN the watchdog (which detects a
+    wedge) and the fail-one-request containment (which gives up).
+
+    A retried op must be IDEMPOTENT at the caller: the serving paths
+    that consume this (page migration, chunked prefill, the bench
+    backend probe) all are — staging pages, two-phase prefix
+    publication, and position-keyed append accounting make a replay
+    write the same bytes to the same places.
+
+    ``max_attempts`` counts total tries (1 = no retry). Delay before
+    retry ``i`` (1-based) is ``base_delay_s * multiplier**(i-1)``,
+    capped at ``max_delay_s``, plus a seeded jitter fraction in
+    ``[0, jitter]`` — jitter is drawn from ``random.Random(seed)`` per
+    :meth:`call`, so two runs with one seed sleep identically (the
+    chaos harness and the tests replay schedules bit-for-bit).
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.0
+    multiplier: float = 2.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(f"jitter is a fraction in [0, 1], got "
+                             f"{self.jitter}")
+
+    def delay_s(self, attempt: int, rng: Optional[random.Random] = None
+                ) -> float:
+        """Backoff before retry ``attempt`` (1-based: the sleep after
+        the ``attempt``-th failure)."""
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        d = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                self.max_delay_s)
+        if self.jitter and rng is not None:
+            d *= 1.0 + self.jitter * rng.random()
+        return d
+
+    def delays(self) -> Tuple[float, ...]:
+        """The full deterministic backoff schedule (one fresh seeded
+        rng — what :meth:`call` will actually sleep)."""
+        rng = random.Random(self.seed)
+        return tuple(self.delay_s(i, rng)
+                     for i in range(1, self.max_attempts))
+
+    def call(self, fn: Callable, *, op: str = "",
+             retry_on: Tuple = (Exception,),
+             deadline_s: Optional[float] = None,
+             on_retry: Optional[Callable] = None,
+             sleep: Callable[[float], None] = time.sleep):
+        """Run ``fn()`` under the policy; returns ``(result, attempts)``.
+
+        Only exceptions matching ``retry_on`` are retried; anything
+        else propagates immediately (a logic bug is not a transient).
+        ``deadline_s`` bounds the TOTAL wall clock (monotonic): when the
+        next backoff would land past it, the last error re-raises even
+        with attempts left — the bench probe's budget semantics.
+        ``on_retry(attempt, exc)`` fires before each backoff sleep
+        (telemetry: the serving counters and ``probe_attempts`` hang
+        off it). ``sleep`` is injectable so tests never wall-clock.
+        """
+        rng = random.Random(self.seed)
+        t_end = (None if deadline_s is None
+                 else time.monotonic() + deadline_s)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(), attempt
+            except retry_on as e:
+                if attempt >= self.max_attempts:
+                    raise
+                d = self.delay_s(attempt, rng)
+                if t_end is not None and time.monotonic() + d > t_end:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                logger.warning(
+                    "op %r attempt %d/%d failed (%r); retrying in "
+                    "%.3fs", op or "<fn>", attempt, self.max_attempts,
+                    e, d)
+                if d > 0:
+                    sleep(d)
+
+    def run(self, fn: Callable, **kw):
+        """:meth:`call` without the attempt count."""
+        return self.call(fn, **kw)[0]
 
 # Fused ops whose signal protocol is rank-divergent (one-sided puts
 # issued under a rank-dependent predicate — ``me == root``, causal

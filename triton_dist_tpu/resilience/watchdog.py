@@ -19,9 +19,11 @@ cannot poison the test process.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Optional
 
-__all__ = ["CommTimeoutError", "Watchdog", "block_until_ready"]
+__all__ = ["CommTimeoutError", "HealthTracker", "Watchdog",
+           "block_until_ready"]
 
 
 class CommTimeoutError(TimeoutError):
@@ -48,6 +50,68 @@ class CommTimeoutError(TimeoutError):
         if detail:
             msg += f" ({detail})"
         super().__init__(msg)
+
+
+class HealthTracker:
+    """Heartbeat/progress-based liveness for one worker/role.
+
+    The serving failover layer needs to separate "one transfer hit a
+    transient" from "this worker is gone": a single timeout retries;
+    ``fail_threshold`` CONSECUTIVE post-retry failures — or no
+    heartbeat for ``dead_after_s`` while work was in flight — declare
+    the role dead, and the caller fails over. ``beat()`` on every
+    completed unit of work resets the streak; ``fail()`` records one
+    exhausted-retries failure and returns whether the role just died.
+    ``clock`` is injectable (fake-clock tests, the chaos harness).
+    """
+
+    def __init__(self, *, fail_threshold: int = 3,
+                 dead_after_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if fail_threshold < 1:
+            raise ValueError(f"fail_threshold must be >= 1, got "
+                             f"{fail_threshold}")
+        self.fail_threshold = fail_threshold
+        self.dead_after_s = dead_after_s
+        self.clock = clock
+        self.consecutive_failures = 0
+        self.total_failures = 0
+        self.last_beat = clock()
+        self.dead = False
+        self.cause: Optional[str] = None
+
+    def beat(self) -> None:
+        """One unit of work completed — the role is alive."""
+        self.consecutive_failures = 0
+        self.last_beat = self.clock()
+
+    def fail(self, cause: str = "") -> bool:
+        """Record one (post-retry) failure; True iff this one crossed
+        the death threshold (fires once — callers fail over exactly
+        once per death)."""
+        self.total_failures += 1
+        self.consecutive_failures += 1
+        if self.dead:
+            return False
+        if self.consecutive_failures >= self.fail_threshold:
+            return self.declare_dead(
+                cause or f"{self.consecutive_failures} consecutive "
+                         "failures")
+        return False
+
+    def stalled(self) -> bool:
+        """No heartbeat inside ``dead_after_s`` (None = never)."""
+        return (self.dead_after_s is not None
+                and self.clock() - self.last_beat > self.dead_after_s)
+
+    def declare_dead(self, cause: str = "declared dead") -> bool:
+        """Force the verdict (operator kill, chaos harness). True iff
+        the role was alive until now."""
+        if self.dead:
+            return False
+        self.dead = True
+        self.cause = cause
+        return True
 
 
 def _default_rank() -> int:

@@ -24,7 +24,9 @@ from triton_dist_tpu.serving.scheduler import (  # noqa: F401
     RequestHandle,
     Scheduler,
 )
-from triton_dist_tpu.serving.server import ServingEngine  # noqa: F401
+from triton_dist_tpu.serving.server import (  # noqa: F401
+    ServingEngine, load_checkpoint, save_checkpoint,
+)
 from triton_dist_tpu.serving.chunked import (  # noqa: F401
     DEFAULT_BUCKETS, ChunkedPrefill,
 )

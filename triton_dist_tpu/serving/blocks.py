@@ -739,6 +739,56 @@ class BlockManager:
         self._slot_tokens.pop(slot, None)
         self._slot_hits.pop(slot, None)
 
+    # -- checkpoint/restore ------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain-data copy of the FULL allocator state (free list,
+        refcounts, per-slot pages/tokens/hits, committed + staged
+        prefix entries, stats) — the host half of a serving
+        checkpoint. Deep-copied: mutating the manager afterwards never
+        mutates the snapshot, and vice versa. Round-trips through
+        :meth:`load_snapshot` (pickle-safe: tuples/lists/dicts/ints
+        only)."""
+        return {
+            "num_pages": self.num_pages, "page": self.page,
+            "p_max": self.p_max, "prefix_reuse": self.prefix_reuse,
+            "free": list(self._free),
+            "refs": dict(self._refs),
+            "slot_pages": {s: list(p)
+                           for s, p in self._slot_pages.items()},
+            "slot_tokens": dict(self._slot_tokens),
+            "slot_hits": dict(self._slot_hits),
+            "prefix": list(self._prefix.items()),
+            "pending_prefix": {s: list(v) for s, v in
+                               self._pending_prefix.items()},
+            "stats": dict(self.stats),
+        }
+
+    def load_snapshot(self, snap: dict) -> None:
+        """Adopt a :meth:`snapshot` wholesale (geometry must match —
+        the pool the snapshot's page ids index into must be the pool
+        being restored alongside)."""
+        for key in ("num_pages", "page", "p_max"):
+            if snap[key] != getattr(self, key):
+                raise ValueError(
+                    f"snapshot {key}={snap[key]} != this manager's "
+                    f"{getattr(self, key)} — restore needs an "
+                    "identically-planned pool")
+        self.prefix_reuse = bool(snap["prefix_reuse"])
+        self._free = deque(snap["free"])
+        self._refs = {int(k): int(v) for k, v in snap["refs"].items()}
+        self._slot_pages = {int(s): list(p) for s, p in
+                            snap["slot_pages"].items()}
+        self._slot_tokens = {int(s): int(n) for s, n in
+                             snap["slot_tokens"].items()}
+        self._slot_hits = {int(s): int(n) for s, n in
+                           snap["slot_hits"].items()}
+        self._prefix = {k: int(v) for k, v in snap["prefix"]}
+        self._pending_prefix = {int(s): [(k, int(p)) for k, p in v]
+                                for s, v in
+                                snap["pending_prefix"].items()}
+        self.stats = dict(snap["stats"])
+
     def table_row(self, slot: int):
         """This slot's block-table row, scratch-padded to p_max."""
         row = [SCRATCH_PAGE] * self.p_max

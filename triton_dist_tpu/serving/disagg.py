@@ -31,10 +31,24 @@ migration is issued asynchronously when the final chunk completes and
 collected at the START of the next tick, so the transfer overlaps the
 next chunk's compute and the decode dispatch in between.
 
-Failure containment mirrors the decode path: the migration is wrapped
-in ``faults.on_op_call("page_migration")`` (fault plans can drop it)
-and the resilience watchdog (``timeout_s``) — a wedged or dropped
-migration fails ONE request, never the server.
+Failure containment mirrors the decode path, now in three escalating
+tiers (docs/resilience.md, "Failure semantics"):
+
+1. **retry** — with a ``retry=RetryPolicy(...)`` the migration and the
+   chunk dispatch are replayed with deterministic exponential backoff
+   (both are replay-idempotent: staging pages, two-phase prefix
+   publication, scratch-routed rewrites), absorbing transients;
+2. **fail-one** — retries exhausted, the migration still wrapped in
+   ``faults.on_op_call("page_migration")`` and the resilience watchdog
+   (``timeout_s``): one request fails, never the server;
+3. **failover** — ``worker_fail_threshold`` CONSECUTIVE post-retry
+   prefill-side failures (or an operator
+   :meth:`DisaggServingEngine.fail_prefill_worker`) declare the
+   active :class:`PrefillWorker` dead: its in-flight handles requeue
+   (token-preserving — the deterministic re-prefill contract keeps
+   them token-exact) and prefill moves to the next surviving worker
+   (``prefill_engines=[...]``), or onto the decode worker's own
+   in-place chunked path when none survives.
 """
 
 from __future__ import annotations
@@ -110,6 +124,13 @@ class PrefillWorker:
             jax.device_put, cache, self.shardings,
             is_leaf=lambda x: isinstance(x, jax.Array))
         self.chunker = ChunkedPrefill(engine, self.shardings, buckets)
+        # Liveness + transport, managed by the owning engine: ``dead``
+        # flips on a declared failover; ``migration``/``bridge`` are
+        # the per-worker payload transport (each worker's mesh slice
+        # gets its own verdict and, for p2p, its own 2-rank bridge).
+        self.dead = False
+        self.migration = "local"
+        self.bridge = None
         # Fixed-shape payload extract: (L, p_max, KV_full, page, hd),
         # gathered replicated so the payload can leave this mesh
         # (quantized pools add the two (L, p_max, KV) scale planes).
@@ -144,72 +165,83 @@ class DisaggServingEngine(ServingEngine):
     ``params`` to both ``Engine`` constructors). Omitting it is the
     single-role degenerate mode: one engine plays both roles on one
     mesh, chunked prefill and page migration still exercised (local
-    scatter instead of the bridge put). ``migration`` picks the
-    payload transport: ``"p2p"`` (one-sided put over a 2-rank bridge
-    mesh — requires disjoint role device sets), ``"local"``, or
-    ``"auto"`` (p2p iff the roles are disjoint).
+    scatter instead of the bridge put). ``prefill_engines=[...]``
+    instead builds N > 1 prefill workers (one active at a time;
+    standbys are failover targets). ``migration`` picks the payload
+    transport: ``"p2p"`` (one-sided put over a 2-rank bridge mesh —
+    requires disjoint role device sets), ``"local"``, or ``"auto"``
+    (p2p iff that worker's devices are disjoint from the decode
+    mesh's — resolved per worker).
+
+    ``failover`` (default on) arms the prefill-role health tracker:
+    ``worker_fail_threshold`` consecutive post-retry chunk/migration
+    failures declare the active worker dead and fail prefill over to
+    the next surviving worker, or to the decode engine's own in-place
+    chunked path (the degenerate local mode) when none survives —
+    in-flight requests requeue token-preserving instead of failing.
     """
 
     def __init__(self, engine, *, prefill_engine=None,
+                 prefill_engines: Optional[Sequence] = None,
                  prefill_buckets: Sequence[int] = DEFAULT_BUCKETS,
                  prefill_num_pages: Optional[int] = None,
                  migration: str = "auto", prefix_reuse: bool = False,
+                 failover: bool = True, worker_fail_threshold: int = 3,
                  **kw):
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+        from triton_dist_tpu.resilience.watchdog import HealthTracker
 
         if isinstance(engine, MegaKernelEngine):
             raise ValueError(
                 "disaggregated serving splits the LAYER path; the "
                 "megakernel is already a single fused decode role")
         super().__init__(engine, prefix_reuse=prefix_reuse, **kw)
-        pf_eng = prefill_engine if prefill_engine is not None else engine
-        if pf_eng.cfg != engine.cfg:
-            raise ValueError("prefill and decode engines must share one "
-                             "ModelConfig (and the same weights)")
-        if pf_eng.max_len != engine.max_len:
-            raise ValueError(
-                f"prefill max_len {pf_eng.max_len} != decode max_len "
-                f"{engine.max_len}: the chunked writer addresses pages "
-                "by global position, the bounds must agree")
-        self.prefill_worker = PrefillWorker(
-            pf_eng, page=self.page, p_max=self.p_max,
-            num_slots=self.num_slots, num_pages=prefill_num_pages,
-            buckets=prefill_buckets, prefix_reuse=prefix_reuse,
-            kv_dtype=self.kv_dtype)
-        self._prefiller = self.prefill_worker
-
+        if prefill_engine is not None and prefill_engines is not None:
+            raise ValueError("pass prefill_engine OR prefill_engines, "
+                             "not both")
+        pf_engines = (list(prefill_engines) if prefill_engines
+                      else [prefill_engine if prefill_engine is not None
+                            else engine])
+        if not pf_engines:
+            raise ValueError("prefill_engines must name at least one "
+                             "engine")
         if migration not in ("auto", "p2p", "local"):
             raise ValueError(f"migration must be 'auto'|'p2p'|'local', "
                              f"got {migration!r}")
-        pf_devs = set(d.id for d in pf_eng.mesh.devices.flat)
-        dec_devs = set(d.id for d in engine.mesh.devices.flat)
-        disjoint = not (pf_devs & dec_devs)
-        if migration == "p2p" and not disjoint:
-            raise ValueError(
-                "migration='p2p' needs disjoint prefill/decode mesh "
-                "slices (the bridge put is a remote DMA edge); "
-                "colocated roles use migration='local'")
-        self.migration = ("p2p" if migration == "auto" and disjoint
-                          else migration if migration != "auto"
-                          else "local")
+        self._pf_buckets = tuple(prefill_buckets)
+        self.failover = bool(failover)
+        self.worker_fail_threshold = int(worker_fail_threshold)
+        self.prefill_workers: List[PrefillWorker] = []
+        for pf_eng in pf_engines:
+            if pf_eng.cfg != engine.cfg:
+                raise ValueError(
+                    "prefill and decode engines must share one "
+                    "ModelConfig (and the same weights)")
+            if pf_eng.max_len != engine.max_len:
+                raise ValueError(
+                    f"prefill max_len {pf_eng.max_len} != decode "
+                    f"max_len {engine.max_len}: the chunked writer "
+                    "addresses pages by global position, the bounds "
+                    "must agree")
+            w = PrefillWorker(
+                pf_eng, page=self.page, p_max=self.p_max,
+                num_slots=self.num_slots, num_pages=prefill_num_pages,
+                buckets=prefill_buckets, prefix_reuse=prefix_reuse,
+                kv_dtype=self.kv_dtype)
+            self._setup_transport(w, migration)
+            self.prefill_workers.append(w)
+        self._prefiller = self.prefill_workers[0]
+        self._pf_health = HealthTracker(
+            fail_threshold=self.worker_fail_threshold,
+            clock=self.sched.clock)
+
         import jax
-
-        self._bridge = None
-        if self.migration == "p2p":
-            from jax.sharding import Mesh
-
-            # 2-rank bridge: one device per role carries the page
-            # payload over the one-sided put edge (the DCN/ICI hop of
-            # a real deployment).
-            self._bridge = Mesh(
-                np.array([pf_eng.mesh.devices.flat[0],
-                          engine.mesh.devices.flat[0]]), ("role",))
 
         # Fixed-shape receiver scatter into the decode pool — donated,
         # pinned to the pool's one sharding spelling (the decode
         # dispatch never re-specializes on a migration). Quantized
         # pools scatter the payload's scales alongside its bytes.
-        if self.prefill_worker.quantized:
+        if self.prefill_workers[0].quantized:
             self._scatter = jax.jit(
                 lambda c, k, v, ks, vs, ids: c.scatter_pages(
                     k, v, ids, ks, vs),
@@ -222,6 +254,45 @@ class DisaggServingEngine(ServingEngine):
                 out_shardings=self._cache_shardings)
         self._pending: List[tuple] = []
         self._handoff_stalled: List[RequestHandle] = []
+
+    def _setup_transport(self, w: PrefillWorker, migration: str):
+        """Resolve one worker's payload transport against the decode
+        mesh; p2p workers get their own 2-rank bridge (one device per
+        role carries the page payload over the one-sided put edge —
+        the DCN/ICI hop of a real deployment)."""
+        pf_devs = set(d.id for d in w.engine.mesh.devices.flat)
+        dec_devs = set(d.id for d in self.engine.mesh.devices.flat)
+        disjoint = not (pf_devs & dec_devs)
+        if migration == "p2p" and not disjoint:
+            raise ValueError(
+                "migration='p2p' needs disjoint prefill/decode mesh "
+                "slices (the bridge put is a remote DMA edge); "
+                "colocated roles use migration='local'")
+        w.migration = ("p2p" if migration == "auto" and disjoint
+                       else migration if migration != "auto"
+                       else "local")
+        if w.migration == "p2p":
+            from jax.sharding import Mesh
+
+            w.bridge = Mesh(
+                np.array([w.engine.mesh.devices.flat[0],
+                          self.engine.mesh.devices.flat[0]]), ("role",))
+
+    # -- role topology (live view: failover moves the active role) ---
+
+    @property
+    def prefill_worker(self) -> Optional[PrefillWorker]:
+        """The ACTIVE prefill worker (None once prefill has failed
+        over onto the decode engine's local path)."""
+        return (self._prefiller
+                if isinstance(self._prefiller, PrefillWorker) else None)
+
+    @property
+    def migration(self) -> str:
+        """The active handoff transport (``"local"`` covers both the
+        colocated worker and the post-failover in-place path)."""
+        w = self.prefill_worker
+        return w.migration if w is not None else "local"
 
     # -- admission: route to the prefill worker ----------------------
 
@@ -236,8 +307,12 @@ class DisaggServingEngine(ServingEngine):
         """Final chunk done: claim decode-side pages, issue the page
         extract (async — collected next tick so the transfer overlaps
         whatever dispatches next), and park the handle as
-        ``"migrating"``."""
-        pw = self.prefill_worker
+        ``"migrating"``. After a failover onto the decode engine's
+        in-place path there is nothing to migrate — the chunks wrote
+        the serving pool directly and the base activation applies."""
+        if self._prefiller is self:
+            return super()._finish_prefill(h, logits)
+        pw = self._prefiller
         slot, seq = h.slot, h.lane
         # The staging pool's pages are fully written — publish them to
         # the prefill side's prefix cache (the decode pool's entries
@@ -271,7 +346,7 @@ class DisaggServingEngine(ServingEngine):
         payload = pw.extract(src_ids)   # (K, V[, K_scale, V_scale])
         h.status = "migrating"
         self._pending.append((h, logits, payload, dst_ids,
-                              len(pages) - hits))
+                              len(pages) - hits, pw))
 
     def step(self) -> int:
         # Collect LAST tick's migrations first: their extracts (and
@@ -298,20 +373,28 @@ class DisaggServingEngine(ServingEngine):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         pending, self._pending = self._pending, []
-        for h, logits, payload, dst_ids, n_mig in pending:
+        for h, logits, payload, dst_ids, n_mig, pw in pending:
             if h.status != "migrating":
-                continue               # failed meanwhile (deadline)
+                continue    # failed/requeued meanwhile (deadline,
+                            # worker failover)
             slot = h.slot
-            k_pay, v_pay = payload[:2]
-            scales = payload[2:]       # () or (k_scale, v_scale)
-            try:
+
+            def _attempt(payload=payload, dst_ids=dst_ids, pw=pw,
+                         slot=slot):
+                # Replay-idempotent: re-staging the same source pages
+                # and re-scattering the same bytes (+ scales) into the
+                # same dst ids — prefix rows stay scratch-routed, and
+                # the two-phase prefix publication means no other
+                # request can be reading the target pages yet.
+                k_pay, v_pay = payload[:2]
+                scales = payload[2:]    # () or (k_scale, v_scale)
                 with faults.on_op_call("page_migration"):
-                    if self.migration == "p2p":
+                    if pw.migration == "p2p":
                         from triton_dist_tpu.ops.p2p import (
                             migrate_pages_host)
 
                         k_pay, v_pay = migrate_pages_host(
-                            k_pay, v_pay, self._bridge, axis="role",
+                            k_pay, v_pay, pw.bridge, axis="role",
                             src=0, dst=1)
                     rep = NamedSharding(self.engine.mesh, P())
                     k_pay = jax.device_put(k_pay, rep)
@@ -334,12 +417,19 @@ class DisaggServingEngine(ServingEngine):
                                 "migrated_pages":
                                     self.stats_counters[
                                         "migrated_pages"]})
+
+            try:
+                self._run_op_with_retry("page_migration", _attempt)
             except (CommTimeoutError, faults.InjectedFault) as e:
-                # One wedged / dropped migration fails ONE request:
-                # decode pages + slot released by _retire, staging
-                # pages by the _retire override below.
+                # Retries exhausted. A worker being declared dead
+                # fails over (this handle requeues, token-preserving);
+                # otherwise one wedged / dropped migration fails ONE
+                # request: decode pages + slot released by _retire,
+                # staging pages by the _retire override below.
                 if isinstance(e, CommTimeoutError):
                     self.stats_counters["comm_timeouts"] += 1
+                if self._note_role_failure("prefill", e):
+                    continue
                 self._fail(h, "timeout"
                            if isinstance(e, CommTimeoutError)
                            else "failed", e)
@@ -347,9 +437,110 @@ class DisaggServingEngine(ServingEngine):
             except Exception as e:  # noqa: BLE001 — release, surface
                 self._fail(h, "failed", e)
                 raise
-            self.prefill_worker.release(slot)
+            pw.release(slot)
+            self._note_role_ok("prefill")
             self.stats_counters["migrated_pages"] += n_mig
             self._activate(h, logits)
+
+    # -- prefill-worker failover --------------------------------------
+
+    def _note_role_ok(self, role: str) -> None:
+        if role == "prefill" and self._prefiller is not self:
+            self._pf_health.beat()
+
+    def _note_role_failure(self, role: str, exc) -> bool:
+        """Fold one exhausted-retries prefill-side failure into the
+        role's health; True when it crossed the death threshold and
+        the failover (which requeues every in-flight handle,
+        INCLUDING the one whose failure tripped this) handled it."""
+        if (role != "prefill" or not self.failover
+                or self._prefiller is self):
+            return False
+        if self._pf_health.fail(repr(exc)):
+            return self._failover_prefill(self._pf_health.cause)
+        return False
+
+    def fail_prefill_worker(self) -> bool:
+        """Operator/chaos kill switch: declare the ACTIVE prefill
+        worker dead and fail over immediately (next surviving worker,
+        else the decode engine's in-place path). True iff a live
+        worker was killed."""
+        if self._prefiller is self:
+            return False
+        self._pf_health.declare_dead("operator/chaos kill")
+        return self._failover_prefill(self._pf_health.cause)
+
+    def _failover_prefill(self, cause) -> bool:
+        """The active prefill worker is dead: requeue its in-flight
+        work token-preserving and move the prefill role.
+
+        Every handle mid-chunk-stream or mid-migration goes back to
+        the queue HEAD in slot order with its generated-so-far tokens
+        intact — the deterministic re-prefill contract (the PR-4
+        preemption path) re-derives their cache on the new role, so
+        survivors stay token-exact. The dead worker's staging pool is
+        abandoned wholesale (a real dead worker's memory is gone; the
+        host bookkeeping is cleared so pool invariants stay
+        checkable). Decode-side pages already claimed by a migrating
+        handle are released — its re-prefill re-allocates."""
+        from triton_dist_tpu.resilience.watchdog import HealthTracker
+
+        dead = self._prefiller
+        if not isinstance(dead, PrefillWorker):
+            return False
+        dead.dead = True
+        self.stats_counters["failovers"] += 1
+        requeue = [h for h in self.sched.running()
+                   if h.status in ("prefill", "migrating")]
+        for h in requeue:
+            slot = h.slot
+            self.sched.slots.pop(slot, None)
+            h.slot = None
+            if h.status == "migrating":
+                # Decode pages were claimed at handoff; the re-prefill
+                # claims fresh ones.
+                self.manager.free_slot(slot)
+            self._lens[slot] = self._live[slot] = self._toks[slot] = 0
+            h.status = "queued"
+            h.prompt_pos, h.lane, h.resident = 0, None, 0
+            h.chunks = []
+        for h in reversed(requeue):
+            self.sched.queue.appendleft(h)
+        # In-flight payload extracts from the dead worker are void
+        # (their handles just left "migrating"; _complete_migrations
+        # skips them).
+        self._pending = [t for t in self._pending
+                         if t[0].status == "migrating"]
+        for slot in list(dead.manager._slot_pages):
+            dead.manager.free_slot(slot)
+        survivor = next((w for w in self.prefill_workers if not w.dead),
+                        None)
+        if survivor is not None:
+            self._prefiller = survivor
+        else:
+            # Degenerate local path: chunk straight into the decode
+            # pool through the decode engine (built lazily ONCE — its
+            # jit cache is bounded by the same bucket count).
+            if self.chunker is None:
+                from triton_dist_tpu.serving.chunked import (
+                    ChunkedPrefill)
+
+                self.chunker = ChunkedPrefill(
+                    self.engine, self._cache_shardings,
+                    self._pf_buckets)
+            self._prefiller = self
+        self._pf_health = HealthTracker(
+            fail_threshold=self.worker_fail_threshold,
+            clock=self.sched.clock)
+        import logging
+
+        logging.getLogger("triton_dist_tpu.resilience").warning(
+            "prefill worker declared dead (%s): %d in-flight "
+            "request(s) requeued, prefill role moved to %s", cause,
+            len(requeue),
+            "local in-place path" if self._prefiller is self
+            else "standby worker")
+        return True
 
     # -- bookkeeping overrides ---------------------------------------
 
@@ -358,17 +549,30 @@ class DisaggServingEngine(ServingEngine):
         super()._retire(h, status, error)
         if slot is not None:
             # Staging pages a mid-prefill/mid-migration failure leaves
-            # behind (no-op once handed off).
-            self.prefill_worker.release(slot)
+            # behind (no-op once handed off). Released on EVERY
+            # worker: the slot id is the key in each staging pool, and
+            # after a failover the allocation may sit on a worker that
+            # is no longer active.
+            for w in self.prefill_workers:
+                w.release(slot)
 
     def _drained(self) -> bool:
         return self.sched.idle and not self._pending
 
     def stats(self) -> dict:
         out = super().stats()
-        out["roles"] = ("prefill+decode/colocated"
-                        if self.prefill_worker.engine is self.engine
-                        else "prefill|decode/disjoint")
+        w = self.prefill_worker
+        if w is None:
+            out["roles"] = "prefill+decode/failover-local"
+        elif w.engine is self.engine:
+            out["roles"] = "prefill+decode/colocated"
+        else:
+            out["roles"] = "prefill|decode/disjoint"
         out["migration_transport"] = self.migration
-        out["prefill_pool"] = self.prefill_worker.manager.fragmentation()
+        out["prefill_workers"] = len(self.prefill_workers)
+        out["dead_prefill_workers"] = sum(
+            1 for x in self.prefill_workers if x.dead)
+        out["prefill_pool"] = (w.manager.fragmentation()
+                               if w is not None
+                               else self.manager.fragmentation())
         return out

@@ -47,7 +47,31 @@ from triton_dist_tpu.serving.scheduler import (
     Request, RequestHandle, Scheduler,
 )
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(snap: dict, path: str) -> str:
+    """Persist a :meth:`ServingEngine.checkpoint` snapshot to ``path``
+    (pickle; numpy pools incl. ml_dtypes fp8 round-trip bit-exact).
+    Atomic: written to a temp file and renamed, so a SIGKILL mid-write
+    leaves the previous checkpoint intact. Returns ``path``."""
+    import os
+    import pickle
+
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    """Read a snapshot :func:`save_checkpoint` wrote (feed it to
+    :meth:`ServingEngine.restore` on a freshly-built engine)."""
+    import pickle
+
+    with open(path, "rb") as f:
+        return pickle.load(f)
 
 
 class ServingEngine:
@@ -77,7 +101,7 @@ class ServingEngine:
                  load_alpha: float = 0.25,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  kv_dtype: str = "bf16", spec_k: int = 0,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3, retry=None):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -124,10 +148,41 @@ class ServingEngine:
         paged-flash kernel yet (docs/serving.md, ROADMAP item 4), so
         weigh spec_k against pool size on ``attn_impl="kernel"``
         deployments.
+
+        ``retry``: a :class:`~triton_dist_tpu.resilience.policy.
+        RetryPolicy` (applied to every retryable serving op), or a
+        ``{op: RetryPolicy}`` dict, or ``None`` (no retries — the
+        pre-existing fail-one behaviour). Retryable ops today:
+        ``"page_migration"`` (the disaggregated KV handoff) and
+        ``"chunked_prefill"`` (the bucketed chunk dispatch) — both are
+        replay-idempotent (staging pages, two-phase prefix
+        publication, position-keyed appends), so a dropped or
+        timed-out transfer is retried with deterministic exponential
+        backoff before the request is failed. Each absorbed transient
+        increments ``stats()["retries"]``.
         """
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+        from triton_dist_tpu.resilience.policy import RetryPolicy
         from triton_dist_tpu.serving.blocks import kv_quant_spec
         from triton_dist_tpu.serving.spec import NgramDraft
+
+        if retry is None:
+            self.retry_policies = {}
+        elif isinstance(retry, RetryPolicy):
+            self.retry_policies = {op: retry for op in
+                                   ("page_migration",
+                                    "chunked_prefill")}
+        elif isinstance(retry, dict):
+            for op, pol in retry.items():
+                if not isinstance(pol, RetryPolicy):
+                    raise TypeError(
+                        f"retry[{op!r}] must be a RetryPolicy, got "
+                        f"{type(pol).__name__}")
+            self.retry_policies = dict(retry)
+        else:
+            raise TypeError(
+                "retry must be a RetryPolicy, an {op: RetryPolicy} "
+                f"dict, or None — got {type(retry).__name__}")
 
         kv_quant_spec(kv_dtype)        # validate the knob early
         self.kv_dtype = kv_dtype
@@ -174,6 +229,7 @@ class ServingEngine:
             "decode_tokens": 0, "prefill_chunks": 0, "migrated_pages": 0,
             "spec_drafted": 0, "spec_accepted": 0,
             "greedy_agree_tokens": 0, "greedy_ref_tokens": 0,
+            "retries": 0, "failovers": 0, "restored_requests": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -505,12 +561,17 @@ class ServingEngine:
         state — e.g. pending migrations)."""
         return self.sched.idle
 
-    def run(self, *, max_steps: int = 100000) -> None:
-        """Drive :meth:`step` until queue and slots drain."""
+    def run(self, *, max_steps: int = 100000, on_tick=None) -> None:
+        """Drive :meth:`step` until queue and slots drain. ``on_tick``
+        (no-arg) fires after every step at a consistent state boundary
+        — the hook checkpoint-on-signal callers need without
+        re-implementing the drain loop."""
         for _ in range(max_steps):
             if self._drained():
                 return
             self.step()
+            if on_tick is not None:
+                on_tick()
         raise RuntimeError(f"serving loop did not drain in {max_steps} "
                            "steps")
 
@@ -618,6 +679,194 @@ class ServingEngine:
         ref = self.stats_counters["greedy_ref_tokens"]
         return (self.stats_counters["greedy_agree_tokens"] / ref
                 if ref else 1.0)
+
+    # -- checkpoint / restore ----------------------------------------
+
+    CHECKPOINT_FORMAT = "tdt-serving-ckpt-v1"
+
+    def _ckpt_meta(self) -> dict:
+        return {
+            "format": self.CHECKPOINT_FORMAT,
+            "kv_dtype": self.kv_dtype, "page": self.page,
+            "p_max": self.p_max, "num_slots": self.num_slots,
+            "max_len": self.max_len, "spec_k": self.spec_k,
+            "vocab_size": self.cfg.vocab_size,
+            "num_pages": self.manager.num_pages,
+        }
+
+    @staticmethod
+    def _ser_handle(h: RequestHandle, *, keep_slot: bool) -> dict:
+        r = h.request
+        return {
+            "request": {
+                "prompt": [int(t) for t in r.prompt],
+                "max_new_tokens": r.max_new_tokens,
+                "request_id": r.request_id, "eos_id": r.eos_id,
+                "deadline": r.deadline, "temperature": r.temperature,
+                "top_k": r.top_k, "seed": r.seed,
+            },
+            "status": "running" if keep_slot else "queued",
+            "tokens": [int(t) for t in h.tokens],
+            "slot": h.slot if keep_slot else None,
+            "decode_steps": h.decode_steps,
+        }
+
+    def checkpoint(self) -> dict:
+        """Host-side snapshot of the FULL serving state at a tick
+        boundary: the paged KV pools (+ quantization scales,
+        bit-exact), the block manager's free-list/refcounts/prefix
+        index, the scheduler queue and slot assignments, the host
+        length mirrors, and every counter. ``restore()`` on a FRESH
+        engine (same model config, weights, and pool plan — weights
+        are NOT in the snapshot) resumes decode token-exact
+        mid-stream — the substrate for preemptible-VM restarts.
+
+        Semantics per in-flight state: ``running`` slots restore
+        exactly (their KV is in the snapshot pools); mid-``prefill``
+        and mid-``migrating`` requests snapshot as QUEUED with their
+        generated-so-far tokens — restore re-prefills them through the
+        deterministic re-prefill contract (token-exact; their partial
+        staging work is dropped, never trusted). ``stream_cb``
+        callbacks cannot cross a process boundary and are dropped:
+        reattach via the handles ``restore()`` returns. Pure
+        observation — the live engine is not mutated.
+        """
+        if self.mega:
+            raise NotImplementedError(
+                "checkpoint/restore is a layer-path feature: the "
+                "megakernel's KV lives in its in-kernel arena "
+                "(docs/serving.md, 'Checkpoint/restore')")
+        running = [h for h in self.sched.running()
+                   if h.status == "running"]
+        inflight = [h for h in self.sched.running()
+                    if h.status != "running"]
+        # Release in-flight (non-running) slots on a COPY of the
+        # allocator state, so the snapshot is self-consistent with
+        # their queued status — reusing free_slot keeps the refcount /
+        # staged-prefix algebra identical to the live path.
+        m2 = BlockManager(self.manager.num_pages, self.page,
+                          self.p_max,
+                          prefix_reuse=self.manager.prefix_reuse)
+        m2.load_snapshot(self.manager.snapshot())
+        lens, live, toks = (self._lens.copy(), self._live.copy(),
+                            self._toks.copy())
+        for h in inflight:
+            if h.slot is not None:
+                m2.free_slot(h.slot)
+                lens[h.slot] = live[h.slot] = toks[h.slot] = 0
+        c = self.cache
+        cache_np = {
+            "k_pages": np.asarray(c.k_pages),
+            "v_pages": np.asarray(c.v_pages),
+            "k_scale": (None if c.k_scale is None
+                        else np.asarray(c.k_scale)),
+            "v_scale": (None if c.v_scale is None
+                        else np.asarray(c.v_scale)),
+        }
+        handles = ([self._ser_handle(h, keep_slot=True)
+                    for h in running]
+                   + [self._ser_handle(h, keep_slot=False)
+                      for h in inflight]
+                   + [self._ser_handle(h, keep_slot=False)
+                      for h in self.sched.queue])
+        return {
+            "meta": self._ckpt_meta(),
+            "cache": cache_np,
+            "manager": m2.snapshot(),
+            "handles": handles,
+            "lens": lens, "live": live, "toks": toks,
+            "counters": dict(self.stats_counters),
+            "sched_counters": dict(self.sched.counters),
+        }
+
+    def restore(self, snap: dict) -> List[RequestHandle]:
+        """Adopt a :meth:`checkpoint` snapshot into this (idle,
+        identically-planned) engine and return the revived handles —
+        running requests resume decode token-exact at the next
+        :meth:`step`; queued ones re-prefill deterministically.
+        Counters continue from the snapshot, and every revived
+        request counts into ``stats()["restored_requests"]``.
+        Deadlines are restored verbatim (they are absolute times on
+        the scheduler clock — after a real process restart, expired
+        ones fail on the first tick, which is the correct reading of
+        a missed SLO)."""
+        import dataclasses as _dc
+        import itertools
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        if self.mega:
+            raise NotImplementedError(
+                "checkpoint/restore is a layer-path feature")
+        meta = snap.get("meta", {})
+        if meta.get("format") != self.CHECKPOINT_FORMAT:
+            raise ValueError(
+                f"not a serving checkpoint (format={meta.get('format')!r},"
+                f" want {self.CHECKPOINT_FORMAT!r})")
+        mine = self._ckpt_meta()
+        bad = {k: (meta.get(k), v) for k, v in mine.items()
+               if meta.get(k) != v}
+        if bad:
+            raise ValueError(
+                "checkpoint/engine plan mismatch (snapshot vs this "
+                f"engine): {bad} — restore needs an identically-"
+                "configured engine over the same weights")
+        if self.sched.slots or self.sched.queue:
+            raise RuntimeError(
+                "restore() needs an idle engine (fresh process / "
+                "drained loop); this one has live slots or a queue")
+        c = snap["cache"]
+        if np.dtype(c["k_pages"].dtype) != np.dtype(
+                self.cache.k_pages.dtype):
+            raise ValueError(
+                f"pool dtype mismatch: snapshot {c['k_pages'].dtype} "
+                f"vs engine {self.cache.k_pages.dtype}")
+        cache = _dc.replace(
+            self.cache,
+            k_pages=jnp.asarray(c["k_pages"]),
+            v_pages=jnp.asarray(c["v_pages"]),
+            k_scale=(None if c["k_scale"] is None
+                     else jnp.asarray(c["k_scale"])),
+            v_scale=(None if c["v_scale"] is None
+                     else jnp.asarray(c["v_scale"])))
+        # Re-pin to the pool's one sharding spelling — the decode
+        # dispatch must not re-specialize on the first post-restore
+        # tick.
+        self.cache = jax.tree.map(
+            jax.device_put, cache, self._cache_shardings,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        self.manager.load_snapshot(snap["manager"])
+        self._lens = np.asarray(snap["lens"], np.int32).copy()
+        self._live = np.asarray(snap["live"], np.int32).copy()
+        self._toks = np.asarray(snap["toks"], np.int32).copy()
+        self.stats_counters.update(snap["counters"])
+        self.sched.counters.update(snap["sched_counters"])
+        handles: List[RequestHandle] = []
+        max_seq = -1
+        now = self.sched.now()
+        for hs in snap["handles"]:
+            req = Request(**hs["request"])
+            if req.request_id:
+                m = re.fullmatch(r"req-(\d+)", req.request_id)
+                if m:
+                    max_seq = max(max_seq, int(m.group(1)))
+            h = RequestHandle(request=req, status=hs["status"],
+                              tokens=list(hs["tokens"]),
+                              slot=hs["slot"],
+                              decode_steps=hs["decode_steps"],
+                              submitted_at=now)
+            if h.status == "running":
+                h.started_at = now
+                self.sched.slots[h.slot] = h
+            else:
+                self.sched.queue.append(h)
+            handles.append(h)
+        # Auto request-ids must not collide with restored ones.
+        self.sched._ids = itertools.count(max_seq + 1)
+        self.stats_counters["restored_requests"] += len(handles)
+        return handles
 
     def prefill_cache_size(self) -> Optional[int]:
         """Jit-cache entries of the PREFILL path — the other half of
@@ -794,6 +1043,45 @@ class ServingEngine:
             if h.status == "prefill":
                 self._advance_chunk(h)
 
+    def _run_op_with_retry(self, op: str, fn):
+        """Run one retryable serving op under its configured
+        :class:`~triton_dist_tpu.resilience.policy.RetryPolicy` (none
+        configured = one attempt). Retries only the transient fault
+        types (a watchdog miss, an injected fault) — every attempt
+        re-enters the op's fault scope, so a ``fail_kth_call`` plan's
+        call index advances per attempt and a transient at k=0 is
+        absorbed. Each retry increments the ``retries`` counter."""
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+        pol = self.retry_policies.get(op)
+        if pol is None:
+            return fn()
+
+        def _note(attempt, exc):
+            self.stats_counters["retries"] += 1
+            if isinstance(exc, CommTimeoutError):
+                # An absorbed wedge is still an observed watchdog
+                # miss — the telemetry keeps counting them even when
+                # the retry hides them from the request.
+                self.stats_counters["comm_timeouts"] += 1
+
+        return pol.run(fn, op=f"serving.{op}",
+                       retry_on=(CommTimeoutError, faults.InjectedFault),
+                       on_retry=_note)
+
+    # Role-health hooks (no-ops here): the disaggregated subclass
+    # tracks per-role heartbeats/failures and fails over a dead
+    # prefill worker. ``_note_role_failure`` returns True when it
+    # handled the failure by failing over (the victim was REQUEUED
+    # with the rest of the in-flight work — do not also fail it).
+
+    def _note_role_ok(self, role: str) -> None:
+        pass
+
+    def _note_role_failure(self, role: str, exc) -> bool:
+        return False
+
     def _advance_chunk(self, h: RequestHandle):
         from triton_dist_tpu.resilience import faults
         from triton_dist_tpu.resilience.watchdog import (
@@ -805,7 +1093,12 @@ class ServingEngine:
         toks = np.zeros((bucket,), np.int32)
         toks[:valid] = seq[start:start + valid]
         row = np.asarray(p.manager.table_row(slot), np.int32)
-        try:
+
+        def _attempt():
+            # Replay-idempotent: a retried chunk rewrites the same
+            # positions of the same pages with the same bytes
+            # (quantized pools re-merge to the identical amax), and
+            # prefix pages stay scratch-routed below ``wfrom``.
             with faults.on_op_call("chunked_prefill"):
                 logits, p.cache = p.chunker.step(
                     p.engine.params, toks, p.cache, row, start,
@@ -817,17 +1110,28 @@ class ServingEngine:
                         progress_fn=lambda: {
                             "slot": slot, "chunk_start": start,
                             "chunks": list(h.chunks)})
+            return logits
+
+        try:
+            logits = self._run_op_with_retry("chunked_prefill",
+                                             _attempt)
         except (CommTimeoutError, faults.InjectedFault) as e:
-            # A wedged / dropped chunk fails THIS request only (slot
-            # and pages released); the loop keeps serving.
+            # Retries exhausted. A dying prefill worker fails over
+            # (this handle requeues with the rest of its in-flight
+            # work); otherwise a wedged / dropped chunk fails THIS
+            # request only (slot and pages released) and the loop
+            # keeps serving.
             if isinstance(e, CommTimeoutError):
                 self.stats_counters["comm_timeouts"] += 1
+            if self._note_role_failure("prefill", e):
+                return
             self._fail(h, "timeout" if isinstance(e, CommTimeoutError)
                        else "failed", e)
             return
         except Exception as e:  # noqa: BLE001 — release, then surface
             self._fail(h, "failed", e)
             raise
+        self._note_role_ok("prefill")
         self.stats_counters["prefill_chunks"] += 1
         self.stats_counters["prefill_tokens"] += valid
         h.chunks.append((start, bucket, valid))
@@ -909,16 +1213,27 @@ class ServingEngine:
             for h in active:
                 tbl[h.slot] = self.manager.table_row(h.slot)
 
+        from triton_dist_tpu.resilience import faults
+
         t0 = time.perf_counter()
         try:
-            logits = self._dispatch(tbl)
+            # The joint decode rides its own fault-op scope: chaos /
+            # fault plans can drop or wedge the k-th decode dispatch
+            # and the containment below fails the victim, not the
+            # server (survivors redo the identical dispatch — length
+            # mirrors never advanced).
+            with faults.on_op_call("serving_decode"):
+                logits = self._dispatch(tbl)
         except Exception as e:  # noqa: BLE001 — route through policy
             from triton_dist_tpu.resilience.watchdog import (
                 CommTimeoutError)
 
-            if not isinstance(e, CommTimeoutError):
+            if not isinstance(e, (CommTimeoutError,
+                                  faults.InjectedFault)):
                 raise
-            self.stats_counters["comm_timeouts"] += 1
+            timed_out = isinstance(e, CommTimeoutError)
+            if timed_out:
+                self.stats_counters["comm_timeouts"] += 1
             if self.mega and getattr(self.engine, "states",
                                      None) is not None:
                 # Hybrid GDN: the recurrent state is NOT position-
@@ -930,7 +1245,8 @@ class ServingEngine:
             else:
                 victims = self.sched.timeout_victims()
             for victim in victims:
-                self._fail(victim, "timeout", e)
+                self._fail(victim, "timeout" if timed_out else "failed",
+                           e)
             return 0
         self.stats_counters["decode_time_s"] += time.perf_counter() - t0
         self.stats_counters["decode_dispatches"] += 1
