@@ -3,8 +3,8 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke apicheck \
-	ci bench-all
+	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
+	qblock-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -75,6 +75,14 @@ spec-smoke: csrc
 # chaos_survived_faults bench gate (docs/resilience.md).
 chaos-smoke: csrc
 	bash scripts/chaos_smoke.sh
+
+# Paged flash Q-block battery: kernel-vs-gather-oracle parity across
+# pool dtypes, flash-path chunk/verify token-exactness + no-recompile
+# gates, a flash chat e2e, and the non-null flash<=ref bench gate on
+# chunk_attend_ms/verify_attend_ms (docs/serving.md, "Attention
+# implementations").
+qblock-smoke: csrc
+	bash scripts/qblock_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

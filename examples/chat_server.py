@@ -57,6 +57,15 @@ def main():
                     help="--disagg/chunked prefill: comma-separated "
                          "chunk-length buckets (the prefill jit cache "
                          "is bounded by their count)")
+    ap.add_argument("--attn-impl", default="ref",
+                    choices=["ref", "kernel", "flash"],
+                    help="layer-path paged attention implementation: "
+                         "'ref' gathers dense rows (CPU default, "
+                         "token-exact oracle); 'kernel' streams decode "
+                         "through the paged flash kernel; 'flash' also "
+                         "routes chunked prefill + speculative "
+                         "verification through the paged Q-block "
+                         "kernel (see docs/serving.md)")
     ap.add_argument("--kv-quant", default="bf16",
                     choices=["bf16", "int8", "fp8"],
                     help="layer-path KV pool storage: int8/fp8 stores "
@@ -119,6 +128,10 @@ def main():
         sys.exit("--kv-quant/--spec are layer-path knobs; the "
                  "megakernel decode lane has no per-page scale or "
                  "verification plumbing (see docs/serving.md)")
+    if args.megakernel and args.attn_impl != "ref":
+        sys.exit("--attn-impl routes the layer path's paged "
+                 "attention; the megakernel's attention task has its "
+                 "own in-arena lane (see docs/serving.md)")
     if args.megakernel and (args.checkpoint_dir or args.checkpoint_after):
         sys.exit("--checkpoint-dir is a layer-path feature; the "
                  "megakernel's KV lives in its in-kernel arena "
@@ -127,8 +140,9 @@ def main():
         sys.exit("--checkpoint-after needs --checkpoint-dir (it is the "
                  "deterministic drill for that snapshot path)")
     # Layer-path serving knobs shared by every engine construction
-    # below: quantized KV pools and/or speculative decode.
+    # below: attention impl, quantized KV pools, speculative decode.
     serve_kw = dict(kv_dtype=args.kv_quant,
+                    attn_impl=args.attn_impl,
                     spec_k=args.spec_k if args.spec else 0)
     def build_disagg(cfg, params, model_kw):
         """Two engines over split tp halves (or one colocated role at
@@ -329,6 +343,10 @@ def main():
         line += (f", roles={st['roles']}, "
                  f"migration={st['migration_transport']}, "
                  f"migrated_pages={st['migrated_pages']}")
+    if st.get("attn_impl") not in (None, "ref") or st.get(
+            "chunk_attn") not in (None, "ref"):
+        line += (f", attn={st['attn_impl']}"
+                 f" (chunk/verify {st['chunk_attn']})")
     if st.get("kv_dtype") not in (None, "bf16"):
         line += (f", kv_dtype={st['kv_dtype']} "
                  f"({st['kv_bytes_per_token']:.0f} B/token)")

@@ -1,0 +1,314 @@
+"""Paged flash Q-BLOCK attention battery (kernel + serving wiring).
+
+The contract under test: ``paged_flash_qblock`` — one Pallas kernel
+for BOTH chunked prefill (C consecutive queries of one slot) and
+speculative verification (K candidate queries per slot) — agrees with
+the gather oracle on every pool dtype and edge shape, and switching
+the serving engine to ``attn_impl="flash"`` changes TRAFFIC, never
+tokens: greedy outputs stay exact vs ``Engine.serve`` across chunk
+boundaries and speculative rollback, and no jit cache grows.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.ops.chunked_prefill import gather_pages_dense
+from triton_dist_tpu.ops.paged_flash_qblock import (
+    paged_flash_qblock, paged_flash_qblock_ref,
+)
+from triton_dist_tpu.serving import ServingEngine
+from triton_dist_tpu.serving.blocks import PagedKVCache
+
+KVH = 2        # kv heads
+REP = 2        # GQA ratio -> H = 4
+HD = 8         # head dim
+PAGE = 8       # tokens per page
+P_MAX = 4      # pages per table row
+H = KVH * REP
+CAP = P_MAX * PAGE
+
+TP = 4
+CFG = ModelConfig.tiny()
+MAX_LEN = 64
+SRV_PAGE = 8
+
+
+def _build(seed, b, num_pages=None):
+    """Random pool + shuffled per-slot tables (page 0 = scratch)."""
+    rng = np.random.RandomState(seed)
+    num_pages = num_pages or (b * P_MAX + 1)
+    kp = rng.randn(num_pages, KVH, PAGE, HD).astype(np.float32)
+    vp = rng.randn(num_pages, KVH, PAGE, HD).astype(np.float32)
+    perm = 1 + rng.permutation(num_pages - 1)[:b * P_MAX]
+    tbl = perm.reshape(b, P_MAX).astype(np.int32)
+    return kp, vp, tbl
+
+
+def _quantize_pool(kp, vp, qdtype, qmax):
+    """Whole-page max-abs quantization — the write_prompt blit's math."""
+    ks = np.abs(kp).max(axis=(2, 3)) / qmax
+    vs = np.abs(vp).max(axis=(2, 3)) / qmax
+    ks = np.where(ks > 0, ks, 1.0).astype(np.float32)
+    vs = np.where(vs > 0, vs, 1.0).astype(np.float32)
+    kq = kp / ks[:, :, None, None]
+    vq = vp / vs[:, :, None, None]
+    if qdtype == jnp.int8:
+        kq, vq = np.round(kq), np.round(vq)
+    return (jnp.asarray(kq).astype(qdtype),
+            jnp.asarray(vq).astype(qdtype),
+            jnp.asarray(ks), jnp.asarray(vs))
+
+
+def _run_both(q, kp, vp, tbl, pos, scales=()):
+    kw = {}
+    if scales:
+        kw = dict(k_scale=scales[0], v_scale=scales[1])
+    out = jax.jit(lambda *a: paged_flash_qblock(*a, **kw))(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tbl), jnp.asarray(pos))
+    ref = paged_flash_qblock_ref(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tbl), jnp.asarray(pos), *scales)
+    return np.asarray(out), np.asarray(ref)
+
+
+# ---------------------------------------------------------------------------
+# kernel == gather oracle
+# ---------------------------------------------------------------------------
+
+def test_qblock_matches_oracle_chunk_and_verify_shapes():
+    """Both serving masks through one call: chunk-style consecutive
+    positions (one slot mid-prompt) and verify-style lens+j positions,
+    ragged across slots."""
+    rng = np.random.RandomState(0)
+    b, cq = 3, 5
+    kp, vp, tbl = _build(1, b)
+    q = rng.randn(b, cq, H, HD).astype(np.float32)
+    pos = np.zeros((b, cq), np.int32)
+    pos[0] = 9 + np.arange(cq)           # chunk at start=9
+    pos[1] = 17 + np.arange(cq)          # verify at lens=17
+    pos[2] = 2 + np.arange(cq)           # short history
+    out, ref = _run_both(q, kp, vp, tbl, pos)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("qdtype,qmax", [
+    (jnp.int8, 127.0),
+    (jnp.float8_e4m3fn, 448.0),
+])
+def test_qblock_quantized_fused_dequant(qdtype, qmax):
+    """int8/fp8 pools through the kernel's fused page-prefetch dequant
+    == the dequantizing gather oracle, and both within quantization
+    tolerance of the fp32 ground truth."""
+    rng = np.random.RandomState(2)
+    b, cq = 2, 4
+    kp, vp, tbl = _build(3, b)
+    kq, vq, ks, vs = _quantize_pool(kp, vp, qdtype, qmax)
+    q = rng.randn(b, cq, H, HD).astype(np.float32)
+    pos = np.stack([11 + np.arange(cq), 23 + np.arange(cq)]
+                   ).astype(np.int32)
+    out, ref = _run_both(q, kq, vq, tbl, pos, scales=(ks, vs))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    exact, _ = _run_both(q, kp, vp, tbl, pos)
+    tol = 5e-2 if qdtype == jnp.int8 else 2e-1
+    assert np.abs(out - exact).max() < tol
+
+
+def test_qblock_ragged_final_page():
+    """Positions ending mid-page (neither page-aligned nor filling the
+    final table entry) mask the page's tail exactly."""
+    rng = np.random.RandomState(4)
+    b, cq = 2, 3
+    kp, vp, tbl = _build(5, b)
+    q = rng.randn(b, cq, H, HD).astype(np.float32)
+    pos = np.stack([PAGE + np.arange(cq),       # 1 page + partial
+                    np.arange(cq)]).astype(np.int32)   # first page only
+    out, ref = _run_both(q, kp, vp, tbl, pos)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qblock_zero_len_parked_slot():
+    """A parked slot (positions 0, scratch table row) stays finite and
+    never perturbs live rows — the fixed-shape batch's empty lane."""
+    rng = np.random.RandomState(6)
+    b, cq = 2, 4
+    kp, vp, tbl = _build(7, b)
+    tbl[1] = 0                            # parked: all-scratch row
+    q = rng.randn(b, cq, H, HD).astype(np.float32)
+    pos = np.zeros((b, cq), np.int32)
+    pos[0] = 13 + np.arange(cq)
+    out, ref = _run_both(q, kp, vp, tbl, pos)
+    assert np.isfinite(out).all(), "parked slot produced non-finite"
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    # Live row unchanged when the parked slot's queries change.
+    q2 = q.copy()
+    q2[1] = rng.randn(cq, H, HD)
+    out2, _ = _run_both(q2, kp, vp, tbl, pos)
+    np.testing.assert_array_equal(out[0], out2[0])
+
+
+def test_qblock_prefix_shared_pages():
+    """Two slots whose tables share leading (prefix) pages: each
+    attends the shared bytes plus its own private suffix — results
+    match a pool where the prefix is duplicated."""
+    rng = np.random.RandomState(8)
+    b, cq = 2, 4
+    kp, vp, tbl = _build(9, b)
+    tbl[1, :2] = tbl[0, :2]               # share the first two pages
+    q = rng.randn(b, cq, H, HD).astype(np.float32)
+    pos = np.stack([2 * PAGE + 3 + np.arange(cq),
+                    3 * PAGE + 1 + np.arange(cq)]).astype(np.int32)
+    out, ref = _run_both(q, kp, vp, tbl, pos)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qblock_position_beyond_capacity_raises():
+    """A concrete position beyond one table row's capacity fails
+    loudly, naming the slot (same contract as paged_flash_decode)."""
+    rng = np.random.RandomState(10)
+    kp, vp, tbl = _build(11, 1)
+    q = rng.randn(1, 2, H, HD).astype(np.float32)
+    pos = np.asarray([[CAP - 1, CAP]], np.int32)
+    with pytest.raises(ValueError, match="slot 0.*capacity"):
+        paged_flash_qblock(jnp.asarray(q), jnp.asarray(kp),
+                           jnp.asarray(vp), jnp.asarray(tbl),
+                           jnp.asarray(pos))
+
+
+def test_qblock_scaleless_quantized_pool_raises():
+    """A quantized pool without scales fails loudly in BOTH the kernel
+    and the oracle instead of attending raw quantized bytes."""
+    kp, vp, tbl = _build(12, 1)
+    kq, vq, ks, vs = _quantize_pool(kp, vp, jnp.int8, 127.0)
+    q = np.random.RandomState(13).randn(1, 2, H, HD).astype(np.float32)
+    pos = np.asarray([[3, 4]], np.int32)
+    with pytest.raises(ValueError, match="QUANTIZED pool"):
+        paged_flash_qblock(jnp.asarray(q), kq, vq, jnp.asarray(tbl),
+                           jnp.asarray(pos))
+    with pytest.raises(ValueError, match="QUANTIZED pool"):
+        paged_flash_qblock_ref(jnp.asarray(q), kq, vq,
+                               jnp.asarray(tbl), jnp.asarray(pos))
+    with pytest.raises(ValueError, match="unquantized"):
+        paged_flash_qblock(jnp.asarray(q), jnp.asarray(kp),
+                           jnp.asarray(vp), jnp.asarray(tbl),
+                           jnp.asarray(pos), k_scale=ks, v_scale=vs)
+
+
+def test_gather_pages_dense_one_definition():
+    """The shared gather helper reproduces the PagedKVCache views it
+    replaced — one definition for the oracle every paged kernel is
+    tested against."""
+    kp, vp, tbl = _build(14, 2)
+    c = PagedKVCache(
+        k_pages=jnp.asarray(kp)[None], v_pages=jnp.asarray(vp)[None],
+        block_table=jnp.asarray(tbl),
+        lens=jnp.asarray([5, 9], jnp.int32),
+        live=jnp.ones((2,), jnp.int32))
+    kd, vd = c.dense_layer(0)
+    np.testing.assert_array_equal(
+        np.asarray(kd),
+        np.asarray(gather_pages_dense(jnp.asarray(kp),
+                                      jnp.asarray(tbl))))
+    kr, _ = c.dense_row(0, jnp.asarray(tbl[1]))
+    np.testing.assert_array_equal(np.asarray(kr), np.asarray(kd)[1])
+
+
+# ---------------------------------------------------------------------------
+# serving wiring: flash changes traffic, never tokens
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh = Mesh(np.array(jax.devices()[:TP]), ("tp",))
+    return Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=3)
+
+
+def _baseline(engine, prompt, gen_len):
+    ids = jnp.asarray(np.tile(np.asarray([prompt], np.int32), (TP, 1)))
+    return np.asarray(engine.serve(ids, gen_len=gen_len))[0].tolist()
+
+
+def test_chunk_boundary_token_exact_flash(engine):
+    """Prompt lengths at b-1 / b / b+1 for bucket b through the FLASH
+    chunk path: greedy tokens equal the monolithic Engine.serve run
+    (chunk boundaries invisible to the math, kernel or gather)."""
+    bucket = 8
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(0, CFG.vocab_size, n)]
+               for n in (bucket - 1, bucket, bucket + 1)]
+    want = [_baseline(engine, p, 8) for p in prompts]
+    srv = ServingEngine(engine, num_slots=2, page=SRV_PAGE,
+                        prefill_buckets=(4, bucket),
+                        attn_impl="flash")
+    got = srv.generate(prompts, max_new_tokens=8)
+    assert got == want
+    assert srv.stats()["chunk_attn"] == "flash"
+
+
+def test_spec_rollback_token_exact_flash(engine):
+    """Speculative decode through the FLASH verification kernel:
+    rejected draft suffixes roll back page accounting and greedy
+    outputs stay bit-identical to Engine.serve — acceptance is data,
+    whichever kernel scored it."""
+    prompts = [[1, 2, 3, 1, 2, 3], [4, 5], [6, 7, 8, 9], [5, 5, 5]]
+    want = [_baseline(engine, p, 10) for p in prompts]
+    srv = ServingEngine(engine, num_slots=2, page=SRV_PAGE, spec_k=4,
+                        chunk_attn="flash")
+    got = srv.generate(prompts, max_new_tokens=10)
+    assert got == want
+    st = srv.stats()
+    # Mixed accept/reject actually exercised the rollback path.
+    assert st["spec"]["drafted"] > st["spec"]["accepted"] > 0
+    # Rollback left the pool clean: every page back on the free list.
+    frag = st["pool"]
+    assert frag["used_pages"] == 0, frag
+
+
+def test_flash_matches_ref_tokens_quantized(engine):
+    """attn_impl='flash' over an int8 pool produces the SAME tokens as
+    the gather ref over the same int8 pool — the fused dequant and the
+    gather dequant are the same math."""
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7]]
+    kw = dict(num_slots=2, page=SRV_PAGE, prefill_buckets=(4, 8),
+              spec_k=3, kv_dtype="int8")
+    got_f = ServingEngine(engine, attn_impl="flash", **kw).generate(
+        prompts, max_new_tokens=8)
+    got_r = ServingEngine(engine, attn_impl="ref", **kw).generate(
+        prompts, max_new_tokens=8)
+    assert got_f == got_r
+
+
+def test_no_recompile_gates_with_flash(engine):
+    """The serving no-growth gates hold with every flash path active:
+    ONE decode(-side) jit entry after warmup and the chunk cache
+    bounded by the bucket count — positions ride as data through the
+    kernel exactly as through the gather."""
+    rng = np.random.RandomState(1)
+    srv = ServingEngine(engine, num_slots=2, page=SRV_PAGE,
+                        prefill_buckets=(4, 8), spec_k=4,
+                        attn_impl="flash")
+    prompts = [[int(t) for t in rng.randint(0, CFG.vocab_size, n)]
+               for n in (3, 5, 7, 9, 11, 13)]    # unseen lengths
+    srv.generate(prompts, max_new_tokens=6)
+    assert srv.decode_cache_size() == 1, srv.decode_cache_size()
+    assert srv.prefill_cache_size() <= 2
+    more = [[int(t) for t in rng.randint(0, CFG.vocab_size, n)]
+            for n in (2, 6, 10)]
+    srv.generate(more, max_new_tokens=4)
+    assert srv.decode_cache_size() == 1
+    assert srv.prefill_cache_size() <= 2
+
+
+def test_bad_attn_impl_values_raise(engine):
+    with pytest.raises(ValueError, match="attn_impl"):
+        ServingEngine(engine, num_slots=2, page=SRV_PAGE,
+                      attn_impl="pallas")
+    with pytest.raises(ValueError, match="chunk_attn"):
+        ServingEngine(engine, num_slots=2, page=SRV_PAGE,
+                      chunk_attn="kernel")

@@ -242,7 +242,8 @@ def decode_step(params, token_ids, cache: KVCache, cfg: ModelConfig, *,
 
 def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                       budget=None, mode: str = "xla", axis: str = "tp",
-                      ctxs: FwdContexts = FwdContexts(), ffn_fn=None):
+                      ctxs: FwdContexts = FwdContexts(),
+                      attn_impl: str = "ref", ffn_fn=None):
     """One SPECULATIVE-VERIFICATION step over a
     :class:`~triton_dist_tpu.serving.blocks.PagedKVCache`: K candidate
     tokens per slot through one fixed-shape dispatch.
@@ -262,6 +263,14 @@ def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
     block_attend`) — candidate j sees exactly what a sequential decode
     of the accepted prefix would see, which is what makes accepted
     tokens token-exact with non-speculative greedy decode.
+
+    ``attn_impl``: ``"ref"`` attends through the gather path
+    (:func:`~triton_dist_tpu.ops.chunked_prefill.block_attend` over
+    :meth:`PagedKVCache.dense_layer` — materializes every slot's
+    dense row); ``"flash"`` streams pages through the K-query
+    :func:`~triton_dist_tpu.ops.paged_flash_qblock.paged_flash_qblock`
+    kernel with the same per-query causal positions riding as data —
+    no dense-row materialization, work scales with resident pages.
 
     Returns ``(logits (S, K, vocab), cache)``. ``logits[s, j]`` is the
     next-token distribution AFTER feeding candidates 0..j. The cache's
@@ -288,9 +297,26 @@ def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
         cache = cache.append_block(
             li, k_tok[:, 0].reshape(s, k, kvl, hd),
             v_tok[:, 0].reshape(s, k, kvl, hd), budget=budget)
-        kd, vd = cache.dense_layer(li)
-        o = block_attend(q[:, 0].reshape(s, k, hl, hd), kd, vd,
-                         lens, cache.live)
+        if attn_impl == "flash":
+            from triton_dist_tpu.ops.paged_flash_qblock import (
+                paged_flash_qblock)
+
+            # Candidate j of a live slot attends positions
+            # <= lens[s]+j (its paged history + the candidate prefix
+            # through itself — block_attend's kv_len-1); parked slots
+            # clamp to position 0 (garbage the scheduler ignores).
+            qpos = jnp.maximum(
+                lens[:, None] + cache.live[:, None]
+                * (jnp.arange(k, dtype=jnp.int32)[None] + 1), 1) - 1
+            ksc, vsc = cache.layer_scales(li)
+            o = paged_flash_qblock(
+                q[:, 0].reshape(s, k, hl, hd), cache.k_pages[li],
+                cache.v_pages[li], cache.block_table, qpos,
+                k_scale=ksc, v_scale=vsc)
+        else:
+            kd, vd = cache.dense_layer(li)
+            o = block_attend(q[:, 0].reshape(s, k, hl, hd), kd, vd,
+                             lens, cache.live)
         x = x + tp_attn.decode_output(
             layer_params["attn"], o.reshape(s * k, -1), h,
             mode=dec_mode, axis=axis, ar_ctx=ctxs.ar)
@@ -330,7 +356,8 @@ def paged_cache_specs(axis: str = "tp", quantized: bool = False):
 def prefill_chunk_paged(params, chunk_toks, cache, table_row,
                         cfg: ModelConfig, *, start, wfrom, valid,
                         mode: str = "xla", axis: str = "tp",
-                        ctxs: FwdContexts = FwdContexts(), ffn_fn=None):
+                        ctxs: FwdContexts = FwdContexts(),
+                        attn_impl: str = "ref", ffn_fn=None):
     """One FIXED-SHAPE chunk of a bucketed paged prefill (per-shard).
 
     The chunked half of the serving split: instead of one monolithic
@@ -357,6 +384,14 @@ def prefill_chunk_paged(params, chunk_toks, cache, table_row,
     replicated (the decode AR regime — no token-sharding divisibility
     constraint ties C to the mesh).
 
+    ``attn_impl``: ``"ref"`` gathers the slot's dense row per layer
+    (:meth:`PagedKVCache.dense_row` + ``chunk_attend`` — O(p_max·page)
+    HBM traffic per chunk regardless of the prompt's actual length);
+    ``"flash"`` streams only the RESIDENT pages through the Q-block
+    :func:`~triton_dist_tpu.ops.paged_flash_qblock.paged_flash_qblock`
+    kernel (positions ride as data — the trace still keys only on the
+    bucket length).
+
     Returns ``(logits (vocab,) of the LAST VALID token, cache)`` — the
     final chunk's logits seed the first generated token; earlier
     chunks' logits are discarded.
@@ -375,8 +410,29 @@ def prefill_chunk_paged(params, chunk_toks, cache, table_row,
             layer_params["attn"], h, cfg, positions, axis=axis)
         cache = cache.write_chunk(li, k_tok, v_tok, table_row,
                                   positions, valid, wfrom)
-        kd, vd = cache.dense_row(li, table_row)
-        o = chunk_attend(q[:, 0], kd, vd, positions)
+        if attn_impl == "flash":
+            from triton_dist_tpu.ops.paged_flash_qblock import (
+                paged_flash_qblock)
+
+            # Bucket-padding rows clamp to the last VALID position:
+            # their outputs are discarded garbage either way, but
+            # unclamped they would stretch the kernel's page-walk
+            # bound (max position) to the padded tail — 8x the DMA
+            # traffic for exactly the short-prompt-in-a-big-bucket
+            # case the kernel exists to make cheap.
+            i = jnp.arange(c, dtype=jnp.int32)
+            last_valid = (jnp.asarray(start, jnp.int32)
+                          + jnp.maximum(jnp.asarray(valid, jnp.int32)
+                                        - 1, 0))
+            qpos = jnp.where(i < valid, positions, last_valid)
+            ksc, vsc = cache.layer_scales(li)
+            o = paged_flash_qblock(
+                q[:, 0][None], cache.k_pages[li], cache.v_pages[li],
+                table_row[None], qpos[None],
+                k_scale=ksc, v_scale=vsc)[0]
+        else:
+            kd, vd = cache.dense_row(li, table_row)
+            o = chunk_attend(q[:, 0], kd, vd, positions)
         x = x + tp_attn.decode_output(
             layer_params["attn"], o.reshape(c, -1), h, mode=dec_mode,
             axis=axis, ar_ctx=ctxs.ar)
@@ -420,7 +476,11 @@ def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
     token-exact-with-``Engine.serve`` path (and the CPU default);
     ``"kernel"`` streams pages through
     :func:`~triton_dist_tpu.ops.paged_flash_decode.paged_flash_decode`
-    without materializing the dense view (the TPU path).
+    without materializing the dense view (the TPU path). ``"flash"``
+    is an alias for ``"kernel"`` here (the one-query decode step IS
+    the paged flash kernel) — it exists so the serving engine can
+    spell "Pallas paged attention everywhere" with one knob value
+    covering decode, chunked prefill, and speculative verification.
 
     ``ffn_fn(layer_params, h) -> h`` overrides the FFN block (the MoE
     model's hook), exactly as in :func:`decode_step`.
@@ -439,17 +499,15 @@ def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
         q, k_tok, v_tok = tp_attn.decode_project(
             layer_params["attn"], h, cfg, lens, axis=axis)
         cache = cache.append_decode(li, k_tok, v_tok)
-        if attn_impl == "kernel":
+        if attn_impl in ("kernel", "flash"):
             from triton_dist_tpu.ops.paged_flash_decode import (
                 paged_flash_decode)
 
+            ksc, vsc = cache.layer_scales(li)
             o = paged_flash_decode(
                 q[:, 0], cache.k_pages[li], cache.v_pages[li],
                 cache.block_table, kv_len, axis=None,
-                k_scale=(cache.k_scale[li] if cache.quantized
-                         else None),
-                v_scale=(cache.v_scale[li] if cache.quantized
-                         else None))
+                k_scale=ksc, v_scale=vsc)
         else:
             kd, vd = cache.dense_layer(li)
             o = tp_attn.sdpa(q, kd, vd, causal=False, kv_len=kv_len)

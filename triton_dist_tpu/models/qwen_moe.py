@@ -268,6 +268,7 @@ def paged_cache_specs(axis: str = "tp", quantized: bool = False):
 def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                       budget=None, mode: str = "xla", axis: str = "tp",
                       ctxs: FwdContexts = FwdContexts(),
+                      attn_impl: str = "ref",
                       moe_impl: str = "tp", ep_ctx=None, transport=None,
                       replicas=None, with_expert_counts: bool = False):
     """Speculative K-token verification with the MoE FFN in the AR
@@ -286,13 +287,15 @@ def verify_step_paged(params, token_ids, cache, cfg: ModelConfig, *,
                             counts=None, _layer_cursor=[0])
     return _dense.verify_step_paged(params, token_ids, cache, cfg,
                                     budget=budget, mode=mode, axis=axis,
-                                    ctxs=ctxs, ffn_fn=ffn)
+                                    ctxs=ctxs, attn_impl=attn_impl,
+                                    ffn_fn=ffn)
 
 
 def prefill_chunk_paged(params, chunk_toks, cache, table_row,
                         cfg: ModelConfig, *, start, wfrom, valid,
                         mode: str = "xla", axis: str = "tp",
                         ctxs: FwdContexts = FwdContexts(),
+                        attn_impl: str = "ref",
                         moe_impl: str = "tp", ep_ctx=None, transport=None,
                         replicas=None, with_expert_counts: bool = False):
     """One bucketed chunk of a paged prefill with the MoE FFN in the
@@ -313,7 +316,7 @@ def prefill_chunk_paged(params, chunk_toks, cache, table_row,
                                       table_row, cfg, start=start,
                                       wfrom=wfrom, valid=valid,
                                       mode=mode, axis=axis, ctxs=ctxs,
-                                      ffn_fn=ffn)
+                                      attn_impl=attn_impl, ffn_fn=ffn)
 
 
 def decode_step_paged(params, token_ids, cache, cfg: ModelConfig, *,

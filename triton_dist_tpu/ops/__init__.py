@@ -23,7 +23,11 @@ from triton_dist_tpu.ops.p2p import (  # noqa: F401
     migrate_pages_host, p2p_put, p2p_put_host, ppermute_ref,
 )
 from triton_dist_tpu.ops.chunked_prefill import (  # noqa: F401
-    chunk_attend, chunk_write_ids, plan_chunks,
+    block_attend, chunk_attend, chunk_write_ids, gather_pages_dense,
+    plan_chunks,
+)
+from triton_dist_tpu.ops.paged_flash_qblock import (  # noqa: F401
+    paged_flash_qblock, paged_flash_qblock_ref, qblock_page_attend,
 )
 from triton_dist_tpu.ops.ag_gemm import (  # noqa: F401
     AGGemmContext, create_ag_gemm_context, ag_gemm, ag_gemm_ref,

@@ -26,6 +26,12 @@ model-level driver):
   per slot to a chunk of queries), masked by each query's GLOBAL
   position so earlier chunks and the shared prefix are attended
   exactly.
+- :func:`gather_pages_dense` — THE dense-row gather (pool pages →
+  position-major view, dequant fused for quantized pools). One
+  definition shared by ``PagedKVCache.dense_row``/``dense_layer``,
+  ``paged_flash_decode_ref``, and ``paged_flash_qblock_ref`` — the
+  oracle the Pallas paged kernels are tested against has exactly one
+  spelling of its gather.
 """
 
 from __future__ import annotations
@@ -35,6 +41,25 @@ import jax.numpy as jnp
 
 
 SCRATCH_PAGE = 0
+
+
+def gather_pages_dense(pool, table, scale=None):
+    """Gather block-table pages into the dense position-major view.
+
+    pool: (num_pages, KV, page, hd) — ONE layer's page pool; table:
+    (..., P) int32 page ids (any leading batch shape: ``(p_max,)`` for
+    one slot's row, ``(S, p_max)`` for a whole decode batch); scale:
+    (num_pages, KV) fp32 per-page per-head dequant scales of a
+    QUANTIZED pool (dequant fuses into the gather), or None for the
+    native path. Returns (..., P·page, KV, hd) — positions past the
+    written region are garbage the caller's mask hides.
+    """
+    kvh, page, hd = pool.shape[1:]
+    g = pool[table]                     # (..., P, KV, page, hd)
+    if scale is not None:               # fused dequant on gather
+        g = g.astype(jnp.float32) * scale[table][..., None, None]
+    g = jnp.moveaxis(g, -2, -3)         # (..., P, page, KV, hd)
+    return g.reshape(*table.shape[:-1], table.shape[-1] * page, kvh, hd)
 
 
 def plan_chunks(n_tokens: int, buckets) -> list:

@@ -79,6 +79,25 @@ def _is_quantized_pool(arr) -> bool:
                                     jnp.dtype(jnp.float8_e4m3fn))
 
 
+def _require_pool_scales(pool, k_scale, *, reject_spurious=False):
+    """The ONE spelling of every paged reader's quantization contract
+    (decode kernel/ref and the Q-block kernel/ref all share it): an
+    int8/fp8 pool without scales fails loudly rather than attending
+    raw quantized bytes; ``reject_spurious`` additionally rejects
+    scales paired with an unquantized pool (the reverse mismatch)."""
+    if _is_quantized_pool(pool) and k_scale is None:
+        raise ValueError(
+            f"k_pages is a QUANTIZED pool ({pool.dtype}) but no "
+            "k_scale/v_scale was passed — a scaleless reader would "
+            "attend raw quantized bytes (kv_dtype mismatch between "
+            "the pool's writer and this reader?)")
+    if (reject_spurious and k_scale is not None
+            and not _is_quantized_pool(pool)):
+        raise ValueError(
+            f"k_scale passed for an unquantized ({pool.dtype}) "
+            "pool — scales only pair with int8/fp8 storage")
+
+
 def _lse_reduce(parts, hd: int):
     """Log-sum-exp combine of flash partials: parts (r, B, H, 2+hd)
     [acc | m | l] → one combined partial (B, H, 2+hd). Associative —
@@ -412,16 +431,7 @@ def paged_flash_decode(q, k_pages, v_pages, block_table, kv_len, *,
     """
     _, kvh, page, _ = k_pages.shape
     p_max = block_table.shape[1]
-    if _is_quantized_pool(k_pages) and k_scale is None:
-        raise ValueError(
-            f"k_pages is a QUANTIZED pool ({k_pages.dtype}) but no "
-            "k_scale/v_scale was passed — a scaleless reader would "
-            "attend raw quantized bytes (kv_dtype mismatch between "
-            "the pool's writer and this reader?)")
-    if k_scale is not None and not _is_quantized_pool(k_pages):
-        raise ValueError(
-            f"k_scale passed for an unquantized ({k_pages.dtype}) "
-            "pool — scales only pair with int8/fp8 storage")
+    _require_pool_scales(k_pages, k_scale, reject_spurious=True)
     return _decode_call(q, k_pages, v_pages, block_table, kv_len,
                         ctx=ctx, axis=axis, page=page, p_max=p_max,
                         paged=True, k_scale=k_scale, v_scale=v_scale)
@@ -443,29 +453,17 @@ def paged_flash_decode_ref(q, k_pages, v_pages, block_table, kv_len,
     the output row is zeros-attention garbage the caller masks).
     Returns (B, H, hd).
     """
+    from triton_dist_tpu.ops.chunked_prefill import gather_pages_dense
     from triton_dist_tpu.ops.flash_decode import flash_decode_ref
 
-    b, p_max = block_table.shape
-    _, kvh, page, hd = k_pages.shape
-    if _is_quantized_pool(k_pages) and k_scale is None:
-        raise ValueError(
-            f"k_pages is a QUANTIZED pool ({k_pages.dtype}) but no "
-            "k_scale/v_scale was passed — a scaleless reader would "
-            "attend raw quantized bytes")
-
-    def gather(pool, scale):
-        g = pool[block_table]               # (B, P_max, KV, page, hd)
-        if scale is not None:
-            g = g.astype(jnp.float32) * scale[block_table][
-                ..., None, None]
-        g = g.transpose(0, 1, 3, 2, 4)      # (B, P_max, page, KV, hd)
-        return g.reshape(b, p_max * page, kvh, hd)
+    _require_pool_scales(k_pages, k_scale)
 
     # Fully-masked rows (kv_len 0) would NaN the softmax; clamp to one
     # position — the row is garbage either way and callers mask it.
     safe_len = jnp.maximum(kv_len, 1)
-    return flash_decode_ref(q, gather(k_pages, k_scale),
-                            gather(v_pages, v_scale), safe_len)
+    return flash_decode_ref(
+        q, gather_pages_dense(k_pages, block_table, k_scale),
+        gather_pages_dense(v_pages, block_table, v_scale), safe_len)
 
 
 def sp_flash_decode_fused(q, k_cache, v_cache, kv_len, *,

@@ -1,0 +1,307 @@
+"""Paged flash Q-BLOCK attention as a Pallas kernel (local form).
+
+Reference: ``ops/paged_flash_decode.py`` is the one-query-per-slot
+decode kernel (FlashAttention's IO-aware online softmax over
+vLLM/PagedAttention-style block-table pages). The serving layer has two
+more attention shapes on its hot path that until now attended through
+the GATHER oracle — materializing every slot's entire dense KV row per
+layer per call, O(p_max·page) HBM traffic regardless of how short the
+slot actually is:
+
+- the CHUNKED-PREFILL step (:func:`models.dense.prefill_chunk_paged`):
+  a chunk of C consecutive queries of ONE slot, query i attending keys
+  at global positions ``<= start + i``;
+- the SPECULATIVE-VERIFICATION step (:func:`models.dense.
+  verify_step_paged`): K candidate queries per slot across the whole
+  decode batch, query j attending ``< lens[s] + j + 1``.
+
+This module is the one kernel both ride: ``paged_flash_decode``
+generalized from 1 query to a Q-BLOCK of Cq queries per slot. Pages
+stream through VMEM double-buffered via the block table (pages past a
+slot's maximum attended position are skipped entirely — the work
+scales with the slot's RESIDENT page count, never with capacity), the
+per-query causal mask comes from a ``(B, Cq)`` position vector (data —
+the trace keys only on the block shape, so the serving jit caches
+never grow), and int8/fp8 pools dequantize inside the page prefetch
+compute exactly like the decode kernel's ``kscale``/``vscale`` path.
+
+The gather path stays as :func:`paged_flash_qblock_ref` — the
+interpret-friendly oracle the kernel is tested against (and the
+serving engine's ``attn_impl="ref"``), built on the ONE shared gather
+(:func:`ops.chunked_prefill.gather_pages_dense`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.lang import core_call
+from triton_dist_tpu.ops.paged_flash_decode import _require_pool_scales
+
+
+def qblock_page_attend(q2, kpage, vpage, m, l, acc, mask, rep: int,
+                       kscale=None, vscale=None):
+    """One online-softmax step of a Q-BLOCK over a KV page —
+    :func:`~triton_dist_tpu.ops.paged_flash_decode.page_attend`
+    generalized from a unit query dim to Cq queries.
+
+    q2: (H, Cq, hd) fp32 head-major queries; kpage/vpage: (KV, page,
+    hd) head-major pages; m/l: (H, Cq) running max / normalizer; acc:
+    (H, Cq, hd); mask: (Cq, page) per-QUERY key validity (the causal
+    mask restricted to this page); rep = H // KV (GQA ratio).
+    ``kscale``/``vscale``: (KV,) fp32 per-head dequant scales of a
+    quantized (int8/fp8) page — the dequant fuses into the page's f32
+    upcast. Everything stays batched-3-D (the Mosaic-legal layout the
+    decode kernel established). Pure function on values."""
+    scale = q2.shape[-1] ** -0.5
+    kf = kpage.astype(jnp.float32)
+    vf = vpage.astype(jnp.float32)
+    if kscale is not None:
+        kf = kf * kscale.reshape(-1, 1, 1)
+        vf = vf * vscale.reshape(-1, 1, 1)
+    krep = jnp.repeat(kf, rep, axis=0)                       # (H,p,hd)
+    vrep = jnp.repeat(vf, rep, axis=0)
+    s = jnp.einsum("hqd,hpd->hqp", q2, krep) * scale         # (H,Cq,p)
+    s = jnp.where(mask[None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("hqp,hpd->hqd", p, vrep)
+    return m_new, l_new, acc_new
+
+
+def _qblock_kernel(*refs, page: int, p_max: int, kvh: int, rep: int,
+                   hd: int, cq: int, quantized: bool):
+    """Grid (B, P_max): slot-major page walk with the decode kernel's
+    double-buffered prefetch (per-parity semaphores); pages past a
+    slot's maximum attended position (``end_ref``) are skipped. No
+    partial exchange — this is the LOCAL (axis=None) form, the layout
+    the serving engine's TP-head-sharded pools use (every rank holds
+    the full sequence for its heads)."""
+    ks_ref = vs_ref = None
+    if quantized:
+        (table_ref, end_ref, pos_ref, q_ref, kp_ref, vp_ref, ks_ref,
+         vs_ref, o_ref) = refs[:9]
+        scratch = refs[9:]
+    else:
+        (table_ref, end_ref, pos_ref, q_ref, kp_ref, vp_ref,
+         o_ref) = refs[:7]
+        scratch = refs[7:]
+    kpage, vpage, m_s, l_s, acc_s, psem = scratch
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    h = kvh * rep
+
+    # Page p of slot b lives at pool slot table[b, p]; pages past the
+    # slot's maximum attended position carry no unmasked key for ANY
+    # query — skip them entirely (this is what makes the kernel scale
+    # with resident pages, not capacity).
+    end = jnp.clip(end_ref[b], 1, p_max * page)
+    active = p * page < end
+    lin = b * p_max + p
+    par = jax.lax.rem(lin, 2)
+
+    def load(b2, p2, buf):
+        pid = table_ref[b2, p2]
+        pltpu.make_async_copy(kp_ref.at[pid], kpage.at[buf],
+                              psem.at[buf]).start()
+        pltpu.make_async_copy(vp_ref.at[pid], vpage.at[buf],
+                              psem.at[buf]).start()
+
+    @pl.when(jnp.logical_and(active, lin == 0))
+    def _():
+        load(b, p, 0)        # cold start; later pages are prefetched
+
+    @pl.when(active)
+    def _():
+        # Per-parity semaphores: this wait cannot consume the prefetch
+        # fired below for the NEXT page (the decode kernel's scheme).
+        pltpu.make_async_copy(kpage.at[par], kpage.at[par],
+                              psem.at[par]).wait()
+        pltpu.make_async_copy(vpage.at[par], vpage.at[par],
+                              psem.at[par]).wait()
+
+    # Prefetch the next block's page while this one computes.
+    nxt = lin + 1
+    b2 = jnp.minimum(nxt // p_max, n_b - 1)
+    p2 = jax.lax.rem(nxt, p_max)
+    end2 = jnp.clip(end_ref[b2], 1, p_max * page)
+    active2 = jnp.logical_and(nxt < n_b * p_max, p2 * page < end2)
+
+    @pl.when(active2)
+    def _():
+        load(b2, p2, jax.lax.rem(nxt, 2))
+
+    @pl.when(p == 0)
+    def _():
+        m_s[...] = jnp.full((h, cq), -jnp.inf, jnp.float32)
+        l_s[...] = jnp.zeros((h, cq), jnp.float32)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(active)
+    def _():
+        q2 = q_ref[0].astype(jnp.float32)                # (H, Cq, hd)
+        key_pos = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)
+        mask = key_pos <= pos_ref[...]       # (Cq, 1) -> (Cq, page)
+        ksc = vsc = None
+        if quantized:
+            # Per-page per-head dequant scales, gathered host-side
+            # through the block table — the fused-dequant hook.
+            ksc = ks_ref[b, p]
+            vsc = vs_ref[b, p]
+        m, l, acc = qblock_page_attend(
+            q2, kpage[par], vpage[par], m_s[...], l_s[...], acc_s[...],
+            mask, rep, kscale=ksc, vscale=vsc)
+        m_s[...] = m
+        l_s[...] = l
+        acc_s[...] = acc
+
+    # The slot's last page step: normalize and emit. Page 0 is always
+    # active (end >= 1), so l has at least one key's mass per query.
+    @pl.when(p == p_max - 1)
+    def _():
+        out = acc_s[...] / jnp.maximum(l_s[...], 1e-30)[..., None]
+        o_ref[...] = out[None].astype(o_ref.dtype)
+
+
+def paged_flash_qblock(q, k_pages, v_pages, block_table, positions, *,
+                       k_scale=None, v_scale=None):
+    """Paged-KV GQA attention of a Q-BLOCK per slot (local form).
+
+    q: (B, Cq, H, hd) — Cq queries per slot (head-major, this rank's
+    heads); k_pages/v_pages: (num_pages, KV, page, hd) — this rank's
+    page pool, every attended key already resident (the chunk writer /
+    candidate block append runs BEFORE the attend, exactly like the
+    gather path); int8/fp8 pools additionally REQUIRE ``k_scale``/
+    ``v_scale`` (num_pages, KV) fp32 per-page per-head dequant scales;
+    block_table: (B, P_max) int32 page ids into the local pool;
+    positions: (B, Cq) int32 — query (b, i) attends keys at global
+    positions ``<= positions[b, i]`` (clamped to >= 0, so a parked
+    slot's garbage row stays finite). Both serving masks are instances:
+    the chunk case passes ``start + arange(C)`` and the verification
+    case ``lens[s] + j`` (parked slots 0).
+
+    Positions ride as DATA — the trace signature depends only on the
+    block shape (B, Cq), never on lengths, so the serving dispatches
+    built on this kernel keep their one-entry jit caches. Concrete
+    positions beyond the table row's capacity are an error (the row
+    cannot hold the key a query asks for).
+    Returns (B, Cq, H, hd).
+    """
+    b, cq, h, hd = q.shape
+    _, kvh, page, _ = k_pages.shape
+    p_max = block_table.shape[1]
+    rep = h // kvh
+    quantized = k_scale is not None
+    _require_pool_scales(k_pages, k_scale, reject_spurious=True)
+    positions = jnp.maximum(jnp.asarray(positions, jnp.int32), 0)
+    if not isinstance(positions, jax.core.Tracer):
+        import numpy as _np
+
+        cap = p_max * page
+        pos_np = _np.asarray(positions)
+        if int(_np.max(pos_np)) >= cap:
+            bad = int(_np.argmax(_np.max(pos_np, axis=1)))
+            raise ValueError(
+                f"position {int(_np.max(pos_np))} of batch slot {bad} "
+                f"exceeds one block-table row's capacity {cap} "
+                f"({p_max} pages x {page}); the query asks for a key "
+                "its table row cannot hold")
+    # Max attended position + 1 per slot — the kernel's page-skip bound.
+    end = jnp.max(positions, axis=1) + 1
+    q_hm = q.transpose(0, 2, 1, 3)              # (B, H, Cq, hd)
+    pos_t = positions.T                         # (Cq, B)
+
+    kernel = functools.partial(
+        _qblock_kernel, page=page, p_max=p_max, kvh=kvh, rep=rep,
+        hd=hd, cq=cq, quantized=quantized)
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),          # block_table
+        pl.BlockSpec(memory_space=pltpu.SMEM),          # end
+        pl.BlockSpec((cq, 1), lambda bb, pp: (0, bb),
+                     memory_space=pltpu.VMEM),          # positions.T
+        pl.BlockSpec((1, h, cq, hd), lambda bb, pp: (bb, 0, 0, 0),
+                     memory_space=pltpu.VMEM),          # q (one slot)
+        pl.BlockSpec(memory_space=pl.ANY),              # k pool
+        pl.BlockSpec(memory_space=pl.ANY),              # v pool
+    ]
+    operands = [block_table.astype(jnp.int32), end.astype(jnp.int32),
+                pos_t, q_hm, k_pages, v_pages]
+    if quantized:
+        # Scales enter PRE-GATHERED through the block table as small
+        # (B, P_max, KV) fp32 tables resident in VMEM (the decode
+        # kernel's fused-dequant plumbing).
+        sc_spec = pl.BlockSpec((b, p_max, kvh), lambda bb, pp: (0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale[block_table].astype(jnp.float32),
+                     v_scale[block_table].astype(jnp.float32)]
+
+    out = core_call(
+        kernel,
+        grid=(b, p_max),
+        out_shape=jax.ShapeDtypeStruct((b, h, cq, hd), q.dtype),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, cq, hd),
+                               lambda bb, pp: (bb, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, kvh, page, hd), k_pages.dtype),  # kpage x2
+            pltpu.VMEM((2, kvh, page, hd), v_pages.dtype),  # vpage x2
+            pltpu.VMEM((h, cq), jnp.float32),               # m
+            pltpu.VMEM((h, cq), jnp.float32),               # l
+            pltpu.VMEM((h, cq, hd), jnp.float32),           # acc
+            pltpu.SemaphoreType.DMA((2,)),                  # page loads
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * cq * h * hd * p_max * page,
+            bytes_accessed=2 * b * p_max * page * kvh * hd
+            * k_pages.dtype.itemsize,
+            transcendentals=b * cq * h * p_max * page,
+        ),
+    )(*operands)
+    return out.transpose(0, 2, 1, 3)            # (B, Cq, H, hd)
+
+
+def paged_flash_qblock_ref(q, k_pages, v_pages, block_table, positions,
+                           k_scale=None, v_scale=None):
+    """XLA gather oracle for :func:`paged_flash_qblock` — the
+    pre-kernel serving path, kept verbatim: gather each slot's pages
+    into the dense position-major view
+    (:func:`~triton_dist_tpu.ops.chunked_prefill.gather_pages_dense`,
+    the ONE shared gather) and run per-query masked fp32 attention
+    (the :func:`~triton_dist_tpu.ops.chunked_prefill.chunk_attend`
+    numerics). A scaleless read of a quantized pool fails loudly —
+    the kernel's contract. Returns (B, Cq, H, hd)."""
+    from triton_dist_tpu.ops.chunked_prefill import gather_pages_dense
+
+    _require_pool_scales(k_pages, k_scale)
+    b, cq, h, hd = q.shape
+    kvh = k_pages.shape[1]
+    rep = h // kvh
+    positions = jnp.maximum(jnp.asarray(positions, jnp.int32), 0)
+    kd = gather_pages_dense(k_pages, block_table, k_scale)
+    vd = gather_pages_dense(v_pages, block_table, v_scale)
+    t = kd.shape[1]
+    k = jnp.repeat(kd, rep, axis=2)             # (B, T, H, hd)
+    v = jnp.repeat(vd, rep, axis=2)
+    scores = jnp.einsum("bchd,bthd->bhct", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    mask = (jnp.arange(t, dtype=jnp.int32)[None, None]
+            <= positions[:, :, None])           # (B, Cq, T)
+    scores = jnp.where(mask[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhct,bthd->bchd", probs, v)

@@ -217,6 +217,15 @@ class PagedKVCache:
     def page(self) -> int:
         return self.k_pages.shape[3]
 
+    def layer_scales(self, layer: int):
+        """One layer's ``(k_scale, v_scale)`` dequant planes, or
+        ``(None, None)`` on an unquantized pool — the ONE spelling of
+        "scales accompany int8/fp8 storage" every paged-kernel call
+        site reads (the kernels' ``_require_pool_scales`` contract)."""
+        if not self.quantized:
+            return None, None
+        return self.k_scale[layer], self.v_scale[layer]
+
     @property
     def capacity(self) -> int:
         """Tokens one block-table row can address (p_max · page)."""
@@ -388,16 +397,12 @@ class PagedKVCache:
         :meth:`dense_layer`, consumed by the chunked-prefill attention
         (positions past the slot's written region are garbage the
         causal mask hides)."""
-        p_max = table_row.shape[0]
-        _, _, kvh, page, hd = self.k_pages.shape
+        from triton_dist_tpu.ops.chunked_prefill import gather_pages_dense
 
         def gather(pool, scale):
-            g = pool[layer][table_row]      # (p_max, KV, page, hd)
-            if scale is not None:           # fused dequant on gather
-                g = g.astype(jnp.float32) * scale[layer][table_row][
-                    ..., None, None]
-            g = g.transpose(0, 2, 1, 3)     # (p_max, page, KV, hd)
-            return g.reshape(p_max * page, kvh, hd)
+            return gather_pages_dense(
+                pool[layer], table_row,
+                None if scale is None else scale[layer])
 
         return (gather(self.k_pages, self.k_scale),
                 gather(self.v_pages, self.v_scale))
@@ -450,16 +455,12 @@ class PagedKVCache:
         (num_slots, p_max·page, KV_loc, hd) — the reference-attention
         path (token-exact with the dense cache; positions past a slot's
         length are garbage the kv_len mask hides)."""
-        s, p_max = self.block_table.shape
-        _, _, kvh, page, hd = self.k_pages.shape
+        from triton_dist_tpu.ops.chunked_prefill import gather_pages_dense
 
         def gather(pool, scale):
-            g = pool[layer][self.block_table]   # (S, p_max, KV, pg, hd)
-            if scale is not None:               # fused dequant on gather
-                g = g.astype(jnp.float32) * scale[layer][
-                    self.block_table][..., None, None]
-            g = g.transpose(0, 1, 3, 2, 4)      # (S, p_max, pg, KV, hd)
-            return g.reshape(s, p_max * page, kvh, hd)
+            return gather_pages_dense(
+                pool[layer], self.block_table,
+                None if scale is None else scale[layer])
 
         return (gather(self.k_pages, self.k_scale),
                 gather(self.v_pages, self.v_scale))

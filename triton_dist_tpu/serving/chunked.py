@@ -40,12 +40,17 @@ class ChunkedPrefill:
     model exposes ``prefill_chunk_paged``; ``cache_shardings`` is the
     pool's NamedSharding pytree (the decode dispatch's compiled
     expectation — chunk outputs are pinned to it); ``buckets`` the
-    chunk lengths. The jit cache of :attr:`_chunk` holds at most one
-    entry per bucket — :meth:`step` asserts that invariant after every
-    dispatch (the prefill half of the serving no-recompilation gate).
+    chunk lengths. ``attn_impl``: ``"ref"`` (the gather-path default)
+    | ``"flash"`` (the paged Q-block Pallas kernel — no dense-row
+    materialization; positions stay data, so the bucket-count bound
+    below is unchanged). The jit cache of :attr:`_chunk` holds at most
+    one entry per bucket — :meth:`step` asserts that invariant after
+    every dispatch (the prefill half of the serving no-recompilation
+    gate).
     """
 
-    def __init__(self, engine, cache_shardings, buckets: Sequence[int]):
+    def __init__(self, engine, cache_shardings, buckets: Sequence[int],
+                 *, attn_impl: str = "ref"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -59,8 +64,14 @@ class ChunkedPrefill:
                 f"model {getattr(model, '__name__', model)!r} has no "
                 "prefill_chunk_paged — chunked prefill needs the paged "
                 "chunk contract (models.dense / models.qwen_moe)")
+        if attn_impl not in ("ref", "flash"):
+            raise ValueError(
+                f"chunk attn_impl must be 'ref' | 'flash', got "
+                f"{attn_impl!r} (the one-query 'kernel' value is the "
+                "DECODE dispatch's knob)")
         self.engine = engine
         self.buckets = buckets
+        self.attn_impl = attn_impl
         cfg, mesh, axis = engine.cfg, engine.mesh, engine.axis
         # Chunk steps take only the regime kwargs — transport/replica/
         # counts are decode-dispatch knobs the chunk contract ignores.
@@ -75,7 +86,7 @@ class ChunkedPrefill:
             return model.prefill_chunk_paged(
                 params, toks, cache, table_row, cfg, start=start,
                 wfrom=wfrom, valid=valid, mode=engine.mode, axis=axis,
-                ctxs=engine.ctxs, **mk)
+                ctxs=engine.ctxs, attn_impl=attn_impl, **mk)
 
         self._chunk = jax.jit(
             jax.shard_map(

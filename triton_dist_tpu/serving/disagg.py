@@ -80,7 +80,8 @@ class PrefillWorker:
     def __init__(self, engine, *, page: int, p_max: int, num_slots: int,
                  num_pages: Optional[int] = None,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
-                 prefix_reuse: bool = False, kv_dtype: str = "bf16"):
+                 prefix_reuse: bool = False, kv_dtype: str = "bf16",
+                 attn_impl: str = "ref"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -123,7 +124,8 @@ class PrefillWorker:
         self.cache = jax.tree.map(
             jax.device_put, cache, self.shardings,
             is_leaf=lambda x: isinstance(x, jax.Array))
-        self.chunker = ChunkedPrefill(engine, self.shardings, buckets)
+        self.chunker = ChunkedPrefill(engine, self.shardings, buckets,
+                                      attn_impl=attn_impl)
         # Liveness + transport, managed by the owning engine: ``dead``
         # flips on a declared failover; ``migration``/``bridge`` are
         # the per-worker payload transport (each worker's mesh slice
@@ -227,7 +229,7 @@ class DisaggServingEngine(ServingEngine):
                 pf_eng, page=self.page, p_max=self.p_max,
                 num_slots=self.num_slots, num_pages=prefill_num_pages,
                 buckets=prefill_buckets, prefix_reuse=prefix_reuse,
-                kv_dtype=self.kv_dtype)
+                kv_dtype=self.kv_dtype, attn_impl=self.chunk_attn)
             self._setup_transport(w, migration)
             self.prefill_workers.append(w)
         self._prefiller = self.prefill_workers[0]
@@ -527,7 +529,7 @@ class DisaggServingEngine(ServingEngine):
 
                 self.chunker = ChunkedPrefill(
                     self.engine, self._cache_shardings,
-                    self._pf_buckets)
+                    self._pf_buckets, attn_impl=self.chunk_attn)
             self._prefiller = self
         self._pf_health = HealthTracker(
             fail_threshold=self.worker_fail_threshold,

@@ -85,15 +85,22 @@ class ServingEngine:
     pool size incl. the reserved scratch page (default: full residency
     for every slot). ``policy``: ``"continuous"`` | ``"static"`` (gang
     batching — the bench ablation). ``attn_impl``: ``"ref"`` |
-    ``"kernel"`` (layer path; default ref — token-exact and
-    interpret-friendly). ``timeout_s`` arms a watchdog on every decode
-    dispatch; ``clock`` is injectable for deadline tests.
+    ``"kernel"`` | ``"flash"`` (layer path; default ref — token-exact
+    and interpret-friendly; ``"kernel"`` streams decode through the
+    paged flash kernel; ``"flash"`` does that AND routes chunked
+    prefill + speculative verification through the paged Q-block
+    kernel — Pallas paged attention on every serving attention).
+    ``chunk_attn`` overrides the chunk/verify half independently
+    (``"ref"`` | ``"flash"``; default derived from ``attn_impl``).
+    ``timeout_s`` arms a watchdog on every decode dispatch; ``clock``
+    is injectable for deadline tests.
     """
 
     def __init__(self, engine, *, num_slots: Optional[int] = None,
                  page: Optional[int] = None,
                  num_pages: Optional[int] = None, max_queue: int = 64,
                  policy: str = "continuous", attn_impl: str = "ref",
+                 chunk_attn: Optional[str] = None,
                  prefix_reuse: bool = False, timeout_s=None,
                  clock=time.monotonic, transport: Optional[str] = None,
                  replica_slots: int = 0, rebalance_every: int = 8,
@@ -143,11 +150,12 @@ class ServingEngine:
         dispatch scores them; accepted tokens (greedy requests) commit
         several tokens per dispatch, token-exact with the non-spec
         greedy run by construction. ``spec_ngram`` bounds the draft's
-        n-gram length. Note: the verification dispatch attends via the
-        gather path regardless of ``attn_impl`` — there is no K-query
-        paged-flash kernel yet (docs/serving.md, ROADMAP item 4), so
-        weigh spec_k against pool size on ``attn_impl="kernel"``
-        deployments.
+        n-gram length. The verification dispatch attends via
+        ``chunk_attn``: ``"flash"`` streams pages through the K-query
+        :func:`~triton_dist_tpu.ops.paged_flash_qblock.
+        paged_flash_qblock` kernel (no dense-row materialization);
+        ``"ref"`` is the dense-row gather path (docs/serving.md,
+        "Attention implementations").
 
         ``retry``: a :class:`~triton_dist_tpu.resilience.policy.
         RetryPolicy` (applied to every retryable serving op), or a
@@ -186,6 +194,22 @@ class ServingEngine:
 
         kv_quant_spec(kv_dtype)        # validate the knob early
         self.kv_dtype = kv_dtype
+        if attn_impl not in ("ref", "kernel", "flash"):
+            raise ValueError(
+                f"attn_impl must be 'ref' | 'kernel' | 'flash', got "
+                f"{attn_impl!r}")
+        self.attn_impl = attn_impl
+        # chunk_attn covers the Q-BLOCK dispatches (chunked prefill +
+        # speculative verification); attn_impl="flash" flips it too
+        # unless overridden — one knob value = Pallas paged attention
+        # on every serving attention.
+        if chunk_attn is None:
+            chunk_attn = "flash" if attn_impl == "flash" else "ref"
+        if chunk_attn not in ("ref", "flash"):
+            raise ValueError(
+                f"chunk_attn must be 'ref' | 'flash', got "
+                f"{chunk_attn!r}")
+        self.chunk_attn = chunk_attn
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
@@ -264,6 +288,11 @@ class ServingEngine:
                     "megakernel serves every expert in-kernel (TP "
                     "regime) and rebalances via the dynamic "
                     "scoreboard's expert-load claim priority instead")
+            if self.attn_impl != "ref" or self.chunk_attn != "ref":
+                raise ValueError(
+                    "attn_impl/chunk_attn are layer-path knobs; the "
+                    "megakernel's attention rides its own in-arena "
+                    "task lane (docs/serving.md)")
             num_slots = engine.batch
             if engine.paged:
                 page = engine.builder.page
@@ -319,7 +348,6 @@ class ServingEngine:
         self.sched = Scheduler(num_slots, max_queue=max_queue,
                                policy=policy, clock=clock)
         self.num_slots = num_slots
-        self.attn_impl = attn_impl
         # Host mirrors (numpy) of the per-slot device state — the
         # scheduler never syncs the device to make a decision.
         self._lens = np.zeros((num_slots,), np.int32)
@@ -365,7 +393,8 @@ class ServingEngine:
             from triton_dist_tpu.serving.chunked import ChunkedPrefill
 
             self.chunker = ChunkedPrefill(eng, shardings,
-                                          self.prefill_buckets)
+                                          self.prefill_buckets,
+                                          attn_impl=self.chunk_attn)
             self._prefiller = self
 
         # EP-MoE decode: resolve the transport knob ONCE (host-side,
@@ -498,7 +527,8 @@ class ServingEngine:
             def _vrf(params, toks, budget, c):
                 return model.verify_step_paged(
                     params, toks, c, cfg, budget=budget, mode=eng.mode,
-                    axis=axis, ctxs=eng.ctxs, **vk)
+                    axis=axis, ctxs=eng.ctxs,
+                    attn_impl=self.chunk_attn, **vk)
 
             self._verify = jax.jit(jax.shard_map(
                 _vrf, mesh=mesh,
@@ -619,6 +649,10 @@ class ServingEngine:
             out["pool"] = self.manager.fragmentation()
         if hasattr(self, "plan"):
             out["plan"] = self.plan
+        # Attention-impl surface: which implementation each serving
+        # attention shape rides (decode vs the chunk/verify Q-block).
+        out["attn_impl"] = None if self.mega else self.attn_impl
+        out["chunk_attn"] = None if self.mega else self.chunk_attn
         # KV quantization surface: which storage the pools ride and
         # what a resident token costs (capacity math in the pool dict).
         out["kv_dtype"] = "bf16" if self.mega else self.kv_dtype
