@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
-	qblock-smoke apicheck ci bench-all
+	qblock-smoke obs-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -83,6 +83,13 @@ chaos-smoke: csrc
 # implementations").
 qblock-smoke: csrc
 	bash scripts/qblock_smoke.sh
+
+# Observability battery: span-timeline determinism under a fake clock,
+# histogram/percentile units, telemetry bit-exactness + no-growth
+# gates, and a traced chat e2e gating the merged Perfetto file and the
+# one-line `obs:` latency summary (docs/observability.md).
+obs-smoke: csrc
+	bash scripts/obs_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

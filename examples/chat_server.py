@@ -91,6 +91,19 @@ def main():
                          "and exit through the same code path after N "
                          "tokens generated this process (deterministic "
                          "— scripts/chaos_smoke.sh uses it)")
+    ap.add_argument("--telemetry", default=None,
+                    choices=["off", "counters", "spans"],
+                    help="serving telemetry level (docs/observability"
+                         ".md): counters = latency histograms only "
+                         "(default); spans = full per-request span "
+                         "timeline. --trace-out implies spans unless "
+                         "overridden")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="dump the merged Perfetto trace (host spans "
+                         "+ megakernel slot records + xprof device "
+                         "spans) and a metrics.json snapshot into DIR "
+                         "on exit and on SIGTERM, and print the "
+                         "one-line 'obs:' latency summary")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -141,9 +154,12 @@ def main():
                  "deterministic drill for that snapshot path)")
     # Layer-path serving knobs shared by every engine construction
     # below: attention impl, quantized KV pools, speculative decode.
+    telemetry = args.telemetry or ("spans" if args.trace_out
+                                   else "counters")
     serve_kw = dict(kv_dtype=args.kv_quant,
                     attn_impl=args.attn_impl,
-                    spec_k=args.spec_k if args.spec else 0)
+                    spec_k=args.spec_k if args.spec else 0,
+                    telemetry=telemetry)
     def build_disagg(cfg, params, model_kw):
         """Two engines over split tp halves (or one colocated role at
         tp=1) sharing ONE weight pytree, wrapped in the disaggregated
@@ -219,8 +235,10 @@ def main():
         # One engine for the whole session; the ServingEngine streams
         # prompts through its prefill lane, so slot count = batch.
         mk = MegaKernelEngine(cfg, mesh1d, batch=args.tp,
-                              max_len=args.max_len, tile_w=16, t_tile=16)
-        srv = ServingEngine(mk)
+                              max_len=args.max_len, tile_w=16,
+                              t_tile=16,
+                              profile=bool(args.trace_out))
+        srv = ServingEngine(mk, telemetry=telemetry)
     elif args.disagg:
         from triton_dist_tpu.models import dense
 
@@ -233,6 +251,48 @@ def main():
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len)
         srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
                             **serve_kw)
+
+    # Telemetry dump wiring (--trace-out): ONE trace session covers
+    # the whole serve; on exit (and on SIGTERM, alongside the
+    # checkpoint path below) the merged Perfetto trace + a
+    # metrics.json snapshot land in the session directory and a
+    # one-line latency summary prints.
+    tracing = {"ctx": None, "sess": None, "dumped": False}
+    if args.trace_out:
+        ctx = srv.trace("chat", out_dir=args.trace_out)
+        tracing["sess"] = ctx.__enter__()
+        tracing["ctx"] = ctx
+
+    def _obs_line(st):
+        lat = st.get("latency") or {}
+
+        def pct(series, q):
+            v = (lat.get(series) or {}).get(q)
+            return "n/a" if v is None else f"{v:.1f}ms"
+
+        return (f"obs: ttft_p50={pct('ttft_ms', 'p50')} "
+                f"ttft_p99={pct('ttft_ms', 'p99')} "
+                f"itl_p50={pct('itl_ms', 'p50')} "
+                f"itl_p99={pct('itl_ms', 'p99')} "
+                f"telemetry={st.get('telemetry')}")
+
+    def _dump_obs():
+        if tracing["dumped"]:
+            return
+        tracing["dumped"] = True
+        st = srv.stats()
+        if tracing["ctx"] is not None:
+            tracing["ctx"].__exit__(None, None, None)
+            sess = tracing["sess"]
+            merged = sess.export()
+            metrics = sess.export_metrics(st)
+            print(f"trace: merged={merged} metrics={metrics}",
+                  flush=True)
+        # The obs: line is opt-in (--trace-out / --telemetry): default
+        # runs keep their pre-existing stdout contract.
+        if ((args.trace_out or args.telemetry)
+                and st.get("latency") is not None):
+            print(_obs_line(st), flush=True)
 
     # Checkpoint/restore wiring (layer path): a SIGTERM mid-serve
     # snapshots the full serving state between ticks; a restart with
@@ -247,24 +307,29 @@ def main():
         inflight = len(srv.sched.queue) + len(srv.sched.slots)
         print(f"\ncheckpointed {inflight} in-flight "
               f"request(s) to {ckpt_path}", flush=True)
+        _dump_obs()
         sys.exit(0)
 
-    if args.checkpoint_dir:
+    if args.checkpoint_dir or args.trace_out:
         import signal
 
-        os.makedirs(args.checkpoint_dir, exist_ok=True)
-        ckpt_path = os.path.join(args.checkpoint_dir, "serving.ckpt")
-
         def _on_term(signum, frame):
-            # Mid-serve: only set the flag — the snapshot happens at
-            # the next tick boundary where the state is consistent.
+            # Mid-serve: only set the flag — the snapshot/dump happens
+            # at the next tick boundary where the state is consistent.
             # Idle (blocked on stdin): the engine IS at a boundary, so
-            # snapshot and exit right here — otherwise Python's EINTR
-            # retry resumes the readline and the signal is swallowed.
+            # act right here — otherwise Python's EINTR retry resumes
+            # the readline and the signal is swallowed.
             stop["flag"] = True
             if not stop["serving"]:
-                _snapshot_and_exit()
+                if ckpt_path:
+                    _snapshot_and_exit()
+                _dump_obs()
+                sys.exit(0)
 
+        if args.checkpoint_dir:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            ckpt_path = os.path.join(args.checkpoint_dir,
+                                     "serving.ckpt")
         signal.signal(signal.SIGTERM, _on_term)
 
     def _checkpoint_tick():
@@ -274,6 +339,11 @@ def main():
                 args.checkpoint_after
                 and done_here >= args.checkpoint_after)):
             _snapshot_and_exit()
+        elif stop["flag"]:
+            # --trace-out without a checkpoint dir: SIGTERM still
+            # drains the telemetry at the tick boundary.
+            _dump_obs()
+            sys.exit(0)
 
     def run_serving():
         stop["serving"] = True
@@ -374,6 +444,7 @@ def main():
                 f"e{e}->r{r}"
                 for e, r in sorted(st["replicated_experts"].items())))
     print(line, flush=True)
+    _dump_obs()
 
 
 if __name__ == "__main__":

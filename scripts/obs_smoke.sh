@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Observability smoke battery on the CPU mesh (no TPU):
+#
+#  1. tests/test_obs.py — histogram bucket math + percentiles, the
+#     bounded event ring + JSONL round-trip, deterministic fake-clock
+#     span timelines for every serving path (chunked, disagg, spec,
+#     retry, failover, preemption), Perfetto-export well-formedness,
+#     and the telemetry="spans" bit-exactness + jit no-growth gates;
+#  2. a traced chat-server e2e: --trace-out must produce a non-empty,
+#     json-loadable merged Perfetto trace + metrics.json and print the
+#     one-line `obs:` latency summary;
+#  3. a SIGTERM drain: the same dump fires on termination mid-session.
+#
+# Sibling of scripts/serve_smoke.sh, wired as `make obs-smoke`. The
+# bench keys this subsystem owns (serving_ttft_ms / serving_itl_ms /
+# telemetry_overhead_pct) ride the interpret serving bench inside
+# bench.py — gated there by the established nulled-not-omitted
+# convention, not re-run here.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PY=${PY:-python}
+
+echo "== observability battery (CPU mesh) =="
+$PY -m pytest tests/test_obs.py -q
+
+echo "== traced chat e2e: merged Perfetto + metrics + obs: line =="
+TDIR=$(mktemp -d)
+trap 'rm -rf "$TDIR"' EXIT
+out=$(printf '1 2 3\n9 8 7 6\n' | timeout 300 $PY examples/chat_server.py \
+      --tp 2 --gen-len 6 --trace-out "$TDIR")
+echo "$out"
+echo "$out" | grep -q '^obs: ttft_p50=' \
+  || { echo "missing obs: exit summary"; exit 1; }
+[ -s "$TDIR/merged_trace.json" ] \
+  || { echo "merged Perfetto trace missing/empty"; exit 1; }
+[ -s "$TDIR/metrics.json" ] \
+  || { echo "metrics.json missing/empty"; exit 1; }
+$PY - "$TDIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+t = json.load(open(f"{d}/merged_trace.json"))
+evs = t["traceEvents"]
+host = [e for e in evs if e.get("pid") == 1 and e.get("ph") in ("X", "i")]
+kinds = {e["args"].get("kind") for e in host if "args" in e}
+assert len(evs) > 0 and host, f"no host spans in merged trace ({len(evs)} events)"
+assert {"queue_wait", "decode", "request"} <= kinds, f"span kinds missing: {sorted(k for k in kinds if k)}"
+m = json.load(open(f"{d}/metrics.json"))
+lat = m["stats"]["latency"]
+assert lat["ttft_ms"]["count"] >= 2 and lat["itl_ms"]["count"] >= 1, lat
+print(f"obs-smoke: merged trace ok ({len(evs)} events, "
+      f"{len(host)} host spans, kinds={sorted(k for k in kinds if k)})")
+EOF
+
+echo "== SIGTERM drains the telemetry dump =="
+TDIR2=$(mktemp -d)
+trap 'rm -rf "$TDIR" "$TDIR2"' EXIT
+( printf '1 2 3\n'; sleep 30 ) | timeout 300 $PY examples/chat_server.py \
+      --tp 1 --gen-len 4 --trace-out "$TDIR2" > /tmp/obs_term.log 2>&1 &
+srv_pid=$!
+for i in $(seq 1 60); do
+  grep -q '^-> ' /tmp/obs_term.log 2>/dev/null && break
+  sleep 1
+done
+kill -TERM $srv_pid 2>/dev/null || true
+wait $srv_pid 2>/dev/null || true
+grep -q '^obs: ttft_p50=' /tmp/obs_term.log \
+  || { echo "SIGTERM did not print the obs: summary"; cat /tmp/obs_term.log; exit 1; }
+[ -s "$TDIR2/merged_trace.json" ] \
+  || { echo "SIGTERM did not dump the merged trace"; exit 1; }
+echo "obs-smoke: SIGTERM dump ok"

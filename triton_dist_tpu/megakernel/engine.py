@@ -30,7 +30,8 @@ class MegaKernelEngine:
                  num_cores: int = 1, strategy: str = "round_robin",
                  schedule: str = "static",
                  paged: bool = False, page=None, num_pages=None,
-                 cost_table=None, timeout_s=None):
+                 cost_table=None, timeout_s=None,
+                 profile: bool = False):
         """``timeout_s`` arms a per-step watchdog: every
         :meth:`decode_step` / :meth:`prefill` blocks on its result
         under a deadline and raises a structured
@@ -44,7 +45,17 @@ class MegaKernelEngine:
         comm-priority-ordered ready list — see docs/megakernel.md), or
         ``"auto"`` (the :func:`tune_schedule` winner persisted in the
         tune cache for this (model, mesh, batch, cores) key; falls
-        back to static when never tuned)."""
+        back to static when never tuned).
+
+        ``profile=True`` threads the builder's slot recorder through
+        the decode step: after every :meth:`decode_step`,
+        :attr:`last_prof` holds the (qlen·num_cores, 2) per-slot
+        (task_type, arg0) log — ``builder.prof_tracks(last_prof)``
+        shapes it for the Perfetto exporters, and a serving
+        :meth:`~triton_dist_tpu.serving.server.ServingEngine.trace`
+        session collects it into the merged trace automatically
+        (docs/observability.md). Decode-only: the batched prefill
+        builder never records."""
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -52,6 +63,8 @@ class MegaKernelEngine:
         self.batch = batch
         self.paged = paged
         self.timeout_s = timeout_s
+        self.profile = bool(profile)
+        self.last_prof = None
         if schedule == "auto":
             schedule = lookup_schedule(cfg, mesh, batch=batch,
                                        num_cores=num_cores, axis=axis)
@@ -76,7 +89,8 @@ class MegaKernelEngine:
                                     num_cores=num_cores,
                                     strategy=strategy,
                                     schedule=self.schedule, paged=paged,
-                                    page=page, cost_table=cost_table)
+                                    page=page, cost_table=cost_table,
+                                    profile=self.profile)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
             # buffer; prefill runs via prefill_chain (decode-only
@@ -192,6 +206,9 @@ class MegaKernelEngine:
         kvspec = P(None, None, None, self.axis, None)
         tblspec = P(None)
         step = self.builder.step_fn()
+        # profile=True appends the slot-recorder output (per-rank rows;
+        # rank 0's view is what the host keeps).
+        prof_spec = (P(None, None),) if self.profile else ()
         if self.cfg.is_hybrid:
             stspec = P(None, None, self.axis, None, None)
             self._step = jax.jit(jax.shard_map(
@@ -199,7 +216,7 @@ class MegaKernelEngine:
                 in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
                           P(None), tblspec, stspec),
                 out_specs=(P(None, self.axis), P(self.axis, None),
-                           kvspec, kvspec, stspec),
+                           kvspec, kvspec, stspec) + prof_spec,
                 check_vma=False), donate_argnums=(0, 1, 2, 6))
         else:
             self._step = jax.jit(jax.shard_map(
@@ -207,7 +224,7 @@ class MegaKernelEngine:
                 in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
                           P(None), tblspec),
                 out_specs=(P(None, self.axis), P(self.axis, None),
-                           kvspec, kvspec),
+                           kvspec, kvspec) + prof_spec,
                 check_vma=False), donate_argnums=(0, 1, 2))
 
     def expert_counts(self) -> np.ndarray:
@@ -323,16 +340,24 @@ class MegaKernelEngine:
             jnp.asarray(cache_len, jnp.int32).reshape(-1),
             (self.batch,))
         if self.states is not None:
-            (logits, self._arena, self.k_cache, self.v_cache,
-             self.states) = self._step(
+            outs = self._step(
                 self._arena, self.k_cache, self.v_cache,
                 jnp.asarray(token_ids, jnp.int32), lens,
                 self.block_table, self.states)
+            if self.profile:
+                self.last_prof = outs[-1]
+                outs = outs[:-1]
+            (logits, self._arena, self.k_cache, self.v_cache,
+             self.states) = outs
         else:
-            logits, self._arena, self.k_cache, self.v_cache = self._step(
+            outs = self._step(
                 self._arena, self.k_cache, self.v_cache,
                 jnp.asarray(token_ids, jnp.int32), lens,
                 self.block_table)
+            if self.profile:
+                self.last_prof = outs[-1]
+                outs = outs[:-1]
+            logits, self._arena, self.k_cache, self.v_cache = outs
         return self._finish(logits, "megakernel.decode_step")
 
     def prefill_chain(self, prompt_ids):

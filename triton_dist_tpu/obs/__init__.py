@@ -1,0 +1,40 @@
+"""Serving observability: span timelines, latency histograms, merged
+Perfetto traces.
+
+The telemetry substrate ROADMAP item 5c's "at production traffic you
+debug with traces, not reruns" calls for (see docs/observability.md):
+
+- :mod:`~triton_dist_tpu.obs.spans` — the typed span taxonomy and the
+  bounded :class:`EventLog` ring with JSONL round-trip;
+- :mod:`~triton_dist_tpu.obs.hist` — fixed log-spaced-bucket latency
+  histograms (TTFT / inter-token / per-op) with percentile summaries
+  and per-tenant grouping;
+- :mod:`~triton_dist_tpu.obs.telemetry` — the per-engine facade behind
+  ``ServingEngine(telemetry="off"|"counters"|"spans")``;
+- :mod:`~triton_dist_tpu.obs.xprof` — best-effort device-span
+  extraction from an xprof capture, keyed to
+  :func:`~triton_dist_tpu.profiler.trace_scalar` markers;
+- :mod:`~triton_dist_tpu.obs.trace` — the one-directory trace session
+  ``ServingEngine.trace()`` yields (xprof + host spans + megakernel
+  slot records -> one merged Perfetto file).
+
+Everything here is host-side bookkeeping on the engine's injectable
+clock: recording never touches a jitted dispatch, so the serving
+no-recompilation gates hold with full span recording active.
+"""
+
+from triton_dist_tpu.obs.spans import (  # noqa: F401
+    SPAN_KINDS,
+    EventLog,
+    Span,
+)
+from triton_dist_tpu.obs.hist import (  # noqa: F401
+    HistogramSet,
+    LatencyHistogram,
+)
+from triton_dist_tpu.obs.telemetry import (  # noqa: F401
+    TELEMETRY_MODES,
+    Telemetry,
+)
+from triton_dist_tpu.obs.xprof import extract_xprof_spans  # noqa: F401
+from triton_dist_tpu.obs.trace import TraceSession  # noqa: F401

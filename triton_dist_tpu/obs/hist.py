@@ -1,0 +1,180 @@
+"""Fixed log-spaced-bucket latency histograms.
+
+The serving telemetry's distribution primitive: a histogram with
+PRECOMPUTED geometric bucket boundaries (no per-observation allocation,
+no dynamic resizing — the counters-mode hot path is one bisect plus an
+integer increment), percentile summaries read off the cumulative
+counts, and per-tenant grouping via :class:`HistogramSet`.
+
+Bucket semantics: boundaries ``b_0 < b_1 < ... < b_n`` with a constant
+ratio ``b_{i+1}/b_i = 10^(1/buckets_per_decade)``; bucket ``i`` covers
+``[b_i, b_{i+1})``, plus an underflow bucket below ``b_0`` and an
+overflow bucket at/above ``b_n``. A percentile answers with the
+GEOMETRIC MIDPOINT of its bucket (clamped to the observed min/max), so
+the relative error is bounded by the bucket ratio (~±21% at the
+default 6 buckets/decade) — the right trade for serving dashboards,
+where the shape of the tail matters and exact sub-bucket rank does
+not. Values are SECONDS internally; summaries report milliseconds.
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_right
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LatencyHistogram", "HistogramSet"]
+
+
+class LatencyHistogram:
+    """Log-spaced-bucket histogram over positive values (seconds).
+
+    ``lo``/``hi`` bound the bucketed range (values outside land in the
+    under/overflow buckets — counted, never lost); the defaults span
+    1µs..1000s, wide enough for both a fake-clock unit test and a real
+    multi-minute prefill.
+    """
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1e3,
+                 buckets_per_decade: int = 6):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+        if buckets_per_decade < 1:
+            raise ValueError("buckets_per_decade must be >= 1")
+        decades = math.log10(hi / lo)
+        n = max(1, int(round(decades * buckets_per_decade)))
+        ratio = (hi / lo) ** (1.0 / n)
+        # Exact geometric ladder; the last bound is pinned to hi so
+        # float accumulation cannot shift the overflow edge.
+        self.bounds: List[float] = [lo * ratio ** i for i in range(n)]
+        self.bounds.append(hi)
+        self.ratio = ratio
+        # counts[0] = underflow, counts[1..n] = buckets, counts[n+1] =
+        # overflow.
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def bucket_index(self, v: float) -> int:
+        """Index into ``counts`` for value ``v`` (0 = underflow,
+        ``len(bounds)`` = overflow)."""
+        return bisect_right(self.bounds, v)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.counts[self.bucket_index(v)] += 1
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` in [0, 1] — the geometric midpoint
+        of the bucket holding the q-th observation, clamped to the
+        observed min/max. None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(q * self.count))
+        cum = 0
+        idx = len(self.counts) - 1
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                idx = i
+                break
+        if idx == 0:                       # underflow bucket
+            rep = self.bounds[0]
+        elif idx >= len(self.bounds):      # overflow bucket
+            rep = self.bounds[-1]
+        else:
+            rep = math.sqrt(self.bounds[idx - 1] * self.bounds[idx])
+        return min(max(rep, self.min), self.max)
+
+    def summary(self) -> Optional[dict]:
+        """p50/p95/p99 + count/mean/min/max in MILLISECONDS (None when
+        nothing was observed)."""
+        if self.count == 0:
+            return None
+        ms = lambda v: round(v * 1e3, 4)  # noqa: E731 — local fmt
+        return {
+            "count": self.count,
+            "p50": ms(self.percentile(0.50)),
+            "p95": ms(self.percentile(0.95)),
+            "p99": ms(self.percentile(0.99)),
+            "mean": ms(self.total / self.count),
+            "min": ms(self.min),
+            "max": ms(self.max),
+        }
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Fold ``other`` in (bucket layouts must match)."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bucket layouts differ")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        if other.min is not None:
+            self.min = (other.min if self.min is None
+                        else min(self.min, other.min))
+        if other.max is not None:
+            self.max = (other.max if self.max is None
+                        else max(self.max, other.max))
+
+
+class HistogramSet:
+    """Named histograms with optional per-tenant/per-tag grouping.
+
+    ``observe(name, v)`` updates the aggregate series; a non-None
+    ``tenant`` additionally updates the ``(name, tenant)`` series — so
+    the aggregate is always the sum of its groups plus the untagged
+    traffic, and summaries never double-count.
+    """
+
+    def __init__(self, **hist_kw):
+        self._hist_kw = hist_kw
+        self._series: Dict[Tuple[str, Optional[str]],
+                           LatencyHistogram] = {}
+
+    def _get(self, name: str, tenant: Optional[str]) -> LatencyHistogram:
+        key = (name, tenant)
+        h = self._series.get(key)
+        if h is None:
+            h = self._series[key] = LatencyHistogram(**self._hist_kw)
+        return h
+
+    def observe(self, name: str, v: float,
+                tenant: Optional[str] = None) -> None:
+        self._get(name, None).observe(v)
+        if tenant is not None:
+            self._get(name, tenant).observe(v)
+
+    def get(self, name: str, tenant: Optional[str] = None
+            ) -> Optional[LatencyHistogram]:
+        return self._series.get((name, tenant))
+
+    def summary(self) -> dict:
+        """``{name: summary}`` for the aggregates plus
+        ``{"per_tenant": {tenant: {name: summary}}}`` when any tagged
+        traffic was observed."""
+        out: dict = {}
+        tenants: dict = {}
+        for (name, tenant), h in sorted(
+                self._series.items(),
+                key=lambda kv: (kv[0][0], kv[0][1] or "")):
+            s = h.summary()
+            if s is None:
+                continue
+            if tenant is None:
+                out[name] = s
+            else:
+                tenants.setdefault(tenant, {})[name] = s
+        if tenants:
+            out["per_tenant"] = tenants
+        return out

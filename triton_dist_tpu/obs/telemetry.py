@@ -1,0 +1,211 @@
+"""The serving telemetry facade: one object per engine.
+
+Three modes, chosen at engine construction
+(``ServingEngine(telemetry=...)``):
+
+- ``"off"`` — every hook is a no-op (the pre-existing counters in
+  ``stats()`` still work; nothing here runs on the hot path).
+- ``"counters"`` — the cheap default: latency histograms (TTFT,
+  inter-token latency, per-op durations) and named counters. No span
+  objects are allocated; the hot-path cost is two clock reads and one
+  histogram bisect per instrumented region.
+- ``"spans"`` — everything above PLUS the full typed-span timeline in
+  the bounded :class:`~triton_dist_tpu.obs.spans.EventLog` (JSONL
+  export, Perfetto merge).
+
+All stamping is host-side on the engine's injectable clock — a fake
+clock makes timelines deterministic in tests, and nothing here is ever
+traced into a jit, so the decode/prefill no-growth gates hold with
+spans active.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from triton_dist_tpu.obs.hist import HistogramSet
+from triton_dist_tpu.obs.spans import EventLog, Span
+
+__all__ = ["TELEMETRY_MODES", "Telemetry"]
+
+TELEMETRY_MODES = ("off", "counters", "spans")
+
+# Span kinds whose durations feed the per-op histogram series
+# ("op:<kind>" in the latency summary).
+_OP_HIST_KINDS = frozenset({
+    "queue_wait", "prefill", "prefill_chunk", "migration", "decode",
+    "spec_draft", "spec_verify", "checkpoint", "restore", "request",
+})
+
+
+class _NullSpan:
+    """Shared no-op context (``telemetry="off"`` / events disabled)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _SpanCtx:
+    """One timed region: clock at enter/exit, histogram fold, and (in
+    spans mode) an EventLog append — error type recorded when the
+    region raised."""
+
+    __slots__ = ("tel", "kind", "fields")
+
+    def __init__(self, tel: "Telemetry", kind: str, fields: dict):
+        self.tel = tel
+        self.kind = kind
+        self.fields = fields
+
+    def __enter__(self):
+        self.fields["_t0"] = self.tel.clock()
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        tel = self.tel
+        fields = self.fields
+        t0 = fields.pop("_t0")
+        t1 = tel.clock()
+        if etype is not None:
+            fields["error"] = etype.__name__
+        tel._finish_span(self.kind, t0, t1, fields)
+        return False
+
+
+class Telemetry:
+    """Per-engine telemetry sink (see module docstring).
+
+    ``clock`` is the engine's monotonic clock (injectable);
+    ``capacity`` bounds the spans-mode event ring.
+    """
+
+    def __init__(self, mode: str = "counters", *,
+                 clock: Callable[[], float] = time.monotonic,
+                 capacity: int = 4096, **hist_kw):
+        if mode not in TELEMETRY_MODES:
+            raise ValueError(
+                f"telemetry must be one of {TELEMETRY_MODES}, got "
+                f"{mode!r}")
+        self.mode = mode
+        self.clock = clock
+        self.log = EventLog(capacity)
+        self.hist = HistogramSet(**hist_kw)
+        self.counters: Dict[str, int] = {}
+
+    # -- mode predicates ---------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    @property
+    def spans_on(self) -> bool:
+        return self.mode == "spans"
+
+    def now(self) -> float:
+        return self.clock()
+
+    # -- recording ----------------------------------------------------
+
+    def span(self, kind: str, **fields):
+        """Context manager timing one region. In counters mode the
+        duration folds into the ``op:<kind>`` histogram; in spans mode
+        a :class:`Span` is appended too. Off mode: a shared no-op."""
+        if self.mode == "off":
+            return _NULL
+        return _SpanCtx(self, kind, fields)
+
+    def _finish_span(self, kind: str, t0: float, t1: float,
+                     fields: dict) -> None:
+        tenant = fields.get("tenant")
+        if kind in _OP_HIST_KINDS:
+            self.hist.observe(f"op:{kind}", t1 - t0, tenant)
+        if self.mode == "spans":
+            self.log.append(Span(
+                kind=kind, t0=t0, t1=t1,
+                request_id=fields.pop("request_id", None),
+                slot=fields.pop("slot", None),
+                step=fields.pop("step", None),
+                tenant=fields.pop("tenant", None),
+                attrs=fields))
+
+    def complete_span(self, kind: str, t0: float,
+                      t1: Optional[float] = None, **fields) -> None:
+        """Record a span whose start was stamped earlier (e.g.
+        queue-wait: ``t0`` is the submit time). ``t1`` defaults to
+        now."""
+        if self.mode == "off":
+            return
+        self._finish_span(kind, t0, self.clock() if t1 is None else t1,
+                          fields)
+
+    def event(self, kind: str, **fields) -> None:
+        """Instant event (spans mode only — events are timeline
+        entries, not distributions). Also bumps the ``kind`` counter in
+        any enabled mode."""
+        if self.mode == "off":
+            return
+        self.counters[kind] = self.counters.get(kind, 0) + 1
+        if self.mode == "spans":
+            self.log.append(Span(
+                kind=kind, t0=self.clock(), t1=None,
+                request_id=fields.pop("request_id", None),
+                slot=fields.pop("slot", None),
+                step=fields.pop("step", None),
+                tenant=fields.pop("tenant", None),
+                attrs=fields))
+
+    def observe(self, name: str, seconds: float,
+                tenant: Optional[str] = None) -> None:
+        """Fold one duration into the named histogram (TTFT / ITL /
+        custom series)."""
+        if self.mode != "off":
+            self.hist.observe(name, seconds, tenant)
+
+    def count(self, name: str, inc: int = 1) -> None:
+        if self.mode != "off":
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    # -- readout ------------------------------------------------------
+
+    def latency_summary(self) -> Optional[dict]:
+        """The ``stats()["latency"]`` payload: named histogram
+        summaries in ms (``ttft_ms`` / ``itl_ms`` aliased from the
+        raw series names), per-op durations under ``ops``, per-tenant
+        groups, counters, and the event-ring accounting. None in off
+        mode."""
+        if self.mode == "off":
+            return None
+        raw = self.hist.summary()
+        out: dict = {
+            "ttft_ms": raw.pop("ttft", None),
+            "itl_ms": raw.pop("itl", None),
+        }
+        ops = {k[len("op:"):]: raw.pop(k)
+               for k in sorted(raw) if k.startswith("op:")}
+        if ops:
+            out["ops"] = ops
+        per_tenant = raw.pop("per_tenant", None)
+        if per_tenant:
+            out["per_tenant"] = {
+                t: {("ttft_ms" if n == "ttft" else
+                     "itl_ms" if n == "itl" else n): s
+                    for n, s in series.items()}
+                for t, series in per_tenant.items()}
+        out.update(raw)          # any remaining custom series
+        if self.counters:
+            out["counters"] = dict(sorted(self.counters.items()))
+        if self.spans_on:
+            out["events"] = {"recorded": self.log.total,
+                             "retained": len(self.log),
+                             "dropped": self.log.dropped}
+        return out

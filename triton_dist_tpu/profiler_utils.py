@@ -78,18 +78,31 @@ def perf_func(fn: Callable, args: Sequence, *, iters_lo: int = 8,
 
 @contextlib.contextmanager
 def group_profile(name: str = "trace", *, log_dir: str = "/tmp/tdt_traces",
-                  create_perfetto_link: bool = False):
+                  create_perfetto_link: bool = False,
+                  create_perfetto_trace: bool = False):
     """Capture a multi-device profile viewable in Perfetto/TensorBoard.
 
     Reference ``group_profile`` merges per-rank torch traces
     (``profiler_utils.py:100-204``); ``jax.profiler.trace`` already
     captures every local device into one trace directory.
+    ``create_perfetto_trace`` additionally materializes the capture as
+    ``perfetto_trace.json.gz`` in the session directory (forwarded to
+    ``jax.profiler.trace`` when this jax supports it; silently dropped
+    on older versions — the ``*.trace.json.gz`` the capture always
+    writes is what :func:`~triton_dist_tpu.obs.extract_xprof_spans`
+    mines either way).
     """
+    import inspect
+
     import jax
 
     path = f"{log_dir}/{name}"
-    with jax.profiler.trace(path,
-                            create_perfetto_link=create_perfetto_link):
+    kw = {"create_perfetto_link": create_perfetto_link}
+    if create_perfetto_trace:
+        sig = inspect.signature(jax.profiler.trace)
+        if "create_perfetto_trace" in sig.parameters:
+            kw["create_perfetto_trace"] = True
+    with jax.profiler.trace(path, **kw):
         yield path
 
 

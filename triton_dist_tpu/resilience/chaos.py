@@ -79,7 +79,12 @@ DEFAULT_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
 
 @dataclasses.dataclass
 class ChaosEvent:
-    """One scheduled fault: where, what, and what it observably did."""
+    """One scheduled fault: where, what, and what it observably did.
+
+    ``at`` is the serving engine's clock reading when the fault fired
+    (None until then) — soak runs are trace-inspectable: the same
+    timestamp domain the request spans and retry events use, so a
+    fault lines up against its victims in the merged timeline."""
 
     tick: int
     name: str
@@ -88,6 +93,7 @@ class ChaosEvent:
     transient: bool
     fired: bool = False       # the fault had a chance to act this tick
     observed: bool = False    # a failure/retry counter moved this tick
+    at: Optional[float] = None  # engine-clock stamp when fired
 
 
 @dataclasses.dataclass
@@ -237,6 +243,16 @@ def _oracle_tokens(engine, prompt: Sequence[int], gen_len: int,
     return cache[key]
 
 
+def _note_fault(srv, ev: ChaosEvent) -> None:
+    """Land the injected fault in the engine's telemetry event log —
+    the soak's faults and the serving spans share ONE timeline, so a
+    retry burst or a failover reads directly against the fault that
+    caused it."""
+    srv.obs.event("chaos_fault", tick=ev.tick, name=ev.name,
+                  op=ev.op, fault_kind=ev.kind,
+                  transient=ev.transient)
+
+
 def _plan_for(ev: ChaosEvent) -> faults.FaultPlan:
     k = 0 if ev.transient else None
     return faults.FaultPlan(
@@ -330,17 +346,23 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
             tracked = [(p, g, revived.get(h.request.request_id, h))
                        for p, g, h in tracked]
             restored_tick = tick
+            srv.obs.event("chaos_restore", tick=tick,
+                          revived=len(revived))
         _submit_maybe()
         ev = schedule.get(tick)
         if ev is None:
             srv.step()
         elif ev.name == "kill_prefill_worker":
+            ev.at = srv.sched.now()
+            _note_fault(srv, ev)
             killed = bool(getattr(srv, "fail_prefill_worker",
                                   lambda: False)())
             ev.fired, ev.observed = True, killed
             srv.step()
         else:
             before = _tick_counters()
+            ev.at = srv.sched.now()
+            _note_fault(srv, ev)
             with faults.inject(_plan_for(ev)):
                 srv.step()
             ev.fired = True

@@ -101,6 +101,7 @@ class RetryPolicy:
              retry_on: Tuple = (Exception,),
              deadline_s: Optional[float] = None,
              on_retry: Optional[Callable] = None,
+             event_cb: Optional[Callable] = None,
              sleep: Callable[[float], None] = time.sleep):
         """Run ``fn()`` under the policy; returns ``(result, attempts)``.
 
@@ -111,24 +112,41 @@ class RetryPolicy:
         with attempts left — the bench probe's budget semantics.
         ``on_retry(attempt, exc)`` fires before each backoff sleep
         (telemetry: the serving counters and ``probe_attempts`` hang
-        off it). ``sleep`` is injectable so tests never wall-clock.
+        off it). ``event_cb(kind, **attrs)`` — when given — receives
+        the policy's timeline events (``"retry_backoff"`` with the
+        scheduled delay before each sleep, ``"retry_giveup"`` when the
+        attempts or the deadline exhaust); the serving telemetry layer
+        passes its span-log emitter here so backoff schedules are
+        trace-inspectable (docs/observability.md). ``sleep`` is
+        injectable so tests never wall-clock.
         """
         rng = random.Random(self.seed)
         t_end = (None if deadline_s is None
                  else time.monotonic() + deadline_s)
         attempt = 0
+
+        def _emit(kind, **attrs):
+            if event_cb is not None:
+                event_cb(kind, op=op, **attrs)
+
         while True:
             attempt += 1
             try:
                 return fn(), attempt
             except retry_on as e:
                 if attempt >= self.max_attempts:
+                    _emit("retry_giveup", attempts=attempt,
+                          error=type(e).__name__)
                     raise
                 d = self.delay_s(attempt, rng)
                 if t_end is not None and time.monotonic() + d > t_end:
+                    _emit("retry_giveup", attempts=attempt,
+                          error=type(e).__name__, deadline=True)
                     raise
                 if on_retry is not None:
                     on_retry(attempt, e)
+                _emit("retry_backoff", attempt=attempt,
+                      delay_s=round(d, 6), error=type(e).__name__)
                 logger.warning(
                     "op %r attempt %d/%d failed (%r); retrying in "
                     "%.3fs", op or "<fn>", attempt, self.max_attempts,

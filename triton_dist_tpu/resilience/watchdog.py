@@ -63,22 +63,44 @@ class HealthTracker:
     completed unit of work resets the streak; ``fail()`` records one
     exhausted-retries failure and returns whether the role just died.
     ``clock`` is injectable (fake-clock tests, the chaos harness).
+
+    Observability: every ``fail``/``dead`` verdict is appended to
+    ``history`` (a bounded ring of ``(clock_time, kind, cause)``
+    tuples) and forwarded to ``on_event(kind, clock_time, cause)``
+    when given — the serving telemetry layer wires this into its span
+    timeline so role health reads off the same trace as the request
+    spans (docs/observability.md). Beats reset streaks but are NOT
+    forwarded (one per completed chunk would drown the log).
     """
+
+    HISTORY = 64
 
     def __init__(self, *, fail_threshold: int = 3,
                  dead_after_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_event: Optional[Callable[[str, float, str],
+                                             None]] = None):
         if fail_threshold < 1:
             raise ValueError(f"fail_threshold must be >= 1, got "
                              f"{fail_threshold}")
         self.fail_threshold = fail_threshold
         self.dead_after_s = dead_after_s
         self.clock = clock
+        self.on_event = on_event
         self.consecutive_failures = 0
         self.total_failures = 0
         self.last_beat = clock()
         self.dead = False
         self.cause: Optional[str] = None
+        from collections import deque
+
+        self.history: "deque" = deque(maxlen=self.HISTORY)
+
+    def _note(self, kind: str, cause: str) -> None:
+        t = self.clock()
+        self.history.append((t, kind, cause))
+        if self.on_event is not None:
+            self.on_event(kind, t, cause)
 
     def beat(self) -> None:
         """One unit of work completed — the role is alive."""
@@ -91,6 +113,7 @@ class HealthTracker:
         once per death)."""
         self.total_failures += 1
         self.consecutive_failures += 1
+        self._note("fail", cause)
         if self.dead:
             return False
         if self.consecutive_failures >= self.fail_threshold:
@@ -111,6 +134,7 @@ class HealthTracker:
             return False
         self.dead = True
         self.cause = cause
+        self._note("dead", cause)
         return True
 
 

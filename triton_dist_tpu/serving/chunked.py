@@ -50,7 +50,7 @@ class ChunkedPrefill:
     """
 
     def __init__(self, engine, cache_shardings, buckets: Sequence[int],
-                 *, attn_impl: str = "ref"):
+                 *, attn_impl: str = "ref", telemetry=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -72,6 +72,10 @@ class ChunkedPrefill:
         self.engine = engine
         self.buckets = buckets
         self.attn_impl = attn_impl
+        # Optional obs.Telemetry sink: per-bucket dispatch counters +
+        # host-side dispatch-time histogram (the owning engine passes
+        # its own; a standalone ChunkedPrefill records nothing).
+        self.telemetry = telemetry
         cfg, mesh, axis = engine.cfg, engine.mesh, engine.axis
         # Chunk steps take only the regime kwargs — transport/replica/
         # counts are decode-dispatch knobs the chunk contract ignores.
@@ -115,10 +119,19 @@ class ChunkedPrefill:
         so the trace signature depends only on the bucket length."""
         import jax.numpy as jnp
 
+        tel = self.telemetry
+        t0 = tel.now() if tel is not None and tel.enabled else None
         logits, cache = self._chunk(
             params, jnp.asarray(toks, jnp.int32), cache,
             jnp.asarray(table_row, jnp.int32), np.int32(start),
             np.int32(wfrom), np.int32(valid))
+        if t0 is not None:
+            # Host dispatch time (the chunk result is async; the
+            # request-level wait is the server's prefill_chunk span) +
+            # which bucket this chunk rode — the padding-efficiency
+            # counter docs/observability.md describes.
+            tel.observe("chunk_dispatch", tel.now() - t0)
+            tel.count(f"chunk_bucket_{toks.shape[0]}")
         # The no-growth gate, enforced inline: every chunk shape comes
         # from `buckets`, so more cache entries than buckets means a
         # shape leak (exactly the recompile-per-length failure this

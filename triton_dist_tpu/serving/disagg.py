@@ -81,7 +81,7 @@ class PrefillWorker:
                  num_pages: Optional[int] = None,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  prefix_reuse: bool = False, kv_dtype: str = "bf16",
-                 attn_impl: str = "ref"):
+                 attn_impl: str = "ref", telemetry=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -125,7 +125,8 @@ class PrefillWorker:
             jax.device_put, cache, self.shardings,
             is_leaf=lambda x: isinstance(x, jax.Array))
         self.chunker = ChunkedPrefill(engine, self.shardings, buckets,
-                                      attn_impl=attn_impl)
+                                      attn_impl=attn_impl,
+                                      telemetry=telemetry)
         # Liveness + transport, managed by the owning engine: ``dead``
         # flips on a declared failover; ``migration``/``bridge`` are
         # the per-worker payload transport (each worker's mesh slice
@@ -229,13 +230,12 @@ class DisaggServingEngine(ServingEngine):
                 pf_eng, page=self.page, p_max=self.p_max,
                 num_slots=self.num_slots, num_pages=prefill_num_pages,
                 buckets=prefill_buckets, prefix_reuse=prefix_reuse,
-                kv_dtype=self.kv_dtype, attn_impl=self.chunk_attn)
+                kv_dtype=self.kv_dtype, attn_impl=self.chunk_attn,
+                telemetry=self.obs)
             self._setup_transport(w, migration)
             self.prefill_workers.append(w)
         self._prefiller = self.prefill_workers[0]
-        self._pf_health = HealthTracker(
-            fail_threshold=self.worker_fail_threshold,
-            clock=self.sched.clock)
+        self._pf_health = self._make_pf_health()
 
         import jax
 
@@ -256,6 +256,19 @@ class DisaggServingEngine(ServingEngine):
                 out_shardings=self._cache_shardings)
         self._pending: List[tuple] = []
         self._handoff_stalled: List[RequestHandle] = []
+
+    def _make_pf_health(self):
+        """Fresh prefill-role health tracker wired into the telemetry
+        event log: every post-retry failure and death verdict lands in
+        the same timeline the request spans live on."""
+        from triton_dist_tpu.resilience.watchdog import HealthTracker
+
+        def _on_event(kind, at, cause):
+            self.obs.event(f"role_{kind}", role="prefill", cause=cause)
+
+        return HealthTracker(
+            fail_threshold=self.worker_fail_threshold,
+            clock=self.sched.clock, on_event=_on_event)
 
     def _setup_transport(self, w: PrefillWorker, migration: str):
         """Resolve one worker's payload transport against the decode
@@ -335,6 +348,7 @@ class DisaggServingEngine(ServingEngine):
                 self._fail(h, "failed", e)
                 return
             h.status = "queued"
+            h.queued_at = self.sched.now()
             self._handoff_stalled.append(h)
             self.stats_counters["admit_stalls"] += 1
             return
@@ -382,15 +396,20 @@ class DisaggServingEngine(ServingEngine):
             slot = h.slot
 
             def _attempt(payload=payload, dst_ids=dst_ids, pw=pw,
-                         slot=slot):
+                         slot=slot, h=h, n_mig=n_mig):
                 # Replay-idempotent: re-staging the same source pages
                 # and re-scattering the same bytes (+ scales) into the
                 # same dst ids — prefix rows stay scratch-routed, and
                 # the two-phase prefix publication means no other
-                # request can be reading the target pages yet.
+                # request can be reading the target pages yet. One
+                # span per ATTEMPT (retries repeat it).
                 k_pay, v_pay = payload[:2]
                 scales = payload[2:]    # () or (k_scale, v_scale)
-                with faults.on_op_call("page_migration"):
+                with self.obs.span(
+                        "migration", request_id=h.request.request_id,
+                        slot=slot, tenant=h.request.tenant,
+                        pages=n_mig, transport=pw.migration), \
+                        faults.on_op_call("page_migration"):
                     if pw.migration == "p2p":
                         from triton_dist_tpu.ops.p2p import (
                             migrate_pages_host)
@@ -485,8 +504,6 @@ class DisaggServingEngine(ServingEngine):
         host bookkeeping is cleared so pool invariants stay
         checkable). Decode-side pages already claimed by a migrating
         handle are released — its re-prefill re-allocates."""
-        from triton_dist_tpu.resilience.watchdog import HealthTracker
-
         dead = self._prefiller
         if not isinstance(dead, PrefillWorker):
             return False
@@ -504,6 +521,7 @@ class DisaggServingEngine(ServingEngine):
                 self.manager.free_slot(slot)
             self._lens[slot] = self._live[slot] = self._toks[slot] = 0
             h.status = "queued"
+            h.queued_at = self.sched.now()
             h.prompt_pos, h.lane, h.resident = 0, None, 0
             h.chunks = []
         for h in reversed(requeue):
@@ -529,11 +547,14 @@ class DisaggServingEngine(ServingEngine):
 
                 self.chunker = ChunkedPrefill(
                     self.engine, self._cache_shardings,
-                    self._pf_buckets, attn_impl=self.chunk_attn)
+                    self._pf_buckets, attn_impl=self.chunk_attn,
+                    telemetry=self.obs)
             self._prefiller = self
-        self._pf_health = HealthTracker(
-            fail_threshold=self.worker_fail_threshold,
-            clock=self.sched.clock)
+        self._pf_health = self._make_pf_health()
+        self.obs.event("failover", requeued=len(requeue),
+                       cause=str(cause),
+                       target=("local" if self._prefiller is self
+                               else "standby"))
         import logging
 
         logging.getLogger("triton_dist_tpu.resilience").warning(

@@ -56,6 +56,10 @@ class Request:
     host sees it. Sampling fields mirror ``Engine.serve`` (temperature
     0 = greedy); seeds fold per-request steps, so a request samples the
     same tokens whether it is served alone or in a shared batch.
+    ``tenant`` is a free-form grouping tag for the telemetry layer —
+    latency histograms (TTFT / inter-token) aggregate per tenant in
+    addition to the global series (docs/observability.md); it never
+    affects scheduling.
     """
 
     prompt: Sequence[int]
@@ -67,6 +71,7 @@ class Request:
     top_k: int = 0
     seed: int = 0
     stream_cb: Optional[Callable[[int, "RequestHandle"], None]] = None
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -100,6 +105,17 @@ class RequestHandle:
     lane: Optional[List[int]] = None
     resident: int = 0
     chunks: List = dataclasses.field(default_factory=list)
+    # Telemetry edges (engine clock): ``queued_at`` is when the handle
+    # LAST entered the wait queue (submission, or a preemption/stall/
+    # failover requeue — each resets it, so a queue_wait span never
+    # swallows time the request already spent running); the first/last
+    # emission stamps are what the TTFT and inter-token-latency
+    # histograms read. Host-side only — never serialized into a
+    # checkpoint (a restored request records no second TTFT, and its
+    # ITL restarts at its first post-restore token).
+    queued_at: float = 0.0
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
 
     @property
     def done(self) -> bool:
@@ -147,6 +163,7 @@ class Scheduler:
             request = dataclasses.replace(
                 request, request_id=f"req-{next(self._ids)}")
         h = RequestHandle(request=request, submitted_at=self.now())
+        h.queued_at = h.submitted_at
         self.queue.append(h)
         self.counters["submitted"] += 1
         self.counters["queue_peak"] = max(self.counters["queue_peak"],

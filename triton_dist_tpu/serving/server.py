@@ -108,7 +108,9 @@ class ServingEngine:
                  load_alpha: float = 0.25,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  kv_dtype: str = "bf16", spec_k: int = 0,
-                 spec_ngram: int = 3, retry=None):
+                 spec_ngram: int = 3, retry=None,
+                 telemetry: str = "counters",
+                 telemetry_capacity: int = 4096):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -168,6 +170,17 @@ class ServingEngine:
         timed-out transfer is retried with deterministic exponential
         backoff before the request is failed. Each absorbed transient
         increments ``stats()["retries"]``.
+
+        ``telemetry``: ``"off"`` | ``"counters"`` (default) |
+        ``"spans"`` — the :mod:`~triton_dist_tpu.obs` recording level.
+        Counters mode folds TTFT / inter-token / per-op latency
+        histograms (surfaced in ``stats()["latency"]``); spans mode
+        additionally records the full typed-span timeline into a
+        bounded ring of ``telemetry_capacity`` entries (JSONL export,
+        Perfetto merge via :meth:`trace`). All stamping is host-side
+        on the injectable ``clock`` — token outputs and every jit
+        no-growth gate are identical across modes
+        (docs/observability.md).
         """
         from triton_dist_tpu.megakernel.engine import MegaKernelEngine
         from triton_dist_tpu.resilience.policy import RetryPolicy
@@ -192,6 +205,16 @@ class ServingEngine:
                 "retry must be a RetryPolicy, an {op: RetryPolicy} "
                 f"dict, or None — got {type(retry).__name__}")
 
+        from triton_dist_tpu.obs import Telemetry
+
+        # The telemetry sink rides the SAME injectable clock as the
+        # scheduler, so fake-clock tests see deterministic timelines;
+        # built first — the draft, chunker, and layer-path plumbing
+        # below all hold a reference.
+        self.obs = Telemetry(telemetry, clock=clock,
+                             capacity=telemetry_capacity)
+        self._trace_session = None
+
         kv_quant_spec(kv_dtype)        # validate the knob early
         self.kv_dtype = kv_dtype
         if attn_impl not in ("ref", "kernel", "flash"):
@@ -213,7 +236,7 @@ class ServingEngine:
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
-        self._draft = NgramDraft(spec_ngram)
+        self._draft = NgramDraft(spec_ngram, telemetry=self.obs)
 
         self.engine = engine
         self.mega = isinstance(engine, MegaKernelEngine)
@@ -394,7 +417,8 @@ class ServingEngine:
 
             self.chunker = ChunkedPrefill(eng, shardings,
                                           self.prefill_buckets,
-                                          attn_impl=self.chunk_attn)
+                                          attn_impl=self.chunk_attn,
+                                          telemetry=self.obs)
             self._prefiller = self
 
         # EP-MoE decode: resolve the transport knob ONCE (host-side,
@@ -563,7 +587,12 @@ class ServingEngine:
                 f"prompt {len(request.prompt)} + gen "
                 f"{request.max_new_tokens} exceeds capacity "
                 f"{min(cap, self.max_len)}")
-        return self.sched.submit(request)
+        h = self.sched.submit(request)
+        self.obs.event("submit", request_id=h.request.request_id,
+                       tenant=h.request.tenant,
+                       prompt_tokens=len(h.request.prompt),
+                       max_new_tokens=h.request.max_new_tokens)
+        return h
 
     def step(self) -> int:
         """One serving tick: deadlines → admission/prefill → one joint
@@ -576,6 +605,17 @@ class ServingEngine:
                 f"{h.request.deadline} (now {now})"))
         stalled: List[RequestHandle] = []
         for h in self.sched.admit():
+            # Queue-wait closes at slot assignment, measured from the
+            # handle's LAST entry into the queue (a stalled/preempted
+            # handle requeues and logs another wait — the timeline
+            # records each wait, never the time it already spent
+            # running).
+            self.obs.complete_span(
+                "queue_wait", h.queued_at, now,
+                request_id=h.request.request_id, slot=h.slot,
+                tenant=h.request.tenant)
+            self.obs.event("admit", request_id=h.request.request_id,
+                           slot=h.slot, tenant=h.request.tenant)
             self._admit(h, stalled)
         # Pool-starved admissions go back to the queue HEAD in their
         # original submission order (reversed appendleft — two stalls
@@ -686,6 +726,11 @@ class ServingEngine:
             out["tokens_per_s"] = (
                 self.stats_counters["decode_tokens"]
                 / self.stats_counters["decode_time_s"])
+        # Telemetry surface: histogram summaries (TTFT / inter-token /
+        # per-op, per-tenant groups) — None in telemetry="off", keeping
+        # the key present either way (nulled, not omitted).
+        out["telemetry"] = self.obs.mode
+        out["latency"] = self.obs.latency_summary()
         return out
 
     def decode_cache_size(self) -> int:
@@ -737,7 +782,7 @@ class ServingEngine:
                 "max_new_tokens": r.max_new_tokens,
                 "request_id": r.request_id, "eos_id": r.eos_id,
                 "deadline": r.deadline, "temperature": r.temperature,
-                "top_k": r.top_k, "seed": r.seed,
+                "top_k": r.top_k, "seed": r.seed, "tenant": r.tenant,
             },
             "status": "running" if keep_slot else "queued",
             "tokens": [int(t) for t in h.tokens],
@@ -770,6 +815,7 @@ class ServingEngine:
                 "checkpoint/restore is a layer-path feature: the "
                 "megakernel's KV lives in its in-kernel arena "
                 "(docs/serving.md, 'Checkpoint/restore')")
+        t_ck = self.obs.now()
         running = [h for h in self.sched.running()
                    if h.status == "running"]
         inflight = [h for h in self.sched.running()
@@ -803,7 +849,7 @@ class ServingEngine:
                       for h in inflight]
                    + [self._ser_handle(h, keep_slot=False)
                       for h in self.sched.queue])
-        return {
+        snap = {
             "meta": self._ckpt_meta(),
             "cache": cache_np,
             "manager": m2.snapshot(),
@@ -812,6 +858,9 @@ class ServingEngine:
             "counters": dict(self.stats_counters),
             "sched_counters": dict(self.sched.counters),
         }
+        self.obs.complete_span("checkpoint", t_ck,
+                               requests=len(handles))
+        return snap
 
     def restore(self, snap: dict) -> List[RequestHandle]:
         """Adopt a :meth:`checkpoint` snapshot into this (idle,
@@ -834,6 +883,7 @@ class ServingEngine:
         if self.mega:
             raise NotImplementedError(
                 "checkpoint/restore is a layer-path feature")
+        t_rs = self.obs.now()
         meta = snap.get("meta", {})
         if meta.get("format") != self.CHECKPOINT_FORMAT:
             raise ValueError(
@@ -891,6 +941,13 @@ class ServingEngine:
                               slot=hs["slot"],
                               decode_steps=hs["decode_steps"],
                               submitted_at=now)
+            h.queued_at = now
+            if h.tokens:
+                # Mid-stream revival: its TTFT already happened in the
+                # previous process — the next emission must not record
+                # a second one, and the ITL chain restarts at the
+                # first post-restore gap (last_token_at stays None).
+                h.first_token_at = now
             if h.status == "running":
                 h.started_at = now
                 self.sched.slots[h.slot] = h
@@ -900,6 +957,7 @@ class ServingEngine:
         # Auto request-ids must not collide with restored ones.
         self.sched._ids = itertools.count(max_seq + 1)
         self.stats_counters["restored_requests"] += len(handles)
+        self.obs.complete_span("restore", t_rs, requests=len(handles))
         return handles
 
     def prefill_cache_size(self) -> Optional[int]:
@@ -917,25 +975,52 @@ class ServingEngine:
         return self.engine._prefill._cache_size()
 
     def trace(self, name: str = "serving", *,
-              expert_histograms: bool = True, **kw):
-        """Profiler hook: a multi-device trace of the serving loop
-        (delegates to :func:`profiler_utils.group_profile`). While the
-        context is active, each decode step's per-expert routed-token
+              expert_histograms: bool = True,
+              log_dir: str = "/tmp/tdt_traces", out_dir=None,
+              xprof="auto", markers=None, top_ops: int = 0,
+              mk_keep: int = 4, create_perfetto_link: bool = False):
+        """One tracing context over the serving loop: the xprof
+        capture, the per-step expert histograms, and the host span
+        timeline all share ONE session directory and ONE context
+        manager (yields a :class:`~triton_dist_tpu.obs.TraceSession`).
+
+        While active: each decode step's per-expert routed-token
         histogram is appended to :attr:`expert_hist` (when the model
-        exposes expert telemetry) — the per-step routing record the
-        load EWMA in :meth:`stats` smooths over."""
+        exposes expert telemetry — the per-step routing record the
+        load EWMA in :meth:`stats` smooths over), and a megakernel
+        engine built with ``profile=True`` contributes its last
+        ``mk_keep`` steps' slot records. On exit the session holds
+        everything :meth:`TraceSession.export` needs to write ONE
+        merged Perfetto file — host request spans (``telemetry=
+        "spans"``), megakernel slot records, and marker-keyed xprof
+        device spans (skip-with-reason when the capture or markers are
+        unavailable — e.g. any off-TPU run).
+
+        The old signature still works: ``with srv.trace("x"):`` starts
+        an xprof capture under ``{log_dir}/{name}`` exactly as before
+        (``os.fspath`` of the yielded session is that directory);
+        ``out_dir`` overrides the session directory wholesale.
+        """
         import contextlib
 
-        from triton_dist_tpu.profiler_utils import group_profile
+        from triton_dist_tpu.obs.trace import TraceSession
+
+        path = out_dir or f"{log_dir}/{name}"
 
         @contextlib.contextmanager
         def _traced():
+            sess = TraceSession(
+                path, self.obs, xprof=xprof, markers=markers,
+                top_ops=top_ops, mk_keep=mk_keep,
+                create_perfetto_link=create_perfetto_link)
             self._hist_active = expert_histograms
+            self._trace_session = sess
             try:
-                with group_profile(name, **kw) as g:
-                    yield g
+                with sess:
+                    yield sess
             finally:
                 self._hist_active = False
+                self._trace_session = None
 
         return _traced()
 
@@ -953,6 +1038,7 @@ class ServingEngine:
             self._fail(h, "failed", error)
             return
         h.status, h.started_at = "queued", None
+        h.queued_at = self.sched.now()
         stalled.append(h)
         self.stats_counters["admit_stalls"] += 1
 
@@ -998,37 +1084,45 @@ class ServingEngine:
         # slot and pages must not leak, and the loop must survive.
         eng = self.engine
         ids = np.tile(np.asarray([seq], np.int32), (self._axis_n, 1))
-        try:
-            logits, kv = eng.prefill(jnp.asarray(ids))
-        except Exception as e:  # noqa: BLE001 — route through policy
-            from triton_dist_tpu.resilience.watchdog import (
-                CommTimeoutError)
+        with self.obs.span("prefill", request_id=h.request.request_id,
+                           slot=slot, tenant=h.request.tenant,
+                           tokens=len(seq)):
+            try:
+                logits, kv = eng.prefill(jnp.asarray(ids))
+            except Exception as e:  # noqa: BLE001 — route via policy
+                from triton_dist_tpu.resilience.watchdog import (
+                    CommTimeoutError)
 
-            if isinstance(e, CommTimeoutError):
-                self.stats_counters["comm_timeouts"] += 1
-                self._fail(h, "timeout", e)
-                return
-            # Unexpected failure: still release the slot and pages
-            # (no leaked half-admitted state), then propagate.
-            self._fail(h, "failed", e)
-            raise
-        self.stats_counters["prefill_calls"] += 1
-        self.stats_counters["prefill_tokens"] += len(seq)
-        # Blit only the NON-shared suffix pages: prefix-hit pages hold
-        # KV already computed by the first sharer, and rewriting them
-        # with this (differently-shaped) prefill's floats could perturb
-        # a live request attending to them — XLA guarantees no bit-
-        # exactness across shapes. (Also skips the redundant writes.)
-        hits = self.manager.prefix_hits(slot)
-        if hits < len(pages):
-            s_pad = len(pages) * self.page
-            k0 = kv.k[:, 0, hits * self.page:s_pad]
-            v0 = kv.v[:, 0, hits * self.page:s_pad]
-            self.cache = self._writer(
-                self.cache, k0, v0,
-                jnp.asarray(pages[hits:], jnp.int32))
-        # Pages written — NOW they may be shared with later requests.
-        self.manager.commit_prefix(slot)
+                if isinstance(e, CommTimeoutError):
+                    self.stats_counters["comm_timeouts"] += 1
+                    self.obs.event(
+                        "timeout", op="serving.prefill",
+                        request_id=h.request.request_id, slot=slot)
+                    self._fail(h, "timeout", e)
+                    return
+                # Unexpected failure: still release the slot and pages
+                # (no leaked half-admitted state), then propagate.
+                self._fail(h, "failed", e)
+                raise
+            self.stats_counters["prefill_calls"] += 1
+            self.stats_counters["prefill_tokens"] += len(seq)
+            # Blit only the NON-shared suffix pages: prefix-hit pages
+            # hold KV already computed by the first sharer, and
+            # rewriting them with this (differently-shaped) prefill's
+            # floats could perturb a live request attending to them —
+            # XLA guarantees no bit-exactness across shapes. (Also
+            # skips the redundant writes.)
+            hits = self.manager.prefix_hits(slot)
+            if hits < len(pages):
+                s_pad = len(pages) * self.page
+                k0 = kv.k[:, 0, hits * self.page:s_pad]
+                v0 = kv.v[:, 0, hits * self.page:s_pad]
+                self.cache = self._writer(
+                    self.cache, k0, v0,
+                    jnp.asarray(pages[hits:], jnp.int32))
+            # Pages written — NOW they may be shared with later
+            # requests.
+            self.manager.commit_prefix(slot)
         self._lens[slot] = len(seq)
         self._live[slot] = 1
         h.status = "running"
@@ -1094,6 +1188,8 @@ class ServingEngine:
 
         def _note(attempt, exc):
             self.stats_counters["retries"] += 1
+            self.obs.event("retry", op=op, attempt=attempt,
+                           error=type(exc).__name__)
             if isinstance(exc, CommTimeoutError):
                 # An absorbed wedge is still an observed watchdog
                 # miss — the telemetry keeps counting them even when
@@ -1102,7 +1198,9 @@ class ServingEngine:
 
         return pol.run(fn, op=f"serving.{op}",
                        retry_on=(CommTimeoutError, faults.InjectedFault),
-                       on_retry=_note)
+                       on_retry=_note,
+                       event_cb=(self.obs.event if self.obs.spans_on
+                                 else None))
 
     # Role-health hooks (no-ops here): the disaggregated subclass
     # tracks per-role heartbeats/failures and fails over a dead
@@ -1132,8 +1230,15 @@ class ServingEngine:
             # Replay-idempotent: a retried chunk rewrites the same
             # positions of the same pages with the same bytes
             # (quantized pools re-merge to the identical amax), and
-            # prefix pages stay scratch-routed below ``wfrom``.
-            with faults.on_op_call("chunked_prefill"):
+            # prefix pages stay scratch-routed below ``wfrom``. One
+            # span per ATTEMPT — retries show as repeated chunk spans
+            # interleaved with their retry events.
+            with self.obs.span("prefill_chunk",
+                               request_id=h.request.request_id,
+                               slot=slot, tenant=h.request.tenant,
+                               start=int(start), bucket=int(bucket),
+                               valid=int(valid)), \
+                    faults.on_op_call("chunked_prefill"):
                 logits, p.cache = p.chunker.step(
                     p.engine.params, toks, p.cache, row, start,
                     h.resident, valid)
@@ -1256,7 +1361,11 @@ class ServingEngine:
             # and the containment below fails the victim, not the
             # server (survivors redo the identical dispatch — length
             # mirrors never advanced).
-            with faults.on_op_call("serving_decode"):
+            with self.obs.span(
+                    "decode",
+                    step=self.stats_counters["decode_dispatches"],
+                    batch=len(active)), \
+                    faults.on_op_call("serving_decode"):
                 logits = self._dispatch(tbl)
         except Exception as e:  # noqa: BLE001 — route through policy
             from triton_dist_tpu.resilience.watchdog import (
@@ -1268,6 +1377,7 @@ class ServingEngine:
             timed_out = isinstance(e, CommTimeoutError)
             if timed_out:
                 self.stats_counters["comm_timeouts"] += 1
+                self.obs.event("timeout", op="serving.decode")
             if self.mega and getattr(self.engine, "states",
                                      None) is not None:
                 # Hybrid GDN: the recurrent state is NOT position-
@@ -1334,6 +1444,9 @@ class ServingEngine:
         preempted = []
         drafts: dict = {}
         budget = np.zeros((self.num_slots,), np.int32)
+        draft_span = self.obs.span("spec_draft", batch=len(active),
+                                   k=kk)
+        draft_span.__enter__()
         for h in active:
             slot = h.slot
             base = int(self._lens[slot])
@@ -1367,6 +1480,7 @@ class ServingEngine:
                 else:
                     d += [d[-1]] * (kk - 1)   # sampled: 1 commit max
             drafts[slot] = d
+        draft_span.__exit__(None, None, None)
         if preempted:
             active = [h for h in active if h not in preempted]
             if not active:
@@ -1379,7 +1493,11 @@ class ServingEngine:
 
         t0 = time.perf_counter()
         try:
-            with faults.on_op_call("spec_verify"):
+            with self.obs.span(
+                    "spec_verify",
+                    step=self.stats_counters["decode_dispatches"],
+                    batch=len(active), k=kk), \
+                    faults.on_op_call("spec_verify"):
                 cache = _dc.replace(self.cache,
                                     block_table=jnp.asarray(tbl),
                                     lens=jnp.asarray(self._lens),
@@ -1433,6 +1551,11 @@ class ServingEngine:
             base = int(self._lens[slot])
             self._lens[slot] = base + m
             self.manager.truncate_to(slot, base + m)
+            rolled = int(budget[slot]) - m
+            if rolled > 0:
+                self.obs.event("spec_rollback",
+                               request_id=h.request.request_id,
+                               slot=slot, accepted=m, rolled=rolled)
             self.stats_counters["decode_tokens"] += m
             for j in range(m):
                 if h.done:
@@ -1470,6 +1593,16 @@ class ServingEngine:
                 # pre-serving warmup traffic never pollutes the load.
                 self._mk_counts_base = self.engine.expert_counts()
             out = self.engine.decode_step(toks, lens)
+            if (self._trace_session is not None
+                    and getattr(self.engine, "last_prof",
+                                None) is not None):
+                # Megakernel slot records for the merged trace: only
+                # while a trace session is open (prof_tracks syncs the
+                # step), keyed by this dispatch's step index.
+                self._trace_session.add_slot_record(
+                    self.stats_counters["decode_dispatches"],
+                    self.engine.builder.prof_tracks(
+                        self.engine.last_prof))
             if self._mk_counts_base is not None:
                 total = self.engine.expert_counts()
                 self._note_expert_counts(total - self._mk_counts_base)
@@ -1669,6 +1802,21 @@ class ServingEngine:
     def _emit(self, h: RequestHandle, tok: int):
         h.tokens.append(int(tok))
         self.stats_counters["tokens_generated"] += 1
+        if self.obs.enabled:
+            # TTFT / inter-token latency edges, on the engine clock.
+            # Host-side stamping only — one clock read per token.
+            now = self.obs.now()
+            if h.first_token_at is None:
+                h.first_token_at = now
+                self.obs.observe("ttft", now - h.submitted_at,
+                                 h.request.tenant)
+                self.obs.event("first_token",
+                               request_id=h.request.request_id,
+                               slot=h.slot, tenant=h.request.tenant)
+            elif h.last_token_at is not None:
+                self.obs.observe("itl", now - h.last_token_at,
+                                 h.request.tenant)
+            h.last_token_at = now
         if h.request.stream_cb is not None:
             h.request.stream_cb(int(tok), h)
         hit_eos = (h.request.eos_id is not None
@@ -1693,8 +1841,11 @@ class ServingEngine:
             self._fail(h, "failed", error)
             return
         h.status = "queued"
+        h.queued_at = self.sched.now()
         self.sched.queue.appendleft(h)
         self.stats_counters["preemptions"] += 1
+        self.obs.event("preempt", request_id=h.request.request_id,
+                       slot=slot, tenant=h.request.tenant)
 
     def _retire(self, h: RequestHandle, status: str, error=None):
         slot = h.slot
@@ -1705,6 +1856,13 @@ class ServingEngine:
             self._toks[slot] = 0
             if self.manager is not None:
                 self.manager.free_slot(slot)
+        # The whole-request span closes at the terminal transition —
+        # submit -> done|failed|timeout, with the generated volume.
+        self.obs.complete_span(
+            "request", h.submitted_at, h.finished_at,
+            request_id=h.request.request_id, slot=slot,
+            tenant=h.request.tenant, status=status,
+            tokens=len(h.tokens), decode_steps=h.decode_steps)
 
     def _fail(self, h: RequestHandle, status: str, error):
         self._retire(h, status, error)

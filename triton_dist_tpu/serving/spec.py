@@ -44,10 +44,19 @@ class NgramDraft:
     shorter grams are fallbacks, and when nothing matches the last
     token repeats (the cheapest guess that still wins on loops)."""
 
-    def __init__(self, n: int = 3):
+    def __init__(self, n: int = 3, *, telemetry=None):
         if n < 1:
             raise ValueError(f"ngram n must be >= 1, got {n}")
         self.n = n
+        # Optional obs.Telemetry sink: counts which n-gram length each
+        # proposal matched at (draft_ngram_0 = the repeat-last-token
+        # fallback) — the accept-rate diagnosis signal: a draft that
+        # mostly falls back cannot win tokens per dispatch.
+        self.telemetry = telemetry
+
+    def _note(self, n: int) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(f"draft_ngram_{n}")
 
     def propose(self, history: Sequence[int], k: int) -> List[int]:
         """Propose ``k`` continuation tokens for ``history`` (which
@@ -57,6 +66,7 @@ class NgramDraft:
             return []
         hist = list(history)
         if not hist:
+            self._note(0)
             return [0] * k
         for n in range(min(self.n, len(hist)), 0, -1):
             tail = hist[-n:]
@@ -68,7 +78,9 @@ class NgramDraft:
                 if hist[i:i + n] == tail:
                     seg = hist[i + n:]
                     if seg:
+                        self._note(n)
                         return [seg[j % len(seg)] for j in range(k)]
+        self._note(0)
         return [hist[-1]] * k
 
 
