@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
-	qblock-smoke obs-smoke apicheck ci bench-all
+	qblock-smoke obs-smoke tier-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -90,6 +90,15 @@ qblock-smoke: csrc
 # one-line `obs:` latency summary (docs/observability.md).
 obs-smoke: csrc
 	bash scripts/obs_smoke.sh
+
+# Tiered-KV battery: tier-store/scored-eviction units, park/resume
+# token-exactness, tier coherence under chaos, the heavy-tailed
+# multi-turn trace, a parked-and-resumed chat e2e gating the `tiers:`
+# exit-summary line, and the non-null kv_hot_hit_rate /
+# session_resume_ms / offloaded_pages bench gate (docs/serving.md,
+# "KV memory hierarchy").
+tier-smoke: csrc
+	bash scripts/tier_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

@@ -753,6 +753,79 @@ def _interpret_chaos() -> dict:
     }
 
 
+def _interpret_tiers() -> dict:
+    """Tiered KV memory hierarchy on the CPU mesh — the
+    ``kv_hot_hit_rate`` / ``session_resume_ms`` / ``offloaded_pages``
+    surface (non-null gate in scripts/tier_smoke.sh): a seeded
+    heavy-tailed multi-turn trace over a 100k-session id space served
+    through an HBM pool sized WELL below the working set, so cold
+    prefixes demote into the host tier and hot reuse prefetches them
+    back; plus a park/resume drill whose resume latency (requeue →
+    token-exact reactivation, prefetch overlapped against decode)
+    lands in the per-op histogram. Absolute times track the CPU
+    dispatch, not silicon; the hit rate and the non-null presence are
+    the gates."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.serving import ServingEngine, heavy_tail_trace
+    from triton_dist_tpu.serving.tiers import extend_session
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=4,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+    srv = ServingEngine(eng, num_slots=2, page=4, num_pages=12,
+                        prefix_reuse=True, prefill_buckets=(4, 8),
+                        kv_tiers={"host_pages": 512})
+    events = heavy_tail_trace(28, n_sessions=100_000, vocab=64, seed=7,
+                              max_total=20)
+    history = {}
+    t0 = time.perf_counter()
+    for ev in events:
+        prompt = extend_session(history, ev, max_prompt=12)
+        h = srv.submit(prompt, max_new_tokens=ev["gen"])
+        srv.run()
+        extend_session(history, ev, reply=h.tokens)
+    trace_dt = time.perf_counter() - t0
+    # Park/resume drill: 3 sessions parked mid-decode and resumed —
+    # the resume span (requeue -> reactivation) feeds the histogram.
+    for i in range(3):
+        h = srv.submit([1 + i, 2, 3], max_new_tokens=5)
+        while h.status != "running":
+            srv.step()
+        srv.step()
+        srv.park(h)
+        srv.resume(h)
+        srv.run()
+        assert h.status == "done"
+    st = srv.stats()
+    resume = (st["latency"]["ops"].get("resume") or {})
+    assert srv.decode_cache_size() == 1, "tiering re-specialized decode"
+    return {
+        "kv_hot_hit_rate": st["kv_hot_hit_rate"],
+        "session_resume_ms": resume.get("mean"),
+        "offloaded_pages": st["offloaded_pages"],
+        "tier_detail": {
+            "trace_events": len(events),
+            "trace_session_space": 100_000,
+            "distinct_sessions": len({e["session"] for e in events}),
+            "trace_wall_ms": round(trace_dt * 1e3, 1),
+            "tier_hits": st["tier_hits"],
+            "tier_misses": st["tier_misses"],
+            "prefetched_pages": st["prefetched_pages"],
+            "demotions": st["pool"]["demotions"],
+            "parks": st["parks"], "resumes": st["resumes"],
+            "session_resume_p99_ms": resume.get("p99"),
+            "hbm_pool_pages": 12,
+        },
+    }
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -841,6 +914,12 @@ def _interpret_bench(reason: str) -> None:
     except Exception as e:  # chaos soak must not sink the record
         ch = {"chaos_survived_faults": None,
               "chaos_error": str(e)[:300]}
+    try:
+        ti = _interpret_tiers()
+    except Exception as e:  # tier bench must not sink the record
+        # Nulled, NOT omitted: the tier_smoke gate greps these keys.
+        ti = {"kv_hot_hit_rate": None, "session_resume_ms": None,
+              "offloaded_pages": None, "tiers_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -865,6 +944,7 @@ def _interpret_bench(reason: str) -> None:
             **ep,
             **qb,
             **ch,
+            **ti,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),

@@ -79,6 +79,25 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="--spec: candidates per verification "
                          "dispatch (static K; jit cache stays flat)")
+    ap.add_argument("--kv-tiers", action="store_true",
+                    help="layer path: arm the tiered KV memory "
+                         "hierarchy — cold committed prefix pages "
+                         "demote into a host-RAM tier (scored "
+                         "eviction) and prefetch back on reuse, and "
+                         "park/resume become serving verbs (see "
+                         "docs/serving.md, 'KV memory hierarchy')")
+    ap.add_argument("--tier-host-pages", type=int, default=64,
+                    help="--kv-tiers: host-tier capacity in pool "
+                         "pages")
+    ap.add_argument("--park-after-idle", type=int, default=0,
+                    metavar="TICKS",
+                    help="--kv-tiers: once a running request has "
+                         "decoded for N consecutive ticks, park it "
+                         "(KV offloaded, slot released) and resume "
+                         "it on the next tick — the deterministic "
+                         "park/resume drill (token streams stay "
+                         "bit-identical to an uninterrupted serve; "
+                         "scripts/tier_smoke.sh gates on it)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="layer path: snapshot the full serving state "
                          "(paged pools + scales, allocator, queue, "
@@ -152,6 +171,13 @@ def main():
     if args.checkpoint_after and not args.checkpoint_dir:
         sys.exit("--checkpoint-after needs --checkpoint-dir (it is the "
                  "deterministic drill for that snapshot path)")
+    if args.megakernel and args.kv_tiers:
+        sys.exit("--kv-tiers routes the layer path's paged pool; the "
+                 "megakernel's KV lives in its in-kernel arena "
+                 "(see docs/serving.md)")
+    if args.park_after_idle and not args.kv_tiers:
+        sys.exit("--park-after-idle needs --kv-tiers (parking "
+                 "offloads into the tier store)")
     # Layer-path serving knobs shared by every engine construction
     # below: attention impl, quantized KV pools, speculative decode.
     telemetry = args.telemetry or ("spans" if args.trace_out
@@ -159,7 +185,9 @@ def main():
     serve_kw = dict(kv_dtype=args.kv_quant,
                     attn_impl=args.attn_impl,
                     spec_k=args.spec_k if args.spec else 0,
-                    telemetry=telemetry)
+                    telemetry=telemetry,
+                    kv_tiers=({"host_pages": args.tier_host_pages}
+                              if args.kv_tiers else None))
     def build_disagg(cfg, params, model_kw):
         """Two engines over split tp halves (or one colocated role at
         tp=1) sharing ONE weight pytree, wrapped in the disaggregated
@@ -345,10 +373,38 @@ def main():
             _dump_obs()
             sys.exit(0)
 
+    # --park-after-idle drill: a running request that has decoded for
+    # N consecutive ticks parks (KV offloaded wholesale, slot free)
+    # and resumes on the next tick — once per request, so the stream
+    # always finishes. Token output is bit-identical to an
+    # uninterrupted serve (the tier_smoke gate).
+    park_state = {"age": {}, "done": set()}
+
+    def _park_tick():
+        if not args.park_after_idle:
+            return
+        for h in list(srv.sched.running()):
+            rid = h.request.request_id
+            if (h.status != "running" or not h.tokens
+                    or rid in park_state["done"]):
+                continue
+            age = park_state["age"].get(rid, 0) + 1
+            park_state["age"][rid] = age
+            if age >= args.park_after_idle:
+                try:
+                    srv.park(h)
+                except Exception as e:  # noqa: BLE001 — drill only
+                    print(f"[park skipped: {e}]", file=sys.stderr,
+                          flush=True)
+                    park_state["done"].add(rid)
+                    continue
+                park_state["done"].add(rid)
+                srv.resume(h)
+
     def run_serving():
         stop["serving"] = True
         try:
-            srv.run(on_tick=_checkpoint_tick)
+            srv.run(on_tick=lambda: (_park_tick(), _checkpoint_tick()))
         finally:
             stop["serving"] = False
 
@@ -420,6 +476,13 @@ def main():
     if st.get("kv_dtype") not in (None, "bf16"):
         line += (f", kv_dtype={st['kv_dtype']} "
                  f"({st['kv_bytes_per_token']:.0f} B/token)")
+    if args.kv_tiers:
+        rate = st.get("kv_hot_hit_rate")
+        line += (f", tiers: offloaded={st['offloaded_pages']} "
+                 f"resumed={st['resumes']} "
+                 f"hit-rate={'n/a' if rate is None else f'{rate:.2f}'}"
+                 f" (tier_pages={st['tier_pages']} "
+                 f"parked={st['parked_sessions']})")
     if (st["retries"] or st["failovers"] or st["restored_requests"]
             or args.checkpoint_dir):
         line += (f", ft: retries={st['retries']} "

@@ -1,0 +1,879 @@
+"""Tiered KV memory hierarchy battery: the host/disk tier store, the
+scored (frequency/recency) prefix eviction that demotes instead of
+dropping, session park/resume token-exactness, tier coherence under
+chaos, and the seeded heavy-tailed multi-turn acceptance trace
+(docs/serving.md, "KV memory hierarchy").
+
+Everything is seeded; token-exactness gates diff against the
+``Engine.serve`` oracle like the rest of the serving batteries.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.resilience import chaos, faults
+from triton_dist_tpu.serving import (
+    BlockManager, KVTierStore, OutOfPagesError, ServingEngine,
+    TierFullError, heavy_tail_trace,
+)
+from triton_dist_tpu.serving.tiers import extend_session
+
+CFG = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                       intermediate_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=4,
+                       head_dim=8)
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+
+@pytest.fixture(scope="module")
+def engine(mesh):
+    return Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+
+
+def _oracle(engine, prompt, gen_len):
+    ids = jnp.asarray(np.asarray([list(prompt)], np.int32))
+    return np.asarray(engine.serve(ids, gen_len=gen_len))[0].tolist()
+
+
+def _payload(seed=0, pages=1, layers=2, kv=2, page=4, hd=3):
+    rng = np.random.RandomState(seed)
+    k = rng.randn(layers, pages, kv, page, hd).astype(np.float32)
+    v = rng.randn(layers, pages, kv, page, hd).astype(np.float32)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# KVTierStore units (pure host logic)
+# ---------------------------------------------------------------------------
+
+def test_tier_store_roundtrip_and_stats():
+    st = KVTierStore(host_pages=8)
+    k, v = _payload(0)
+    st.put(("prefix", ("a",)), (k, v), pages=1)
+    assert ("prefix", ("a",)) in st and len(st) == 1
+    got = st.get(("prefix", ("a",)))
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    # get leaves the entry resident (promotion pops explicitly).
+    assert ("prefix", ("a",)) in st
+    assert st.get(("nope",)) is None
+    e = st.pop(("prefix", ("a",)))
+    assert e is not None and ("prefix", ("a",)) not in st
+    s = st.stats()
+    assert s["puts"] == 1 and s["hits"] == 1 and s["misses"] == 1
+    assert s["offloaded_pages"] == 1 and s["host_pages_used"] == 0
+    st.check_coherence()
+
+
+def test_tier_store_spill_to_disk_and_promote(tmp_path):
+    st = KVTierStore(host_pages=2, disk_pages=8,
+                     disk_dir=str(tmp_path))
+    payloads = {i: _payload(i) for i in range(4)}
+    for i in range(4):
+        st.put(("prefix", i), payloads[i], pages=1)
+    st.check_coherence()
+    s = st.stats()
+    # Host holds the 2 newest; the 2 oldest spilled to disk, bytes
+    # intact through the uint8 spill codec.
+    assert s["host_pages_used"] == 2 and s["disk_pages_used"] == 2
+    assert s["spills"] == 2 and s["dropped_entries"] == 0
+    got = st.get(("prefix", 0))          # disk hit -> promoted
+    np.testing.assert_array_equal(got[0], payloads[0][0])
+    st.check_coherence()
+    # Promoted into the (full) host tier: its LRU victim spilled the
+    # other way, so entry 0 now lives host-side.
+    assert ("prefix", 0) in st._host
+    assert st.stats()["host_pages_used"] == 2
+
+
+def test_tier_store_promotion_cascade_never_evicts_fetchee(tmp_path):
+    """Regression: with BOTH tiers at capacity, promoting a disk hit
+    spills a host victim into the disk tier — that cascade must never
+    evict (and delete the spill file of) the entry being fetched."""
+    st = KVTierStore(host_pages=1, disk_pages=1,
+                     disk_dir=str(tmp_path))
+    ka, va = _payload(1)
+    st.put(("prefix", "a"), (ka, va), pages=1)
+    st.put(("prefix", "b"), _payload(2), pages=1)   # a spills to disk
+    got = st.get(("prefix", "a"))                   # disk hit, full cascade
+    np.testing.assert_array_equal(got[0], ka)
+    st.check_coherence()
+    assert ("prefix", "a") in st
+    # And it stays readable on the next fetch too.
+    np.testing.assert_array_equal(st.get(("prefix", "a"))[0], ka)
+
+
+def test_tier_store_oversized_payload_goes_straight_to_disk(tmp_path):
+    """A session payload larger than the WHOLE host tier must still
+    park when the disk tier has room (pinned payloads are
+    never-dropped by contract, so 'host too small' alone cannot be a
+    permanent park failure)."""
+    st = KVTierStore(host_pages=2, disk_pages=16,
+                     disk_dir=str(tmp_path))
+    big = _payload(9, pages=6)
+    st.put(("session", "big"), big, pages=6, pinned=True)
+    st.check_coherence()
+    assert st.stats()["disk_pages_used"] == 6
+    np.testing.assert_array_equal(st.get(("session", "big"))[0],
+                                  big[0])
+    # Without a disk tier it IS a (loud) failure — and the store is
+    # left unchanged.
+    st2 = KVTierStore(host_pages=2)
+    with pytest.raises(TierFullError):
+        st2.put(("session", "big"), big, pages=6, pinned=True)
+    assert len(st2) == 0
+    st2.check_coherence()
+
+
+def test_tier_store_samekey_replace_never_double_counts():
+    st = KVTierStore(host_pages=4)
+    st.put(("session", "r"), _payload(1, pages=4), pages=4,
+           pinned=True)
+    # Refreshing the SAME key at full capacity is a pure replace —
+    # the old copy must not count against the new one's room.
+    newer = _payload(2, pages=4)
+    st.put(("session", "r"), newer, pages=4, pinned=True)
+    np.testing.assert_array_equal(st.get(("session", "r"))[0],
+                                  newer[0])
+    assert st.stats()["host_pages_used"] == 4
+    st.check_coherence()
+    # And a FAILED replace (faulted transfer) keeps the old payload.
+    plan = faults.FaultPlan(
+        name="drop-tier",
+        faults=(faults.Fault("fail_call", op="tier_transfer", k=0),))
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            st.put(("session", "r"), _payload(3, pages=4), pages=4,
+                   pinned=True)
+    np.testing.assert_array_equal(st.get(("session", "r"))[0],
+                                  newer[0])
+    st.check_coherence()
+
+
+def test_tier_store_failed_spill_cascade_keeps_pinned(tmp_path):
+    """Regression: the host tier holds a pinned session while the
+    disk tier is full of pinned payloads — a put() that needs room
+    must fail WITHOUT destroying the host victim mid-cascade (the
+    spill write happens before the entry leaves the host index)."""
+    st = KVTierStore(host_pages=1, disk_pages=1,
+                     disk_dir=str(tmp_path))
+    pa = _payload(1)
+    st.put(("session", "disk"), _payload(0), pages=1, pinned=True)
+    st.put(("session", "host"), pa, pages=1, pinned=True)  # spills 'disk'? no:
+    # host full after this put; 'disk' got spilled to the disk tier.
+    st.check_coherence()
+    with pytest.raises(TierFullError):
+        st.put(("prefix", "x"), _payload(2), pages=1)
+    st.check_coherence()
+    # Both pinned payloads survive the failed put, bytes intact.
+    np.testing.assert_array_equal(st.get(("session", "host"))[0],
+                                  pa[0])
+    assert ("session", "disk") in st
+
+
+def test_tier_store_pinned_full_disk_falls_back_to_droppable(tmp_path):
+    """Regression: a pinned-full DISK tier must not fail a put that
+    evicting recomputable host content could satisfy — the spill
+    fallback drops the droppable host entry instead of raising."""
+    st = KVTierStore(host_pages=4, disk_pages=2,
+                     disk_dir=str(tmp_path))
+    st.put(("session", "d"), _payload(0, pages=2), pages=2,
+           pinned=True)
+    st.put(("session", "h"), _payload(1, pages=2), pages=2,
+           pinned=True)
+    st.put(("prefix", "x"), _payload(2, pages=2), pages=2)
+    st.check_coherence()         # host: [h(pinned), x]; disk: [d]
+    assert st.stats()["disk_pages_used"] == 2
+    pa = _payload(3, pages=2)
+    st.put(("session", "new"), pa, pages=2, pinned=True)
+    st.check_coherence()
+    # The droppable prefix entry made way; all three pinned sessions
+    # survive with bytes intact.
+    for k in (("session", "d"), ("session", "h"), ("session", "new")):
+        assert k in st, k
+    assert ("prefix", "x") not in st
+    np.testing.assert_array_equal(st.get(("session", "new"))[0], pa[0])
+
+
+def test_tier_store_pinned_never_dropped():
+    st = KVTierStore(host_pages=2)
+    st.put(("session", "r1"), _payload(1), pages=1, pinned=True)
+    st.put(("prefix", 1), _payload(2), pages=1)
+    # A third put evicts the LRU DROPPABLE entry, never the pinned
+    # session (no disk tier here — dropping it would lose a parked
+    # request's only KV copy).
+    st.put(("prefix", 2), _payload(3), pages=1)
+    assert ("session", "r1") in st and ("prefix", 1) not in st
+    assert st.stats()["dropped_entries"] == 1
+    st.put(("session", "r2"), _payload(4), pages=1, pinned=True)
+    with pytest.raises(TierFullError):
+        st.put(("session", "r3"), _payload(5), pages=1, pinned=True)
+    st.check_coherence()
+
+
+def test_tier_store_two_phase_fault_leaves_store_unchanged():
+    st = KVTierStore(host_pages=8)
+    st.put(("prefix", 1), _payload(1), pages=1)
+    plan = faults.FaultPlan(
+        name="drop-tier",
+        faults=(faults.Fault("fail_call", op="tier_transfer", k=0),))
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            st.put(("prefix", 2), _payload(2), pages=1)
+    # The staged entry was discarded, nothing committed, the earlier
+    # entry untouched — and a faulted GET keeps the entry resident.
+    st.check_coherence()
+    assert ("prefix", 2) not in st and ("prefix", 1) in st
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            st.get(("prefix", 1))
+    assert ("prefix", 1) in st
+    np.testing.assert_array_equal(st.get(("prefix", 1))[0],
+                                  _payload(1)[0])
+
+
+def test_tier_store_snapshot_roundtrip(tmp_path):
+    st = KVTierStore(host_pages=2, disk_pages=4,
+                     disk_dir=str(tmp_path / "a"))
+    st.put(("session", "r"), _payload(7), pages=1, pinned=True,
+           meta={"n_tok": 5})
+    for i in range(2):
+        st.put(("prefix", i), _payload(i), pages=1)
+    snap = st.snapshot()
+    st2 = KVTierStore(host_pages=4)          # no disk on the restorer
+    st2.load_snapshot(snap)
+    st2.check_coherence()
+    assert len(st2) == 3
+    np.testing.assert_array_equal(st2.get(("session", "r"))[0],
+                                  _payload(7)[0])
+    assert st2.entry(("session", "r")).meta["n_tok"] == 5
+
+
+def test_tier_bridge_put_roundtrip():
+    """The tier hop over the one-sided p2p edge (the multi-controller
+    host-memory hop's shape): bytes bit-exact through the put."""
+    from triton_dist_tpu.ops.p2p import tier_pages_host
+
+    bridge = Mesh(np.array(jax.devices()[:2]), ("role",))
+    k, v = _payload(3, pages=2)
+    k2, v2 = tier_pages_host(k, v, bridge, axis="role", src=0, dst=1)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    st = KVTierStore(host_pages=8, bridge=(bridge, "role", 0, 1))
+    st.put(("prefix", 0), (k, v), pages=2)
+    got = st.get(("prefix", 0))
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    assert st.stats()["transport"] == "p2p"
+
+
+# ---------------------------------------------------------------------------
+# Scored eviction (BlockManager)
+# ---------------------------------------------------------------------------
+
+def _commit_prefix(m, slot, tokens):
+    pages = m.alloc_prefill(slot, tokens)
+    m.commit_prefix(slot)
+    return pages
+
+
+def test_scored_eviction_prefers_cold():
+    m = BlockManager(num_pages=8, page=4, p_max=4, prefix_reuse=True)
+    cold = list(range(8))                 # 2 full pages, committed 1st
+    hot = list(range(100, 108))
+    _commit_prefix(m, 0, cold)
+    _commit_prefix(m, 1, hot)
+    m.free_slot(0)
+    m.free_slot(1)
+    # Touch the HOT prefix repeatedly: its EWMA score grows while the
+    # cold one decays.
+    for s in (2, 3, 4):
+        m.alloc_prefill(s, hot)
+        m.free_slot(s)
+    assert m.stats["prefix_hits"] >= 6
+    demoted = []
+    m.on_demote = lambda key, pid: demoted.append((key, pid)) or True
+    # Insertion order would evict the COLD-first entry anyway here, so
+    # force two: the second victim must still not be the hot set.
+    victims = m.evict(2)
+    assert len(victims) == 2 and len(demoted) == 2
+    assert m.stats["demotions"] == 2 and m.stats["evictions"] == 2
+    # Both cold pages left; both hot pages survive.
+    hot_alloc = m.alloc_prefill(5, hot)
+    assert m.stats["prefix_hits"] >= 8, "hot prefix was evicted"
+    m.free_slot(5)
+    # Reverse check: recommit cold, touch it, starve-evict — the
+    # (now untouched) hot entries go first despite later insertion.
+    _commit_prefix(m, 6, cold)
+    m.free_slot(6)
+    for s in (2, 3, 4):
+        m.alloc_prefill(s, cold)
+        m.free_slot(s)
+    v2 = m.evict(2)
+    cold_pages = set(m.alloc_prefill(7, cold))
+    assert m.stats["prefix_hits"] >= 13, \
+        f"cold-turned-hot prefix evicted: {v2} vs {cold_pages}"
+
+
+def test_evict_skips_pages_live_sharers_hold():
+    m = BlockManager(num_pages=6, page=4, p_max=4, prefix_reuse=True)
+    shared = list(range(4))
+    _commit_prefix(m, 0, shared)           # slot 0 HOLDS the page
+    assert m.evict(4) == [], "evicted a page a live slot references"
+    m.free_slot(0)
+    assert len(m.evict(4)) == 1            # now unreferenced -> fair game
+
+
+def test_manager_snapshot_keeps_scores():
+    m = BlockManager(num_pages=8, page=4, p_max=4, prefix_reuse=True)
+    _commit_prefix(m, 0, list(range(4)))
+    m.free_slot(0)
+    m.alloc_prefill(1, list(range(4)))
+    m.free_slot(1)
+    snap = m.snapshot()
+    m2 = BlockManager(num_pages=8, page=4, p_max=4, prefix_reuse=True)
+    m2.load_snapshot(snap)
+    assert m2._score == m._score and m2._tick == m._tick
+
+
+# ---------------------------------------------------------------------------
+# Park / resume (serving engine)
+# ---------------------------------------------------------------------------
+
+def test_park_resume_token_exact(engine):
+    srv = ServingEngine(engine, num_slots=2, page=8, prefix_reuse=True,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6, 7], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.step()
+    assert h.status == "running" and len(h.tokens) >= 2
+    srv.park(h)
+    assert h.status == "parked" and h.slot is None
+    st = srv.stats()
+    assert st["parked_sessions"] == 1 and st["parks"] == 1
+    assert st["tier_pages"] >= 1 and st["offloaded_pages"] >= 1
+    chaos.check_invariants(srv)
+    srv.resume(h)
+    srv.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, [5, 6, 7], 6), \
+        "park/resume diverged from the uninterrupted serve"
+    assert srv.decode_cache_size() == 1
+    assert srv.stats()["resumes"] == 1
+    chaos.check_invariants(srv)
+
+
+def test_park_frees_slot_for_other_traffic(engine):
+    srv = ServingEngine(engine, num_slots=1, page=8,
+                        kv_tiers={"host_pages": 32})
+    a = srv.submit([1, 2, 3], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.park(a)
+    # The single slot is free again: b serves END TO END while a sits
+    # parked — the capacity the park verb exists to reclaim.
+    b = srv.submit([9, 8], max_new_tokens=4)
+    srv.run()
+    assert b.status == "done" and a.status == "parked"
+    assert b.tokens == _oracle(engine, [9, 8], 4)
+    srv.resume(a)
+    srv.run()
+    assert a.tokens == _oracle(engine, [1, 2, 3], 6)
+
+
+def test_park_resume_quantized_pool(mesh):
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    kw = dict(num_slots=2, page=8, kv_dtype="int8")
+    plain = ServingEngine(eng, **kw)
+    want = plain.generate([[4, 5, 6]], max_new_tokens=6)[0]
+    srv = ServingEngine(eng, kv_tiers={"host_pages": 32}, **kw)
+    h = srv.submit([4, 5, 6], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.step()
+    srv.park(h)
+    # Quantized pools park their STORED bytes + scales — bit-exact.
+    e = srv.tiers.entry(("session", h.request.request_id))
+    assert len(e.arrays) == 4 and e.arrays[0].dtype == np.int8
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == want, "quantized park/resume drifted"
+
+
+def test_park_quant_harder(engine):
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32},
+                        park_quant="int8")
+    h = srv.submit([3, 1, 4], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.step()
+    n_pre = len(h.tokens)
+    srv.park(h)
+    e = srv.tiers.entry(("session", h.request.request_id))
+    # "Quantize harder": the parked payload stores at 1 B/elem with
+    # fp32 scales alongside (vs the pool's fp32) — 4x smaller host
+    # bytes; resume is approximate, not bit-exact (documented).
+    assert e.arrays[0].dtype == np.int8 and len(e.arrays) == 4
+    assert e.meta["park_quant"] == "int8"
+    srv.resume(h)
+    srv.run()
+    assert h.status == "done" and len(h.tokens) == 6
+    assert h.tokens[:n_pre] == _oracle(engine, [3, 1, 4], 6)[:n_pre]
+
+
+def test_park_after_failed_dispatch_page_skew(engine):
+    """Regression: a failed decode dispatch leaves the allocator one
+    idempotent pre-appended page AHEAD of the length mirror — a park
+    in that state must payload exactly the mirror's pages, or resume's
+    alloc_resume re-derives a different count and the scatter
+    corrupts/crashes."""
+    srv = ServingEngine(engine, num_slots=2, page=4,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6, 7], max_new_tokens=8)
+    srv.step()
+    while int(srv._lens[h.slot]) % 4 != 0:
+        srv.step()                      # land exactly on a page edge
+    assert h.status == "running"
+    # The failed tick's surviving pre-append: allocator grows a page,
+    # the mirror does not advance (the step's results were dropped).
+    srv.manager.append(h.slot, int(srv._lens[h.slot]))
+    assert (len(srv.manager._slot_pages[h.slot]) * 4
+            > int(srv._lens[h.slot]))
+    srv.park(h)
+    srv.resume(h)
+    srv.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, [5, 6, 7], 8)
+    chaos.check_invariants(srv)
+
+
+def test_fits_snapshot_matches_actual_load(tmp_path):
+    """The restore gate's dry-run placement must agree with what
+    load_snapshot actually does — including greedy-spill failures on
+    sets a smarter packing could fit."""
+    def snap_of(entries):
+        return {"host": [{"key": ("session", str(i)), "pages": p,
+                          "pinned": pin, "meta": {},
+                          "arrays": _payload(i, pages=p)}
+                         for i, (p, pin) in enumerate(entries)],
+                "disk": [], "counters": {}}
+
+    cases = [
+        # (entries, host, disk): one oversized pinned entry — sum fits
+        # host+disk but the atomic entry fits neither tier's spill.
+        ([(6, True)], 4, 4),
+        # greedy spill order fails though an optimal packing exists
+        ([(4, True), (4, True), (2, True)], 5, 6),
+        # loadable: overflow spills, droppables drop
+        ([(2, True), (2, False), (2, True)], 4, 2),
+        ([(1, False)] * 3, 4, 0),
+        # pinned-full disk mid-load: the droppable-host fallback
+        # makes this loadable where a spill-only policy would fail
+        ([(2, True), (2, False), (2, True)], 2, 2),
+        # ... and with nothing droppable it genuinely cannot fit
+        ([(2, True), (2, True), (2, True)], 2, 2),
+    ]
+    for i, (entries, hp, dp) in enumerate(cases):
+        kw = ({"disk_pages": dp, "disk_dir": str(tmp_path / str(i))}
+              if dp else {})
+        st = KVTierStore(host_pages=hp, **kw)
+        verdict = st.fits_snapshot(snap_of(entries))
+        try:
+            st.load_snapshot(snap_of(entries))
+            loaded = True
+            st.check_coherence()
+        except TierFullError:
+            loaded = False
+        assert (verdict is None) == loaded, \
+            f"case {i}: dry-run said {verdict!r}, load said {loaded}"
+
+
+def test_restore_into_undersized_tiers_rejected_before_mutation(
+        mesh, engine):
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6, 7], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.park(h)
+    snap = srv.checkpoint()
+    small = ServingEngine(engine, num_slots=2, page=8,
+                          kv_tiers={"host_pages": 32})
+    # Shrink the would-be restorer's host tier below the pinned
+    # payload: the up-front gate must fire BEFORE any mutation.
+    small.tiers.host_pages = 0
+    with pytest.raises(ValueError, match="do not fit"):
+        small.restore(snap)
+    assert not small.sched.slots and not small.sched.queue
+    assert not small._parked and len(small.tiers) == 0
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == _oracle(engine, [5, 6, 7], 6)
+
+
+def test_faulted_park_leaves_request_running(engine):
+    """The two-phase park: a dropped offload transfer (past retries)
+    aborts the park with NOTHING freed — the request keeps running
+    and finishes token-exact; a later un-faulted park succeeds."""
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6, 7], max_new_tokens=6)
+    srv.step()
+    srv.step()
+    srv.step()
+    plan = faults.FaultPlan(
+        name="drop-park",
+        faults=(faults.Fault("fail_call", op="tier_transfer",
+                             k=None),))
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            srv.park(h)
+    assert h.status == "running" and h.slot is not None
+    assert srv.stats()["parked_sessions"] == 0
+    assert len(srv.tiers) == 0 and not srv.tiers._staged
+    chaos.check_invariants(srv)
+    srv.park(h)                        # un-faulted retry works
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == _oracle(engine, [5, 6, 7], 6)
+
+
+def test_park_payload_is_materialized_not_a_gather_view(engine):
+    """Regression: the parked payload must own exactly its pages'
+    bytes — a slice VIEW would pin the whole p_max-wide gather buffer
+    in host RAM behind every parked session, defeating host_pages."""
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6, 7], max_new_tokens=4)
+    srv.step()
+    srv.step()
+    srv.park(h)
+    e = srv.tiers.entry(("session", h.request.request_id))
+    for a in e.arrays:
+        assert a.base is None and a.flags["C_CONTIGUOUS"], \
+            "parked payload retains the full gather buffer (view)"
+        assert a.shape[1] == e.pages
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == _oracle(engine, [5, 6, 7], 4)
+
+
+def test_park_bad_states(engine):
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([1, 2], max_new_tokens=4)
+    with pytest.raises(ValueError, match="running"):
+        srv.park(h)                         # still queued
+    with pytest.raises(ValueError, match="parked"):
+        srv.resume(h)
+    srv.run()
+    plain = ServingEngine(engine, num_slots=2, page=8)
+    g = plain.submit([1, 2], max_new_tokens=4)
+    plain.step()
+    with pytest.raises(RuntimeError, match="kv_tiers"):
+        plain.park(g)
+    plain.run()
+    with pytest.raises(ValueError, match="park_quant"):
+        ServingEngine(engine, num_slots=2, page=8, park_quant="int8")
+    with pytest.raises(ValueError, match="UNQUANTIZED"):
+        ServingEngine(engine, num_slots=2, page=8, kv_dtype="int8",
+                      kv_tiers={"host_pages": 8}, park_quant="fp8")
+    with pytest.raises(TypeError, match="kv_tiers"):
+        ServingEngine(engine, num_slots=2, page=8, kv_tiers=3.5)
+
+
+def test_megakernel_rejects_kv_tiers(mesh):
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           head_dim=8)
+    mk = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
+                          t_tile=16)
+    with pytest.raises(ValueError, match="layer-path knob"):
+        ServingEngine(mk, kv_tiers=True)
+
+
+# ---------------------------------------------------------------------------
+# Prefix demote -> tier refetch
+# ---------------------------------------------------------------------------
+
+PREFIX = [9, 10, 11, 12, 13, 14, 15, 16, 2]      # 2 full pages @ page=4
+
+
+def _tiered_prefix_engine(mesh, **kw):
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    kw.setdefault("kv_tiers", {"host_pages": 64})
+    return ServingEngine(eng, num_slots=2, page=4, num_pages=10,
+                         prefix_reuse=True, prefill_buckets=(4, 8),
+                         **kw)
+
+
+def test_prefix_demote_and_tier_refetch_token_exact(mesh):
+    srv = _tiered_prefix_engine(mesh)
+    want = _oracle(srv.engine, PREFIX, 3)
+    assert srv.generate([PREFIX], max_new_tokens=3)[0] == want
+    # Unrelated traffic starves the pool: the cold committed prefix
+    # DEMOTES into the host tier instead of dropping.
+    for i in range(4):
+        srv.generate([[20 + i, 21, 22, 23, 24, 25, 26, 27]],
+                     max_new_tokens=3)
+    st = srv.stats()
+    assert st["pool"]["demotions"] >= 1, "eviction dropped, not demoted"
+    assert st["tier_pages"] >= 1
+    # The same prefix returns: its pages prefetch back from the tier
+    # (tier_hits), the chunk stream skips them, tokens stay exact.
+    assert srv.generate([PREFIX], max_new_tokens=3)[0] == want
+    st = srv.stats()
+    assert st["tier_hits"] >= 1 and st["prefetched_pages"] >= 1
+    # Promotion popped the tier entries — exactly one authoritative
+    # tier per page, checkable.
+    chaos.check_invariants(srv)
+    assert srv.decode_cache_size() == 1
+    assert srv.prefill_cache_size() <= 2
+
+
+def test_demoted_prefix_under_live_sharer_not_corrupted(mesh):
+    srv = _tiered_prefix_engine(mesh)
+    want6 = _oracle(srv.engine, PREFIX, 6)
+    # a holds the shared prefix pages LIVE while the pool starves:
+    # eviction must never pick (or demote) its pages.
+    a = srv.submit(PREFIX, max_new_tokens=6)
+    for _ in range(4):
+        srv.step()
+    assert a.status == "running"
+    with pytest.raises(OutOfPagesError):
+        # a's live pages (prefix ones included) are not evictable, so
+        # a near-pool-sized ask must starve instead of demoting them.
+        srv.manager.alloc_prefill(63, list(range(30, 62)))
+    assert srv.manager.stats["demotions"] == 0
+    srv.run()
+    assert a.tokens == want6, "live sharer's pages were corrupted"
+    # Sharer gone: the prefix CAN now demote (explicit evict — the
+    # same path pool pressure takes), and a newcomer refetches the
+    # first sharer's exact bytes from the tier.
+    assert len(srv.manager.evict(2)) == 2
+    assert srv.manager.stats["demotions"] == 2
+    tier_hits0 = srv.stats()["tier_hits"]
+    assert srv.generate([PREFIX], max_new_tokens=6)[0] == want6
+    assert srv.stats()["tier_hits"] >= tier_hits0 + 2
+
+
+def test_tier_transfer_fault_falls_back_to_recompute(mesh):
+    srv = _tiered_prefix_engine(mesh)
+    want = _oracle(srv.engine, PREFIX, 3)
+    srv.generate([PREFIX], max_new_tokens=3)
+    for i in range(4):
+        srv.generate([[20 + i, 21, 22, 23, 24, 25, 26, 27]],
+                     max_new_tokens=3)
+    assert srv.stats()["pool"]["demotions"] >= 1
+    # Every tier transfer dropped: the prefetch degrades to a miss and
+    # the prompt recomputes — tokens identical, nothing stuck.
+    plan = faults.FaultPlan(
+        name="drop-all-tier",
+        faults=(faults.Fault("fail_call", op="tier_transfer", k=None),))
+    with faults.inject(plan):
+        assert srv.generate([PREFIX], max_new_tokens=3)[0] == want
+    assert srv.stats()["tier_misses"] >= 1
+    chaos.check_invariants(srv)
+
+
+def test_disagg_composes_with_tiers(mesh):
+    from triton_dist_tpu.serving import DisaggServingEngine
+
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    srv = DisaggServingEngine(eng, num_slots=2, page=4, num_pages=10,
+                              prefill_buckets=(4, 8),
+                              prefix_reuse=True,
+                              kv_tiers={"host_pages": 64})
+    want = _oracle(eng, PREFIX, 3)
+    assert srv.generate([PREFIX], max_new_tokens=3)[0] == want
+    for i in range(4):
+        srv.generate([[20 + i, 21, 22, 23, 24, 25, 26, 27]],
+                     max_new_tokens=3)
+    # Decode-pool demotions refetch at HANDOFF time (migration rows
+    # skip tier-resident pages like warm prefix hits).
+    assert srv.generate([PREFIX], max_new_tokens=3)[0] == want
+    st = srv.stats()
+    if st["pool"]["demotions"]:
+        assert st["tier_hits"] >= 1
+    # Park/resume rides the decode side unchanged.
+    h = srv.submit([5, 6, 7], max_new_tokens=4)
+    while h.status != "running":
+        srv.step()
+    srv.step()
+    srv.park(h)
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == _oracle(eng, [5, 6, 7], 4)
+    chaos.check_invariants(srv)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry, checkpoint, chaos, and the acceptance trace
+# ---------------------------------------------------------------------------
+
+def test_tier_spans_and_latency(engine):
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        clock["t"] += 0.5
+        return clock["t"]
+
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32},
+                        telemetry="spans", clock=fake_clock)
+    h = srv.submit([5, 6, 7], max_new_tokens=5)
+    srv.step()
+    srv.step()
+    srv.park(h)
+    srv.resume(h)
+    srv.run()
+    kinds = [s.kind for s in srv.obs.log.spans()]
+    for k in ("park", "kv_offload", "kv_prefetch", "resume"):
+        assert k in kinds, f"span kind {k!r} missing from the timeline"
+    # The resume span closes at REACTIVATION (requeue -> running), on
+    # the injectable clock, and feeds the per-op histogram — the
+    # session_resume_ms bench surface.
+    ops = srv.stats()["latency"]["ops"]
+    for k in ("park", "kv_offload", "kv_prefetch", "resume"):
+        assert ops[k]["count"] >= 1 and ops[k]["mean"] > 0
+    resume_span = [s for s in srv.obs.log.spans()
+                   if s.kind == "resume" and s.t1 is not None][0]
+    assert resume_span.duration > 0
+
+
+def test_checkpoint_restore_with_parked_and_offloaded(mesh, tmp_path):
+    from triton_dist_tpu.serving import load_checkpoint, save_checkpoint
+
+    def build():
+        return _tiered_prefix_engine(mesh)
+
+    srv = build()
+    want_park = _oracle(srv.engine, [5, 6, 7], 6)
+    srv.generate([PREFIX], max_new_tokens=3)
+    for i in range(4):
+        srv.generate([[20 + i, 21, 22, 23, 24, 25, 26, 27]],
+                     max_new_tokens=3)
+    assert srv.stats()["pool"]["demotions"] >= 1   # offloaded pages
+    h = srv.submit([5, 6, 7], max_new_tokens=6)
+    while h.status != "running":
+        srv.step()
+    srv.step()
+    srv.park(h)
+    path = save_checkpoint(srv.checkpoint(), str(tmp_path / "t.ckpt"))
+    srv2 = build()
+    revived = srv2.restore(load_checkpoint(path))
+    h2 = next(x for x in revived if x.status == "parked")
+    # The snapshot carried the tier wholesale: parked payload AND the
+    # demoted prefix pages survive the process boundary.
+    assert ("session", h2.request.request_id) in srv2.tiers
+    assert srv2.stats()["tier_pages"] == srv.stats()["tier_pages"]
+    srv2.resume(h2)
+    srv2.run()
+    assert h2.tokens == want_park
+    assert srv2.generate([PREFIX], max_new_tokens=3)[0] == \
+        _oracle(srv2.engine, PREFIX, 3)
+    chaos.check_invariants(srv2)
+
+
+def test_restore_tiered_snapshot_needs_tiers(mesh, engine):
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    snap = srv.checkpoint()
+    plain = ServingEngine(engine, num_slots=2, page=8)
+    with pytest.raises(ValueError, match="mismatch|kv_tiers"):
+        plain.restore(snap)
+
+
+def test_chaos_soak_with_tier_faults_and_parks(mesh):
+    from triton_dist_tpu.resilience.policy import RetryPolicy
+
+    def factory():
+        eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+        return ServingEngine(eng, num_slots=2, page=4, num_pages=12,
+                             prefix_reuse=True,
+                             kv_tiers={"host_pages": 64},
+                             retry=RetryPolicy(max_attempts=2))
+
+    rep = chaos.run_soak(
+        factory, seed=5, ticks=30, n_faults=4,
+        kinds=(chaos.DEFAULT_FAULT_KINDS[:6] + chaos.TIER_FAULT_KINDS),
+        park_p=0.25)
+    # A completed soak already proved tier coherence every tick and
+    # token-exactness of every survivor (parked/resumed included).
+    assert rep.survived_faults == rep.faults_injected == 4
+    assert rep.counters["parks"] >= 1
+    assert rep.counters["parks"] == rep.counters["resumes"]
+
+
+def test_tier_invariant_checker_catches_corruption(engine):
+    srv = ServingEngine(engine, num_slots=2, page=8,
+                        kv_tiers={"host_pages": 32})
+    h = srv.submit([5, 6], max_new_tokens=4)
+    srv.step()
+    srv.step()
+    srv.park(h)
+    chaos.check_invariants(srv)
+    # Corrupt: drop the parked payload behind the registry's back.
+    srv.tiers.pop(("session", h.request.request_id))
+    with pytest.raises(chaos.InvariantViolation, match="no tier payload"):
+        chaos.check_invariants(srv)
+
+
+def test_heavy_tail_trace_runs_to_drain(mesh):
+    """The acceptance shape, scaled to the CPU battery: a seeded
+    multi-turn trace over a 100k-session heavy-tailed id space served
+    through an HBM pool sized WELL below the working set — the tier
+    keeps it draining, hot-set hit rate and resume latency land as
+    real numbers, and a spot-checked session is token-exact."""
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    srv = ServingEngine(eng, num_slots=2, page=4, num_pages=12,
+                        prefix_reuse=True, prefill_buckets=(4, 8),
+                        kv_tiers={"host_pages": 256})
+    events = heavy_tail_trace(24, n_sessions=100_000, vocab=64,
+                              seed=7, max_total=20)
+    history, done = {}, []
+    distinct = {ev["session"] for ev in events}
+    assert any(ev["turn"] > 0 for ev in events), \
+        "heavy tail produced no session reuse — trace shape broken"
+    for ev in events:
+        prompt = extend_session(history, ev, max_prompt=12)
+        h = srv.submit(prompt, max_new_tokens=ev["gen"])
+        srv.run()
+        assert h.status == "done", (h.status, h.error)
+        extend_session(history, ev, reply=h.tokens)
+        done.append((list(prompt), ev["gen"], h))
+    st = srv.stats()
+    assert st["kv_hot_hit_rate"] is not None
+    assert st["pool"]["demotions"] + st["tier_hits"] >= 0  # coherent
+    # Spot-check token-exactness on the 3 longest prompts.
+    for prompt, gen, h in sorted(done, key=lambda t: -len(t[0]))[:3]:
+        assert h.tokens == _oracle(eng, prompt, gen), \
+            f"trace request diverged (prompt={prompt})"
+    # Park/resume a final session so the resume histogram is non-null
+    # (the session_resume_ms bench key reads exactly this).
+    h = srv.submit([1, 2, 3], max_new_tokens=5)
+    while h.status != "running":
+        srv.step()
+    srv.step()
+    srv.park(h)
+    srv.resume(h)
+    srv.run()
+    assert h.tokens == _oracle(eng, [1, 2, 3], 5)
+    assert srv.stats()["latency"]["ops"]["resume"]["count"] >= 1
+    assert len(distinct) >= 2
+    chaos.check_invariants(srv)

@@ -44,6 +44,11 @@ SPAN_KINDS = (
     "spec_rollback",     # event: rejected suffix rolled back
     "first_token",       # event: TTFT edge (request's first emission)
     "request",           # span: submit -> terminal status
+    # KV memory hierarchy (docs/serving.md, "KV memory hierarchy")
+    "kv_offload",        # span: page payload demoted into the tier
+    "kv_prefetch",       # span: tier payload scattered back into HBM
+    "park",              # span: session offloaded + slot released
+    "resume",            # span: resume() -> token-exact reactivation
     # resilience
     "retry",             # event: one absorbed transient (attempt n)
     "retry_backoff",     # event: backoff sleep scheduled (policy)

@@ -36,6 +36,7 @@ TELEMETRY_MODES = ("off", "counters", "spans")
 _OP_HIST_KINDS = frozenset({
     "queue_wait", "prefill", "prefill_chunk", "migration", "decode",
     "spec_draft", "spec_verify", "checkpoint", "restore", "request",
+    "kv_offload", "kv_prefetch", "park", "resume",
 })
 
 

@@ -174,6 +174,30 @@ def migrate_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
     included) and leaves this ``None``; direct callers get the same
     containment here.
     """
+    return _paged_put_host(k_payload, v_payload, mesh, axis=axis,
+                           src=src, dst=dst, retry=retry,
+                           op="p2p.migrate_pages_host")
+
+
+def tier_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
+                    src: int = 0, dst: int = 1, retry=None):
+    """KV tier transition over the one-sided bridge: the exact
+    transfer contract of :func:`migrate_pages_host` (K and V stacked
+    into ONE put, only the dst slab pulled back), kept as its own
+    named op so fault plans, retries, and telemetry can target tier
+    traffic (HBM ↔ host-tier demote/prefetch — see
+    :class:`~triton_dist_tpu.serving.tiers.KVTierStore`) separately
+    from role-to-role page migration."""
+    return _paged_put_host(k_payload, v_payload, mesh, axis=axis,
+                           src=src, dst=dst, retry=retry,
+                           op="p2p.tier_pages_host")
+
+
+def _paged_put_host(k_payload, v_payload, mesh, *, axis, src, dst,
+                    retry, op):
+    """Shared body of the whole-page payload hops (role migration and
+    tier transitions): one-sided put of the stacked K/V slab from
+    ``src`` to ``dst`` along the bridge mesh's ``axis``."""
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -201,7 +225,7 @@ def migrate_pages_host(k_payload, v_payload, mesh, *, axis: str = "role",
 
     # Transients only: a shape/mesh logic error must propagate on the
     # first attempt, not replay through the full backoff schedule.
-    return retry.run(_once, op="p2p.migrate_pages_host",
+    return retry.run(_once, op=op,
                      retry_on=(CommTimeoutError, faults.InjectedFault,
                                TimeoutError))
 

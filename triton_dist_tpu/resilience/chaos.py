@@ -49,7 +49,8 @@ import numpy as np
 from triton_dist_tpu.resilience import faults
 
 __all__ = ["ChaosEvent", "ChaosReport", "InvariantViolation",
-           "DEFAULT_FAULT_KINDS", "check_invariants", "run_soak"]
+           "DEFAULT_FAULT_KINDS", "TIER_FAULT_KINDS",
+           "check_invariants", "run_soak"]
 
 
 class InvariantViolation(AssertionError):
@@ -74,6 +75,20 @@ DEFAULT_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
     ("drop_decode", "serving_decode", "fail_call"),
     ("wedge_decode", "serving_decode", "timeout_call"),
     ("kill_prefill_worker", None, None),
+)
+
+# The tiered-KV additions (engines built with ``kv_tiers``): dropped /
+# wedged tier transfers — a faulted demote drops the (recomputable)
+# prefix content, a faulted prefetch falls back to recompute, a
+# faulted park leaves the request running, a faulted resume re-enters
+# via the deterministic re-prefill; all token-exact by construction.
+# Kept separate so un-tiered soaks (and their seeded schedules) stay
+# byte-identical; pass ``kinds=DEFAULT_FAULT_KINDS + TIER_FAULT_KINDS``
+# for a tiered engine.
+TIER_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
+                        ...] = (
+    ("drop_tier_transfer", "tier_transfer", "fail_call"),
+    ("wedge_tier_transfer", "tier_transfer", "timeout_call"),
 )
 
 
@@ -211,7 +226,7 @@ def check_invariants(srv) -> None:
                         f"slot {s} allocator tokens {n} drifted from "
                         f"length mirror {srv._lens[s]} (allowed slack "
                         f"{spec_slack})")
-        elif h.status in ("prefill", "migrating"):
+        elif h.status in ("prefill", "migrating", "resuming"):
             if srv._live[s] != 0 and not srv.mega:
                 raise InvariantViolation(
                     f"parked ({h.status}) slot {s} is marked live")
@@ -223,6 +238,59 @@ def check_invariants(srv) -> None:
             raise InvariantViolation(
                 f"queued request {h.request.request_id} still holds "
                 f"slot {h.slot}")
+    _check_tiers(srv)
+
+
+def _check_tiers(srv) -> None:
+    """Tier-coherence sweep (engines built with ``kv_tiers``): every
+    payload lives in exactly ONE authoritative tier, no HBM free-list
+    entry is backed by a pending (uncommitted) demotion, and the
+    parked registry and tier store agree."""
+    tiers = getattr(srv, "tiers", None)
+    if tiers is None:
+        return
+    try:
+        # Staged-demotion window empty between ticks + host/disk
+        # disjoint + capacity bounds (the store's own algebra).
+        tiers.check_coherence()
+    except AssertionError as e:
+        raise InvariantViolation(str(e)) from e
+    # Exactly-one-tier across the hierarchy: a key committed in the
+    # HBM prefix cache must not ALSO be tier-resident (demotion pops
+    # it from HBM, promotion pops it from the tier).
+    if srv.manager is not None:
+        hbm_keys = set(srv.manager._prefix)
+        for k in tiers.keys():
+            k = tuple(k)
+            if k[0] == "prefix" and k[1] in hbm_keys:
+                raise InvariantViolation(
+                    f"prefix key resident in BOTH the HBM cache and "
+                    f"the tier store: {k[1]!r}")
+    parked = getattr(srv, "_parked", {})
+    for rid, h in parked.items():
+        if h.status != "parked" or h.slot is not None:
+            raise InvariantViolation(
+                f"parked registry holds request {rid} in state "
+                f"{h.status!r} (slot={h.slot})")
+        if ("session", rid) not in tiers:
+            raise InvariantViolation(
+                f"parked request {rid} has no tier payload — its KV "
+                "is unrecoverable")
+        if h in srv.sched.queue:
+            raise InvariantViolation(
+                f"parked request {rid} is also queued")
+    for k in tiers.keys():
+        k = tuple(k)
+        if k[0] != "session":
+            continue
+        e = tiers.entry(k)
+        if e.pinned and k[1] not in parked and not any(
+                getattr(h, "resume_key", None) == k
+                for h in list(srv.sched.queue)
+                + list(srv.sched.slots.values())):
+            raise InvariantViolation(
+                f"pinned session payload {k[1]!r} has no parked or "
+                "resuming owner — leaked tier pages")
 
 
 # ---------------------------------------------------------------------------
@@ -268,7 +336,8 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
              gen_choices: Sequence[int] = (2, 3, 4, 6, 8),
              prompt_reuse_p: float = 0.3,
              restore_at: Optional[int] = None,
-             max_drain_steps: Optional[int] = None) -> ChaosReport:
+             max_drain_steps: Optional[int] = None,
+             park_p: float = 0.0) -> ChaosReport:
     """Drive ``ticks`` serving steps of seeded mixed traffic under
     ``n_faults`` seeded fault events, checking every invariant after
     every tick, then drain fault-free and verify terminal resolution +
@@ -280,6 +349,13 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
     exactness oracle is ``Engine.serve``). Raises
     :class:`InvariantViolation` (or the server's own crash) on any
     violation; returns a :class:`ChaosReport` otherwise.
+
+    ``park_p`` > 0 (engines built with ``kv_tiers``) additionally
+    parks a seeded-random running request with that per-tick
+    probability and resumes it 1–4 ticks later — resumed sessions
+    flow through the same token-exactness gate as everything else, so
+    a park/resume byte drift fails the soak. Anything still parked
+    when the soak ends resumes before the drain.
     """
     rng = np.random.RandomState(seed)
     srv = factory()
@@ -334,6 +410,45 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
                     k: srv.sched.counters[k] for k in
                     ("failed", "timed_out")}
 
+    # Seeded park/resume drill state: parked handles and the tick
+    # each one resumes at. All rng draws are gated on park_p, so a
+    # park_p=0 soak's random sequence (and therefore its entire
+    # schedule) is byte-identical to the pre-tier soaks.
+    resume_at: Dict[int, List[object]] = {}
+    parked: List[object] = []
+
+    def _park_maybe(tick: int):
+        if not park_p or getattr(srv, "tiers", None) is None:
+            return
+        for h in resume_at.pop(tick, []):
+            if h.status == "parked":
+                srv.resume(h)
+                parked.remove(h)
+        if rng.rand() >= park_p:
+            return
+        cands = [h for h in srv.sched.running()
+                 if h.status == "running" and h.tokens]
+        if not cands:
+            return
+        h = cands[int(rng.randint(len(cands)))]
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+        from triton_dist_tpu.serving.tiers import TierFullError
+
+        try:
+            srv.park(h)
+        except (TierFullError, CommTimeoutError,
+                faults.InjectedFault):
+            # Correct containment, not a bug: a full tier or a
+            # dropped/wedged offload transfer aborts the park and the
+            # request KEEPS RUNNING (the two-phase offload frees
+            # nothing before the transfer commits) — on fault ticks
+            # _park_maybe runs INSIDE the injection scope precisely
+            # to exercise this.
+            return
+        parked.append(h)
+        resume_at.setdefault(
+            tick + 1 + int(rng.randint(4)), []).append(h)
+
     for tick in range(ticks):
         if restore_at is not None and tick == restore_at:
             # The mid-run kill/restore drill: snapshot, throw the
@@ -345,12 +460,18 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
                        for h in srv.restore(snap)}
             tracked = [(p, g, revived.get(h.request.request_id, h))
                        for p, g, h in tracked]
+            parked = [revived.get(h.request.request_id, h)
+                      for h in parked]
+            resume_at = {t: [revived.get(h.request.request_id, h)
+                             for h in hs]
+                         for t, hs in resume_at.items()}
             restored_tick = tick
             srv.obs.event("chaos_restore", tick=tick,
                           revived=len(revived))
         _submit_maybe()
         ev = schedule.get(tick)
         if ev is None:
+            _park_maybe(tick)
             srv.step()
         elif ev.name == "kill_prefill_worker":
             ev.at = srv.sched.now()
@@ -358,19 +479,32 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
             killed = bool(getattr(srv, "fail_prefill_worker",
                                   lambda: False)())
             ev.fired, ev.observed = True, killed
+            _park_maybe(tick)
             srv.step()
         else:
             before = _tick_counters()
             ev.at = srv.sched.now()
             _note_fault(srv, ev)
             with faults.inject(_plan_for(ev)):
+                # The park drill runs INSIDE the fault scope: a tier
+                # fault can hit the park offload itself (aborted park,
+                # request keeps running) as well as the step's
+                # demotes/prefetches.
+                _park_maybe(tick)
                 srv.step()
             ev.fired = True
             ev.observed = _tick_counters() != before
         check_invariants(srv)
         invariant_checks += 1
 
-    # Drain fault-free: everything still in flight must resolve.
+    # Drain fault-free: everything still in flight must resolve —
+    # parked sessions resume first (a park with no resume is a
+    # deliberate suspension, not a drain blocker; the drill resumes
+    # everything so its token-exactness is checked).
+    for h in parked:
+        if h.status == "parked":
+            srv.resume(h)
+    parked.clear()
     budget = max_drain_steps or (ticks * 4 + 200)
     for _ in range(budget):
         if srv._drained():
@@ -412,7 +546,8 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
             for k in ("done", "failed", "timeout")}},
         counters={k: srv.stats_counters[k] for k in
                   ("retries", "failovers", "comm_timeouts",
-                   "preemptions", "restored_requests")},
+                   "preemptions", "restored_requests", "parks",
+                   "resumes")},
         invariant_checks=invariant_checks,
         token_exact_requests=token_exact,
         restored_at=restored_tick)

@@ -30,6 +30,9 @@ from triton_dist_tpu.serving.server import (  # noqa: F401
 from triton_dist_tpu.serving.chunked import (  # noqa: F401
     DEFAULT_BUCKETS, ChunkedPrefill,
 )
+from triton_dist_tpu.serving.tiers import (  # noqa: F401
+    KVTierStore, TierFullError, heavy_tail_trace,
+)
 from triton_dist_tpu.serving.disagg import (  # noqa: F401
     DisaggServingEngine, PrefillWorker,
 )

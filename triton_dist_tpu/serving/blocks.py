@@ -527,14 +527,24 @@ class BlockManager:
     page ids (refcounted) instead of new pages. Shared pages are always
     full, so decode appends (which only ever touch a slot's last,
     private page) can never mutate them. The cache itself holds one
-    reference per shared page; when the free list runs dry, unreferenced
-    prefix pages are evicted LRU-insertion-order before giving up.
+    reference per shared page; when the free list runs dry,
+    unreferenced prefix pages are evicted by SCORE — an EWMA of hit
+    frequency/recency per committed block (every hit bumps the score,
+    every allocation tick decays it by ``score_decay``), so the cold
+    tail leaves first and the hot set stays HBM-resident. ``on_demote``
+    (installed by the serving engine when a
+    :class:`~triton_dist_tpu.serving.tiers.KVTierStore` is configured)
+    fires per victim BEFORE its page is freed: the hook offloads the
+    page's bytes to the tier below, turning eviction from
+    drop-and-recompute into demote-and-prefetch.
     """
 
     def __init__(self, num_pages: int, page: int, p_max: int, *,
                  prefix_reuse: bool = False,
                  page_bytes: Optional[int] = None,
-                 native_page_bytes: Optional[int] = None):
+                 native_page_bytes: Optional[int] = None,
+                 score_decay: float = 0.9,
+                 on_demote=None):
         if num_pages < 2:
             raise ValueError(f"num_pages={num_pages} < 2 (page 0 is the "
                              "reserved scratch page)")
@@ -563,8 +573,28 @@ class BlockManager:
         # the migration handoff both write AFTER allocating).
         self._prefix: Dict[Tuple, int] = {}
         self._pending_prefix: Dict[int, List[Tuple[Tuple, int]]] = {}
+        # Eviction scoring: committed key -> (score, last-touch tick).
+        # The tick advances per alloc_prefill; a hit folds +1 into the
+        # geometrically-decayed running score, so frequency AND
+        # recency both count (a once-hot-now-cold prefix decays below
+        # a steadily-warm one).
+        if not (0.0 < score_decay <= 1.0):
+            raise ValueError(f"score_decay must be in (0, 1], got "
+                             f"{score_decay}")
+        self.score_decay = float(score_decay)
+        self.on_demote = on_demote
+        # Publication hook, the demote hook's dual: fires per key the
+        # moment it COMMITS into the HBM prefix cache. The serving
+        # engine uses it to drop any stale tier copy of the same
+        # content (a faulted prefetch falls back to recompute; once
+        # the recomputed pages publish, HBM is the one authoritative
+        # tier again and the tier entry must go).
+        self.on_commit = None
+        self._score: Dict[Tuple, Tuple[float, int]] = {}
+        self._tick = 0
         self.stats = {"allocs": 0, "frees": 0, "prefix_hits": 0,
-                      "prefix_misses": 0, "evictions": 0}
+                      "prefix_misses": 0, "evictions": 0,
+                      "demotions": 0}
 
     # -- raw pool ----------------------------------------------------
 
@@ -588,16 +618,56 @@ class BlockManager:
             self.stats["frees"] += 1
 
     def _evict_prefix(self):
-        """Free ONE unreferenced prefix-cache page (insertion order) —
-        incremental, so a transient pool-dry tick reclaims exactly what
-        it needs instead of wiping the whole warm prefix cache."""
-        for key, pid in list(self._prefix.items()):
-            if self._free:
+        """Free ONE unreferenced prefix-cache page — incremental, so a
+        transient pool-dry tick reclaims exactly what it needs instead
+        of wiping the whole warm prefix cache. Victim choice and the
+        demote hook live in :meth:`evict`."""
+        self.evict(1)
+
+    def _decayed_score(self, key: Tuple) -> float:
+        score, last = self._score.get(key, (0.0, self._tick))
+        return score * self.score_decay ** (self._tick - last)
+
+    def _touch_score(self, key: Tuple):
+        self._score[key] = (self._decayed_score(key) + 1.0, self._tick)
+
+    def evict(self, n: int = 1) -> List[Tuple[Tuple, int]]:
+        """Evict up to ``n`` UNREFERENCED committed prefix pages, the
+        lowest frequency/recency score first (ties break in insertion
+        order). Each victim runs the ``on_demote(key, pid)`` hook —
+        while it runs, the page is still HBM-resident and still out of
+        the free list (the two-phase tier transition: the hook stages
+        + commits the payload into the tier below, and only then does
+        the page free here) — a True return counts a demotion, False
+        (or no hook) drops the content (recomputable by contract).
+        Pages a live slot still references are never candidates.
+        Returns the evicted ``(key, pid)`` pairs.
+
+        The victim scan is a deliberate linear pass: every committed
+        entry pins a distinct pool page, so it is bounded by
+        ``num_pages`` — O(pool) per pool-dry eviction, with exact
+        decayed scores under arbitrary refcount churn (a heap would
+        trade that exactness for staleness-invalidation machinery)."""
+        out: List[Tuple[Tuple, int]] = []
+        for _ in range(n):
+            victim, best = None, None
+            for key, pid in self._prefix.items():
+                if self._refs.get(pid, 0) != 1:   # a slot still reads it
+                    continue
+                s = self._decayed_score(key)
+                if best is None or s < best:
+                    victim, best = (key, pid), s
+            if victim is None:
                 break
-            if self._refs.get(pid, 0) == 1:   # only the cache's ref
-                del self._prefix[key]
-                self._drop_ref(pid)
-                self.stats["evictions"] += 1
+            key, pid = victim
+            if self.on_demote is not None and self.on_demote(key, pid):
+                self.stats["demotions"] += 1
+            del self._prefix[key]
+            self._score.pop(key, None)
+            self._drop_ref(pid)
+            self.stats["evictions"] += 1
+            out.append(victim)
+        return out
 
     # -- per-slot API ------------------------------------------------
 
@@ -612,6 +682,7 @@ class BlockManager:
         if slot in self._slot_pages:
             raise ValueError(f"slot {slot} already allocated; free it "
                              "before reuse")
+        self._tick += 1            # the eviction score's decay clock
         n_tok = len(tokens)
         n_pages = max((n_tok + self.page - 1) // self.page, 1)
         if n_pages > self.p_max:
@@ -631,6 +702,7 @@ class BlockManager:
                     if pid is not None:
                         self._refs[pid] += 1
                         self.stats["prefix_hits"] += 1
+                        self._touch_score(key)
                         if hits == i:     # hits are always a prefix run
                             hits += 1
                         pages.append(pid)
@@ -663,11 +735,70 @@ class BlockManager:
         for the overlap window, never reading unwritten pages. If
         another sharer committed the same content first, its entry
         wins and this slot's copy stays private."""
-        for key, pid in self._pending_prefix.pop(slot, []):
+        self.commit_pages(slot, [pid for _, pid in
+                                 self._pending_prefix.get(slot, [])])
+
+    def commit_pages(self, slot: int, pids) -> None:
+        """Publish only the staged prefix entries whose page is in
+        ``pids`` (the rest stay staged) — the tier-prefetch commit
+        point: a page whose bytes just scattered in FROM THE TIER is
+        content-resident (and shareable) immediately, while the rest
+        of the slot's prompt is still streaming through prefill."""
+        pids = set(int(p) for p in pids)
+        keep: List[Tuple[Tuple, int]] = []
+        for key, pid in self._pending_prefix.get(slot, []):
+            if pid not in pids:
+                keep.append((key, pid))
+                continue
             if key in self._prefix:
                 continue
-            self._refs[pid] += 1            # the cache's own ref
+            self._refs[pid] += 1
             self._prefix[key] = pid
+            self._score[key] = (1.0, self._tick)
+            if self.on_commit is not None:
+                self.on_commit(key)
+        if keep:
+            self._pending_prefix[slot] = keep
+        else:
+            self._pending_prefix.pop(slot, None)
+
+    def note_tier_hits(self, slot: int, upto_pages: int) -> None:
+        """Extend ``slot``'s resident leading-page run to
+        ``upto_pages`` — the tier-prefetch form of a prefix hit: the
+        pages' KV bytes just arrived from the tier store, so the blit
+        / chunk stream must skip them exactly like first-sharer
+        pages (and :meth:`truncate_to`'s keep-floor protects them)."""
+        self._slot_hits[slot] = max(self._slot_hits.get(slot, 0),
+                                    int(upto_pages))
+
+    def alloc_resume(self, slot: int, n_tokens: int) -> List[int]:
+        """Allocate PRIVATE pages for a parked session re-entering
+        with ``n_tokens`` of tier-resident KV (no prefix lookup: the
+        payload scatter rewrites every page, and writing into a
+        shared page a live reader holds is exactly what the prefix
+        protocol forbids). Same rollback contract as
+        :meth:`alloc_prefill`."""
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already allocated; free it "
+                             "before reuse")
+        self._tick += 1
+        n_pages = max((n_tokens + self.page - 1) // self.page, 1)
+        if n_pages > self.p_max:
+            raise BlockTableOverflowError(
+                f"resume of {n_tokens} tokens needs {n_pages} pages > "
+                f"one block-table row ({self.p_max} x {self.page})")
+        pages: List[int] = []
+        try:
+            for _ in range(n_pages):
+                pages.append(self._take_page())
+        except OutOfPagesError:
+            for pid in pages:
+                self._drop_ref(pid)
+            raise
+        self._slot_pages[slot] = pages
+        self._slot_tokens[slot] = int(n_tokens)
+        self._slot_hits[slot] = 0
+        return list(pages)
 
     def prefix_hits(self, slot: int) -> int:
         """Leading page count of ``slot``'s allocation that came from
@@ -760,6 +891,9 @@ class BlockManager:
             "slot_tokens": dict(self._slot_tokens),
             "slot_hits": dict(self._slot_hits),
             "prefix": list(self._prefix.items()),
+            "prefix_score": [(k, s, t) for k, (s, t) in
+                             self._score.items()],
+            "tick": self._tick,
             "pending_prefix": {s: list(v) for s, v in
                                self._pending_prefix.items()},
             "stats": dict(self.stats),
@@ -785,10 +919,14 @@ class BlockManager:
         self._slot_hits = {int(s): int(n) for s, n in
                            snap["slot_hits"].items()}
         self._prefix = {k: int(v) for k, v in snap["prefix"]}
+        self._score = {k: (float(s), int(t)) for k, s, t in
+                       snap.get("prefix_score", [])}
+        self._tick = int(snap.get("tick", 0))
         self._pending_prefix = {int(s): [(k, int(p)) for k, p in v]
                                 for s, v in
                                 snap["pending_prefix"].items()}
         self.stats = dict(snap["stats"])
+        self.stats.setdefault("demotions", 0)
 
     def table_row(self, slot: int):
         """This slot's block-table row, scratch-padded to p_max."""

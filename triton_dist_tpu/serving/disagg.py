@@ -352,6 +352,13 @@ class DisaggServingEngine(ServingEngine):
             self._handoff_stalled.append(h)
             self.stats_counters["admit_stalls"] += 1
             return
+        # Decode-side tier hits: prefix pages demoted out of the
+        # decode pool earlier prefetch back from the host/disk tier
+        # here, extending the resident run — those rows skip the
+        # migration payload exactly like warm prefix hits (the chunk
+        # compute already happened on the prefill worker; the saving
+        # is transfer bytes + decode-pool churn).
+        self._tier_prefill_fetch(h, slot)
         hits = self.manager.prefix_hits(slot)
         src_ids = np.asarray(pw.manager.table_row(slot), np.int32)
         dst_ids = np.full((self.p_max,), SCRATCH_PAGE, np.int32)

@@ -79,13 +79,26 @@ class RequestHandle:
     """Mutable per-request state the server and callers observe.
 
     ``status``: queued → prefill → running → one of
-    done | failed | timeout. ``tokens`` grows as the request decodes
-    (``stream_cb`` sees each append); ``error`` carries the failure.
+    done | failed | timeout; the tiered-KV verbs add parked (KV
+    offloaded, no slot, waiting for ``resume()``) and resuming (tier
+    payload scattering back, activated next tick). ``tokens`` grows
+    as the request decodes (``stream_cb`` sees each append);
+    ``error`` carries the failure.
     """
 
     request: Request
     status: str = "queued"
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # KV-tier park/resume (docs/serving.md, "KV memory hierarchy"):
+    # a PARKED handle owns no slot and sits in the engine's parked
+    # registry with its KV offloaded to the tier store; ``resume()``
+    # requeues it with ``resume_key`` set, so admission prefetches the
+    # tier payload instead of re-prefilling (status passes through
+    # "resuming" for the one tick the scatter overlaps decode).
+    # ``resume_t0`` stamps the resume() call — the "resume" span (and
+    # the session_resume_ms bench key) closes at reactivation.
+    resume_key: Optional[tuple] = None
+    resume_t0: Optional[float] = None
     error: Optional[BaseException] = None
     slot: Optional[int] = None
     submitted_at: float = 0.0

@@ -110,7 +110,8 @@ class ServingEngine:
                  kv_dtype: str = "bf16", spec_k: int = 0,
                  spec_ngram: int = 3, retry=None,
                  telemetry: str = "counters",
-                 telemetry_capacity: int = 4096):
+                 telemetry_capacity: int = 4096,
+                 kv_tiers=None, park_quant: Optional[str] = None):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -171,6 +172,25 @@ class ServingEngine:
         backoff before the request is failed. Each absorbed transient
         increments ``stats()["retries"]``.
 
+        ``kv_tiers`` (layer path): the tier BELOW the paged HBM pool —
+        a :class:`~triton_dist_tpu.serving.tiers.KVTierStore` (or
+        ``True`` for the defaults, or a kwargs dict). With it on,
+        scored prefix-cache eviction DEMOTES cold committed prefix
+        pages into host RAM (then disk) instead of dropping them, a
+        later same-prefix admission prefetches them back
+        (``tier_hits``), and :meth:`park`/:meth:`resume` become
+        first-class serving verbs — a parked session's KV offloads
+        wholesale, its slot and pages free for other traffic, and the
+        resume prefetch overlaps in-flight decode ticks
+        (docs/serving.md, "KV memory hierarchy").
+
+        ``park_quant``: ``None`` (default — parked payloads keep
+        their pool bytes verbatim, resume is BIT-exact) or
+        ``"int8"``/``"fp8"`` to requantize an unquantized pool's
+        parked payload host-side ("quantize harder": 2–4x smaller
+        host bytes at a bounded divergence after resume; quantized
+        pools always park their stored bytes + scales, bit-exact).
+
         ``telemetry``: ``"off"`` | ``"counters"`` (default) |
         ``"spans"`` — the :mod:`~triton_dist_tpu.obs` recording level.
         Counters mode folds TTFT / inter-token / per-op latency
@@ -192,7 +212,8 @@ class ServingEngine:
         elif isinstance(retry, RetryPolicy):
             self.retry_policies = {op: retry for op in
                                    ("page_migration",
-                                    "chunked_prefill")}
+                                    "chunked_prefill",
+                                    "tier_transfer")}
         elif isinstance(retry, dict):
             for op, pol in retry.items():
                 if not isinstance(pol, RetryPolicy):
@@ -238,6 +259,42 @@ class ServingEngine:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self._draft = NgramDraft(spec_ngram, telemetry=self.obs)
 
+        # KV memory hierarchy: the host/disk tier below the HBM pool
+        # (docs/serving.md, "KV memory hierarchy").
+        from triton_dist_tpu.serving.tiers import KVTierStore
+
+        if kv_tiers is None or kv_tiers is False:
+            self.tiers = None
+        elif isinstance(kv_tiers, KVTierStore):
+            self.tiers = kv_tiers
+        elif kv_tiers is True:
+            self.tiers = KVTierStore()
+        elif isinstance(kv_tiers, dict):
+            self.tiers = KVTierStore(**kv_tiers)
+        else:
+            raise TypeError(
+                "kv_tiers must be a KVTierStore, a kwargs dict, True, "
+                f"or None — got {type(kv_tiers).__name__}")
+        if park_quant is not None:
+            if kv_quant_spec(park_quant)[0] is None:
+                park_quant = None          # "bf16" = keep verbatim
+            elif kv_quant_spec(kv_dtype)[0] is not None:
+                raise ValueError(
+                    f"park_quant={park_quant!r} applies to an "
+                    "UNQUANTIZED pool (a quantized pool parks its "
+                    "stored bytes + scales verbatim, already small "
+                    "and bit-exact)")
+        self.park_quant = park_quant
+        if self.park_quant is not None and self.tiers is None:
+            raise ValueError("park_quant needs kv_tiers (parking "
+                             "offloads into the tier store)")
+        # Parked sessions: request_id -> handle (token-preserving; no
+        # slot, no queue position). _resuming holds last tick's
+        # prefetch dispatches, activated at the next tick boundary so
+        # the scatter overlaps the decode dispatches in between.
+        self._parked: dict = {}
+        self._resuming: List = []
+
         self.engine = engine
         self.mega = isinstance(engine, MegaKernelEngine)
         self.replica_slots = int(replica_slots)
@@ -277,6 +334,8 @@ class ServingEngine:
             "spec_drafted": 0, "spec_accepted": 0,
             "greedy_agree_tokens": 0, "greedy_ref_tokens": 0,
             "retries": 0, "failovers": 0, "restored_requests": 0,
+            "tier_hits": 0, "tier_misses": 0, "offloaded_pages": 0,
+            "prefetched_pages": 0, "parks": 0, "resumes": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -316,6 +375,12 @@ class ServingEngine:
                     "attn_impl/chunk_attn are layer-path knobs; the "
                     "megakernel's attention rides its own in-arena "
                     "task lane (docs/serving.md)")
+            if self.tiers is not None:
+                raise ValueError(
+                    "kv_tiers is a layer-path knob: the megakernel's "
+                    "KV lives in its in-kernel arena, which the tier "
+                    "gather/scatter path cannot address "
+                    "(docs/serving.md, 'KV memory hierarchy')")
             num_slots = engine.batch
             if engine.paged:
                 page = engine.builder.page
@@ -531,6 +596,43 @@ class ServingEngine:
             donate_argnums=(0,), out_shardings=shardings)
         self._axis_n = n
 
+        if self.tiers is not None:
+            # Tier transfer dispatches, both FIXED-SHAPE so the jit
+            # cache stays bounded: the gather replicates whole-page
+            # payloads off the sharded pool (ids are (1,) for a
+            # single-page prefix demote or (p_max,) scratch-padded for
+            # a session park — two entries, never more), the scatter
+            # blits a scratch-padded (p_max,)-payload back in, donated
+            # and PINNED to the pool's one sharding spelling so the
+            # decode dispatch never re-specializes on a prefetch.
+            rep = NamedSharding(mesh, P())
+            self._tier_gather = jax.jit(
+                lambda c, ids: c.gather_pages(ids),
+                out_shardings=((rep,) * 4 if cache.quantized
+                               else (rep, rep)))
+            if cache.quantized:
+                self._tier_scatter = jax.jit(
+                    lambda c, k, v, ks, vs, ids: c.scatter_pages(
+                        k, v, ids, ks, vs),
+                    donate_argnums=(0,),
+                    out_shardings=shardings)
+            else:
+                self._tier_scatter = jax.jit(
+                    lambda c, k, v, ids: c.scatter_pages(k, v, ids),
+                    donate_argnums=(0,),
+                    out_shardings=shardings)
+            # Scored eviction demotes instead of dropping: the hook
+            # offloads the victim page's bytes (+ scales) into the
+            # tier store while the page is still HBM-resident — the
+            # two-phase tier transition (stage, commit, THEN free).
+            self.manager.on_demote = self._demote_prefix_page
+            # And the dual direction: a key committing into the HBM
+            # cache (first publication OR a recompute after a faulted
+            # prefetch) drops any stale tier copy -- exactly one
+            # authoritative tier per page, always.
+            self.manager.on_commit = (
+                lambda key: self.tiers.pop(("prefix", key), None))
+
         self._verify = None
         if self.spec_k:
             if not hasattr(model, "verify_step_paged"):
@@ -598,6 +700,8 @@ class ServingEngine:
         """One serving tick: deadlines → admission/prefill → one joint
         decode dispatch → per-slot token handling. Returns how many
         live slots decoded (0 = idle tick)."""
+        if self._resuming:
+            self._collect_resumes()
         now = self.sched.now()
         for h in self.sched.expired(now):
             self._fail(h, "timeout", TimeoutError(
@@ -726,6 +830,27 @@ class ServingEngine:
             out["tokens_per_s"] = (
                 self.stats_counters["decode_tokens"]
                 / self.stats_counters["decode_time_s"])
+        # KV memory hierarchy surface: tier occupancy + the hot-set
+        # HBM hit rate (prefix allocations served from HBM over all
+        # prefix lookups — tier hits and recomputes are the misses).
+        # Nulled, not omitted, when tiering is off; tier_hits /
+        # tier_misses / offloaded_pages / parks / resumes ride the
+        # plain counters above.
+        out["parked_sessions"] = len(self._parked)
+        if self.tiers is not None:
+            ts = self.tiers.stats()
+            out["tiers"] = ts
+            out["tier_pages"] = (ts["host_pages_used"]
+                                 + ts["disk_pages_used"])
+            s = self.manager.stats if self.manager is not None else {}
+            denom = (s.get("prefix_hits", 0)
+                     + s.get("prefix_misses", 0))
+            out["kv_hot_hit_rate"] = (
+                round(s["prefix_hits"] / denom, 4) if denom else None)
+        else:
+            out["tiers"] = None
+            out["tier_pages"] = None
+            out["kv_hot_hit_rate"] = None
         # Telemetry surface: histogram summaries (TTFT / inter-token /
         # per-op, per-tenant groups) — None in telemetry="off", keeping
         # the key present either way (nulled, not omitted).
@@ -771,10 +896,12 @@ class ServingEngine:
             "max_len": self.max_len, "spec_k": self.spec_k,
             "vocab_size": self.cfg.vocab_size,
             "num_pages": self.manager.num_pages,
+            "kv_tiers": self.tiers is not None,
         }
 
     @staticmethod
-    def _ser_handle(h: RequestHandle, *, keep_slot: bool) -> dict:
+    def _ser_handle(h: RequestHandle, *, keep_slot: bool,
+                    status: Optional[str] = None) -> dict:
         r = h.request
         return {
             "request": {
@@ -784,7 +911,7 @@ class ServingEngine:
                 "deadline": r.deadline, "temperature": r.temperature,
                 "top_k": r.top_k, "seed": r.seed, "tenant": r.tenant,
             },
-            "status": "running" if keep_slot else "queued",
+            "status": status or ("running" if keep_slot else "queued"),
             "tokens": [int(t) for t in h.tokens],
             "slot": h.slot if keep_slot else None,
             "decode_steps": h.decode_steps,
@@ -848,7 +975,10 @@ class ServingEngine:
                    + [self._ser_handle(h, keep_slot=False)
                       for h in inflight]
                    + [self._ser_handle(h, keep_slot=False)
-                      for h in self.sched.queue])
+                      for h in self.sched.queue]
+                   + [self._ser_handle(h, keep_slot=False,
+                                       status="parked")
+                      for h in self._parked.values()])
         snap = {
             "meta": self._ckpt_meta(),
             "cache": cache_np,
@@ -857,6 +987,12 @@ class ServingEngine:
             "lens": lens, "live": live, "toks": toks,
             "counters": dict(self.stats_counters),
             "sched_counters": dict(self.sched.counters),
+            # Tier contents ride the snapshot wholesale (offloaded
+            # prefix pages + parked-session payloads, disk entries
+            # materialized) — a restored process resumes parked
+            # sessions without the original spill directory.
+            "tiers": (None if self.tiers is None
+                      else self.tiers.snapshot()),
         }
         self.obs.complete_span("checkpoint", t_ck,
                                requests=len(handles))
@@ -897,10 +1033,27 @@ class ServingEngine:
                 "checkpoint/engine plan mismatch (snapshot vs this "
                 f"engine): {bad} — restore needs an identically-"
                 "configured engine over the same weights")
-        if self.sched.slots or self.sched.queue:
+        if self.sched.slots or self.sched.queue or self._parked:
             raise RuntimeError(
                 "restore() needs an idle engine (fresh process / "
-                "drained loop); this one has live slots or a queue")
+                "drained loop); this one has live slots, a queue, or "
+                "parked sessions")
+        # Tier-capacity validation UP FRONT, before any mutation: a
+        # snapshot whose tier contents cannot fit this store must not
+        # leave a half-restored engine behind.
+        t_snap = snap.get("tiers")
+        if t_snap is not None:
+            if self.tiers is None:
+                raise ValueError(
+                    "snapshot carries tier contents (offloaded pages "
+                    "/ parked sessions); construct the restoring "
+                    "engine with kv_tiers")
+            reason = self.tiers.fits_snapshot(t_snap)
+            if reason is not None:
+                raise ValueError(
+                    f"snapshot tier contents do not fit this "
+                    f"engine's tier store ({reason}) — restore needs "
+                    "an equally-provisioned tier store")
         c = snap["cache"]
         if np.dtype(c["k_pages"].dtype) != np.dtype(
                 self.cache.k_pages.dtype):
@@ -951,9 +1104,24 @@ class ServingEngine:
             if h.status == "running":
                 h.started_at = now
                 self.sched.slots[h.slot] = h
+            elif h.status == "parked":
+                # Token-preserving parked registry — its KV payload
+                # arrives with the tier snapshot below; resume() works
+                # exactly as in the original process.
+                self._parked[req.request_id] = h
             else:
                 self.sched.queue.append(h)
             handles.append(h)
+        if t_snap is not None:
+            self.tiers.load_snapshot(t_snap)
+            # Sessions that were mid-"resuming" at snapshot time were
+            # serialized as QUEUED (they re-prefill deterministically)
+            # — their orphaned pinned payloads are dead weight.
+            keep = {("session", h.request.request_id)
+                    for h in self._parked.values()}
+            for k in list(self.tiers.keys()):
+                if tuple(k)[0] == "session" and tuple(k) not in keep:
+                    self.tiers.pop(tuple(k))
         # Auto request-ids must not collide with restored ones.
         self.sched._ids = itertools.count(max_seq + 1)
         self.stats_counters["restored_requests"] += len(handles)
@@ -1047,6 +1215,13 @@ class ServingEngine:
         import jax.numpy as jnp
 
         slot = h.slot
+        # Parked-session resume: prefetch the tier payload instead of
+        # recomputing (falls through to the re-prefill below only when
+        # the payload is gone — equally token-exact).
+        if (getattr(h, "resume_key", None) is not None
+                and self.tiers is not None):
+            if self._admit_resume(h, stalled):
+                return
         # Resume form (preempted requests): the cache must be rebuilt
         # from the prompt PLUS every already-fed generated token; the
         # last generated token was never fed and re-enters via decode.
@@ -1077,6 +1252,10 @@ class ServingEngine:
         except OutOfPagesError as e:
             self._unadmit(h, e, stalled)
             return
+        # Tier hits extend the resident run (pages scattered back from
+        # the host/disk tier — the blit below skips them like any
+        # prefix hit).
+        self._tier_prefill_fetch(h, slot)
         # Token-exact prefill through the engine's own dispatch: B=tp
         # identical rows satisfies the token-sharding divisibility for
         # ANY prompt length; row 0 is the answer (chat_server pattern).
@@ -1126,6 +1305,7 @@ class ServingEngine:
         self._lens[slot] = len(seq)
         self._live[slot] = 1
         h.status = "running"
+        self._close_resume_span(h, path="reprefill")
         if not h.tokens:
             first = self._pick(np.asarray(logits)[0], h.request, 0)
             self._emit(h, first)
@@ -1152,6 +1332,14 @@ class ServingEngine:
         except OutOfPagesError as e:
             self._unadmit(h, e, stalled)
             return
+        if p is self:
+            # In-place chunked prefill: tier-resident prefix pages
+            # prefetch straight into the serving pool and the chunk
+            # stream starts PAST them — the compute skip that turns a
+            # demoted cold prefix back into a (slower) cache hit. (A
+            # disaggregated prefill worker stages in its own pool; its
+            # decode-side tier fetch happens at handoff instead.)
+            self._tier_prefill_fetch(h, slot)
         h.resident = p.manager.prefix_hits(slot) * self.page
         h.lane = seq
         h.prompt_pos = min(h.resident, len(seq) - 1)
@@ -1297,9 +1485,328 @@ class ServingEngine:
         self._live[slot] = 1
         self._toks[slot] = h.lane[-1]
         h.status = "running"
+        self._close_resume_span(h, path="reprefill")
         if not h.tokens:
             first = self._pick(np.asarray(logits), h.request, 0)
             self._emit(h, first)
+
+    # -- KV memory hierarchy: demote / prefetch / park / resume ------
+
+    def _gather_tier_pages(self, page_ids) -> tuple:
+        """Whole-page tier payload (replicated numpy) for ``page_ids``
+        — ``(k, v)`` plus the scale planes on a quantized pool. Two
+        call shapes only ((1,) demote, (p_max,) park), so the gather's
+        jit cache is bounded at two entries."""
+        import jax.numpy as jnp
+
+        payload = self._tier_gather(
+            self.cache, jnp.asarray(np.asarray(page_ids, np.int32)))
+        return tuple(np.asarray(a) for a in payload)
+
+    def _scatter_tier_payload(self, arrays, dst_ids) -> None:
+        """Blit a tier payload back into HBM pages: ``arrays`` hold
+        ``n`` pages along axis 1, ``dst_ids`` the ``n`` target pool
+        slots. Scratch-padded to ``p_max`` — one fixed-shape dispatch
+        whatever the payload size (padding rows land in the scratch
+        page, benign garbage by contract)."""
+        import jax.numpy as jnp
+        from triton_dist_tpu.serving.blocks import SCRATCH_PAGE
+
+        n = int(arrays[0].shape[1])
+        ids = np.full((self.p_max,), SCRATCH_PAGE, np.int32)
+        ids[:n] = np.asarray(dst_ids, np.int32)
+        padded = []
+        for a in arrays:
+            a = np.asarray(a)
+            pad = np.zeros(a.shape[:1] + (self.p_max - n,)
+                           + a.shape[2:], a.dtype)
+            padded.append(jnp.asarray(np.concatenate([a, pad], axis=1)))
+        self.cache = self._tier_scatter(self.cache, *padded,
+                                        jnp.asarray(ids))
+
+    def _demote_prefix_page(self, key, pid) -> bool:
+        """BlockManager eviction hook: offload one cold committed
+        prefix page into the tier store BEFORE its HBM page frees
+        (stage → transfer → commit; the manager frees only after this
+        returns). A dropped/wedged transfer past retries — or a tier
+        full of pinned parked sessions — returns False: the content
+        drops instead (recomputable by contract), eviction proceeds,
+        the server never stalls on its own cache."""
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+        from triton_dist_tpu.serving.tiers import TierFullError
+
+        try:
+            with self.obs.span("kv_offload", pages=1, payload="prefix"):
+                arrays = self._gather_tier_pages([pid])
+                self._run_op_with_retry(
+                    "tier_transfer",
+                    lambda: self.tiers.put(("prefix", key), arrays,
+                                           pages=1))
+        except (CommTimeoutError, faults.InjectedFault, TierFullError):
+            return False
+        self.stats_counters["offloaded_pages"] += 1
+        return True
+
+    def _tier_prefill_fetch(self, h: RequestHandle, slot: int) -> int:
+        """Extend ``slot``'s resident leading-page run with prefix
+        pages prefetched FROM THE TIER: for each staged (missed) page
+        whose chained content key is tier-resident, scatter the
+        payload into the already-allocated page, publish it
+        (``commit_pages``) and pop the tier entry — the promote half
+        of the two-phase transition. Stops at the first genuinely
+        cold page (neither HBM-shared nor tier-resident): hits must
+        stay a leading run, the contract every blit/chunk skip is
+        built on. Returns the page count fetched."""
+        if self.tiers is None or self.manager is None:
+            return 0
+        pend = self.manager._pending_prefix.get(slot)
+        if not pend:
+            return 0
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+        pend_by_pid = {pid: key for key, pid in pend}
+        pages = self.manager._slot_pages[slot]
+        pos = self.manager.prefix_hits(slot)
+        fetch = []                          # (pid, payload arrays)
+        while pos < len(pages):
+            pid = pages[pos]
+            key = pend_by_pid.get(pid)
+            if key is None:
+                # Not a staged miss: resident only if it is a SHARED
+                # page (slot ref + cache/another holder); a private
+                # page (the ragged tail, or anything past the prefix-
+                # eligible region) ends the run.
+                if self.manager._refs.get(pid, 0) > 1:
+                    pos += 1
+                    continue
+                break
+            try:
+                arrays = self._run_op_with_retry(
+                    "tier_transfer",
+                    lambda k=key: self.tiers.get(("prefix", k)))
+            except (CommTimeoutError, faults.InjectedFault):
+                arrays = None            # faulted past retries: a miss
+            if arrays is None:
+                self.stats_counters["tier_misses"] += 1
+                break
+            fetch.append((pid, arrays))
+            pos += 1
+        if not fetch:
+            return 0
+        with self.obs.span("kv_prefetch",
+                           request_id=h.request.request_id, slot=slot,
+                           tenant=h.request.tenant, pages=len(fetch),
+                           payload="prefix"):
+            stacked = tuple(
+                np.concatenate([arr[i] for _, arr in fetch], axis=1)
+                for i in range(len(fetch[0][1])))
+            self._scatter_tier_payload(stacked,
+                                       [pid for pid, _ in fetch])
+        # Bytes resident: publish the pages (shareable NOW) — the
+        # manager's on_commit hook pops each tier entry as its key
+        # publishes, so HBM is the one authoritative tier again.
+        self.manager.commit_pages(slot, [pid for pid, _ in fetch])
+        self.manager.note_tier_hits(slot, pos)
+        self.stats_counters["tier_hits"] += len(fetch)
+        self.stats_counters["prefetched_pages"] += len(fetch)
+        return len(fetch)
+
+    def park(self, h: RequestHandle) -> RequestHandle:
+        """Park a RUNNING request: offload its KV pages wholesale into
+        the tier store (requantized under ``park_quant``), release its
+        slot and HBM pages for other traffic, and keep the
+        token-preserving handle in the parked registry
+        (``stats()["parked_sessions"]``). :meth:`resume` continues it
+        token-exact — BIT-exact when the payload was not requantized.
+        The offload is two-phase: slot and pages free only after the
+        tier transfer commits, so a failed park (dropped transfer
+        past retries, or :class:`~triton_dist_tpu.serving.tiers.
+        TierFullError`) leaves the request RUNNING, untouched."""
+        if self.mega:
+            raise NotImplementedError(
+                "park/resume is a layer-path feature: the megakernel's "
+                "KV lives in its in-kernel arena (docs/serving.md)")
+        if self.tiers is None:
+            raise RuntimeError(
+                "park() needs kv_tiers — the tier store holds the "
+                "parked payload (docs/serving.md, 'KV memory "
+                "hierarchy')")
+        if h.status != "running" or h.slot is None or not h.tokens:
+            raise ValueError(
+                f"park() needs a running slot-holder; request "
+                f"{h.request.request_id} is {h.status!r}")
+        from triton_dist_tpu.serving.blocks import SCRATCH_PAGE
+        from triton_dist_tpu.serving.tiers import quantize_park_payload
+
+        slot, rid = h.slot, h.request.request_id
+        n_tok = int(self._lens[slot])
+        # Page list derived from the LENGTH MIRROR, not the allocator:
+        # a failed dispatch's idempotent pre-append can leave the
+        # allocator one page ahead of _lens, and resume's
+        # alloc_resume(n_tok) must re-derive the identical page count
+        # (the extra page held only the never-committed position,
+        # rewritten by the post-resume decode anyway).
+        n_pages = max((n_tok + self.page - 1) // self.page, 1)
+        pages = list(self.manager._slot_pages[slot])[:n_pages]
+        key = ("session", rid)
+        with self.obs.span("park", request_id=rid, slot=slot,
+                           tenant=h.request.tenant, pages=len(pages),
+                           tokens=n_tok):
+            ids = np.full((self.p_max,), SCRATCH_PAGE, np.int32)
+            ids[:len(pages)] = pages
+            with self.obs.span("kv_offload", request_id=rid, slot=slot,
+                               tenant=h.request.tenant,
+                               pages=len(pages), payload="session"):
+                # Materialized copy, not a slice VIEW: the tier would
+                # otherwise retain the whole p_max-wide gather buffer
+                # behind every parked page — defeating the host_pages
+                # budget by up to p_max/n_pages.
+                arrays = tuple(np.ascontiguousarray(a[:, :len(pages)])
+                               for a in self._gather_tier_pages(ids))
+                meta = {"n_tok": n_tok, "park_quant": None}
+                if self.park_quant is not None:
+                    arrays = quantize_park_payload(arrays,
+                                                   self.park_quant)
+                    meta["park_quant"] = self.park_quant
+                self._run_op_with_retry(
+                    "tier_transfer",
+                    lambda: self.tiers.put(key, arrays,
+                                           pages=len(pages),
+                                           pinned=True, meta=meta))
+            # Transfer committed — only NOW does the HBM side release
+            # (the two-phase demotion: a fault above left everything
+            # running).
+            self.sched.slots.pop(slot, None)
+            h.slot = None
+            self._live[slot] = self._lens[slot] = self._toks[slot] = 0
+            self.manager.free_slot(slot)
+            h.status = "parked"
+            self._parked[rid] = h
+            self.stats_counters["parks"] += 1
+            self.stats_counters["offloaded_pages"] += len(pages)
+        return h
+
+    def resume(self, h: RequestHandle) -> RequestHandle:
+        """Resume a parked session: requeue it at the HEAD with its
+        tier payload marked for prefetch. Admission allocates fresh
+        pages and dispatches the scatter WITHOUT blocking — the handle
+        parks one tick as ``"resuming"`` while in-flight decode
+        dispatches run over the transfer, then reactivates
+        token-exact at its parked position (the ``resume`` span /
+        ``session_resume_ms`` measure requeue → reactivation)."""
+        if h.status != "parked":
+            raise ValueError(
+                f"resume() needs a parked handle; request "
+                f"{h.request.request_id} is {h.status!r}")
+        rid = h.request.request_id
+        self._parked.pop(rid, None)
+        h.status = "queued"
+        h.queued_at = self.sched.now()
+        h.resume_key = ("session", rid)
+        h.resume_t0 = h.queued_at
+        self.sched.queue.appendleft(h)
+        self.stats_counters["resumes"] += 1
+        return h
+
+    def _admit_resume(self, h: RequestHandle,
+                      stalled: List[RequestHandle]) -> bool:
+        """Slot assigned to a resuming session: prefetch its tier
+        payload into fresh pages (async dispatch — activation happens
+        at the NEXT tick boundary, so the scatter overlaps this tick's
+        decode). Returns False when the payload is unavailable
+        (dropped transfer past retries): the caller falls through to
+        the deterministic re-prefill contract, which is equally
+        token-exact, just slower."""
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+        from triton_dist_tpu.serving.tiers import (
+            dequantize_park_payload)
+
+        slot, key = h.slot, h.resume_key
+        entry = self.tiers.entry(key)
+        if entry is None:
+            self.stats_counters["tier_misses"] += 1
+            h.resume_key = None
+            return False
+        # Allocate BEFORE fetching: a pool-dry tick must not pay the
+        # payload transfer (disk unspill / bridge hop) just to throw
+        # it away and repeat it on every stalled retry.
+        n_tok = int(entry.meta.get(
+            "n_tok", len(h.request.prompt) + len(h.tokens) - 1))
+        try:
+            pages = self.manager.alloc_resume(slot, n_tok)
+        except OutOfPagesError as e:
+            # Pool dry: the payload stays tier-resident and the
+            # resume_key survives the requeue — retried next tick.
+            self._unadmit(h, e, stalled)
+            return True
+        try:
+            arrays = self._run_op_with_retry(
+                "tier_transfer", lambda: self.tiers.get(key))
+        except (CommTimeoutError, faults.InjectedFault):
+            arrays = None
+        if arrays is None:
+            # Transfer faulted past retries (or the payload vanished):
+            # release the fresh pages and fall back to the
+            # deterministic re-prefill — equally token-exact.
+            self.manager.free_slot(slot)
+            self.stats_counters["tier_misses"] += 1
+            self.tiers.pop(key, None)
+            h.resume_key = None
+            return False
+        if entry.meta.get("park_quant") and not self.cache.quantized:
+            arrays = dequantize_park_payload(
+                arrays, np.dtype(self.cache.k_pages.dtype))
+        with self.obs.span("kv_prefetch",
+                           request_id=h.request.request_id, slot=slot,
+                           tenant=h.request.tenant, pages=len(pages),
+                           payload="session"):
+            self._scatter_tier_payload(arrays, pages)
+        h.status = "resuming"
+        self._lens[slot] = self._live[slot] = self._toks[slot] = 0
+        self._resuming.append((h, key))
+        self.stats_counters["tier_hits"] += 1
+        self.stats_counters["prefetched_pages"] += len(pages)
+        return True
+
+    def _close_resume_span(self, h: RequestHandle, *,
+                           path: str) -> None:
+        """Close the resume span at REACTIVATION whichever route got
+        there — the overlapped prefetch or the re-prefill fallback
+        after a faulted/missing payload. ``session_resume_ms`` must
+        include the slow path, or it reads optimistic exactly when
+        tier transfers are failing. No-op for handles that are not
+        mid-resume."""
+        if h.resume_t0 is None:
+            return
+        self.obs.complete_span(
+            "resume", h.resume_t0, request_id=h.request.request_id,
+            slot=h.slot, tenant=h.request.tenant,
+            tokens=len(h.tokens), path=path)
+        h.resume_t0 = None
+
+    def _collect_resumes(self) -> None:
+        """Activate LAST tick's resume prefetches — their scatters have
+        been in flight across the gap, overlapped with every dispatch
+        issued since (resume latency hides behind decode, not ahead
+        of it)."""
+        pend, self._resuming = self._resuming, []
+        for h, key in pend:
+            if h.status != "resuming":
+                continue      # expired/failed meanwhile; _retire
+                              # already cleaned the tier entry up
+            slot = h.slot
+            self._lens[slot] = (len(h.request.prompt)
+                                + len(h.tokens) - 1)
+            self._live[slot] = 1
+            self._toks[slot] = h.tokens[-1]
+            h.status = "running"
+            # Promotion commit: HBM is the authoritative tier again.
+            self.tiers.pop(key, None)
+            h.resume_key = None
+            self._close_resume_span(h, path="prefetch")
 
     # -- the decode tick --------------------------------------------
 
@@ -1849,6 +2356,13 @@ class ServingEngine:
 
     def _retire(self, h: RequestHandle, status: str, error=None):
         slot = h.slot
+        if getattr(h, "resume_key", None) is not None \
+                and self.tiers is not None:
+            # A mid-resume failure (deadline, timeout victim) must not
+            # leak its pinned session payload in the tier.
+            self.tiers.pop(h.resume_key, None)
+            h.resume_key = None
+        self._close_resume_span(h, path=status)
         self.sched.retire(h, status, error)
         if slot is not None:
             self._live[slot] = 0

@@ -1,0 +1,654 @@
+"""Tiered KV memory below the paged HBM pool: host RAM, then disk.
+
+HBM pages are the scarcest resource in the serving stack — PR 8's
+int8/fp8 pools bought ~3.8x pages per byte, but capacity still
+hard-stops at the pool, and a cold prefix or a parked session costs as
+much HBM as a hot one. This module is the tier BELOW the pool:
+
+- :class:`KVTierStore` — a host-RAM tier (bounded in pages) with an
+  optional disk tier behind it. Entries are whole-page payloads — the
+  pool's natural transfer unit, exactly what
+  :meth:`~triton_dist_tpu.serving.blocks.PagedKVCache.gather_pages`
+  emits and :meth:`~triton_dist_tpu.serving.blocks.PagedKVCache.
+  scatter_pages` consumes (stored bytes + quantization scales, so a
+  demote→prefetch round trip is BIT-EXACT regardless of ``kv_dtype``).
+- Two kinds of entries share the store: demoted committed PREFIX
+  pages (key ``("prefix", <chained content key>)`` — droppable, the
+  content can always be recomputed) and parked SESSION payloads (key
+  ``("session", <request id>)`` — pinned: they may spill host→disk
+  but are never silently dropped, because a parked request's KV is
+  not recomputable without replaying its decode).
+
+Tier-transition discipline (the PR 7 staged/committed two-phase page
+protocol generalized): a page is READABLE in exactly one authoritative
+tier at a time. A demotion STAGES the payload, transfers it (the
+``"tier_transfer"`` fault-plan op — chaos can drop or wedge it),
+COMMITS it into the tier index, and only then does the caller free the
+HBM page; a promotion scatters the payload back into a fresh HBM page
+and then :meth:`KVTierStore.pop`\\ s the tier entry. The intermediate
+staged state is invariant-checkable
+(:meth:`KVTierStore.check_coherence`) and is empty at every tick
+boundary.
+
+The transfer itself is host-staged on this single-controller container
+— the same edge :func:`~triton_dist_tpu.ops.p2p.migrate_pages_host`
+stages through; pass ``bridge=(mesh, axis, src, dst)`` to route the
+bulk K/V payload over the one-sided p2p put
+(:func:`~triton_dist_tpu.ops.p2p.tier_pages_host`) instead, the shape
+a multi-controller deployment's host-memory hop takes.
+
+:func:`heavy_tail_trace` generates the acceptance workload (ROADMAP
+item 4): a seeded multi-turn chat trace over 100k+ distinct session
+ids with Zipf-heavy-tailed reuse, where each turn's prompt extends the
+session's full history (prefix reuse across turns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TierFullError", "KVTierStore", "heavy_tail_trace",
+           "quantize_park_payload", "dequantize_park_payload"]
+
+
+class TierFullError(RuntimeError):
+    """Every tier is full of PINNED (parked-session) payloads — the
+    put cannot make room without destroying a parked request's only KV
+    copy. Callers keep the pages in HBM (a failed park leaves the
+    request running; a failed prefix demote drops the content
+    instead)."""
+
+
+@dataclasses.dataclass
+class TierEntry:
+    """One tier-resident payload. ``arrays`` is the in-host tuple of
+    numpy page payloads (``(k, v)`` or ``(k, v, k_scale, v_scale)``);
+    on the disk tier ``arrays`` is None and ``path`` names the spill
+    file (``specs`` carries the (dtype, shape) pairs that rebuild the
+    views). ``pages`` is the entry's size in pool pages — the unit
+    both tier capacities are accounted in."""
+
+    key: tuple
+    pages: int
+    pinned: bool = False
+    meta: dict = dataclasses.field(default_factory=dict)
+    arrays: Optional[Tuple[np.ndarray, ...]] = None
+    path: Optional[str] = None
+    specs: Optional[List[Tuple[str, tuple]]] = None
+
+
+def _spill(entry: TierEntry, path: str) -> None:
+    """Host → disk: flat uint8 views (ml_dtypes fp8 has no npz codec;
+    byte views round-trip any pool dtype exactly)."""
+    np.savez(path, **{f"a{i}": np.ascontiguousarray(a).reshape(-1)
+                      .view(np.uint8)
+                      for i, a in enumerate(entry.arrays)})
+    entry.specs = [(a.dtype.str if a.dtype.kind in "fiu"
+                    else str(a.dtype), a.shape) for a in entry.arrays]
+    entry.path, entry.arrays = path, None
+
+
+def _unspill(entry: TierEntry) -> Tuple[np.ndarray, ...]:
+    """Disk → host: rebuild the typed views from the byte payload."""
+    import ml_dtypes  # noqa: F401 — registers fp8 dtype names
+
+    with np.load(entry.path) as z:
+        return tuple(
+            z[f"a{i}"].view(np.dtype(dt)).reshape(shape)
+            for i, (dt, shape) in enumerate(entry.specs))
+
+
+class KVTierStore:
+    """Host-RAM (+ optional disk) tier below the paged HBM pool (see
+    module docstring).
+
+    ``host_pages`` bounds the host tier; ``disk_pages`` > 0 with
+    ``disk_dir`` adds the disk tier behind it (host evictions SPILL
+    there before anything is dropped). ``bridge`` optionally routes
+    the bulk K/V payload of every put/get over the one-sided p2p edge
+    (``(mesh, axis, src, dst)`` — see
+    :func:`~triton_dist_tpu.ops.p2p.tier_pages_host`); the default is
+    the host-staged hop. Every transfer runs under the
+    ``"tier_transfer"`` fault-plan op, so chaos plans can drop or
+    wedge tier traffic like any other serving op.
+    """
+
+    def __init__(self, host_pages: int = 256, *,
+                 disk_pages: int = 0, disk_dir: Optional[str] = None,
+                 bridge: Optional[tuple] = None):
+        if host_pages < 1:
+            raise ValueError(f"host_pages must be >= 1, got "
+                             f"{host_pages}")
+        if disk_pages and not disk_dir:
+            raise ValueError("disk_pages > 0 needs disk_dir")
+        self.host_pages = int(host_pages)
+        self.disk_pages = int(disk_pages)
+        self.disk_dir = disk_dir
+        self.bridge = bridge
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+        # LRU order: oldest first; get() re-appends. Page occupancy
+        # rides running counters (mutations go through _ins/_rm) so
+        # the room-making loops stay O(victims), not O(entries) per
+        # victim — check_coherence() cross-validates them against a
+        # full re-sum.
+        self._host: "OrderedDict[tuple, TierEntry]" = OrderedDict()
+        self._disk: "OrderedDict[tuple, TierEntry]" = OrderedDict()
+        self._host_used = 0
+        self._disk_used = 0
+        # The two-phase window: staged-but-uncommitted puts. Non-empty
+        # only INSIDE put() — the chaos invariant sweep asserts it is
+        # empty at every tick boundary ("no HBM free-list entry backed
+        # by a pending demotion": the caller frees HBM only after
+        # commit).
+        self._staged: Dict[tuple, TierEntry] = {}
+        # The key a get() is currently promoting disk→host: never a
+        # victim of the room-making it triggers (a host spill can
+        # cascade into a disk eviction that would otherwise drop the
+        # very entry being fetched).
+        self._fetch_guard: Optional[tuple] = None
+        self._spill_seq = 0
+        self.stats_counters = {
+            "puts": 0, "gets": 0, "hits": 0, "misses": 0,
+            "offloaded_pages": 0, "fetched_pages": 0,
+            "spills": 0, "dropped_entries": 0,
+        }
+
+    # -- capacity ----------------------------------------------------
+
+    def _ins(self, tier: "OrderedDict[tuple, TierEntry]", key: tuple,
+             entry: TierEntry) -> None:
+        tier[key] = entry
+        if tier is self._host:
+            self._host_used += entry.pages
+        else:
+            self._disk_used += entry.pages
+
+    def _rm(self, tier: "OrderedDict[tuple, TierEntry]",
+            key: tuple) -> Optional[TierEntry]:
+        e = tier.pop(key, None)
+        if e is not None:
+            if tier is self._host:
+                self._host_used -= e.pages
+            else:
+                self._disk_used -= e.pages
+        return e
+
+    @property
+    def host_used(self) -> int:
+        return self._host_used
+
+    @property
+    def disk_used(self) -> int:
+        return self._disk_used
+
+    def _make_room_disk(self, pages: int) -> None:
+        while self.disk_used + pages > self.disk_pages:
+            victim = next((k for k, e in self._disk.items()
+                           if not e.pinned
+                           and k != self._fetch_guard), None)
+            if victim is None:
+                raise TierFullError(
+                    f"disk tier full ({self.disk_pages} pages) of "
+                    "pinned parked-session payloads")
+            e = self._rm(self._disk, victim)
+            if e.path and os.path.exists(e.path):
+                os.remove(e.path)
+            self.stats_counters["dropped_entries"] += 1
+
+    def _spill_to_disk(self, entry: TierEntry) -> None:
+        """Write one entry's payload onto the disk tier: disk room
+        first, then the spill file — it may raise (disk full of
+        pinned payloads, I/O failure), and the CALLER removes the
+        entry from its source index only AFTER this returns, so a
+        failed cascade never destroys the entry (pinned payloads are
+        never dropped, and a failed put leaves the store unchanged)."""
+        self._make_room_disk(entry.pages)
+        self._spill_seq += 1
+        _spill(entry, os.path.join(
+            self.disk_dir, f"tier-{self._spill_seq}.npz"))
+        self.stats_counters["spills"] += 1
+
+    def _make_room_host(self, pages: int) -> None:
+        if pages > self.host_pages:
+            raise TierFullError(
+                f"payload of {pages} pages exceeds the whole host "
+                f"tier ({self.host_pages} pages)")
+        while self.host_used + pages > self.host_pages:
+            # LRU victim; pinned entries spill to disk (never dropped),
+            # droppable ones spill when a disk tier exists, else drop
+            # (the content is recomputable by contract).
+            victim = None
+            for k, e in self._host.items():
+                if e.pinned and not self.disk_pages:
+                    continue   # nowhere safe to move it — skip
+                if k == self._fetch_guard:
+                    continue
+                victim = k
+                break
+            if victim is None:
+                raise TierFullError(
+                    f"host tier full ({self.host_pages} pages) of "
+                    "pinned parked-session payloads and no disk tier "
+                    "configured")
+            e = self._host[victim]
+            if self.disk_pages:
+                try:
+                    # Raises with e still host-resident.
+                    self._spill_to_disk(e)
+                except TierFullError:
+                    # Disk pinned-full: fall back to DROPPING the
+                    # oldest droppable host entry instead — a full
+                    # disk must not fail a put that evicting
+                    # recomputable content could satisfy
+                    # (TierFullError only when pinned genuinely
+                    # leaves no room anywhere).
+                    dv = next((k for k, x in self._host.items()
+                               if not x.pinned
+                               and k != self._fetch_guard), None)
+                    if dv is None:
+                        raise
+                    self._rm(self._host, dv)
+                    self.stats_counters["dropped_entries"] += 1
+                    continue
+                self._rm(self._host, victim)
+                self._ins(self._disk, victim, e)
+            else:
+                self._rm(self._host, victim)
+                self.stats_counters["dropped_entries"] += 1
+
+    # -- the transfer edge -------------------------------------------
+
+    def _transfer(self, arrays: Tuple[np.ndarray, ...]
+                  ) -> Tuple[np.ndarray, ...]:
+        """One tier hop under the fault scope: the host-staged copy,
+        or the one-sided p2p put when a bridge is configured (the K/V
+        bulk rides the put; scale planes stage host-side beside it,
+        exactly like the disagg migration)."""
+        from triton_dist_tpu.resilience import faults
+
+        with faults.on_op_call("tier_transfer"):
+            if self.bridge is not None and len(arrays) >= 2:
+                from triton_dist_tpu.ops.p2p import tier_pages_host
+
+                mesh, axis, src, dst = self.bridge
+                k, v = tier_pages_host(arrays[0], arrays[1], mesh,
+                                       axis=axis, src=src, dst=dst)
+                return (k, v) + tuple(np.asarray(a)
+                                      for a in arrays[2:])
+            return tuple(np.asarray(a) for a in arrays)
+
+    # -- the tier API ------------------------------------------------
+
+    def put(self, key: tuple, arrays: Tuple[np.ndarray, ...], *,
+            pages: int = 1, pinned: bool = False,
+            meta: Optional[dict] = None) -> None:
+        """Demote a payload into the tier: STAGE → transfer → COMMIT.
+        A faulted transfer (or a full store) discards the staged entry
+        and re-raises with the store UNCHANGED — the caller still
+        holds the authoritative HBM copy and decides (drop the content
+        for a prefix page, abort the park for a session). A payload
+        too large for the host tier commits straight to the disk tier
+        when one is configured; :class:`TierFullError` only when
+        pinned payloads genuinely leave no room anywhere."""
+        entry = TierEntry(key=key, pages=int(pages), pinned=pinned,
+                          meta=dict(meta or {}))
+        self._staged[key] = entry
+        # A same-key replace must not double-count its own old copy
+        # during room-making: hold it aside, restore on failure.
+        old_host = self._rm(self._host, key)
+        old_disk = self._rm(self._disk, key)
+        try:
+            entry.arrays = self._transfer(arrays)
+            if entry.pages > self.host_pages and self.disk_pages:
+                # Oversized for the whole host tier: spill straight to
+                # disk (a parked session must never fail a park the
+                # disk tier has room for).
+                self._spill_to_disk(entry)
+                dst = self._disk
+            else:
+                self._make_room_host(entry.pages)
+                dst = self._host
+        except BaseException:
+            self._staged.pop(key, None)
+            if old_host is not None:
+                self._ins(self._host, key, old_host)
+            if old_disk is not None:
+                self._ins(self._disk, key, old_disk)
+            raise
+        # Commit: the entry becomes the page's one authoritative home
+        # (the caller frees the HBM copy after this returns).
+        self._staged.pop(key, None)
+        if old_disk is not None and old_disk.path \
+                and os.path.exists(old_disk.path):
+            os.remove(old_disk.path)
+        self._ins(dst, key, entry)
+        self.stats_counters["puts"] += 1
+        self.stats_counters["offloaded_pages"] += entry.pages
+
+    def get(self, key: tuple) -> Optional[Tuple[np.ndarray, ...]]:
+        """Fetch a payload (host hit, or disk hit promoted to host).
+        Returns None on a miss; the entry STAYS tier-resident — the
+        caller :meth:`pop`\\ s it only once the HBM copy is live (the
+        promote half of the two-phase discipline). A faulted transfer
+        re-raises with the entry intact (retry-safe)."""
+        self.stats_counters["gets"] += 1
+        e = self._host.get(key)
+        if e is not None:
+            out = self._transfer(e.arrays)
+            self._host.move_to_end(key)
+            self.stats_counters["hits"] += 1
+            self.stats_counters["fetched_pages"] += e.pages
+            return out
+        e = self._disk.get(key)
+        if e is not None:
+            arrays = _unspill(e)
+            out = self._transfer(arrays)
+            # Promote to the host tier when it fits (LRU warmth);
+            # serve straight from disk otherwise. The fetch guard
+            # keeps the room-making's spill cascade from evicting
+            # THIS entry out from under the fetch.
+            self._fetch_guard = key
+            try:
+                self._make_room_host(e.pages)
+            except TierFullError:
+                pass
+            else:
+                self._rm(self._disk, key)
+                if e.path and os.path.exists(e.path):
+                    os.remove(e.path)
+                e.arrays, e.path, e.specs = arrays, None, None
+                self._ins(self._host, key, e)
+            finally:
+                self._fetch_guard = None
+            self.stats_counters["hits"] += 1
+            self.stats_counters["fetched_pages"] += e.pages
+            return out
+        self.stats_counters["misses"] += 1
+        return None
+
+    def pop(self, key: tuple, default=None):
+        """Remove an entry WITHOUT a transfer — the promotion commit
+        point (the HBM copy is authoritative again), or an abandon
+        (a resumed-then-re-prefilled session)."""
+        e = self._rm(self._host, key)
+        if e is None:
+            e = self._rm(self._disk, key)
+            if e is not None and e.path and os.path.exists(e.path):
+                os.remove(e.path)
+        return default if e is None else e
+
+    def entry(self, key: tuple) -> Optional[TierEntry]:
+        return self._host.get(key) or self._disk.get(key)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._host or key in self._disk
+
+    def keys(self) -> List[tuple]:
+        return list(self._host) + list(self._disk)
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    # -- invariants / readout ----------------------------------------
+
+    def check_coherence(self) -> None:
+        """Raise AssertionError when the tier algebra broke: a payload
+        resident in both tiers at once, a staged (uncommitted) demotion
+        outliving its put, or page accounting past either capacity.
+        Cheap host work — the chaos sweep calls it every tick."""
+        if self._staged:
+            raise AssertionError(
+                f"staged-but-uncommitted tier demotion(s) survive the "
+                f"tick boundary: {sorted(map(str, self._staged))} — "
+                "an HBM free could now race the transfer")
+        both = set(self._host) & set(self._disk)
+        if both:
+            raise AssertionError(
+                f"payload(s) live in BOTH tiers: {sorted(map(str, both))}")
+        if self.host_used > self.host_pages:
+            raise AssertionError(
+                f"host tier over capacity: {self.host_used} > "
+                f"{self.host_pages} pages")
+        if self.disk_used > self.disk_pages:
+            raise AssertionError(
+                f"disk tier over capacity: {self.disk_used} > "
+                f"{self.disk_pages} pages")
+        for tier, name in ((self._host, "host"), (self._disk, "disk")):
+            for k, e in tier.items():
+                if (e.arrays is None) == (tier is self._host):
+                    raise AssertionError(
+                        f"{name}-tier entry {k} has "
+                        f"{'no arrays' if e.arrays is None else 'arrays'}"
+                        " — spill state drifted from its tier")
+
+    def stats(self) -> dict:
+        return {
+            **self.stats_counters,
+            "host_entries": len(self._host),
+            "disk_entries": len(self._disk),
+            "host_pages_used": self.host_used,
+            "disk_pages_used": self.disk_used,
+            "host_pages": self.host_pages,
+            "disk_pages": self.disk_pages,
+            "transport": "p2p" if self.bridge is not None else "host",
+        }
+
+    # -- checkpoint --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Plain-data copy of BOTH tiers (disk entries materialized —
+        the snapshot must survive the spill directory's deletion).
+        Rides inside :meth:`ServingEngine.checkpoint`, so a restored
+        process sees its offloaded pages and parked sessions."""
+        def ser(tier):
+            out = []
+            for k, e in tier.items():
+                arrays = e.arrays if e.arrays is not None else _unspill(e)
+                out.append({"key": k, "pages": e.pages,
+                            "pinned": e.pinned, "meta": dict(e.meta),
+                            "arrays": tuple(np.asarray(a).copy()
+                                            for a in arrays)})
+            return out
+
+        return {"host": ser(self._host), "disk": ser(self._disk),
+                "counters": dict(self.stats_counters)}
+
+    def fits_snapshot(self, snap: dict) -> Optional[str]:
+        """Dry-run :meth:`load_snapshot`'s placement against THIS
+        store's capacities on (pages, pinned) metadata only — the
+        exact greedy algorithm (load all into host, LRU-spill the
+        overflow to disk, drop droppables when disk dries), no
+        payload copies. Returns None when the load will succeed, else
+        the reason it would raise — restore() gates on this BEFORE
+        mutating anything, so a too-small tier store can never leave
+        a half-restored engine."""
+        host = [(d["pages"], d["pinned"])
+                for d in snap["host"] + snap["disk"]]
+        disk: List[Tuple[int, bool]] = []
+        host_used = sum(p for p, _ in host)
+        disk_used = 0
+        while host_used > self.host_pages:
+            vi = next((i for i, (p, pin) in enumerate(host)
+                       if not (pin and not self.disk_pages)), None)
+            if vi is None:
+                return (f"host tier ({self.host_pages} pages) cannot "
+                        "hold the snapshot's pinned payloads and no "
+                        "disk tier is configured")
+            pages, pin = host[vi]
+            if not self.disk_pages:
+                host.pop(vi)
+                host_used -= pages            # dropped (droppable)
+                continue
+            stuck = False
+            while disk_used + pages > self.disk_pages:
+                di = next((i for i, (p2, pin2) in enumerate(disk)
+                           if not pin2), None)
+                if di is None:
+                    stuck = True              # disk pinned-full
+                    break
+                disk_used -= disk.pop(di)[0]
+            if stuck:
+                # Mirror the droppable-fallback: drop the oldest
+                # droppable HOST entry instead of failing the spill.
+                dv = next((i for i, (p2, pin2) in enumerate(host)
+                           if not pin2), None)
+                if dv is None:
+                    return (f"disk tier ({self.disk_pages} pages) is "
+                            "pinned-full and the host tier holds no "
+                            "droppable entries to evict instead")
+                host_used -= host.pop(dv)[0]
+                continue
+            host.pop(vi)
+            host_used -= pages
+            disk.append((pages, pin))
+            disk_used += pages
+        return None
+
+    def load_snapshot(self, snap: dict) -> None:
+        """Adopt a :meth:`snapshot` wholesale into this (fresh) store.
+        Disk-tier entries re-spill into this store's ``disk_dir`` (or
+        join the host tier when none is configured)."""
+        self._host.clear()
+        for e in self._disk.values():
+            if e.path and os.path.exists(e.path):
+                os.remove(e.path)
+        self._disk.clear()
+        self._staged.clear()
+        self._host_used = self._disk_used = 0
+        for d in snap["host"] + snap["disk"]:
+            entry = TierEntry(key=tuple(d["key"]), pages=d["pages"],
+                              pinned=d["pinned"], meta=dict(d["meta"]),
+                              arrays=tuple(d["arrays"]))
+            self._ins(self._host, entry.key, entry)
+        # Re-apply the capacity discipline (spills what overflows).
+        if self.host_used > self.host_pages:
+            self._make_room_host(0)
+        self.stats_counters.update(snap.get("counters", {}))
+
+
+# ---------------------------------------------------------------------------
+# Park-time requantization ("quantize harder")
+# ---------------------------------------------------------------------------
+
+def quantize_park_payload(arrays: Tuple[np.ndarray, ...],
+                          park_quant: str) -> Tuple[np.ndarray, ...]:
+    """Requantize an UNQUANTIZED (k, v) page payload for parking —
+    the "quantize harder" half of park: a parked session's host bytes
+    shrink 2–4x at a bounded divergence on resume (docs/serving.md —
+    the default park path keeps the payload verbatim and is
+    bit-exact). Symmetric max-abs per (layer, page, kv_head), the
+    pool's own scale granularity. Returns
+    (k_q, v_q, k_scale, v_scale)."""
+    from triton_dist_tpu.serving.blocks import kv_quant_spec
+
+    qdtype, qmax = kv_quant_spec(park_quant)
+    if qdtype is None:
+        raise ValueError(f"park_quant={park_quant!r} is not a "
+                         "quantized storage dtype")
+    if len(arrays) != 2:
+        raise ValueError("payload is already quantized — parking "
+                         "keeps its stored bytes + scales verbatim")
+
+    def quant(a):
+        a32 = np.asarray(a, np.float32)
+        amax = np.abs(a32).max(axis=(3, 4))          # (L, n, KV)
+        scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+        y = a32 / scale[..., None, None]
+        if np.dtype(qdtype) == np.dtype(np.int8):
+            q = np.clip(np.rint(y), -qmax, qmax).astype(np.int8)
+        else:
+            q = np.clip(y, -qmax, qmax).astype(qdtype)
+        return q, scale
+
+    kq, ks = quant(arrays[0])
+    vq, vs = quant(arrays[1])
+    return kq, vq, ks, vs
+
+
+def dequantize_park_payload(arrays: Tuple[np.ndarray, ...],
+                            dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """Resume half of :func:`quantize_park_payload`: rebuild the
+    (k, v) payload at the pool's native ``dtype``."""
+    kq, vq, ks, vs = arrays
+    k = (np.asarray(kq, np.float32) * ks[..., None, None]).astype(dtype)
+    v = (np.asarray(vq, np.float32) * vs[..., None, None]).astype(dtype)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# The acceptance workload: seeded heavy-tailed multi-turn sessions
+# ---------------------------------------------------------------------------
+
+def heavy_tail_trace(n_events: int, *, n_sessions: int = 100_000,
+                     vocab: int = 64, seed: int = 0,
+                     zipf_a: float = 1.3,
+                     turn_tokens: Tuple[int, int] = (2, 6),
+                     gen_tokens: Tuple[int, int] = (2, 4),
+                     max_total: Optional[int] = None
+                     ) -> List[dict]:
+    """Seeded multi-turn chat trace over a heavy-tailed session
+    population (ROADMAP item 4's acceptance shape): ``n_events`` turns
+    drawn from ``n_sessions`` distinct session ids under a Zipf
+    distribution — a small hot set dominates while the cold tail is
+    enormous, so an HBM pool sized well below the working set must
+    tier to serve it.
+
+    Each event is ``{"session": id, "tokens": [...], "turn": k,
+    "gen": n}`` where ``tokens`` is the turn's FRESH user input; the
+    served prompt is the session's full history (prior turns +
+    replies), composed by the caller via :func:`extend_session` —
+    prefix reuse across turns is the point.
+    ``max_total`` caps the FRESH turn's tokens+gen per event; the
+    composed multi-turn prompt grows with the session history, so
+    callers must also bound it (``extend_session``'s ``max_prompt``)
+    to stay inside the serving capacity."""
+    rng = np.random.RandomState(seed)
+    events: List[dict] = []
+    turns: Dict[int, int] = {}
+    for _ in range(n_events):
+        # Zipf over a bounded id space: rejection-sample the long tail.
+        while True:
+            sid = int(rng.zipf(zipf_a))
+            if sid <= n_sessions:
+                break
+        sid -= 1
+        t_lo, t_hi = turn_tokens
+        g_lo, g_hi = gen_tokens
+        events.append({
+            "session": sid,
+            "turn": turns.get(sid, 0),
+            "tokens": [int(x) for x in rng.randint(
+                0, vocab, int(rng.randint(t_lo, t_hi + 1)))],
+            "gen": int(rng.randint(g_lo, g_hi + 1)),
+        })
+        turns[sid] = turns.get(sid, 0) + 1
+    if max_total:
+        for ev in events:
+            ev["gen"] = max(1, min(ev["gen"],
+                                   max_total - len(ev["tokens"]) - 1))
+    return events
+
+
+def extend_session(history: Dict[int, List[int]], event: dict,
+                   reply: Optional[List[int]] = None,
+                   max_prompt: Optional[int] = None) -> List[int]:
+    """Multi-turn composition helper: the event's prompt is the
+    session's accumulated history plus this turn's fresh tokens;
+    ``reply`` (the served tokens) folds back into the history so the
+    NEXT turn's prompt shares the grown prefix. ``max_prompt`` bounds
+    the history window (drop-oldest) so long sessions stay inside the
+    serving capacity."""
+    h = history.setdefault(event["session"], [])
+    if reply is not None:
+        h.extend(int(t) for t in reply)
+        return h
+    h.extend(event["tokens"])
+    if max_prompt is not None and len(h) > max_prompt:
+        del h[:len(h) - max_prompt]
+    return list(h)
