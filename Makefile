@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
-	qblock-smoke obs-smoke tier-smoke apicheck ci bench-all
+	qblock-smoke obs-smoke tier-smoke fleet-smoke apicheck ci \
+	bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -99,6 +100,17 @@ obs-smoke: csrc
 # "KV memory hierarchy").
 tier-smoke: csrc
 	bash scripts/tier_smoke.sh
+
+# Fleet-serving battery: affinity routing vs round-robin, cross-fleet
+# failover token-exactness (parked-tier handoff + re-prefill),
+# drain/restore autoscale, shed-by-deadline-class, the fleet chaos
+# soak, an R=2 chat e2e with a mid-serve fleet kill gating
+# bit-identical token streams, and the non-null fleet_p99_ttft_ms /
+# fleet_failover_resumed / fleet_shed_requests /
+# router_affinity_hit_rate bench gate (docs/serving.md, "Fleet
+# serving").
+fleet-smoke: csrc
+	bash scripts/fleet_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

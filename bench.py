@@ -826,6 +826,106 @@ def _interpret_tiers() -> dict:
     }
 
 
+def _interpret_fleet() -> dict:
+    """Fleet-scale serving on the CPU mesh — the
+    ``fleet_p99_ttft_ms`` / ``fleet_failover_resumed`` /
+    ``fleet_shed_requests`` / ``router_affinity_hit_rate`` surface
+    (non-null gate in scripts/fleet_smoke.sh): a seeded heavy-tailed
+    multi-turn trace routed with prefix affinity across R=2 fleets, a
+    mid-run reachable fleet kill whose running session fails over
+    cross-fleet through the parked-tier path (token-exactness
+    asserted inline), and a saturation drill that sheds one
+    batch-class request. Absolute times track the CPU dispatch; the
+    counters and non-null presence are the gates."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.resilience import chaos
+    from triton_dist_tpu.serving import (
+        FleetRouter, ServingEngine, heavy_tail_trace,
+    )
+    from triton_dist_tpu.serving.tiers import extend_session
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=4,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+
+    def factory(**kw):
+        args = dict(num_slots=2, page=4, num_pages=16,
+                    prefix_reuse=True, kv_tiers={"host_pages": 128})
+        args.update(kw)
+        return ServingEngine(eng, **args)
+
+    router = FleetRouter(lambda: factory(), fleets=2)
+    events = heavy_tail_trace(24, n_sessions=40, vocab=64, seed=5,
+                              zipf_a=1.2, turn_tokens=(4, 8),
+                              max_total=16)
+    history = {}
+    t0 = time.perf_counter()
+    for ev in events:
+        prompt = extend_session(history, ev, max_prompt=16)
+        h = router.submit(prompt, max_new_tokens=ev["gen"])
+        router.run()
+        extend_session(history, ev, reply=h.tokens)
+    trace_dt = time.perf_counter() - t0
+    # Mid-run fleet-kill drill: a running session fails over through
+    # the parked-tier hop and must resume token-exact.
+    prompt = [5, 5, 5, 5, 5, 5, 5, 5]
+    ids = np.tile(np.asarray([prompt], np.int32), (1, 1))
+    want = np.asarray(eng.serve(jnp.asarray(ids),
+                                gen_len=8))[0].tolist()
+    h = router.submit(prompt, max_new_tokens=8)
+    for _ in range(200):
+        if h.status == "running" and h.tokens:
+            break
+        router.step()
+    victim = router._fleet_of(h)
+    router.kill_fleet(victim.id, reachable=True)
+    chaos.check_fleet_invariants(router, [h])
+    router.run()
+    assert h.status == "done" and h.tokens == want, (
+        "cross-fleet failover diverged from the single-engine oracle")
+    st = router.stats()
+    assert all(n == 1 for n in router.decode_cache_sizes()), (
+        "fleet routing re-specialized a decode dispatch")
+    # Saturation shed drill (tiny queues, batch class): deterministic
+    # graceful degradation so the shed counter is a real measurement.
+    shed_router = FleetRouter(
+        lambda: factory(num_slots=1, max_queue=1, kv_tiers=None),
+        fleets=2, max_queue=0, affinity=False)
+    backlog = [shed_router.submit([i + 1, 2], max_new_tokens=2)
+               for i in range(2)]
+    dropped = shed_router.submit([9, 9], max_new_tokens=2)
+    assert dropped.status == "shed"
+    shed_router.run()
+    assert all(b.status == "done" for b in backlog)
+    ttft = st["fleet_ttft_ms"] or {}
+    return {
+        "fleet_p99_ttft_ms": ttft.get("p99"),
+        "fleet_failover_resumed": st["failover_resumed"],
+        "fleet_shed_requests":
+            shed_router.stats()["shed_requests"],
+        "router_affinity_hit_rate": st["router_affinity_hit_rate"],
+        "fleet_detail": {
+            "fleets": 2,
+            "trace_events": len(events),
+            "trace_wall_ms": round(trace_dt * 1e3, 1),
+            "routed": st["routed"],
+            "spillovers": st["spillovers"],
+            "fleet_failovers": st["fleet_failovers"],
+            "failover_reprefilled": st["failover_reprefilled"],
+            "kv_hot_hit_rate": st["kv_hot_hit_rate"],
+            "fleet_p50_ttft_ms": ttft.get("p50"),
+            "live_fleets": st["live_fleets"],
+        },
+    }
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -920,6 +1020,15 @@ def _interpret_bench(reason: str) -> None:
         # Nulled, NOT omitted: the tier_smoke gate greps these keys.
         ti = {"kv_hot_hit_rate": None, "session_resume_ms": None,
               "offloaded_pages": None, "tiers_error": str(e)[:300]}
+    try:
+        fl = _interpret_fleet()
+    except Exception as e:  # fleet bench must not sink the record
+        # Nulled, NOT omitted: the fleet_smoke gate greps these keys.
+        fl = {"fleet_p99_ttft_ms": None,
+              "fleet_failover_resumed": None,
+              "fleet_shed_requests": None,
+              "router_affinity_hit_rate": None,
+              "fleet_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -945,6 +1054,7 @@ def _interpret_bench(reason: str) -> None:
             **qb,
             **ch,
             **ti,
+            **fl,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),

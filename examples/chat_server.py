@@ -98,6 +98,22 @@ def main():
                          "park/resume drill (token streams stay "
                          "bit-identical to an uninterrupted serve; "
                          "scripts/tier_smoke.sh gates on it)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="R",
+                    help="layer path: serve through a FleetRouter "
+                         "over R replicated serving fleets (prefix-"
+                         "affinity routing, health feedback, fleet "
+                         "failover — docs/serving.md, 'Fleet "
+                         "serving'); prefix_reuse is forced on. "
+                         "Combine with --kv-tiers for the parked-tier "
+                         "cross-fleet failover path")
+    ap.add_argument("--kill-fleet-after", type=int, default=0,
+                    metavar="N",
+                    help="--fleet: once N tokens have been generated, "
+                         "kill one live fleet MID-SERVE (reachable — "
+                         "running sessions fail over cross-fleet) and "
+                         "keep serving; token streams stay "
+                         "bit-identical to an unkilled run "
+                         "(scripts/fleet_smoke.sh gates on it)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="layer path: snapshot the full serving state "
                          "(paged pools + scales, allocator, queue, "
@@ -178,6 +194,20 @@ def main():
     if args.park_after_idle and not args.kv_tiers:
         sys.exit("--park-after-idle needs --kv-tiers (parking "
                  "offloads into the tier store)")
+    if args.kill_fleet_after and args.fleet < 2:
+        sys.exit("--kill-fleet-after needs --fleet >= 2 (killing the "
+                 "last live fleet has nowhere to fail over to)")
+    if args.fleet and (args.megakernel or args.disagg or args.moe_ep
+                       or args.transport or args.replica_slots):
+        sys.exit("--fleet fronts replicated layer-path ServingEngines;"
+                 " it does not combine with --megakernel/--disagg or "
+                 "the EP decode knobs")
+    if args.fleet and (args.checkpoint_dir or args.trace_out
+                       or args.park_after_idle):
+        sys.exit("--fleet does not combine with --checkpoint-dir/"
+                 "--trace-out/--park-after-idle (those drive one "
+                 "engine; the router has scale_to/kill_fleet drills "
+                 "instead)")
     # Layer-path serving knobs shared by every engine construction
     # below: attention impl, quantized KV pools, speculative decode.
     telemetry = args.telemetry or ("spans" if args.trace_out
@@ -212,7 +242,28 @@ def main():
             dec_eng, prefill_engine=pf_eng, num_slots=args.slots,
             page=args.page, prefill_buckets=buckets, **serve_kw)
 
-    if args.hf_dir:
+    if args.fleet and args.hf_dir:
+        sys.exit("--fleet serves the built-in tiny dense model "
+                 "(replicated fleets share one weight pytree); drop "
+                 "one of --fleet/--hf-dir")
+    if args.fleet:
+        from triton_dist_tpu.serving import FleetRouter
+
+        cfg = ModelConfig.tiny(vocab_size=128)
+        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
+        eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len)
+
+        # Every fleet shares the one Engine (weights + prefill jit)
+        # but owns its pools, scheduler, and tier store — the
+        # replicated-fleet shape. prefix_reuse forced on: the chained
+        # content keys are the affinity signal.
+        def fleet_factory():
+            return ServingEngine(eng, num_slots=args.slots,
+                                 page=args.page, prefix_reuse=True,
+                                 **serve_kw)
+
+        srv = FleetRouter(fleet_factory, fleets=args.fleet)
+    elif args.hf_dir:
         from triton_dist_tpu.models.hf_loader import load_hf_checkpoint
 
         cfg, params = load_hf_checkpoint(args.hf_dir, dtype=jnp.float32)
@@ -361,6 +412,8 @@ def main():
         signal.signal(signal.SIGTERM, _on_term)
 
     def _checkpoint_tick():
+        if not (ckpt_path or stop["flag"]):
+            return
         done_here = (srv.stats_counters["tokens_generated"]
                      - tokens_at_start)
         if ckpt_path and (stop["flag"] or (
@@ -401,10 +454,37 @@ def main():
                 park_state["done"].add(rid)
                 srv.resume(h)
 
+    # --kill-fleet-after drill: once N tokens have streamed, one live
+    # fleet dies MID-SERVE (reachable: running sessions park into its
+    # tier and hop to a survivor — or re-prefill without tiers). Fires
+    # once; streams stay bit-identical to an unkilled run.
+    fleet_kill = {"done": False}
+
+    def _fleet_tick():
+        if not args.kill_fleet_after or fleet_kill["done"]:
+            return
+        done_tokens = sum(f.engine.stats_counters["tokens_generated"]
+                          for f in srv.fleets)
+        if done_tokens < args.kill_fleet_after:
+            return
+        live = srv._live_fleets()
+        if len(live) < 2:
+            fleet_kill["done"] = True
+            return
+        # Prefer a fleet with live work so the kill actually
+        # exercises the cross-fleet failover path.
+        victim = next((f for f in live if f.engine.sched.slots),
+                      live[-1])
+        srv.kill_fleet(victim.id, reachable=True)
+        fleet_kill["done"] = True
+        print(f"[fleet {victim.id} killed mid-serve: failed over]",
+              file=sys.stderr, flush=True)
+
     def run_serving():
         stop["serving"] = True
         try:
-            srv.run(on_tick=lambda: (_park_tick(), _checkpoint_tick()))
+            srv.run(on_tick=lambda: (_park_tick(), _checkpoint_tick(),
+                                     _fleet_tick()))
         finally:
             stop["serving"] = False
 
@@ -416,7 +496,8 @@ def main():
         os.remove(ckpt_path)   # consumed; SIGTERM writes a fresh one
         print(f"restored {len(restored_handles)} in-flight "
               f"request(s) from {ckpt_path}", flush=True)
-    tokens_at_start = srv.stats_counters["tokens_generated"]
+    tokens_at_start = (srv.stats_counters["tokens_generated"]
+                       if hasattr(srv, "stats_counters") else 0)
     if restored_handles:
         run_serving()
         for h in restored_handles:
@@ -483,6 +564,16 @@ def main():
                  f"hit-rate={'n/a' if rate is None else f'{rate:.2f}'}"
                  f" (tier_pages={st['tier_pages']} "
                  f"parked={st['parked_sessions']})")
+    if args.fleet:
+        ar = st.get("router_affinity_hit_rate")
+        line += (f", fleet: routed={st['routed']} "
+                 f"failovers={st['fleet_failovers']} "
+                 f"(resumed={st['failover_resumed']} "
+                 f"reprefilled={st['failover_reprefilled']}) "
+                 f"shed={st['shed_requests']} "
+                 f"affinity-hit-rate="
+                 f"{'n/a' if ar is None else f'{ar:.2f}'} "
+                 f"live={st['live_fleets']}/{len(srv.fleets)}")
     if (st["retries"] or st["failovers"] or st["restored_requests"]
             or args.checkpoint_dir):
         line += (f", ft: retries={st['retries']} "

@@ -1,0 +1,610 @@
+"""Fleet-scale serving battery: the FleetRouter over R replicated
+serving fleets — prefix-affinity routing vs the round-robin baseline,
+cross-fleet session failover (parked-tier handoff AND deterministic
+re-prefill, both token-exact vs ``Engine.serve``), drain/restore
+autoscale with in-flight sessions, deterministic saturation spillover,
+shed-by-deadline-class graceful degradation, the fleet chaos soak, and
+the fleet invariant checker's own teeth (docs/serving.md, "Fleet
+serving").
+
+Everything is seeded and runs on the CPU mesh; all fleets share one
+module-scoped layer Engine (weights + jit prefill), each with its own
+pools, scheduler, and tier store — exactly the replicated-fleet shape.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.resilience import chaos
+from triton_dist_tpu.resilience.policy import RetryPolicy
+from triton_dist_tpu.serving import (
+    FleetRouter, QueueFullError, Request, ServingEngine, ShedError,
+    heavy_tail_trace,
+)
+from triton_dist_tpu.serving.tiers import extend_session
+
+CFG = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                       intermediate_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=4,
+                       head_dim=8)
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    return Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+
+
+def _oracle(engine, prompt, gen_len):
+    ids = jnp.asarray(np.asarray([list(prompt)], np.int32))
+    return np.asarray(engine.serve(ids, gen_len=gen_len))[0].tolist()
+
+
+def _factory(engine, **kw):
+    """One fleet: a ServingEngine with its own pools + tier store."""
+    def make():
+        args = dict(num_slots=2, page=4, num_pages=16,
+                    prefix_reuse=True, kv_tiers={"host_pages": 128})
+        args.update(kw)
+        return ServingEngine(engine, **args)
+    return make
+
+
+def _run_until_decoding(router, h):
+    """Step until ``h`` is running with at least one emitted token
+    (the parked-handoff failover precondition)."""
+    for _ in range(200):
+        if h.status == "running" and h.tokens:
+            return
+        router.step()
+    raise AssertionError(f"{h.request.request_id} never started "
+                         f"decoding ({h.status})")
+
+
+# ---------------------------------------------------------------------------
+# Routing: affinity vs round-robin, spillover determinism
+# ---------------------------------------------------------------------------
+
+def _serve_trace(router, n_events=30, seed=5):
+    events = heavy_tail_trace(n_events, n_sessions=40, vocab=64,
+                              seed=seed, zipf_a=1.2,
+                              turn_tokens=(4, 8), max_total=16)
+    history = {}
+    for ev in events:
+        prompt = extend_session(history, ev, max_prompt=16)
+        h = router.submit(prompt, max_new_tokens=ev["gen"])
+        router.run()
+        extend_session(history, ev, reply=h.tokens)
+    return router.stats()
+
+
+def test_affinity_routing_beats_round_robin(engine):
+    """Same seeded multi-turn trace, two routers: prefix-affinity
+    routing must land strictly more prefix hits than the round-robin
+    spread (same-session turns keep hitting the fleet that holds
+    their pages)."""
+    st_aff = _serve_trace(FleetRouter(_factory(engine), fleets=2,
+                                      affinity=True))
+    st_rr = _serve_trace(FleetRouter(_factory(engine), fleets=2,
+                                     affinity=False))
+    assert st_aff["kv_hot_hit_rate"] is not None
+    assert st_aff["kv_hot_hit_rate"] > (st_rr["kv_hot_hit_rate"] or 0.0)
+    assert st_aff["affinity_hits"] > 0
+    assert st_aff["router_affinity_hit_rate"] > 0
+    # Round-robin records no affinity hits by construction.
+    assert st_rr["affinity_hits"] == 0
+
+
+def test_routing_is_token_exact_and_jit_flat(engine):
+    router = FleetRouter(_factory(engine), fleets=2)
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8], [9, 8, 7], [1, 2, 3, 4, 9]]
+    got = router.generate(prompts, max_new_tokens=5)
+    for p, toks in zip(prompts, got):
+        assert toks == _oracle(engine, p, 5)
+    # The fleet-wide no-recompilation gate: every fleet's decode
+    # dispatch holds exactly one jit entry with routing active.
+    assert router.decode_cache_sizes() == [1, 1]
+
+
+def test_saturation_spillover_is_deterministic(engine):
+    """A burst past one fleet's queue spills onto the next in a fully
+    deterministic order: two identical routers assign every request
+    to the same fleet."""
+    prefix = [1, 2, 3, 4]                    # one full page key
+
+    def assignments():
+        router = FleetRouter(
+            _factory(engine, num_slots=1, max_queue=2), fleets=2)
+        # Seed the prefix on one fleet so affinity PREFERS it...
+        router.generate([prefix + [9]], max_new_tokens=2)
+        # ...then burst more same-prefix traffic than it can queue.
+        hs = [router.submit(prefix + [i + 1], max_new_tokens=2)
+              for i in range(6)]
+        placed = [router._fleet_of(h).id if router._fleet_of(h)
+                  else None for h in hs]
+        st = router.stats()
+        router.run()
+        for h in hs:
+            assert h.status == "done"
+        return placed, st["spillovers"]
+
+    a1, spill1 = assignments()
+    a2, spill2 = assignments()
+    assert a1 == a2
+    assert spill1 == spill2 and spill1 > 0
+    # The burst overflowed the preferred fleet onto the other one.
+    assert len(set(x for x in a1 if x is not None)) == 2
+
+
+def test_router_queue_and_admission_shed(engine):
+    """Everything saturated: interactive submissions get backpressure
+    (QueueFullError), batch-class ones shed terminally — admission
+    control degrades by deadline class instead of failing broadly."""
+    router = FleetRouter(
+        _factory(engine, num_slots=1, max_queue=2), fleets=2,
+        max_queue=0)
+    # Fill both fleet queues (placement is queue-side until a tick).
+    hs = [router.submit([i + 1, 2], max_new_tokens=2)
+          for i in range(4)]
+    batch = router.submit([9, 9, 9], max_new_tokens=2)
+    assert batch.status == "shed" and batch.done
+    assert isinstance(batch.error, ShedError)
+    with pytest.raises(QueueFullError):
+        router.submit(Request(prompt=[8, 8], max_new_tokens=2,
+                              deadline=1e9))
+    st = router.stats()
+    assert st["shed_requests"] == 1
+    router.run()
+    for h in hs:
+        assert h.status == "done"
+
+
+# ---------------------------------------------------------------------------
+# Fleet failover: both cross-fleet paths, token-exact
+# ---------------------------------------------------------------------------
+
+def test_fleet_kill_parked_handoff_token_exact(engine):
+    """A reachable dead fleet's running session parks into its tier,
+    the pinned payload hops to a survivor, and the session resumes
+    there TOKEN-EXACT (the cross-fleet tier path)."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    prompt = [5, 5, 5, 5, 5, 5, 5, 5]
+    h = router.submit(prompt, max_new_tokens=8)
+    _run_until_decoding(router, h)
+    victim = router._fleet_of(h)
+    assert router.kill_fleet(victim.id, reachable=True)
+    chaos.check_fleet_invariants(router, [h])
+    router.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, prompt, 8)
+    st = router.stats()
+    assert st["failover_resumed"] >= 1
+    assert st["fleet_failovers"] == 1
+    assert st["dead_fleets"] == 1 and st["live_fleets"] == 1
+
+
+def test_fleet_kill_reprefill_token_exact(engine):
+    """An UNREACHABLE dead fleet's sessions re-enter via the
+    deterministic re-prefill contract on the adoptive fleet — equally
+    token-exact, no tier payload needed."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    prompt = [6, 6, 6, 1, 2, 3]
+    h = router.submit(prompt, max_new_tokens=8)
+    other = router.submit([4, 4, 4], max_new_tokens=4)
+    _run_until_decoding(router, h)
+    victim = router._fleet_of(h)
+    router.kill_fleet(victim.id, reachable=False)
+    chaos.check_fleet_invariants(router, [h, other])
+    router.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, prompt, 8)
+    assert other.status == "done"
+    assert other.tokens == _oracle(engine, [4, 4, 4], 4)
+    assert router.stats()["failover_resumed"] == 0
+    assert router.stats()["failover_reprefilled"] >= 1
+
+
+def test_kill_fleet_guards(engine):
+    router = FleetRouter(_factory(engine), fleets=2)
+    router.kill_fleet(0)
+    # A dead fleet kills idempotently; the last live fleet never.
+    assert router.kill_fleet(0) is False
+    with pytest.raises(ValueError, match="last live fleet"):
+        router.kill_fleet(1)
+    with pytest.raises(ValueError, match="no fleet"):
+        router.kill_fleet(99)
+
+
+def test_route_faults_strike_health_into_failover(engine):
+    """Hard fleet_route faults strike the targeted fleet's health;
+    crossing the threshold fails it over and the request still lands
+    (the router never fails broadly on a link fault)."""
+    from triton_dist_tpu.resilience import faults
+
+    router = FleetRouter(_factory(engine), fleets=2,
+                         fleet_fail_threshold=2)
+    plan = faults.FaultPlan(
+        name="drop-route",
+        faults=(faults.Fault("fail_call", op="fleet_route", k=None),))
+    with faults.inject(plan):
+        h1 = router.submit([1, 2, 3], max_new_tokens=2)
+        h2 = router.submit([4, 5, 6], max_new_tokens=2)
+    # Every send faulted: both requests fell into the router queue;
+    # strikes accumulated (2 per submit across both fleets).
+    assert len(router.queue) == 2
+    st = router.stats()
+    # Both fleets were struck to the threshold, but the router keeps
+    # at least one fleet serving (fail-soft, never dead-everything).
+    assert st["live_fleets"] >= 1
+    router.run()
+    assert h1.status == "done" and h2.status == "done"
+    assert h1.tokens == _oracle(engine, [1, 2, 3], 2)
+
+
+# ---------------------------------------------------------------------------
+# Drain / restore autoscale
+# ---------------------------------------------------------------------------
+
+def test_scale_round_trip_with_inflight_sessions(engine):
+    """Scale 2→3→1 with sessions mid-decode: drained fleets park
+    their running sessions, the checkpoint+tier snapshot carries the
+    payloads onto the new topology, and every request finishes
+    token-exact with its original handle."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    hs = [router.submit([i + 1, 2, 3, 4, 5], max_new_tokens=6)
+          for i in range(4)]
+    for _ in range(2):
+        router.step()
+    assert router.scale_to(3) == []
+    assert len(router._live_fleets()) == 3
+    h_live = router.submit([7, 7, 7, 7, 7, 7], max_new_tokens=8)
+    _run_until_decoding(router, h_live)
+    snaps = router.scale_to(1)
+    assert len(snaps) == 2
+    assert len(router._live_fleets()) == 1
+    for snap in snaps:
+        assert snap["meta"]["format"] == "tdt-serving-ckpt-v1"
+    chaos.check_fleet_invariants(router, hs + [h_live])
+    router.run()
+    for i, h in enumerate(hs):
+        assert h.status == "done"
+        assert h.tokens == _oracle(engine, [i + 1, 2, 3, 4, 5], 6)
+    assert h_live.status == "done"
+    assert h_live.tokens == _oracle(engine, [7, 7, 7, 7, 7, 7], 8)
+    st = router.stats()
+    assert st["scale_ups"] == 1 and st["scale_downs"] == 2
+    assert st["drain_resumed"] >= 1      # the snapshot-payload path
+    assert router.decode_cache_sizes() == [1]
+
+
+def test_scale_down_without_tiers_finishes_inflight(engine):
+    """No tier store: drain cannot park, so in-flight sessions FINISH
+    on the draining fleet before its snapshot (park-or-finish)."""
+    router = FleetRouter(_factory(engine, kv_tiers=None),
+                         fleets=2, affinity=False)
+    hs = [router.submit([i + 1, 9], max_new_tokens=3)
+          for i in range(3)]
+    router.step()
+    router.scale_to(1)
+    router.run()
+    for i, h in enumerate(hs):
+        assert h.status == "done"
+        assert h.tokens == _oracle(engine, [i + 1, 9], 3)
+
+
+def test_user_parked_session_stays_parked_across_failover(engine):
+    """A session the CALLER parked is a deliberate suspension: a
+    reachable fleet kill hops its pinned payload to a survivor but
+    does NOT resume it — a later ``router.resume(h)`` finds it parked
+    there and reactivates token-exact."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    prompt = [3, 1, 4, 1, 5, 9]
+    h = router.submit(prompt, max_new_tokens=8)
+    _run_until_decoding(router, h)
+    victim = router._fleet_of(h)
+    router.park(h)
+    assert h.status == "parked"
+    assert router.kill_fleet(victim.id, reachable=True)
+    chaos.check_fleet_invariants(router, [h])
+    assert h.status == "parked"        # the suspension survived
+    router.run()                       # ...and does not block drain
+    assert h.status == "parked"
+    assert router.stats()["parked_sessions"] == 1
+    router.resume(h)
+    router.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, prompt, 8)
+
+
+def test_drain_restores_user_parked_session_parked(engine):
+    """``scale_to`` preserves a caller-parked session AS PARKED on the
+    surviving topology (payload from the drain snapshot); resume is
+    still the caller's verb."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    filler = router.submit([9, 9, 9], max_new_tokens=4)   # loads f0
+    prompt = [2, 7, 1, 8, 2, 8]
+    h = router.submit(prompt, max_new_tokens=8)           # lands f1
+    _run_until_decoding(router, h)
+    router.park(h)
+    # Guard against vacuousness: h must sit on the fleet scale_to(1)
+    # will drain (the highest-id live fleet).
+    assert router._fleet_of(h) is router._live_fleets()[-1]
+    router.scale_to(1)
+    assert h.status == "parked"        # moved, not resumed
+    chaos.check_fleet_invariants(router, [h, filler])
+    router.run()
+    assert h.status == "parked"
+    router.resume(h)
+    router.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, prompt, 8)
+    assert filler.status == "done"
+
+
+def test_drain_never_sheds_under_saturation(engine):
+    """A voluntary ``scale_to`` must never terminate traffic: with the
+    survivor's queue AND the router queue full, the drained backlog
+    force-queues on the router (past ``max_queue``) instead of
+    shedding — every request still completes."""
+    router = FleetRouter(_factory(engine, num_slots=1, max_queue=1,
+                                  kv_tiers=None),
+                         fleets=2, max_queue=0, affinity=False)
+    hs = [router.submit([i + 1, 2], max_new_tokens=2)    # batch class
+          for i in range(2)]                 # one per fleet queue
+    router.scale_to(1)
+    assert router.stats()["shed_requests"] == 0
+    chaos.check_fleet_invariants(router, hs)
+    router.run()
+    for i, h in enumerate(hs):
+        assert h.status == "done"
+        assert h.tokens == _oracle(engine, [i + 1, 2], 2)
+    assert router.stats()["shed_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Shed by deadline class
+# ---------------------------------------------------------------------------
+
+def test_failover_sheds_batch_class_before_interactive(engine):
+    """Fleet loss with the survivor saturated: the victim's queued
+    backlog rehomes interactive-first, and what cannot fit sheds —
+    the BATCH class, never the interactive one (deadline-class
+    ordering)."""
+    router = FleetRouter(
+        _factory(engine, num_slots=1, max_queue=6), fleets=2,
+        affinity=False, max_queue=0)
+    far = 1e9
+    # Round-robin rotation alternates fleets per submit; a period-4
+    # class pattern puts 2 batch + 2 interactive on EACH fleet.
+    batch, interactive = [], []
+    for i in range(8):
+        if i % 4 >= 2:
+            interactive.append(router.submit(
+                Request(prompt=[i + 1, 2], max_new_tokens=2,
+                        deadline=far)))
+        else:
+            batch.append(router.submit([i + 1, 2], max_new_tokens=2))
+    live = [h for h in batch + interactive if not h.done]
+    victims = {f.id: [] for f in router.fleets}
+    for h in live:
+        f = router._fleet_of(h)
+        if f is not None:
+            victims[f.id].append(h)
+    # Kill fleet 0: its backlog must rehome onto fleet 1's bounded
+    # queue — interactive first, batch shed when full.
+    router.kill_fleet(0, reachable=True)
+    shed = [h for h in live if h.status == "shed"]
+    assert shed, "saturated failover shed nothing"
+    assert all(h.request.deadline is None for h in shed), (
+        "an interactive request was shed while batch survived")
+    assert all(h.status != "shed" for h in interactive)
+    chaos.check_fleet_invariants(router, live)
+    router.run()
+    for h in interactive:
+        assert h.status == "done"
+    st = router.stats()
+    assert st["shed_requests"] == len(shed)
+    # Shed is its own verdict — never counted as a failure.
+    assert st["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The fleet invariant checker's own teeth
+# ---------------------------------------------------------------------------
+
+def _small_router(engine):
+    router = FleetRouter(_factory(engine), fleets=2)
+    h = router.submit([1, 2, 3, 4], max_new_tokens=4)
+    router.step()
+    return router, h
+
+
+def test_checker_passes_on_healthy_router(engine):
+    router, h = _small_router(engine)
+    chaos.check_fleet_invariants(router, [h])
+    router.run()
+    chaos.check_fleet_invariants(router, [h])
+
+
+def test_checker_catches_double_ownership(engine):
+    from triton_dist_tpu.serving.scheduler import RequestHandle
+
+    router, _ = _small_router(engine)
+    dup = RequestHandle(request=Request(prompt=[1, 2],
+                                        request_id="dup"))
+    for f in router.fleets:
+        f.engine.sched.queue.append(dup)
+    with pytest.raises(chaos.InvariantViolation, match="owned by BOTH"):
+        chaos.check_fleet_invariants(router, [dup])
+
+
+def test_checker_catches_session_on_two_fleets(engine):
+    router, _ = _small_router(engine)
+    k, v = (np.zeros((2, 1, 4, 4, 8), np.float32),) * 2
+    for f in router.fleets:
+        f.engine.tiers.put(("session", "dup"), (k, v), pages=1,
+                           pinned=True)
+    with pytest.raises(chaos.InvariantViolation, match="pinned on BOTH"):
+        chaos.check_fleet_invariants(router)
+
+
+def test_checker_catches_health_liveness_drift(engine):
+    router, _ = _small_router(engine)
+    router.fleets[1].health.declare_dead("drift")
+    with pytest.raises(chaos.InvariantViolation,
+                       match="failover skipped"):
+        chaos.check_fleet_invariants(router)
+
+
+def test_checker_catches_drain_gate_breach(engine):
+    from triton_dist_tpu.serving.scheduler import RequestHandle
+
+    router, _ = _small_router(engine)
+    f = router.fleets[1]
+    f.draining = True
+    f.engine.sched.queue.append(RequestHandle(
+        request=Request(prompt=[1], request_id="sneak")))
+    with pytest.raises(chaos.InvariantViolation, match="drain gate"):
+        chaos.check_fleet_invariants(router)
+
+
+def test_checker_catches_lost_request(engine):
+    router, _ = _small_router(engine)
+    from triton_dist_tpu.serving.scheduler import RequestHandle
+
+    ghost = RequestHandle(request=Request(prompt=[1],
+                                          request_id="ghost"))
+    with pytest.raises(chaos.InvariantViolation, match="lost"):
+        chaos.check_fleet_invariants(router, [ghost])
+
+
+# ---------------------------------------------------------------------------
+# Router-time predictive prefetch rides routing
+# ---------------------------------------------------------------------------
+
+def test_router_prefetch_warms_tier_payloads(engine):
+    """Routing a same-prefix request fires the chosen fleet's
+    tier_prefetch: the transfer runs at ROUTE time and admission
+    consumes the warm payload without a second tier hop."""
+    router = FleetRouter(_factory(engine), fleets=2)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    router.generate([prompt], max_new_tokens=4)
+    fleet = max(router.fleets,
+                key=lambda f: f.engine.manager.stats["allocs"])
+    eng = fleet.engine
+    eng.manager.evict(len(eng.manager._prefix))
+    assert len(eng.tiers) >= 2
+    gets0 = eng.tiers.stats()["gets"]
+    h = router.submit(prompt, max_new_tokens=4)
+    # The route-time prefetch already ran the transfers.
+    assert eng.stats_counters["router_prefetched_pages"] >= 2
+    gets_at_route = eng.tiers.stats()["gets"] - gets0
+    router.run()
+    assert eng.tiers.stats()["gets"] - gets0 == gets_at_route, (
+        "admission re-transferred despite the route-time warm buffer")
+    assert h.tokens == _oracle(engine, prompt, 4)
+
+
+# ---------------------------------------------------------------------------
+# Stats / spans
+# ---------------------------------------------------------------------------
+
+def test_router_stats_and_spans(engine):
+    router = FleetRouter(_factory(engine), fleets=2,
+                         telemetry="spans")
+    hs = [router.submit([i + 1, 2, 3, 4, 5], max_new_tokens=4)
+          for i in range(3)]
+    _run_until_decoding(router, hs[0])
+    router.kill_fleet(router._fleet_of(hs[0]).id, reachable=True)
+    router.run()
+    router.scale_to(2)
+    router.scale_to(1)
+    st = router.stats()
+    for key in ("routed", "router_affinity_hit_rate", "shed_requests",
+                "fleet_failovers", "failover_resumed", "queue_depth",
+                "kv_hot_hit_rate", "fleet_ttft_ms", "latency",
+                "fleets", "live_fleets"):
+        assert key in st
+    assert st["routed"] == 3
+    assert len(st["fleets"]) == len(router.fleets)
+    ops = (st["latency"] or {}).get("ops", {})
+    assert "route" in ops and ops["route"]["count"] == 3
+    for kind in ("fleet_failover", "drain", "restore_fleet"):
+        assert kind in ops, f"span kind {kind} missing from latency"
+    kinds = {s.kind for s in router.obs.log.spans()}
+    assert {"route", "fleet_failover", "drain",
+            "restore_fleet"} <= kinds
+    # Fleet-wide TTFT merges per-fleet histograms.
+    assert st["fleet_ttft_ms"] is not None
+    assert st["fleet_ttft_ms"]["count"] == 3
+
+
+def test_router_rejects_bad_construction(engine):
+    with pytest.raises(ValueError, match="prefix_reuse"):
+        FleetRouter(_factory(engine, prefix_reuse=False,
+                             kv_tiers=None), fleets=1)
+    with pytest.raises(ValueError, match="fleets must be"):
+        FleetRouter(_factory(engine), fleets=0)
+    with pytest.raises(TypeError, match="RetryPolicy"):
+        FleetRouter(_factory(engine), fleets=1, retry={"fleet_route":
+                                                       object()})
+    calls = {"n": 0}
+
+    def mismatched():
+        calls["n"] += 1
+        return ServingEngine(engine, num_slots=2,
+                             page=4 if calls["n"] == 1 else 8,
+                             prefix_reuse=True)
+
+    with pytest.raises(ValueError, match="identically planned"):
+        FleetRouter(mismatched, fleets=2, affinity=False)
+
+
+# ---------------------------------------------------------------------------
+# The fleet chaos soak
+# ---------------------------------------------------------------------------
+
+def _soak_factory(engine):
+    def make():
+        return ServingEngine(engine, num_slots=2, page=4, num_pages=16,
+                             prefix_reuse=True,
+                             kv_tiers={"host_pages": 64},
+                             retry=RetryPolicy(max_attempts=2))
+    return make
+
+
+def test_fleet_soak_mini_run(engine):
+    rep = chaos.run_fleet_soak(
+        _soak_factory(engine), fleets=2, seed=3, ticks=40, n_faults=6,
+        router_kw={"retry": RetryPolicy(max_attempts=2)},
+        scale_at=(20, 3))
+    assert rep.survived_faults == rep.faults_injected == 6
+    assert rep.invariant_checks >= rep.ticks
+    assert rep.requests["submitted"] == sum(
+        rep.requests[k] for k in ("done", "failed", "timeout", "shed"))
+    assert rep.token_exact_requests == rep.requests["done"] > 0
+    assert rep.scaled_at == 20
+
+
+@pytest.mark.slow
+def test_fleet_soak_acceptance(engine):
+    """The acceptance soak (scripts/fleet_smoke.sh): ≥200 ticks, 12
+    seeded faults across kills / route / handoff / tier families over
+    3 fleets with a mid-soak autoscale, per-tick fleet invariants,
+    every request terminal, done requests token-exact."""
+    rep = chaos.run_fleet_soak(
+        _soak_factory(engine), fleets=3, seed=7, ticks=200,
+        n_faults=12,
+        router_kw={"retry": RetryPolicy(max_attempts=2)},
+        scale_at=(120, 2))
+    assert rep.survived_faults >= 10
+    assert rep.invariant_checks >= 200
+    assert rep.token_exact_requests == rep.requests["done"] > 0
+    assert rep.requests["submitted"] == sum(
+        rep.requests[k] for k in ("done", "failed", "timeout", "shed"))

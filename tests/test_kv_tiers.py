@@ -722,6 +722,70 @@ def test_disagg_composes_with_tiers(mesh):
     chaos.check_invariants(srv)
 
 
+def test_disagg_prefill_worker_consults_tier(mesh):
+    """PR 12 known-limit regression: tier-resident leading pages now
+    skip recompute on the prefill WORKER too — the staging pool
+    scatters them in at chunk-stream start, so the second serve of a
+    demoted prefix needs fewer chunk dispatches (and the tier entry
+    survives for the decode-side handoff fetch), token-exact."""
+    from triton_dist_tpu.serving import DisaggServingEngine
+
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    srv = DisaggServingEngine(eng, num_slots=2, page=4,
+                              prefill_buckets=(4, 8),
+                              prefix_reuse=True,
+                              kv_tiers={"host_pages": 64})
+    prompt = list(range(1, 13))                # three full pages
+    want = _oracle(eng, prompt, 4)
+    assert srv.generate([prompt], max_new_tokens=4)[0] == want
+    chunks_first = srv.stats_counters["prefill_chunks"]
+    assert chunks_first == 2                   # cold: bucket 8 + 4
+    # Demote the committed prefix out of BOTH pools: the decode side
+    # offloads into the tier (on_demote), the worker side just drops.
+    srv.manager.evict(len(srv.manager._prefix))
+    pw = srv.prefill_worker
+    pw.manager.evict(len(pw.manager._prefix))
+    assert len(srv.tiers) >= 3
+    h = srv.submit(prompt, max_new_tokens=4)
+    srv.run()
+    assert h.tokens == want
+    st = srv.stats()
+    assert st["worker_prefetched_pages"] >= 3
+    # The chunk stream started PAST the fetched pages: one small tail
+    # chunk instead of the cold serve's two.
+    assert st["prefill_chunks"] - chunks_first == 1
+    assert h.chunks == [(11, 4, 1)]            # start, bucket, valid
+    chaos.check_invariants(srv)
+
+
+def test_router_time_prefetch_warms_admission(mesh):
+    """ROADMAP item 4 remainder: tier_prefetch runs the transfer at
+    ROUTE time into the warm buffer; the admission-time fetch then
+    consumes it without a second tier hop (gets counter flat), still
+    token-exact. Without a prefetch the admission path is unchanged."""
+    eng = Engine(CFG, mesh, mode="xla", max_len=MAX_LEN, seed=0)
+    srv = ServingEngine(eng, num_slots=2, page=4, num_pages=16,
+                        prefix_reuse=True, kv_tiers={"host_pages": 64})
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    want = _oracle(eng, prompt, 4)
+    assert srv.generate([prompt], max_new_tokens=4)[0] == want
+    srv.manager.evict(len(srv.manager._prefix))
+    assert srv.tier_prefetch(prompt) == 2
+    assert len(srv._tier_warm) == 2
+    gets_after_warm = srv.tiers.stats()["gets"]
+    assert srv.generate([prompt], max_new_tokens=4)[0] == want
+    assert srv.tiers.stats()["gets"] == gets_after_warm, (
+        "admission re-transferred despite the route-time warm buffer")
+    assert not srv._tier_warm                 # consumed on use
+    st = srv.stats()
+    assert st["router_prefetched_pages"] == 2
+    assert st["tier_hits"] >= 2
+    assert srv.decode_cache_size() == 1
+    # No-tiers / no-prefix engines: a safe no-op.
+    srv2 = ServingEngine(eng, num_slots=2, page=4)
+    assert srv2.tier_prefetch(prompt) == 0
+
+
 # ---------------------------------------------------------------------------
 # Telemetry, checkpoint, chaos, and the acceptance trace
 # ---------------------------------------------------------------------------

@@ -49,6 +49,12 @@ SPAN_KINDS = (
     "kv_prefetch",       # span: tier payload scattered back into HBM
     "park",              # span: session offloaded + slot released
     "resume",            # span: resume() -> token-exact reactivation
+    # fleet serving (docs/serving.md, "Fleet serving")
+    "route",             # span: routing decision -> fleet admission
+    "fleet_failover",    # span: dead fleet's work rehomed on survivors
+    "drain",             # span: fleet drained (park/finish in-flight)
+    "restore_fleet",     # span: fleet state restored on new topology
+    "shed",              # event: request shed by deadline class
     # resilience
     "retry",             # event: one absorbed transient (attempt n)
     "retry_backoff",     # event: backoff sleep scheduled (policy)

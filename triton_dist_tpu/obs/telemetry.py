@@ -37,6 +37,7 @@ _OP_HIST_KINDS = frozenset({
     "queue_wait", "prefill", "prefill_chunk", "migration", "decode",
     "spec_draft", "spec_verify", "checkpoint", "restore", "request",
     "kv_offload", "kv_prefetch", "park", "resume",
+    "route", "fleet_failover", "drain", "restore_fleet",
 })
 
 

@@ -48,9 +48,12 @@ import numpy as np
 
 from triton_dist_tpu.resilience import faults
 
-__all__ = ["ChaosEvent", "ChaosReport", "InvariantViolation",
+__all__ = ["ChaosEvent", "ChaosReport", "FleetChaosReport",
+           "InvariantViolation",
            "DEFAULT_FAULT_KINDS", "TIER_FAULT_KINDS",
-           "check_invariants", "run_soak"]
+           "FLEET_FAULT_KINDS",
+           "check_invariants", "check_fleet_invariants",
+           "run_soak", "run_fleet_soak"]
 
 
 class InvariantViolation(AssertionError):
@@ -91,6 +94,23 @@ TIER_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
     ("wedge_tier_transfer", "tier_transfer", "timeout_call"),
 )
 
+# The fleet-level menu (``run_fleet_soak`` over a ``FleetRouter``):
+# dropped / wedged router→fleet links (``fleet_route`` — the send that
+# places a request on a fleet's queue), dropped / wedged cross-fleet
+# session handoffs (``fleet_handoff`` — the parked-payload hop during
+# failover and drain/restore), and whole-fleet kills — a seeded coin
+# picks reachable (parked-tier handoff path) vs vanished (deterministic
+# re-prefill path). Kept separate so ``run_soak``'s seeded schedules
+# stay byte-identical.
+FLEET_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
+                         ...] = (
+    ("kill_fleet", None, None),
+    ("drop_route", "fleet_route", "fail_call"),
+    ("wedge_route", "fleet_route", "timeout_call"),
+    ("drop_handoff", "fleet_handoff", "fail_call"),
+    ("wedge_handoff", "fleet_handoff", "timeout_call"),
+)
+
 
 @dataclasses.dataclass
 class ChaosEvent:
@@ -127,6 +147,27 @@ class ChaosReport:
     invariant_checks: int
     token_exact_requests: int
     restored_at: Optional[int]
+
+
+@dataclasses.dataclass
+class FleetChaosReport:
+    """What a completed fleet soak measured (completion already means:
+    router alive, per-tick fleet invariants held, every request
+    terminal, done requests token-exact vs the single-engine oracle).
+    ``requests`` adds the ``shed`` class; ``router`` is the final
+    router counter dict (failovers, handoff resumes, sheds...)."""
+
+    seed: int
+    ticks: int
+    fleets: int
+    events: List[ChaosEvent]
+    faults_injected: int
+    survived_faults: int
+    requests: Dict[str, int]
+    router: Dict[str, int]
+    invariant_checks: int
+    token_exact_requests: int
+    scaled_at: Optional[int]
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +332,94 @@ def _check_tiers(srv) -> None:
             raise InvariantViolation(
                 f"pinned session payload {k[1]!r} has no parked or "
                 "resuming owner — leaked tier pages")
+
+
+def check_fleet_invariants(router, tracked=None) -> None:
+    """Fleet-level sweep over a :class:`~triton_dist_tpu.serving.
+    router.FleetRouter` — the per-fleet :func:`check_invariants` plus
+    the cross-fleet algebra:
+
+    - every in-flight request is owned by exactly ONE place (the
+      router queue, or one live fleet's queue / slots / parked
+      registry) — never two;
+    - no session payload is pinned in two fleets' tier stores at once
+      (the cross-fleet handoff pops the source before the target
+      resumes);
+    - the router's health view is consistent with liveness (a fleet
+      marked dead carries a dead health verdict; a declared-dead
+      health verdict on a live fleet means the failover was skipped);
+    - the drain gate holds: a draining fleet admits nothing (its
+      queue stays empty);
+    - router-queued handles are slotless and non-terminal.
+
+    ``tracked`` (optional handles) must each be terminal or owned
+    somewhere.
+    """
+    seen: Dict[str, str] = {}
+
+    def note(h, where):
+        rid = h.request.request_id
+        if rid in seen:
+            raise InvariantViolation(
+                f"request {rid} owned by BOTH {seen[rid]} and {where}")
+        seen[rid] = where
+
+    # Cross-fleet session uniqueness first: a payload pinned on two
+    # fleets is its own violation class (a handoff that copied
+    # without popping), reported before the ownership scan can fold
+    # it into a generic double-ownership message.
+    session_owner: Dict[tuple, int] = {}
+    for f in router.fleets:
+        if f.dead or f.engine.tiers is None:
+            continue
+        for k in f.engine.tiers.keys():
+            k = tuple(k)
+            if k[0] != "session":
+                continue
+            if k in session_owner:
+                raise InvariantViolation(
+                    f"session payload {k[1]!r} pinned on BOTH fleet "
+                    f"{session_owner[k]} and fleet {f.id}")
+            session_owner[k] = f.id
+    for h in router.queue:
+        if h.slot is not None:
+            raise InvariantViolation(
+                f"router-queued request {h.request.request_id} still "
+                f"holds slot {h.slot}")
+        if h.done:
+            raise InvariantViolation(
+                f"terminal request {h.request.request_id} "
+                f"({h.status}) sits in the router queue")
+        note(h, "router-queue")
+    for f in router.fleets:
+        if f.dead:
+            if not f.health.dead:
+                raise InvariantViolation(
+                    f"fleet {f.id} marked dead without a dead health "
+                    "verdict")
+            continue
+        if f.health.dead:
+            raise InvariantViolation(
+                f"fleet {f.id} health declared dead "
+                f"({f.health.cause!r}) but the router still routes to "
+                "it — failover skipped")
+        check_invariants(f.engine)
+        if f.draining and f.engine.sched.queue:
+            raise InvariantViolation(
+                f"draining fleet {f.id} admitted new work (drain gate "
+                f"broke): queue={[h.request.request_id for h in f.engine.sched.queue]}")
+        for h in f.engine.sched.queue:
+            note(h, f"fleet{f.id}-queue")
+        for h in f.engine.sched.slots.values():
+            note(h, f"fleet{f.id}-slot")
+        for h in f.engine._parked.values():
+            note(h, f"fleet{f.id}-parked")
+    for h in tracked or ():
+        if not h.done and h.request.request_id not in seen:
+            raise InvariantViolation(
+                f"in-flight request {h.request.request_id} "
+                f"({h.status}) owned by NO fleet and not router-"
+                "queued — lost")
 
 
 # ---------------------------------------------------------------------------
@@ -551,3 +680,180 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
         invariant_checks=invariant_checks,
         token_exact_requests=token_exact,
         restored_at=restored_tick)
+
+
+def run_fleet_soak(factory: Callable[[], object], *,
+                   fleets: int = 2, seed: int = 0, ticks: int = 200,
+                   n_faults: int = 10, arrival_p: float = 0.35,
+                   kinds: Sequence = (FLEET_FAULT_KINDS
+                                      + TIER_FAULT_KINDS),
+                   transient_p: float = 0.5,
+                   gen_choices: Sequence[int] = (2, 3, 4, 6, 8),
+                   prompt_reuse_p: float = 0.4,
+                   deadline_p: float = 0.5,
+                   scale_at: Optional[Tuple[int, int]] = None,
+                   max_drain_steps: Optional[int] = None,
+                   router_kw: Optional[Dict] = None
+                   ) -> FleetChaosReport:
+    """Fleet-level chaos soak: drive ``ticks`` router steps of seeded
+    mixed traffic through a :class:`~triton_dist_tpu.serving.router.
+    FleetRouter` over ``fleets`` replicas of ``factory()``, under a
+    seeded schedule of whole-fleet kills (a seeded coin picks
+    reachable — the parked-tier handoff path — vs vanished — the
+    re-prefill path; never the last live fleet), dropped/wedged
+    ``fleet_route`` / ``fleet_handoff`` links, and tier faults.
+    :func:`check_fleet_invariants` sweeps after EVERY tick, the run
+    drains fault-free, every request must reach a terminal state
+    (``shed`` counts — graceful degradation is a terminal verdict,
+    not a hang), and every ``done`` request's tokens must equal the
+    single-engine ``Engine.serve`` oracle.
+
+    ``deadline_p``: fraction of requests submitted with a (far)
+    deadline — the interactive class, so fleet-loss shedding has both
+    classes to discriminate. ``scale_at=(tick, R')`` additionally
+    runs the drain/restore autoscale drill mid-soak. Raises
+    :class:`InvariantViolation` on any violation; returns a
+    :class:`FleetChaosReport` otherwise.
+    """
+    from triton_dist_tpu.serving.router import FleetRouter
+    from triton_dist_tpu.serving.scheduler import QueueFullError
+
+    rng = np.random.RandomState(seed)
+    router = FleetRouter(factory, fleets=fleets, **(router_kw or {}))
+    oracle_engine = router.fleets[0].engine.engine
+    vocab = router.fleets[0].engine.cfg.vocab_size
+    ref = router.fleets[0].engine
+    cap = min(ref.p_max * ref.page, ref.max_len)
+    max_gen = max(g for g in gen_choices)
+    max_prompt = max(1, min(12, cap - max_gen - 1))
+    kinds = list(kinds)
+    fault_ticks = sorted(
+        int(t) for t in rng.choice(np.arange(1, max(ticks, 2)),
+                                   size=min(n_faults, ticks - 1),
+                                   replace=False))
+    schedule: Dict[int, ChaosEvent] = {}
+    for t in fault_ticks:
+        name, op, kind = kinds[int(rng.randint(len(kinds)))]
+        schedule[t] = ChaosEvent(
+            tick=t, name=name, op=op, kind=kind,
+            transient=bool(rng.rand() < transient_p))
+
+    tracked: List[Tuple[Tuple[int, ...], int, object]] = []
+    prior_prompts: List[List[int]] = []
+    oracle_cache: Dict = {}
+    invariant_checks = 0
+    scaled_tick = None
+
+    def _submit_maybe():
+        if rng.rand() >= arrival_p:
+            return
+        if prior_prompts and rng.rand() < prompt_reuse_p:
+            # Prompt reuse = the affinity signal: same-prefix traffic
+            # should keep landing on the fleet holding the pages.
+            prompt = list(prior_prompts[
+                int(rng.randint(len(prior_prompts)))])
+        else:
+            n = int(rng.randint(1, max_prompt + 1))
+            prompt = [int(x) for x in rng.randint(0, vocab, n)]
+            prior_prompts.append(prompt)
+        gen = int(gen_choices[int(rng.randint(len(gen_choices)))])
+        # Interactive (far-deadline) vs batch class — both present so
+        # fleet-loss shedding has an ordering to exercise.
+        deadline = (router.obs.now() + 1e6
+                    if rng.rand() < deadline_p else None)
+        try:
+            h = router.submit(prompt, max_new_tokens=gen,
+                              deadline=deadline)
+        except QueueFullError:
+            return      # backpressure is correct behaviour, not a bug
+        tracked.append((tuple(prompt), gen, h))
+
+    def _fault_tick(ev: ChaosEvent):
+        before = (dict(router.counters),
+                  tuple(f.health.total_failures
+                        for f in router.fleets))
+        ev.at = router.obs.now()
+        router.obs.event("chaos_fault", tick=ev.tick, name=ev.name,
+                         op=ev.op, fault_kind=ev.kind,
+                         transient=ev.transient)
+        if ev.name == "kill_fleet":
+            live = router._live_fleets()
+            if len(live) < 2:
+                ev.fired = False        # nothing safely killable
+                _submit_maybe()
+                router.step()
+                return
+            victim = live[int(rng.randint(len(live)))]
+            reachable = bool(rng.rand() < 0.5)
+            router.kill_fleet(victim.id, reachable=reachable)
+            ev.fired = ev.observed = True
+            _submit_maybe()
+            router.step()
+            return
+        # Route/handoff/tier faults: the injection window covers the
+        # SUBMIT (where routing happens) and the step (queue drain,
+        # failover handoffs, tier traffic).
+        with faults.inject(_plan_for(ev)):
+            _submit_maybe()
+            router.step()
+        ev.fired = True
+        ev.observed = (dict(router.counters),
+                       tuple(f.health.total_failures
+                             for f in router.fleets)) != before
+
+    for tick in range(ticks):
+        if scale_at is not None and tick == scale_at[0]:
+            router.scale_to(scale_at[1])
+            scaled_tick = tick
+            router.obs.event("chaos_scale", tick=tick, to=scale_at[1])
+        ev = schedule.get(tick)
+        if ev is None:
+            _submit_maybe()
+            router.step()
+        else:
+            _fault_tick(ev)
+        check_fleet_invariants(router, [h for _, _, h in tracked])
+        invariant_checks += 1
+
+    budget = max_drain_steps or (ticks * 4 + 200)
+    for _ in range(budget):
+        if router.drained:
+            break
+        router.step()
+        check_fleet_invariants(router, [h for _, _, h in tracked])
+        invariant_checks += 1
+    else:
+        raise InvariantViolation(
+            f"fleet serving failed to drain within {budget} post-soak "
+            f"steps (router queue={len(router.queue)})")
+
+    statuses = Counter(h.status for _, _, h in tracked)
+    unresolved = [h.request.request_id for _, _, h in tracked
+                  if not h.done]
+    if unresolved:
+        raise InvariantViolation(
+            f"request(s) never terminally resolved: {unresolved}")
+    token_exact = 0
+    for prompt, gen, h in tracked:
+        if h.status != "done":
+            continue
+        want = _oracle_tokens(oracle_engine, prompt, gen, oracle_cache)
+        if list(h.tokens) != list(want):
+            raise InvariantViolation(
+                f"survivor {h.request.request_id} diverged from the "
+                f"single-engine oracle: {h.tokens} != {want} "
+                f"(prompt={list(prompt)})")
+        token_exact += 1
+
+    events = [schedule[t] for t in fault_ticks]
+    return FleetChaosReport(
+        seed=seed, ticks=ticks, fleets=fleets, events=events,
+        faults_injected=len(events),
+        survived_faults=sum(1 for e in events if e.fired),
+        requests={"submitted": len(tracked), **{
+            k: statuses.get(k, 0)
+            for k in ("done", "failed", "timeout", "shed")}},
+        router=dict(router.counters),
+        invariant_checks=invariant_checks,
+        token_exact_requests=token_exact,
+        scaled_at=scaled_tick)

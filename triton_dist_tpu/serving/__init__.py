@@ -36,3 +36,6 @@ from triton_dist_tpu.serving.tiers import (  # noqa: F401
 from triton_dist_tpu.serving.disagg import (  # noqa: F401
     DisaggServingEngine, PrefillWorker,
 )
+from triton_dist_tpu.serving.router import (  # noqa: F401
+    FleetRouter, ShedError,
+)

@@ -671,6 +671,19 @@ class BlockManager:
 
     # -- per-slot API ------------------------------------------------
 
+    def iter_prefix_keys(self, tokens: Sequence[int]):
+        """Successive chained content keys for ``tokens``'s FULL
+        pages — THE one definition of the prefix-key algebra.
+        :meth:`alloc_prefill`, the fleet router's affinity walk, and
+        the router-time tier prefetch all consume this iterator, so a
+        change to the key shape moves them together (an affinity hit
+        stays a prefix hit at admission by construction)."""
+        key: Tuple = ()
+        for i in range(len(tokens) // self.page):
+            key = (key, tuple(tokens[i * self.page:
+                                     (i + 1) * self.page]))
+            yield key
+
     def alloc_prefill(self, slot: int, tokens: Sequence[int]) -> List[int]:
         """Allocate the page list for a prompt entering ``slot``:
         shared full-prefix pages (when ``prefix_reuse``) + private
@@ -693,11 +706,10 @@ class BlockManager:
         hits = 0
         try:
             full = n_tok // self.page
-            key: Tuple = ()
+            keys = self.iter_prefix_keys(tokens)
             for i in range(n_pages):
                 if self.prefix_reuse and i < full:
-                    key = (key, tuple(tokens[i * self.page:
-                                             (i + 1) * self.page]))
+                    key = next(keys)
                     pid = self._prefix.get(key)
                     if pid is not None:
                         self._refs[pid] += 1

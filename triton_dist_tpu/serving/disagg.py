@@ -142,6 +142,21 @@ class PrefillWorker:
             lambda c, ids: c.gather_pages(ids),
             out_shardings=((rep, rep, rep, rep) if cache.quantized
                            else (rep, rep)))
+        # The reverse edge: a fixed-shape scatter INTO the staging
+        # pool (donated, pinned to the pool's one sharding spelling)
+        # — tier-resident leading prefix pages land here at
+        # chunk-stream start so the worker skips their compute, the
+        # dual of the decode-side handoff fetch. One jit entry: the
+        # payload is always scratch-padded to p_max pages.
+        if cache.quantized:
+            self._inject = jax.jit(
+                lambda c, k, v, ks, vs, ids: c.scatter_pages(
+                    k, v, ids, ks, vs),
+                donate_argnums=(0,), out_shardings=self.shardings)
+        else:
+            self._inject = jax.jit(
+                lambda c, k, v, ids: c.scatter_pages(k, v, ids),
+                donate_argnums=(0,), out_shardings=self.shardings)
 
     def extract(self, page_ids: np.ndarray):
         """Dispatch the (async) payload gather for ``page_ids``
@@ -156,6 +171,26 @@ class PrefillWorker:
     def release(self, slot: int):
         """Free a slot's staging pages (no-op if none staged)."""
         self.manager.free_slot(slot)
+
+    def inject(self, arrays, dst_ids) -> None:
+        """Blit a tier payload into staging-pool pages: ``arrays``
+        hold ``n`` pages along axis 1, ``dst_ids`` the ``n`` target
+        page ids. Scratch-padded to ``p_max`` — one fixed-shape
+        dispatch whatever the payload size."""
+        import jax.numpy as jnp
+
+        n = int(arrays[0].shape[1])
+        ids = np.full((self.p_max,), SCRATCH_PAGE, np.int32)
+        ids[:n] = np.asarray(dst_ids, np.int32)
+        padded = []
+        for a in arrays:
+            a = np.asarray(a)
+            pad = np.zeros(a.shape[:1] + (self.p_max - n,)
+                           + a.shape[2:], a.dtype)
+            padded.append(jnp.asarray(
+                np.concatenate([a, pad], axis=1)))
+        self.cache = self._inject(self.cache, *padded,
+                                  jnp.asarray(ids))
 
 
 class DisaggServingEngine(ServingEngine):
@@ -315,6 +350,69 @@ class DisaggServingEngine(ServingEngine):
     # ``_prefiller`` set it routes to _admit_chunked, which allocates
     # in the prefill worker's STAGING pool; decode-pool pages are only
     # claimed at handoff time (_finish_prefill below).
+
+    def _tier_worker_fetch(self, h: RequestHandle, slot: int) -> int:
+        """Extend ``slot``'s resident leading-page run in the PREFILL
+        WORKER's staging pool with tier-resident prefix pages — the
+        worker-side dual of ``_tier_prefill_fetch``: the chunk stream
+        starts past the fetched pages, skipping their compute (the
+        PR 12 known limit: only the decode-side handoff consulted the
+        tier). The tier entry is PEEKED, never popped — the staging
+        pool is transient (abandoned wholesale on failover), so the
+        tier copy stays authoritative until the decode-side handoff
+        fetch publishes the key in the decode pool. Stops at the
+        first genuinely cold page (hits must stay a leading run)."""
+        if self.tiers is None or self._prefiller is self:
+            return 0
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+        pw = self._prefiller
+        pend = pw.manager._pending_prefix.get(slot)
+        if not pend:
+            return 0
+        pend_by_pid = {pid: key for key, pid in pend}
+        pages = pw.manager._slot_pages[slot]
+        pos = pw.manager.prefix_hits(slot)
+        fetch = []                          # (pid, payload arrays)
+        while pos < len(pages):
+            pid = pages[pos]
+            key = pend_by_pid.get(pid)
+            if key is None:
+                if pw.manager._refs.get(pid, 0) > 1:
+                    pos += 1                # shared: already resident
+                    continue
+                break
+            if not self._tier_resident_prefix(key):
+                break
+            try:
+                arrays = self._tier_fetch_prefix(key)
+            except (CommTimeoutError, faults.InjectedFault):
+                arrays = None            # faulted past retries: a miss
+            if arrays is None:
+                self.stats_counters["tier_misses"] += 1
+                break
+            fetch.append((pid, arrays))
+            pos += 1
+        if not fetch:
+            return 0
+        with self.obs.span("kv_prefetch",
+                           request_id=h.request.request_id, slot=slot,
+                           tenant=h.request.tenant, pages=len(fetch),
+                           payload="worker"):
+            stacked = tuple(
+                np.concatenate([arr[i] for _, arr in fetch], axis=1)
+                for i in range(len(fetch[0][1])))
+            pw.inject(stacked, [pid for pid, _ in fetch])
+        # Publish in the STAGING prefix cache (no on_commit hook there
+        # — the tier copy survives for the decode-side handoff fetch)
+        # and extend the resident run so the chunk stream skips the
+        # fetched pages.
+        pw.manager.commit_pages(slot, [pid for pid, _ in fetch])
+        pw.manager.note_tier_hits(slot, pos)
+        self.stats_counters["tier_hits"] += len(fetch)
+        self.stats_counters["worker_prefetched_pages"] += len(fetch)
+        return len(fetch)
 
     # -- handoff: allocate decode pages, migrate, activate -----------
 

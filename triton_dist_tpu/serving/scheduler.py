@@ -81,9 +81,11 @@ class RequestHandle:
     ``status``: queued → prefill → running → one of
     done | failed | timeout; the tiered-KV verbs add parked (KV
     offloaded, no slot, waiting for ``resume()``) and resuming (tier
-    payload scattering back, activated next tick). ``tokens`` grows
-    as the request decodes (``stream_cb`` sees each append);
-    ``error`` carries the failure.
+    payload scattering back, activated next tick); the fleet router
+    adds shed (dropped by deadline class under fleet loss — terminal,
+    surfaced separately from failures). ``tokens`` grows as the
+    request decodes (``stream_cb`` sees each append); ``error``
+    carries the failure.
     """
 
     request: Request
@@ -132,7 +134,7 @@ class RequestHandle:
 
     @property
     def done(self) -> bool:
-        return self.status in ("done", "failed", "timeout")
+        return self.status in ("done", "failed", "timeout", "shed")
 
 
 class Scheduler:

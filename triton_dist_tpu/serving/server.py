@@ -231,9 +231,14 @@ class ServingEngine:
         # The telemetry sink rides the SAME injectable clock as the
         # scheduler, so fake-clock tests see deterministic timelines;
         # built first — the draft, chunker, and layer-path plumbing
-        # below all hold a reference.
-        self.obs = Telemetry(telemetry, clock=clock,
-                             capacity=telemetry_capacity)
+        # below all hold a reference. Passing a Telemetry INSTANCE
+        # shares one timeline across engines (the fleet router's
+        # merged-fleet view — docs/serving.md, "Fleet serving").
+        if isinstance(telemetry, Telemetry):
+            self.obs = telemetry
+        else:
+            self.obs = Telemetry(telemetry, clock=clock,
+                                 capacity=telemetry_capacity)
         self._trace_session = None
 
         kv_quant_spec(kv_dtype)        # validate the knob early
@@ -294,6 +299,16 @@ class ServingEngine:
         # the scatter overlaps the decode dispatches in between.
         self._parked: dict = {}
         self._resuming: List = []
+        # Router-time predictive prefetch (docs/serving.md, "Fleet
+        # serving"): prefix payloads whose tier_transfer already ran
+        # at ROUTE time — the admission-time fetch consumes them
+        # without a second transfer, so the hop overlaps queue wait.
+        # Bounded drop-oldest; entries are popped on use and whenever
+        # the same key re-publishes in HBM (on_commit below).
+        from collections import OrderedDict as _OD
+
+        self._tier_warm: "_OD" = _OD()
+        self._tier_warm_cap = 32
 
         self.engine = engine
         self.mega = isinstance(engine, MegaKernelEngine)
@@ -336,6 +351,7 @@ class ServingEngine:
             "retries": 0, "failovers": 0, "restored_requests": 0,
             "tier_hits": 0, "tier_misses": 0, "offloaded_pages": 0,
             "prefetched_pages": 0, "parks": 0, "resumes": 0,
+            "router_prefetched_pages": 0, "worker_prefetched_pages": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -629,9 +645,14 @@ class ServingEngine:
             # And the dual direction: a key committing into the HBM
             # cache (first publication OR a recompute after a faulted
             # prefetch) drops any stale tier copy -- exactly one
-            # authoritative tier per page, always.
-            self.manager.on_commit = (
-                lambda key: self.tiers.pop(("prefix", key), None))
+            # authoritative tier per page, always. The router-time
+            # warm buffer is a copy of the tier payload, so it goes
+            # with it.
+            def _on_commit(key):
+                self.tiers.pop(("prefix", key), None)
+                self._tier_warm.pop(key, None)
+
+            self.manager.on_commit = _on_commit
 
         self._verify = None
         if self.spec_k:
@@ -1336,10 +1357,15 @@ class ServingEngine:
             # In-place chunked prefill: tier-resident prefix pages
             # prefetch straight into the serving pool and the chunk
             # stream starts PAST them — the compute skip that turns a
-            # demoted cold prefix back into a (slower) cache hit. (A
-            # disaggregated prefill worker stages in its own pool; its
-            # decode-side tier fetch happens at handoff instead.)
+            # demoted cold prefix back into a (slower) cache hit.
             self._tier_prefill_fetch(h, slot)
+        else:
+            # Disaggregated prefill WORKER: tier-resident leading
+            # pages scatter into the staging pool so the chunk stream
+            # skips their compute too (the decode-side handoff fetch
+            # is unchanged — it pops the tier entry when the decode
+            # pool becomes authoritative).
+            self._tier_worker_fetch(h, slot)
         h.resident = p.manager.prefix_hits(slot) * self.page
         h.lane = seq
         h.prompt_pos = min(h.resident, len(seq) - 1)
@@ -1389,6 +1415,14 @@ class ServingEngine:
                        on_retry=_note,
                        event_cb=(self.obs.event if self.obs.spans_on
                                  else None))
+
+    def _tier_worker_fetch(self, h: RequestHandle, slot: int) -> int:
+        """Staging-pool tier fetch hook — a no-op on the in-place
+        chunk path (the disaggregated subclass scatters tier-resident
+        leading pages into its prefill WORKER's staging pool so the
+        chunk stream skips their compute; docs/serving.md, 'KV memory
+        hierarchy')."""
+        return 0
 
     # Role-health hooks (no-ops here): the disaggregated subclass
     # tracks per-role heartbeats/failures and fails over a dead
@@ -1524,6 +1558,69 @@ class ServingEngine:
         self.cache = self._tier_scatter(self.cache, *padded,
                                         jnp.asarray(ids))
 
+    def _tier_fetch_prefix(self, key):
+        """One prefix payload off the tier: the router-time warm
+        buffer when present (its transfer already ran at route time),
+        else a live ``tier_transfer`` hop. Raises the transfer's fault
+        past retries; returns None on a genuine miss."""
+        warm = self._tier_warm.pop(key, None)
+        if warm is not None:
+            return warm
+        return self._run_op_with_retry(
+            "tier_transfer", lambda: self.tiers.get(("prefix", key)))
+
+    def _tier_resident_prefix(self, key) -> bool:
+        """Is ``key``'s payload reachable below HBM (tier entry or the
+        router-time warm buffer)? The affinity/prefetch membership
+        test — never transfers."""
+        return (key in self._tier_warm
+                or ("prefix", key) in self.tiers)
+
+    def tier_prefetch(self, tokens) -> int:
+        """Router-time predictive prefetch (ROADMAP item 4 remainder):
+        run the ``tier_transfer`` hop for the prompt's tier-resident
+        leading prefix run NOW — at routing time — into a host-side
+        warm buffer the admission-time fetch consumes without a second
+        transfer, so the (disk unspill / bridge hop) latency overlaps
+        queue wait instead of starting at admission. Walks
+        ``BlockManager.iter_prefix_keys`` — the same chain
+        ``alloc_prefill`` consumes: HBM-resident keys extend the run,
+        the first genuinely cold key ends it. Safe no-op without tiers/prefix-reuse (the
+        admission-time fetch is unchanged when routing is off).
+        Returns the page count warmed."""
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+
+        if (self.tiers is None or self.manager is None
+                or not self.manager.prefix_reuse):
+            return 0
+        t0 = self.obs.now()
+        fetched = 0
+        for key in self.manager.iter_prefix_keys(tokens):
+            if key in self.manager._prefix:
+                continue              # HBM-resident: the run goes on
+            if key in self._tier_warm:
+                continue              # already warmed
+            if ("prefix", key) not in self.tiers:
+                break                 # genuinely cold: run ends
+            try:
+                arrays = self._run_op_with_retry(
+                    "tier_transfer",
+                    lambda k=key: self.tiers.get(("prefix", k)))
+            except (CommTimeoutError, faults.InjectedFault):
+                break                 # faulted past retries: a miss
+            if arrays is None:
+                break
+            self._tier_warm[key] = arrays
+            while len(self._tier_warm) > self._tier_warm_cap:
+                self._tier_warm.popitem(last=False)
+            fetched += 1
+        if fetched:
+            self.stats_counters["router_prefetched_pages"] += fetched
+            self.obs.complete_span("kv_prefetch", t0, pages=fetched,
+                                   payload="router")
+        return fetched
+
     def _demote_prefix_page(self, key, pid) -> bool:
         """BlockManager eviction hook: offload one cold committed
         prefix page into the tier store BEFORE its HBM page frees
@@ -1583,9 +1680,7 @@ class ServingEngine:
                     continue
                 break
             try:
-                arrays = self._run_op_with_retry(
-                    "tier_transfer",
-                    lambda k=key: self.tiers.get(("prefix", k)))
+                arrays = self._tier_fetch_prefix(key)
             except (CommTimeoutError, faults.InjectedFault):
                 arrays = None            # faulted past retries: a miss
             if arrays is None:
