@@ -4,8 +4,8 @@ PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
-	qblock-smoke obs-smoke tier-smoke fleet-smoke apicheck ci \
-	bench-all
+	qblock-smoke obs-smoke tier-smoke fleet-smoke \
+	mega-parity-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -111,6 +111,16 @@ tier-smoke: csrc
 # serving").
 fleet-smoke: csrc
 	bash scripts/fleet_smoke.sh
+
+# Megakernel serving-parity battery: quantized-KV token agreement +
+# capacity gates, Q-block speculation token-exact vs the non-spec mk
+# run, schema checkpoint/restore resuming mid-stream, a
+# bit-identical-streams chat e2e with --megakernel --kv-quant int8
+# --spec, and the non-null megakernel_decode_quant_ms /
+# megakernel_tokens_per_s_spec bench gate (docs/megakernel.md,
+# "Arena schema").
+mega-parity-smoke: csrc
+	bash scripts/mega_parity_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

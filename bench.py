@@ -431,6 +431,75 @@ def _interpret_megakernel_times() -> dict:
     return out
 
 
+def _interpret_mega_parity() -> dict:
+    """Megakernel serving parity on the interpret mesh: the paged
+    persistent lane's decode-step wall time per kv_dtype (fused
+    quantize-on-write / dequantize-on-read vs the fp32 pools) and the
+    Q-block speculative tokens/s vs the non-spec lane on the same
+    repetitive trace — the serving-speed keys the layer path has had
+    since PR 8, now with megakernel values (interpret overhead, not
+    silicon; presence + relative shape are the signal)."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend warmup
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models.config import ModelConfig
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    kw = dict(batch=2, max_len=32, tile_w=16, t_tile=16, paged=True,
+              page=16, num_pages=5)
+
+    out = {"megakernel_decode_quant_ms": {},
+           "megakernel_tokens_per_s_spec": {}}
+    for kvd in ("bf16", "int8", "fp8"):
+        mk = MegaKernelEngine(cfg, mesh, kv_dtype=kvd, **kw)
+        s = ServingEngine(mk, kv_dtype=kvd)
+        s.generate([[1, 2, 3]], max_new_tokens=2)    # compile warmup
+        s.submit([4, 5, 6], max_new_tokens=6)
+        s.submit([7, 8], max_new_tokens=6)
+        n0 = s.stats()["decode_dispatches"]
+        t0 = time.perf_counter()
+        s.run()
+        dt = time.perf_counter() - t0
+        n = s.stats()["decode_dispatches"] - n0
+        out["megakernel_decode_quant_ms"][kvd] = round(
+            dt * 1e3 / max(n, 1), 3)
+
+    # Q-block speculation on/off over the repetitive greedy trace (the
+    # workload the n-gram draft wins on): tokens/s including the
+    # prefill-lane ticks, plus the accept rate and the one-entry
+    # verification jit gate.
+    spec_trace = [[1, 2, 3, 1, 2, 3, 1, 2], [7, 8, 7, 8, 7, 8]]
+    out["megakernel_spec_accept_rate"] = None
+    for name, k in (("nospec", 0), ("spec", 2)):
+        mk = MegaKernelEngine(cfg, mesh, spec_k=k,
+                              schedule="dynamic" if k else "static",
+                              **kw)
+        s = ServingEngine(mk, spec_k=k)
+        s.generate(spec_trace, max_new_tokens=8)     # compile warmup
+        for c in s.stats_counters:
+            s.stats_counters[c] = type(s.stats_counters[c])(0)
+        t0 = time.perf_counter()
+        s.generate(spec_trace, max_new_tokens=16)
+        dt = time.perf_counter() - t0
+        st = s.stats()
+        out["megakernel_tokens_per_s_spec"][name] = round(
+            st["tokens_generated"] / max(dt, 1e-9), 2)
+        if k:
+            out["megakernel_spec_accept_rate"] = (
+                None if st["spec"]["accept_rate"] is None
+                else round(st["spec"]["accept_rate"], 4))
+            assert st["spec"]["tokens_per_dispatch"] > 1.0, (
+                "megakernel speculation never amortized a dispatch")
+    return out
+
+
 def _interpret_serving_times() -> dict:
     """Serving throughput on the CPU mesh: the continuous-batching
     ServingEngine vs gang ("static") batching over the SAME engine and
@@ -1029,6 +1098,14 @@ def _interpret_bench(reason: str) -> None:
               "fleet_shed_requests": None,
               "router_affinity_hit_rate": None,
               "fleet_error": str(e)[:300]}
+    try:
+        mp = _interpret_mega_parity()
+    except Exception as e:  # mk parity bench must not sink the record
+        # Nulled, NOT omitted: the mega_parity_smoke gate greps these.
+        mp = {"megakernel_decode_quant_ms": None,
+              "megakernel_tokens_per_s_spec": None,
+              "megakernel_spec_accept_rate": None,
+              "mega_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -1055,6 +1132,7 @@ def _interpret_bench(reason: str) -> None:
             **ch,
             **ti,
             **fl,
+            **mp,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),

@@ -68,14 +68,17 @@ def main():
                          "kernel (see docs/serving.md)")
     ap.add_argument("--kv-quant", default="bf16",
                     choices=["bf16", "int8", "fp8"],
-                    help="layer-path KV pool storage: int8/fp8 stores "
-                         "pages quantized with per-page scales (2-4x "
-                         "capacity, bounded divergence; see "
-                         "docs/serving.md)")
+                    help="KV pool storage (both lanes): int8/fp8 "
+                         "stores pages quantized with per-page scales "
+                         "(2-4x capacity, bounded divergence; with "
+                         "--megakernel the persistent lane's arena "
+                         "pools quantize too; see docs/serving.md)")
     ap.add_argument("--spec", action="store_true",
-                    help="speculative decoding (layer path): n-gram "
+                    help="speculative decoding (both lanes): n-gram "
                          "self-draft + one K-token verification "
-                         "dispatch, token-exact greedy outputs")
+                         "dispatch, token-exact greedy outputs (with "
+                         "--megakernel: the Q-block verification "
+                         "task)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="--spec: candidates per verification "
                          "dispatch (static K; jit cache stays flat)")
@@ -115,11 +118,12 @@ def main():
                          "bit-identical to an unkilled run "
                          "(scripts/fleet_smoke.sh gates on it)")
     ap.add_argument("--checkpoint-dir", default=None,
-                    help="layer path: snapshot the full serving state "
-                         "(paged pools + scales, allocator, queue, "
-                         "counters) here on SIGTERM, and RESUME from "
-                         "an existing snapshot on startup — restored "
-                         "requests finish token-exact mid-stream "
+                    help="snapshot the full serving state (paged "
+                         "pools + scales, allocator, queue, counters; "
+                         "--megakernel: the arena by schema) here on "
+                         "SIGTERM, and RESUME from an existing "
+                         "snapshot on startup — restored requests "
+                         "finish token-exact mid-stream "
                          "(docs/serving.md, checkpoint/restore)")
     ap.add_argument("--checkpoint-after", type=int, default=0,
                     help="drill flag for the SIGTERM path: checkpoint "
@@ -172,18 +176,15 @@ def main():
         sys.exit("--transport/--replica-slots route the layer path's "
                  "EP decode dispatch; the megakernel serves experts "
                  "in-kernel (use --moe-ep without --megakernel)")
-    if args.megakernel and (args.kv_quant != "bf16" or args.spec):
-        sys.exit("--kv-quant/--spec are layer-path knobs; the "
-                 "megakernel decode lane has no per-page scale or "
-                 "verification plumbing (see docs/serving.md)")
+    if args.megakernel and args.mk_model == "hybrid" and (
+            args.kv_quant != "bf16" or args.spec):
+        sys.exit("--kv-quant/--spec cover the attention families; the "
+                 "hybrid GDN recurrent state is neither paged nor "
+                 "rewindable (see docs/serving.md)")
     if args.megakernel and args.attn_impl != "ref":
         sys.exit("--attn-impl routes the layer path's paged "
                  "attention; the megakernel's attention task has its "
                  "own in-arena lane (see docs/serving.md)")
-    if args.megakernel and (args.checkpoint_dir or args.checkpoint_after):
-        sys.exit("--checkpoint-dir is a layer-path feature; the "
-                 "megakernel's KV lives in its in-kernel arena "
-                 "(see docs/serving.md)")
     if args.checkpoint_after and not args.checkpoint_dir:
         sys.exit("--checkpoint-after needs --checkpoint-dir (it is the "
                  "deterministic drill for that snapshot path)")
@@ -313,11 +314,33 @@ def main():
         mesh1d = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
         # One engine for the whole session; the ServingEngine streams
         # prompts through its prefill lane, so slot count = batch.
+        # Quantized KV, speculation, and checkpointing all ride the
+        # PAGED arena (per-page scales / block-table verification /
+        # schema snapshots); the plain run keeps the original dense
+        # cache.
+        mk_paged = bool(args.kv_quant != "bf16" or args.spec
+                        or args.checkpoint_dir)
+        mk_kw = {}
+        if mk_paged:
+            page = 16
+            if args.max_len % page:
+                sys.exit(f"--megakernel with serving knobs pages the "
+                         f"arena at {page} tokens; --max-len must be "
+                         f"a multiple of {page}")
+            mk_kw = dict(paged=True, page=page,
+                         num_pages=args.tp * (args.max_len // page) + 1,
+                         kv_dtype=args.kv_quant,
+                         spec_k=args.spec_k if args.spec else 0)
+            if args.spec:
+                # The scoreboard claims hot verification chains first.
+                mk_kw["schedule"] = "dynamic"
         mk = MegaKernelEngine(cfg, mesh1d, batch=args.tp,
                               max_len=args.max_len, tile_w=16,
                               t_tile=16,
-                              profile=bool(args.trace_out))
-        srv = ServingEngine(mk, telemetry=telemetry)
+                              profile=bool(args.trace_out), **mk_kw)
+        srv = ServingEngine(mk, telemetry=telemetry,
+                            kv_dtype=args.kv_quant,
+                            spec_k=args.spec_k if args.spec else 0)
     elif args.disagg:
         from triton_dist_tpu.models import dense
 
@@ -557,6 +580,12 @@ def main():
     if st.get("kv_dtype") not in (None, "bf16"):
         line += (f", kv_dtype={st['kv_dtype']} "
                  f"({st['kv_bytes_per_token']:.0f} B/token)")
+    if args.megakernel:
+        # Lane-capability line: smoke scripts gate on this instead of
+        # grepping tracebacks for the old layer-path-only rejects.
+        line += (f", mk: kv_dtype={st['mk_kv_dtype']} "
+                 f"spec={st['mk_spec']} checkpointable="
+                 f"{'yes' if st['mk_checkpointable'] else 'no'}")
     if args.kv_tiers:
         rate = st.get("kv_hot_hit_rate")
         line += (f", tiers: offloaded={st['offloaded_pages']} "

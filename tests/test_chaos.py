@@ -154,22 +154,93 @@ def test_soak_worker_kill_only(tiny_factory):
     assert rep.token_exact_requests == rep.requests["done"]
 
 
-def test_soak_rejects_megakernel():
+# One engine per build config for the module: each factory() call
+# wraps the SAME engine in a fresh ServingEngine — safe because the
+# soak's factory re-invocations are strictly sequential (the restore
+# drill overwrites pools/scales wholesale; the oracle runs only after
+# the soak srv drained) and engine builds dominate wall clock.
+_MK_ENGINES: dict = {}
+
+
+def _mk_factory(**kw):
     from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
-    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
-                           intermediate_size=32, num_hidden_layers=2,
-                           num_attention_heads=4,
-                           num_key_value_heads=2, head_dim=8)
-    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    key = tuple(sorted(kw.items()))
+    if key not in _MK_ENGINES:
+        cfg = ModelConfig.tiny(vocab_size=128)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+        _MK_ENGINES[key] = MegaKernelEngine(
+            cfg, mesh, batch=2, max_len=32, tile_w=16, t_tile=16,
+            paged=True, page=16, num_pages=5, **kw)
 
     def factory():
-        return ServingEngine(MegaKernelEngine(cfg, mesh, batch=2,
-                                              max_len=32, tile_w=16,
-                                              t_tile=16))
+        return ServingEngine(_MK_ENGINES[key], **(
+            {"kv_dtype": kw["kv_dtype"]} if "kv_dtype" in kw else {}))
 
-    with pytest.raises(NotImplementedError):
-        chaos.run_soak(factory, seed=0, ticks=2, n_faults=0)
+    return factory
+
+
+def test_soak_megakernel_with_restore():
+    """The converted mk-reject: the chaos soak drives the PERSISTENT
+    lane too — seeded decode drops/wedges under MK_FAULT_KINDS, the
+    mid-run kill/checkpoint/restore drill through the schema snapshot,
+    the extended arena-coherence sweep (region disjointness, scale
+    sanity, monotonic counters) after EVERY tick, and survivors
+    token-exact vs a fault-free serving oracle."""
+    rep = chaos.run_soak(_mk_factory(), seed=3, ticks=30, n_faults=3,
+                         kinds=chaos.MK_FAULT_KINDS, restore_at=15,
+                         gen_choices=(2, 3), arrival_p=0.4)
+    assert rep.faults_injected == 3
+    assert rep.restored_at == 15
+    assert rep.requests["done"] >= 1
+    assert rep.token_exact_requests == rep.requests["done"]
+    assert rep.invariant_checks >= 30
+
+
+def test_soak_megakernel_quantized():
+    """Quantized mk soak: the scale-sanity half of the arena sweep
+    runs against live int8 pools under decode faults."""
+    rep = chaos.run_soak(_mk_factory(kv_dtype="int8"), seed=5,
+                         ticks=20, n_faults=2,
+                         kinds=chaos.MK_FAULT_KINDS,
+                         gen_choices=(2, 3), arrival_p=0.4)
+    assert rep.faults_injected == 2
+    assert rep.token_exact_requests == rep.requests["done"]
+
+
+def test_arena_checker_catches_corruption():
+    """A checker that cannot fail gates nothing: a clobbered scale
+    plane and a backwards counter must raise InvariantViolation."""
+    import jax.numpy as jnp
+
+    srv = _mk_factory(kv_dtype="int8")()
+    srv.generate([[1, 2, 3]], max_new_tokens=2)
+    chaos.check_invariants(srv)               # healthy passes
+    good = srv.engine.k_scale
+    srv.engine.k_scale = jnp.asarray(good).at[0, 1, 0, 0].set(-1.0)
+    with pytest.raises(chaos.InvariantViolation, match="scale"):
+        chaos.check_invariants(srv)
+    srv.engine.k_scale = good
+    chaos.check_invariants(srv)
+
+    moe = ModelConfig.tiny_moe(vocab_size=64, hidden_size=32,
+                               num_hidden_layers=2,
+                               num_attention_heads=4,
+                               num_key_value_heads=2, head_dim=8,
+                               num_experts=4, num_experts_per_tok=2,
+                               moe_intermediate_size=32)
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    msrv = ServingEngine(MegaKernelEngine(moe, mesh, batch=2,
+                                          max_len=32, tile_w=16,
+                                          t_tile=16, paged=True,
+                                          page=16, num_pages=5))
+    msrv.generate([[1, 2]], max_new_tokens=2)
+    chaos.check_invariants(msrv)              # seeds the counter sweep
+    msrv._mk_counts_sweep = msrv._mk_counts_sweep + 10
+    with pytest.raises(chaos.InvariantViolation, match="BACKWARDS"):
+        chaos.check_invariants(msrv)
 
 
 # ---------------------------------------------------------------------------

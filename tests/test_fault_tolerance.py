@@ -517,21 +517,86 @@ def test_disagg_checkpoint_requeues_inflight(role_engines):
     assert srv2_stats["restored_requests"] == 2
 
 
-def test_megakernel_checkpoint_rejected():
+# One megakernel engine per kv_dtype for the module: restore()
+# overwrites pools/scales wholesale, so even the "fresh process"
+# half of the round-trip can share the engine (what a real fresh
+# process repacks — the weights — is identical by construction).
+_MK_ENGINES: dict = {}
+
+
+def _mk_serving(kv_dtype="bf16"):
     from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
-    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
-                           intermediate_size=32, num_hidden_layers=2,
-                           num_attention_heads=4,
-                           num_key_value_heads=2, head_dim=8)
-    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    mk = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
-                          t_tile=16)
-    srv = ServingEngine(mk)
-    with pytest.raises(NotImplementedError):
-        srv.checkpoint()
-    with pytest.raises(NotImplementedError):
-        srv.restore({"meta": {}})
+    if kv_dtype not in _MK_ENGINES:
+        cfg = ModelConfig.tiny(vocab_size=128)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+        _MK_ENGINES[kv_dtype] = MegaKernelEngine(
+            cfg, mesh, batch=2, max_len=32, tile_w=16, t_tile=16,
+            paged=True, page=16, num_pages=5, kv_dtype=kv_dtype)
+    return ServingEngine(_MK_ENGINES[kv_dtype], kv_dtype=kv_dtype)
+
+
+@pytest.mark.parametrize("kvd", ["bf16", "int8"])
+def test_megakernel_checkpoint_restore_token_exact(kvd):
+    """The converted mk-reject: a schema-driven checkpoint (KV pools +
+    scale tables + counters by arena-region name) restores into a
+    FRESH megakernel engine and resumes mid-stream decode token-exact
+    — bit-exact pools at bf16 AND int8. A mid-prefill-LANE request
+    snapshots as queued and re-prefills deterministically."""
+    prompts = [[5, 6, 7], [3, 4]]
+    want = _mk_serving(kvd).generate(prompts, max_new_tokens=6)
+    srv = _mk_serving(kvd)
+    h0 = srv.submit(prompts[0], max_new_tokens=6)
+    for _ in range(6):       # h0 mid-decode
+        srv.step()
+    h1 = srv.submit(prompts[1], max_new_tokens=6)
+    srv.step()               # h1 mid-prefill-lane
+    assert h0.status == "running" and h0.tokens
+    snap = srv.checkpoint()
+    fresh = _mk_serving(kvd)
+    revived = {h.request.request_id: h for h in fresh.restore(snap)}
+    fresh.run()
+    got = [revived[h0.request.request_id].tokens,
+           revived[h1.request.request_id].tokens]
+    assert got == want, (kvd, got, want)
+    assert fresh.stats()["restored_requests"] == 2
+    assert fresh.stats()["mk_checkpointable"] is True
+    chaos.check_invariants(fresh)
+
+
+def test_megakernel_checkpoint_file_roundtrip(tmp_path):
+    """The pickle path carries the mk snapshot too (int8 pool bytes
+    view-round-trip through numpy, scale planes exact)."""
+    from triton_dist_tpu.serving.server import (load_checkpoint,
+                                                save_checkpoint)
+
+    srv = _mk_serving("int8")
+    srv.submit([5, 6, 7], max_new_tokens=6)
+    for _ in range(5):
+        srv.step()
+    snap = srv.checkpoint()
+    p = save_checkpoint(snap, str(tmp_path / "mk.ckpt"))
+    snap2 = load_checkpoint(p)
+    np.testing.assert_array_equal(
+        snap["cache"]["k_cache"].view(np.uint8),
+        snap2["cache"]["k_cache"].view(np.uint8))
+    np.testing.assert_array_equal(snap["cache"]["k_scale"],
+                                  snap2["cache"]["k_scale"])
+    fresh = _mk_serving("int8")
+    revived = fresh.restore(snap2)
+    fresh.run()
+    assert all(h.status == "done" for h in revived)
+
+
+def test_megakernel_checkpoint_meta_mismatch_rejected():
+    """A layer-path snapshot cannot restore into an mk engine (and
+    vice versa): the engine_kind meta key fails the plan check."""
+    srv = _mk_serving()
+    snap = srv.checkpoint()
+    snap["meta"]["engine_kind"] = "layer"
+    fresh = _mk_serving()
+    with pytest.raises(ValueError, match="plan mismatch"):
+        fresh.restore(snap)
 
 
 # ---------------------------------------------------------------------------

@@ -209,18 +209,100 @@ def test_quantized_spec_composes(engine):
     assert got == want
 
 
-def test_megakernel_rejects_kv_quant():
+def _mk_cfg():
+    return ModelConfig.tiny(vocab_size=128)
+
+
+# One megakernel engine per kv_dtype for the whole module: engine
+# builds dominate the battery's wall clock, and reuse is exactly the
+# serving layer's slot-recycling contract (positions rewrite, lengths
+# mask — stale pool bytes are never read).
+_MK_CACHE: dict = {}
+
+
+def _mk_engine(**kw):
     from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
-    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
-                           intermediate_size=32, num_hidden_layers=2,
-                           num_attention_heads=4, num_key_value_heads=2,
-                           head_dim=8)
+    key = tuple(sorted(kw.items()))
+    if key not in _MK_CACHE:
+        mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+        base = dict(batch=2, max_len=32, tile_w=16, t_tile=16,
+                    paged=True, page=16, num_pages=5)
+        base.update(kw)
+        _MK_CACHE[key] = MegaKernelEngine(_mk_cfg(), mesh, **base)
+    return _MK_CACHE[key]
+
+
+MK_PROMPTS = [[5, 6, 7], [3, 4], [9, 10, 11, 12], [1]]
+
+
+def test_megakernel_bf16_still_bit_identical():
+    """The quantization machinery existing must not perturb the
+    unquantized persistent lane: kv_dtype='bf16' serving tokens equal
+    solo runs on a fresh engine (the pre-existing mk contract), and
+    the jitted step count stays flat after warmup."""
+    want = ServingEngine(_mk_engine()).generate(MK_PROMPTS,
+                                                max_new_tokens=6)
+    srv = ServingEngine(_mk_engine(), kv_dtype="bf16")
+    assert srv.engine.k_scale is None     # bf16 = no scale tables
+    got = srv.generate(MK_PROMPTS, max_new_tokens=6)
+    assert got == want
+    n = srv.decode_cache_size()
+    srv.generate([[2, 4]], max_new_tokens=3)
+    assert srv.decode_cache_size() == n, "mk decode step re-specialized"
+
+
+@pytest.mark.parametrize("kvd,min_agree", [("int8", 0.7), ("fp8", 0.5)])
+def test_megakernel_quant_decode_token_agreement(kvd, min_agree):
+    """The converted mk-reject: int8/fp8 pools on the persistent lane
+    decode token-AGREEING with the layer-path quantized contract's
+    bar (fused quantize-on-write / dequantize-on-read vs the fp32
+    pools), surfaced via compare_greedy, with the jit cache flat."""
+    want = ServingEngine(_mk_engine()).generate(MK_PROMPTS,
+                                                max_new_tokens=6)
+    srv = ServingEngine(_mk_engine(kv_dtype=kvd), kv_dtype=kvd)
+    got = srv.generate(MK_PROMPTS, max_new_tokens=6)
+    agree = srv.compare_greedy(zip(got, want))
+    st = srv.stats()
+    assert st["greedy_agreement"] == agree
+    assert agree >= min_agree, (kvd, agree, got, want)
+    assert st["kv_dtype"] == kvd
+    assert st["mk_kv_dtype"] == kvd
+    n = srv.decode_cache_size()
+    srv.generate([[2, 4]], max_new_tokens=3)
+    assert srv.decode_cache_size() == n, "mk decode step re-specialized"
+
+
+def test_megakernel_int8_capacity_ratio_gate():
+    """The capacity win is planned and reported on the mk lane too:
+    int8 >= 1.9x pages at fixed pool bytes vs the fp32-native pools
+    (BlockManager stats + the model plan, like the layer path)."""
+    srv = ServingEngine(_mk_engine(kv_dtype="int8"), kv_dtype="int8")
+    pool = srv.stats()["pool"]
+    assert pool["capacity_ratio_vs_native"] >= 1.9, pool
+    assert srv.plan["capacity_ratio_vs_native"] >= 1.9
+    assert srv.stats()["kv_bytes_per_token"] < srv.plan[
+        "native_page_bytes_per_rank"] / 16
+
+
+def test_megakernel_quant_knob_validation():
+    """kv_dtype is an ENGINE knob on the mk lane: a serving/engine
+    mismatch, a dense (non-paged) build, and a hybrid build all fail
+    loudly with actionable messages."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    mk = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
-                          t_tile=16)
-    with pytest.raises(ValueError, match="layer-path knob"):
-        ServingEngine(mk, kv_dtype="int8")
+    with pytest.raises(ValueError, match="kv_dtype mismatch"):
+        ServingEngine(_mk_engine(), kv_dtype="int8")
+    with pytest.raises(ValueError, match="paged"):
+        MegaKernelEngine(_mk_cfg(), mesh, batch=2, max_len=32,
+                         tile_w=16, t_tile=16, kv_dtype="int8")
+    hcfg = ModelConfig.tiny_next(vocab_size=128, num_key_value_heads=4,
+                                 full_attn_interval=2)
+    with pytest.raises(NotImplementedError, match="hybrid"):
+        MegaKernelEngine(hcfg, mesh, batch=2, max_len=32, tile_w=16,
+                         t_tile=16, paged=True, page=16,
+                         kv_dtype="int8")
 
 
 def test_bad_kv_dtype_rejected(engine):

@@ -987,3 +987,77 @@ def test_megakernel_serves_real_checkpoints(tp2_mesh):
         np.testing.assert_array_equal(
             toks, ref,
             err_msg=f"megakernel vs layer engine diverged on {fixture}")
+
+
+# ---------------------------------------------------------------------------
+# Arena schema: the described memory layout (PR: megakernel serving
+# parity) — every region named, disjoint, and addressable.
+# ---------------------------------------------------------------------------
+
+def test_arena_schema_regions_disjoint_and_named(tp2_mesh):
+    """Every _alloc lands in the schema with a name + kind; the
+    in-arena regions tile [0, arena_rows) exactly (no overlap, no
+    gap) and the legacy offset table agrees with the schema."""
+    mb = ModelBuilder(CFG, tp2_mesh, batch=B, max_len=MAXLEN,
+                      tile_w=16, t_tile=16)
+    mb.schema.check_disjoint()
+    assert mb.schema.rows == mb.arena_rows
+    for name, off in mb._offsets.items():
+        assert mb.schema.region(name).offset == off
+    kinds = {r.kind for r in mb.schema}
+    assert {"weight", "activation", "workspace", "io"} <= kinds
+    # Weight rows match the pack manifest the arena assembler uses.
+    wrows = sum(r.rows for r in mb.schema.regions(kind="weight"))
+    assert wrows == sum(r for _, r in mb._weight_entries)
+
+
+def test_arena_schema_counter_and_buffers():
+    """MoE builds name their router-counter region; engines register
+    the KV pools (+ scale tables on quantized builds) as schema
+    buffers, and snapshot_regions() is exactly the checkpoint set."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
+    mcfg = ModelConfig.tiny_moe(vocab_size=64, hidden_size=32,
+                                num_hidden_layers=2,
+                                num_attention_heads=4,
+                                num_key_value_heads=2, head_dim=8,
+                                num_experts=4, num_experts_per_tok=2,
+                                moe_intermediate_size=32)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = MegaKernelEngine(mcfg, mesh, batch=2, max_len=32, tile_w=16,
+                           t_tile=16, paged=True, page=16, num_pages=5,
+                           kv_dtype="int8")
+    sch = eng.builder.schema
+    assert "moe_counts" in sch
+    assert sch.region("moe_counts").kind == "counter"
+    assert sch.region("moe_counts").offset == eng.builder.moe_counts_off
+    names = {r.name for r in sch.snapshot_regions()}
+    assert names == {"moe_counts", "k_cache", "v_cache", "k_scale",
+                     "v_scale"}
+    # describe() is plain data (the docs/diagnostics surface).
+    d = sch.describe()
+    assert any(e["name"] == "k_scale" and e["kind"] == "scale"
+               for e in d)
+    # Double allocation fails loudly.
+    with pytest.raises(ValueError, match="already allocated"):
+        sch.alloc("moe_counts", 1, "counter")
+
+
+def test_qblock_builder_schedules_verification_tasks():
+    """qblock=True swaps the KV pair for WRITE_KV_QBLOCK/ATTN_QBLOCK
+    (per-row-position verification tasks), requires paged, and keeps
+    the dynamic claim list covering every task exactly once."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    mb = ModelBuilder(CFG, mesh, batch=2 * 2, max_len=32, tile_w=16,
+                      t_tile=16, seq=2, qblock=True, paged=True,
+                      page=16, schedule="dynamic")
+    tt = set(int(t.task_type) for t in mb.graph.tasks)
+    assert int(TaskType.WRITE_KV_QBLOCK) in tt
+    assert int(TaskType.ATTN_QBLOCK) in tt
+    assert int(TaskType.WRITE_KV) not in tt
+    assert int(TaskType.ATTN_PREFILL) not in tt
+    claimed = sorted(int(t) for t in mb.claims.reshape(-1) if t >= 0)
+    assert claimed == list(range(len(mb.graph.tasks)))
+    with pytest.raises(ValueError, match="paged"):
+        ModelBuilder(CFG, mesh, batch=4, max_len=32, tile_w=16,
+                     t_tile=16, seq=2, qblock=True)

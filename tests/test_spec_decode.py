@@ -207,15 +207,91 @@ def test_spec_dropped_verification_fails_one_request(engine):
 # knob validation
 # ---------------------------------------------------------------------------
 
-def test_megakernel_rejects_spec():
+# One megakernel engine per build config for the whole module —
+# engine builds dominate wall clock, and reuse is the serving layer's
+# slot-recycling contract (positions rewrite, lengths mask).
+_MK_CACHE: dict = {}
+
+
+def _mk_engine(**kw):
     from triton_dist_tpu.megakernel.engine import MegaKernelEngine
 
-    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
-                           intermediate_size=32, num_hidden_layers=2,
-                           num_attention_heads=4, num_key_value_heads=2,
-                           head_dim=8)
+    key = tuple(sorted(kw.items()))
+    if key not in _MK_CACHE:
+        mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+        base = dict(batch=2, max_len=64, tile_w=16, t_tile=16,
+                    paged=True, page=16, num_pages=9)
+        base.update(kw)
+        _MK_CACHE[key] = MegaKernelEngine(
+            ModelConfig.tiny(vocab_size=128), mesh, **base)
+    return _MK_CACHE[key]
+
+
+def test_megakernel_spec_token_exact_vs_nonspec():
+    """The converted mk-reject: spec_k=2 on the megakernel under
+    schedule='dynamic' (the scoreboard claims the verification chains)
+    is token-exact vs the non-spec mk run on the repetitive trace —
+    the Q-block verification rows' logits are bit-identical to the
+    sequential decode body's, so greedy acceptance commits exactly
+    the sequential tokens — with > 1 tokens per dispatch measured."""
+    rep = [[1, 2, 3, 1, 2, 3, 1, 2], [7, 8, 7, 8, 7, 8]]
+    want = ServingEngine(_mk_engine()).generate(rep, max_new_tokens=16)
+    srv = ServingEngine(_mk_engine(spec_k=2, schedule="dynamic"),
+                        spec_k=2)
+    got = srv.generate(rep, max_new_tokens=16)
+    assert got == want
+    st = srv.stats()
+    assert st["spec"]["k"] == 2
+    assert st["spec"]["tokens_per_dispatch"] > 1.0, st["spec"]
+    assert st["mk_spec"] == 2
+    # The verification dispatch never re-specializes: requests
+    # joining/leaving, acceptance patterns, and budget-clamped tails
+    # are all data.
+    n = srv.decode_cache_size()
+    srv.generate([[4, 4, 4]], max_new_tokens=4)
+    assert srv.decode_cache_size() == n, "mk verify re-specialized"
+
+
+def test_megakernel_spec_eos_budget_and_sampled():
+    """EOS mid-block, a max_new budget smaller than K (over-budget
+    rows MASKED in-kernel, never touching real pages), and sampled
+    requests (one exact token per dispatch) all match the non-spec
+    megakernel run."""
+    want = ServingEngine(_mk_engine()).generate([[1, 2, 3]],
+                                                max_new_tokens=3)[0]
+    srv = ServingEngine(_mk_engine(spec_k=4), spec_k=4)
+    h = srv.submit([1, 2, 3], max_new_tokens=3)     # budget < K
+    srv.run()
+    assert h.tokens == want
+    eos = want[1]
+    srv2 = ServingEngine(_mk_engine(spec_k=4), spec_k=4)
+    h2 = srv2.submit([1, 2, 3], max_new_tokens=10, eos_id=eos)
+    srv2.run()
+    assert h2.tokens == want[:want.index(eos) + 1]
+    req = dict(max_new_tokens=5, temperature=0.8, top_k=4, seed=11)
+    base = ServingEngine(_mk_engine())
+    hb = base.submit([3, 1, 4], **req)
+    base.run()
+    spec = ServingEngine(_mk_engine(spec_k=4), spec_k=4)
+    hs = spec.submit([3, 1, 4], **req)
+    spec.run()
+    assert hs.tokens == hb.tokens
+
+
+def test_megakernel_spec_knob_validation():
+    """spec_k is an ENGINE knob on the mk lane: serving/engine
+    mismatch, non-paged builds, and hybrid builds fail loudly."""
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    mk = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
-                          t_tile=16)
-    with pytest.raises(ValueError, match="spec_k is a layer-path"):
-        ServingEngine(mk, spec_k=2)
+    with pytest.raises(ValueError, match="spec_k mismatch"):
+        ServingEngine(_mk_engine(), spec_k=2)
+    with pytest.raises(ValueError, match="paged"):
+        MegaKernelEngine(ModelConfig.tiny(vocab_size=128), mesh,
+                         batch=2, max_len=32, tile_w=16, t_tile=16,
+                         spec_k=2)
+    hcfg = ModelConfig.tiny_next(vocab_size=128, num_key_value_heads=4,
+                                 full_attn_interval=2)
+    with pytest.raises(NotImplementedError, match="hybrid"):
+        MegaKernelEngine(hcfg, mesh, batch=2, max_len=32, tile_w=16,
+                         t_tile=16, paged=True, page=16, spec_k=2)

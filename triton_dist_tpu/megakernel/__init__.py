@@ -32,5 +32,5 @@ from triton_dist_tpu.megakernel.scheduler import (  # noqa: F401
     simulate_static,
 )
 from triton_dist_tpu.megakernel.builder import (  # noqa: F401
-    ModelBuilder, calibrate_cost_table,
+    ArenaRegion, ArenaSchema, ModelBuilder, calibrate_cost_table,
 )

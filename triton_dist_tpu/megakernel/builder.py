@@ -41,6 +41,144 @@ def _cdiv(a, b):
     return -(-a // b)
 
 
+# Region kinds a serving checkpoint must carry: the KV pools and their
+# quantization scales, the hybrid recurrent state, and the in-arena
+# counters (everything else is weights — repacked from params — or
+# per-step activation scratch).
+SNAPSHOT_KINDS = ("kv", "scale", "state", "counter")
+
+# Kinds that occupy rows of the (rows, w) arena itself; the rest are
+# named DEVICE BUFFERS (KV pools, scale tables, GDN state) that ride
+# beside the arena through the kernel's aliased operands.
+ARENA_KINDS = ("weight", "activation", "workspace", "counter", "io")
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaRegion:
+    """One named region of the megakernel's memory layout.
+
+    In-arena kinds (``weight``/``activation``/``workspace``/
+    ``counter``/``io``) describe ``rows`` rows at ``offset`` of the
+    (arena_rows, w) arena; buffer kinds (``kv``/``scale``/``state``)
+    describe a standalone device array of ``shape``/``dtype`` that the
+    kernel addresses through its own aliased operand."""
+
+    name: str
+    kind: str
+    offset: int = 0
+    rows: int = 0
+    shape: Tuple[int, ...] = ()
+    dtype: str = "float32"
+
+    @property
+    def in_arena(self) -> bool:
+        return self.kind in ARENA_KINDS
+
+
+class ArenaSchema:
+    """Described memory layout of a megakernel build: every region —
+    weight tiles, activation tiles, the allreduce workspace, MoE
+    router counters, KV pools and their per-(layer, page, kv_head)
+    scale tables, GDN state — by name, with offset/rows (in-arena) or
+    shape/dtype (device buffers). Replaces the bare ``_alloc`` cursor
+    arithmetic: consumers (engine checkpoint/restore, the chaos
+    sweep's arena-coherence check, docs) address regions by NAME, so
+    adding a region is one ``alloc``/``add_buffer`` call, never
+    offset bookkeeping (see docs/megakernel.md, "Arena schema")."""
+
+    def __init__(self, w: int):
+        self.w = int(w)
+        self._regions: "Dict[str, ArenaRegion]" = {}
+        self._cursor = 0
+
+    # -- building ----------------------------------------------------
+    def alloc(self, name: str, rows: int, kind: str = "activation"
+              ) -> int:
+        """Claim ``rows`` arena rows for ``name``; returns the offset
+        (the cursor allocator, now with provenance)."""
+        if kind not in ARENA_KINDS:
+            raise ValueError(f"kind {kind!r} is not an in-arena kind "
+                             f"{ARENA_KINDS}")
+        if name in self._regions:
+            raise ValueError(f"arena region {name!r} already allocated")
+        off = self._cursor
+        self._regions[name] = ArenaRegion(name=name, kind=kind,
+                                          offset=off, rows=int(rows))
+        self._cursor += int(rows)
+        return off
+
+    def add_buffer(self, name: str, shape, dtype, kind: str) -> None:
+        """Register a named device buffer (KV pool, scale table, GDN
+        state) that lives beside the row arena."""
+        if kind in ARENA_KINDS:
+            raise ValueError(f"kind {kind!r} is an in-arena kind — use "
+                             "alloc()")
+        if name in self._regions:
+            raise ValueError(f"arena region {name!r} already allocated")
+        self._regions[name] = ArenaRegion(
+            name=name, kind=kind, shape=tuple(int(s) for s in shape),
+            dtype=str(dtype))
+
+    # -- reading -----------------------------------------------------
+    @property
+    def rows(self) -> int:
+        """Total arena rows claimed so far (the pack/zero footprint)."""
+        return self._cursor
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._regions
+
+    def __iter__(self):
+        return iter(self._regions.values())
+
+    def region(self, name: str) -> ArenaRegion:
+        return self._regions[name]
+
+    def regions(self, kind: Optional[str] = None):
+        """All regions, or just one kind's, in allocation order."""
+        return [r for r in self._regions.values()
+                if kind is None or r.kind == kind]
+
+    def snapshot_regions(self):
+        """The regions a checkpoint snapshots by name (KV + scales +
+        counters + GDN state — bit-exact at any kv_dtype)."""
+        return [r for r in self._regions.values()
+                if r.kind in SNAPSHOT_KINDS]
+
+    def check_disjoint(self) -> None:
+        """Arena coherence: in-arena regions must tile [0, rows) with
+        no overlap and no gap — the invariant the chaos sweep asserts
+        per tick (a drifted offset would silently alias a weight tile
+        onto an activation or counter)."""
+        spans = sorted((r.offset, r.offset + r.rows, r.name)
+                       for r in self._regions.values() if r.in_arena)
+        at = 0
+        for start, end, name in spans:
+            if start != at:
+                kind = "overlaps the previous region" \
+                    if start < at else "leaves an unclaimed gap"
+                raise ValueError(
+                    f"arena region {name!r} at [{start}, {end}) {kind} "
+                    f"(cursor was at {at})")
+            at = end
+        if at != self._cursor:
+            raise ValueError(
+                f"arena regions cover {at} rows but the cursor claims "
+                f"{self._cursor}")
+
+    def describe(self):
+        """Plain-data region table (docs / diagnostics)."""
+        out = []
+        for r in self._regions.values():
+            if r.in_arena:
+                out.append({"name": r.name, "kind": r.kind,
+                            "offset": r.offset, "rows": r.rows})
+            else:
+                out.append({"name": r.name, "kind": r.kind,
+                            "shape": list(r.shape), "dtype": r.dtype})
+        return out
+
+
 def calibrate_cost_table(observations) -> dict:
     """Profile-feedback calibration: solve per-task-type unit times
     from wall-clock observations of whole megakernel steps.
@@ -93,7 +231,8 @@ class ModelBuilder:
                  seq: int = 1, paged: bool = False,
                  page: Optional[int] = None, profile: bool = False,
                  cost_table: Optional[dict] = None,
-                 expert_load=None):
+                 expert_load=None, kv_quant: Optional[str] = None,
+                 qblock: bool = False):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -167,10 +306,50 @@ class ModelBuilder:
                             else None)
         # seq > 1: batched prefill — ``batch`` counts ROWS (B*S, b-major)
         # and the attention/cache tasks use the causal prefill bodies.
+        # qblock=True instead selects the Q-BLOCK VERIFICATION pair
+        # (WRITE_KV_QBLOCK/ATTN_QBLOCK): seq = K rows per slot, each
+        # row at its OWN per-row position (len_s[row]; < 0 masks the
+        # row) — the speculative-decode verification chain as one
+        # megakernel launch.
         self.seq = seq
+        self.qblock = bool(qblock)
         if batch % seq:
             raise ValueError(f"batch rows {batch} not divisible by "
                              f"seq {seq}")
+        if self.qblock:
+            if seq < 2:
+                raise ValueError("qblock builds verify K >= 2 "
+                                 f"candidates per slot (seq={seq})")
+            if not paged:
+                raise ValueError("the Q-block verification task set "
+                                 "addresses the cache through block "
+                                 "tables — build with paged=True")
+        # kv_quant: int8/fp8 pools with per-(layer, page, kv_head)
+        # fp32 scale tables riding as extra aliased operands —
+        # quantize fused into write_kv, dequant into every cache read.
+        # qmax comes from the layer path's ONE quantization table
+        # (kv_quant_spec), so the in-kernel quantizer can never
+        # silently diverge from serving.blocks._quantize.
+        self.kv_qmax = 0.0
+        if kv_quant is not None:
+            from triton_dist_tpu.serving.blocks import kv_quant_spec
+
+            qdtype, qmax = kv_quant_spec(kv_quant)
+            if qdtype is None:
+                kv_quant = None
+            else:
+                self.kv_qmax = float(qmax)
+        if kv_quant is not None:
+            if not paged:
+                raise ValueError(
+                    "quantized megakernel KV needs paged=True (scales "
+                    "are per (layer, page, kv_head))")
+            if seq > 1 and not self.qblock:
+                raise NotImplementedError(
+                    "the batched-prefill bodies have no fused-quant "
+                    "write; quantized engines stream prompts through "
+                    "the prefill lane (decode kernel)")
+        self.kv_quant = kv_quant
         hd = cfg.head_dim
         self.w = tile_w or max(128, hd)
         if self.w % hd:
@@ -189,7 +368,12 @@ class ModelBuilder:
         self.p_max = 0
         if paged:
             self.page = page or max(self.t_tile, seq)
-            if (self.page % self.t_tile or (seq > 1 and self.page % seq)
+            # qblock rows write one position each (never a seq-span
+            # block store), so only the t_tile and max_len alignment
+            # applies there.
+            seq_align = seq > 1 and not self.qblock
+            if (self.page % self.t_tile
+                    or (seq_align and self.page % seq)
                     or max_len % self.page):
                 raise ValueError(
                     f"page={self.page} needs t_tile|page, seq|page and "
@@ -210,6 +394,15 @@ class ModelBuilder:
         # engine). Head slices must sit inside lane tiles.
         self.hybrid = cfg.is_hybrid
         if self.hybrid:
+            if self.kv_quant:
+                raise NotImplementedError(
+                    "quantized KV covers the attention families; the "
+                    "hybrid GDN state is fp32 recurrent, not paged")
+            if self.qblock:
+                raise NotImplementedError(
+                    "Q-block verification needs position-addressed KV; "
+                    "the hybrid GDN recurrent state cannot rewind a "
+                    "rejected draft")
             if self.seq > 1:
                 raise ValueError("hybrid megakernel is decode-only "
                                  "(seq == 1); prefill via prefill_chain")
@@ -256,17 +449,21 @@ class ModelBuilder:
             self.ffe_tiles = _cdiv(cfg.moe_intermediate_size // n,
                                    self.w)
 
-        self._cursor = 0
         self._offsets: Dict[str, int] = {}
+        self.schema = ArenaSchema(self.w)
         self.graph = Graph()
         self._weight_entries: List[Tuple[str, int]] = []
         self._build()
 
     # ---------------- arena layout -------------------------------------
-    def _alloc(self, name: str, rows: int) -> int:
-        off = self._cursor
+    # The described memory layout: every _alloc lands in the schema
+    # with a name + kind, so consumers (checkpoint/restore, the chaos
+    # arena sweep, docs) address regions by NAME instead of trusting
+    # cursor arithmetic.
+    def _alloc(self, name: str, rows: int,
+               kind: str = "activation") -> int:
+        off = self.schema.alloc(name, rows, kind)
         self._offsets[name] = off
-        self._cursor += rows
         return off
 
     def _alloc_act(self, name: str, tiles: int) -> int:
@@ -292,12 +489,12 @@ class ModelBuilder:
         # Weights region (per layer) — order defines pack_arena.
         def walloc(name, k_tiles, n_tiles):
             rows = k_tiles * n_tiles * w
-            off = self._alloc(name, rows)
+            off = self._alloc(name, rows, kind="weight")
             self._weight_entries.append((name, rows))
             return off
 
         def vecalloc(name, tiles):
-            off = self._alloc(name, tiles)
+            off = self._alloc(name, tiles, kind="weight")
             self._weight_entries.append((name, tiles))
             return off
 
@@ -348,7 +545,8 @@ class ModelBuilder:
 
         # Allreduce workspace + I/O regions.
         ar_max_tiles = max(d_t, 1)
-        self.ar_ws_off = self._alloc("ar_ws", self.n * ar_max_tiles * b)
+        self.ar_ws_off = self._alloc("ar_ws", self.n * ar_max_tiles * b,
+                                     kind="workspace")
         self.ar_max_tiles = ar_max_tiles
         x_off = self._alloc_act("x", d_t)
         self.x_off = x_off
@@ -360,7 +558,8 @@ class ModelBuilder:
         # packs zeroed, so no per-step reset task is needed.
         self.moe_counts_off = 0
         if self.moe:
-            self.moe_counts_off = self._alloc("moe_counts", b)
+            self.moe_counts_off = self._alloc("moe_counts", b,
+                                              kind="counter")
 
         # Embedding lookup inside the kernel (token ids via prefetch),
         # then an allreduce to sum the vocab-shard contributions.
@@ -444,8 +643,16 @@ class ModelBuilder:
                              in_rows=d_t * b, w_rows=d_t * kv_t * w)
                 kv_layer = (self.layer_kinds[li][1] if self.hybrid
                             else li)
-                g.add(TaskType.WRITE_KV if self.seq == 1
-                      else TaskType.WRITE_KV_PREFILL,
+                if self.qblock:
+                    wk_type = TaskType.WRITE_KV_QBLOCK
+                    at_type = TaskType.ATTN_QBLOCK
+                elif self.seq == 1:
+                    wk_type = TaskType.WRITE_KV
+                    at_type = TaskType.ATTN_DECODE
+                else:
+                    wk_type = TaskType.WRITE_KV_PREFILL
+                    at_type = TaskType.ATTN_PREFILL
+                g.add(wk_type,
                       (kx, vx, kv_layer, o[f"l{li}.k_norm"]),
                       reads=[(kx, kv_t * b), (vx, kv_t * b),
                              (o[f"l{li}.k_norm"], 1)],
@@ -453,8 +660,7 @@ class ModelBuilder:
                 # ATTN reads the cache written by WRITE_KV — encode the
                 # ordering as an artificial region keyed off the task
                 # above.
-                attn_task = g.add(TaskType.ATTN_DECODE if self.seq == 1
-                                  else TaskType.ATTN_PREFILL,
+                attn_task = g.add(at_type,
                                   (q, attn, kv_layer,
                                    o[f"l{li}.q_norm"]),
                                   reads=[(q, hq_t * b),
@@ -550,12 +756,14 @@ class ModelBuilder:
               writes=[(out_off, d_t * b)])
         self.out_off = out_off
         # LM head inside the kernel: logits over this rank's vocab shard.
-        logits_off = self._alloc_act("logits", self.vloc_tiles)
+        logits_off = self._alloc("logits", self.vloc_tiles * b,
+                                 kind="io")
         self._linear(out_off, o["lm_head_T"], logits_off, d_t,
                      self.vloc_tiles, layer=-1, in_rows=d_t * b,
                      w_rows=d_t * self.vloc_tiles * w)
         self.logits_off = logits_off
-        self.arena_rows = self._cursor
+        self.arena_rows = self.schema.rows
+        self.schema.check_disjoint()
 
         # -------- native schedule --------
         # The kernel's allreduce body substitutes the STATIC
@@ -762,8 +970,13 @@ class ModelBuilder:
         if t.task_type == TaskType.ATTN_PREFILL:
             # S-row blocked flash attention: the prefill heavyweight.
             return 8 * self.d_tiles * max(self.seq // 8, 1)
+        if t.task_type == TaskType.ATTN_QBLOCK:
+            # K per-row online-softmax streams per slot.
+            return 4 * self.d_tiles * self.seq
         if t.task_type == TaskType.WRITE_KV_PREFILL:
             return 2 * max(self.seq // 8, 1)
+        if t.task_type == TaskType.WRITE_KV_QBLOCK:
+            return 2 * self.seq
         if t.task_type == TaskType.ALLREDUCE:
             return 2 * int(t.args[1])
         if t.task_type == TaskType.WEIGHTED_ADD:
@@ -876,15 +1089,31 @@ class ModelBuilder:
             moe_norm=self.cfg.norm_topk_prob,
             gdn_h_loc=(self.gdn_h_loc if self.hybrid else 0),
             gdn_dk=self.cfg.gdn_head_dim_k,
-            gdn_dv=self.cfg.gdn_head_dim_v)
+            gdn_dv=self.cfg.gdn_head_dim_v,
+            kv_quant=self.kv_quant,
+            qmax=self.kv_qmax,
+            qblock=self.qblock)
+
+    def _n_state(self) -> int:
+        """Aliased state operands: arena + K/V pools, plus the scale
+        tables (quantized) and the GDN state buffer (hybrid)."""
+        return (3 + (2 if self.kv_quant else 0)
+                + (1 if self.hybrid else 0))
 
     def _kernel(self, types_s, args_s, wait_tab_s, sig_tab_s,
                 wait_edges_s, sig_edges_s, bucket_s, bsizes_s, len_s,
-                tok_s, tbl_s, arena_in, kc_in, vc_in, *tail):
-        if self.hybrid:
-            states_in, tail = tail[0], tail[1:]
+                tok_s, tbl_s, *tail):
+        # Inputs are aliased onto the outputs — skip the input refs
+        # and unpack the output half (arena, K/V pools, [scales],
+        # [states]), then prof, scratches, semaphores.
+        tail = tail[self._n_state():]
         arena, k_cache, v_cache = tail[:3]
         tail = tail[3:]
+        if self.kv_quant:
+            k_scale, v_scale = tail[:2]
+            tail = tail[2:]
+        else:
+            k_scale = v_scale = None
         if self.hybrid:
             states, tail = tail[0], tail[1:]
         else:
@@ -900,6 +1129,11 @@ class ModelBuilder:
             tail = tail[3:]
         else:
             vrow = vrow2 = vS = None
+        if self.kv_quant:
+            vqt, vqd, vscl = tail[:3]
+            tail = tail[3:]
+        else:
+            vqt = vqd = vscl = None
         claim_cnt, claim_sem, edge_sem, send_sem, recv_sem = tail
         cfg = self.kernel_config()
         q = pl.program_id(0)
@@ -934,7 +1168,9 @@ class ModelBuilder:
                 "va": va, "vb": vb, "vc": vc, "vw": vw, "acc": acc,
                 "vhd": vhd, "vkt": vkt, "vsq": vsq, "send_sem": send_sem,
                 "recv_sem": recv_sem, "tbl_s": tbl_s, "states": states,
-                "vrow": vrow, "vrow2": vrow2, "vS": vS}
+                "vrow": vrow, "vrow2": vrow2, "vS": vS,
+                "k_scale": k_scale, "v_scale": v_scale,
+                "vqt": vqt, "vqd": vqd, "vscl": vscl}
 
         # Progress tracing (TRITON_DIST_TPU_TRACE_PROGRESS=1): one line
         # per queue slot as the scoreboard advances. In interpret mode
@@ -977,6 +1213,8 @@ class ModelBuilder:
             lambda: K.weighted_add_body(cfg, args, refs),
             (lambda: K.gdn_decode_body(cfg, args, refs))
             if self.hybrid else (lambda: None),
+            lambda: K.attn_qblock_body(cfg, args, refs, len_s),
+            lambda: K.write_kv_qblock_body(cfg, args, refs, len_s),
         ]
         # lax.switch traces EVERY branch, scheduled or not — and a body
         # whose geometry does not fit this build (the decode cache
@@ -1059,15 +1297,20 @@ class ModelBuilder:
         bsizes = jnp.asarray(self.bucket_claims)
 
         def step(arena, k_cache, v_cache, token_ids, cache_len,
-                 block_table=None, states=None):
+                 block_table=None, states=None, k_scale=None,
+                 v_scale=None):
             if self.hybrid and states is None:
                 raise ValueError("hybrid megakernel step needs the GDN "
                                  "states buffer")
+            if self.kv_quant and (k_scale is None or v_scale is None):
+                raise ValueError("quantized megakernel step needs the "
+                                 "k_scale/v_scale tables")
             # cache_len: scalar (uniform batch, the classic form) or a
             # (batch,) vector of PER-ROW positions — the live-slot
-            # serving form. Either way the kernel sees a (batch,) SMEM
-            # vector; write_kv/attn_decode index it per row, the
-            # prefill bodies read the shared base at [0].
+            # serving form (qblock builds: per-ROW verification
+            # positions, < 0 masks a row). Either way the kernel sees
+            # a (batch,) SMEM vector; write_kv/attn_decode index it
+            # per row, the prefill bodies read the shared base at [0].
             len_arr = jnp.broadcast_to(
                 jnp.asarray(cache_len, jnp.int32).reshape(-1), (b,))
             tok_arr = jnp.asarray(token_ids, jnp.int32)
@@ -1078,7 +1321,7 @@ class ModelBuilder:
             tbl_arr = jnp.asarray(block_table, jnp.int32).reshape(-1)
 
             C = self.num_cores
-            n_big = 4 if self.hybrid else 3
+            n_big = self._n_state()
             out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_big
             if self.profile:
                 # One (task_type, arg0) row per executed queue slot,
@@ -1108,7 +1351,13 @@ class ModelBuilder:
                     pltpu.VMEM((self.cfg.gdn_head_dim_k,
                                 self.cfg.gdn_head_dim_v),
                                jnp.float32),                # vS
-                ] if self.hybrid else []) + [
+                ] if self.hybrid else []) + ([
+                    pltpu.VMEM((self.t_tile, self.cfg.head_dim),
+                               k_cache.dtype),              # vqt
+                    pltpu.VMEM((1, self.cfg.head_dim),
+                               k_cache.dtype),              # vqd
+                    pltpu.VMEM((1, 1), jnp.float32),        # vscl
+                ] if self.kv_quant else []) + [
                     pltpu.SMEM((1,), jnp.int32),            # claim_cnt
                     pltpu.SemaphoreType.REGULAR(
                         (max(self.n_buckets, 1),)),         # claim_sem
@@ -1138,6 +1387,11 @@ class ModelBuilder:
                 jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                 jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
             ]
+            if self.kv_quant:
+                out_shape.append(jax.ShapeDtypeStruct(
+                    k_scale.shape, k_scale.dtype))
+                out_shape.append(jax.ShapeDtypeStruct(
+                    v_scale.shape, v_scale.dtype))
             if self.hybrid:
                 out_shape.append(jax.ShapeDtypeStruct(
                     states.shape, states.dtype))
@@ -1148,9 +1402,8 @@ class ModelBuilder:
                 self._kernel,
                 grid_spec=grid_spec,
                 out_shape=tuple(out_shape),
-                input_output_aliases=(
-                    {11: 0, 12: 1, 13: 2, 14: 3} if self.hybrid
-                    else {11: 0, 12: 1, 13: 2}),
+                input_output_aliases={
+                    11 + i: i for i in range(n_big)},
                 # A rankless megakernel traces no barrier: Mosaic
                 # rejects a collective_id without one.
                 compiler_params=(comm_compiler_params() if self.n > 1
@@ -1160,11 +1413,16 @@ class ModelBuilder:
             operands = [types, args, wait_tab, sig_tab, wait_edges,
                         sig_edges, bucket, bsizes, len_arr, tok_arr,
                         tbl_arr, arena, k_cache, v_cache]
+            if self.kv_quant:
+                operands += [k_scale, v_scale]
             if self.hybrid:
                 operands.append(states)
             outs = list(outs_fn(*operands))
             arena, k_cache, v_cache = outs[:3]
             outs = outs[3:]
+            if self.kv_quant:
+                k_scale, v_scale = outs[:2]
+                outs = outs[2:]
             if self.hybrid:
                 states, outs = outs[0], outs[1:]
             prof = outs[0] if self.profile else None
@@ -1175,6 +1433,8 @@ class ModelBuilder:
             logits = out_rows.reshape(lt, b, w).transpose(1, 0, 2
                                                           ).reshape(b, lt * w)
             ret = [logits[:, :self.vocab_loc], arena, k_cache, v_cache]
+            if self.kv_quant:
+                ret += [k_scale, v_scale]
             if self.hybrid:
                 ret.append(states)
             if self.profile:

@@ -31,7 +31,8 @@ class MegaKernelEngine:
                  schedule: str = "static",
                  paged: bool = False, page=None, num_pages=None,
                  cost_table=None, timeout_s=None,
-                 profile: bool = False):
+                 profile: bool = False, kv_dtype: str = "bf16",
+                 spec_k: int = 0):
         """``timeout_s`` arms a per-step watchdog: every
         :meth:`decode_step` / :meth:`prefill` blocks on its result
         under a deadline and raises a structured
@@ -55,7 +56,52 @@ class MegaKernelEngine:
         :meth:`~triton_dist_tpu.serving.server.ServingEngine.trace`
         session collects it into the merged trace automatically
         (docs/observability.md). Decode-only: the batched prefill
-        builder never records."""
+        builder never records, and neither does the ``spec_k``
+        verification step — a traced speculative serve carries host
+        spans but no megakernel slot records.
+
+        ``kv_dtype``: ``"bf16"`` keeps the original fp32 pools
+        (bit-identical code path); ``"int8"``/``"fp8"`` store the K/V
+        pools quantized with per-(layer, page, kv_head) fp32 scale
+        tables, quantize fused into ``write_kv`` and dequant into
+        every cache read — ~3.8x pages per HBM byte on the persistent
+        lane's fastest decode path. Requires ``paged=True`` (scales
+        are per page); attention families only (hybrid GDN rejected);
+        prompts stream through the prefill lane (``prefill_seq`` is
+        incompatible).
+
+        ``spec_k=K`` (>= 2) additionally builds the Q-BLOCK
+        VERIFICATION step (:meth:`verify_step`): one launch scores K
+        drafted tokens per slot under the per-query causal mask —
+        the serving layer's speculative decode on the megakernel
+        lane. Same constraints as ``kv_dtype`` (paged, non-hybrid,
+        no ``prefill_seq``)."""
+        from triton_dist_tpu.serving.blocks import kv_quant_spec
+
+        qdtype, _ = kv_quant_spec(kv_dtype)
+        self.kv_dtype = "bf16" if qdtype is None else kv_dtype
+        self.spec_k = int(spec_k or 0)
+        if self.spec_k == 1:
+            self.spec_k = 0            # K=1 degenerates to plain decode
+        for knob, on in (("kv_dtype", qdtype is not None),
+                         ("spec_k", bool(self.spec_k))):
+            if not on:
+                continue
+            if not paged:
+                raise ValueError(f"{knob} needs paged=True (per-page "
+                                 "scales / block-table verification)")
+            if cfg.is_hybrid:
+                raise NotImplementedError(
+                    f"{knob} covers the attention families; the hybrid "
+                    "GDN recurrent state is neither paged nor "
+                    "rewindable")
+            if prefill_seq > 1:
+                raise ValueError(
+                    f"{knob} is incompatible with prefill_seq: stream "
+                    "prompts through the prefill lane (the decode "
+                    "kernel) instead")
+        if self.spec_k and self.spec_k < 2:
+            raise ValueError(f"spec_k must be 0 or >= 2, got {spec_k}")
         self.cfg = cfg
         self.mesh = mesh
         self.axis = axis
@@ -83,6 +129,8 @@ class MegaKernelEngine:
             import math
             page = math.lcm(t_tile,
                             prefill_seq if prefill_seq > 1 else 1)
+        self._kv_quant = (None if self.kv_dtype == "bf16"
+                          else self.kv_dtype)
         self.builder = ModelBuilder(cfg, mesh, batch=batch,
                                     max_len=max_len, axis=axis,
                                     tile_w=tile_w, t_tile=t_tile,
@@ -90,7 +138,21 @@ class MegaKernelEngine:
                                     strategy=strategy,
                                     schedule=self.schedule, paged=paged,
                                     page=page, cost_table=cost_table,
-                                    profile=self.profile)
+                                    profile=self.profile,
+                                    kv_quant=self._kv_quant)
+        # Q-block verification builder: the SAME weight layout at
+        # batch*K rows (seq=K, one row per drafted candidate), sharing
+        # the decode arena — its (bigger) activation tail sizes the
+        # buffer, exactly the batched-prefill arrangement.
+        self.verify_builder = None
+        if self.spec_k:
+            self.verify_builder = ModelBuilder(
+                cfg, mesh, batch=batch * self.spec_k, max_len=max_len,
+                axis=axis, tile_w=tile_w, t_tile=t_tile,
+                seq=self.spec_k, qblock=True, num_cores=num_cores,
+                strategy=strategy, schedule=self.schedule, paged=True,
+                page=page, cost_table=cost_table,
+                kv_quant=self._kv_quant)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
             # buffer; prefill runs via prefill_chain (decode-only
@@ -125,7 +187,6 @@ class MegaKernelEngine:
         # coincide; the activation tail is per-run scratch and the
         # bigger (prefill) footprint sizes the buffer.
         self.prefill_builder = None
-        pack_builder = self.builder
         if cfg.is_hybrid and prefill_seq > 1:
             raise ValueError(
                 "hybrid (GDN) megakernel is decode-only: batched "
@@ -140,7 +201,6 @@ class MegaKernelEngine:
                 schedule=self.schedule, paged=paged, page=page,
                 cost_table=cost_table)
             self.prefill_seq = prefill_seq
-            pack_builder = self.prefill_builder
             pstep = self.prefill_builder.step_fn()
             self._prefill_step = jax.jit(jax.shard_map(
                 pstep, mesh=mesh,
@@ -149,6 +209,13 @@ class MegaKernelEngine:
                 out_specs=(P(None, axis), P(axis, None), kvspec,
                            kvspec),
                 check_vma=False), donate_argnums=(0, 1, 2))
+        # The arena is shared by every builder (identical weight
+        # region; activation tails are per-run scratch) — the largest
+        # footprint sizes and packs it.
+        pack_builder = max(
+            [b for b in (self.builder, self.prefill_builder,
+                         self.verify_builder) if b is not None],
+            key=lambda b: b.arena_rows)
         self._arena = jax.jit(jax.shard_map(
             pack_builder.pack_arena, mesh=mesh, in_specs=(specs,),
             out_specs=P(axis, None), check_vma=False))(placed)
@@ -192,10 +259,39 @@ class MegaKernelEngine:
             self.block_table = jnp.zeros((1,), jnp.int32)
             shape = (kv_layers, batch, max_len, kv,
                      cfg.head_dim)
+        # qdtype still holds the ctor-top kv_quant_spec derivation.
+        pool_dtype = jnp.float32 if qdtype is None else qdtype
         self.k_cache = jax.device_put(
-            jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
+            jnp.zeros(shape, pool_dtype), NamedSharding(mesh, kvspec))
         self.v_cache = jax.device_put(
-            jnp.zeros(shape, jnp.float32), NamedSharding(mesh, kvspec))
+            jnp.zeros(shape, pool_dtype), NamedSharding(mesh, kvspec))
+        # Per-(layer, page, kv_head) fp32 dequant scales (quantized
+        # pools): trailing singleton keeps the in-kernel scalar DMA a
+        # 2-D (1, 1) copy. Init 1.0 — a page's first write RESETS it.
+        self.k_scale = self.v_scale = None
+        self._scale_sharding = None
+        if qdtype is not None:
+            self._scale_sharding = NamedSharding(
+                mesh, P(None, None, axis, None))
+            sshape = (kv_layers, self.num_pages, kv, 1)
+            self.k_scale = jax.device_put(
+                jnp.ones(sshape, jnp.float32), self._scale_sharding)
+            self.v_scale = jax.device_put(
+                jnp.ones(sshape, jnp.float32), self._scale_sharding)
+        # Schema buffer registration: the engine-owned device buffers
+        # (pools, scales, GDN state) join the decode builder's
+        # described layout, so checkpoint/restore and the chaos
+        # arena sweep address EVERYTHING by name.
+        sch = self.builder.schema
+        pool_dtype_name = np.dtype(pool_dtype).name
+        sch.add_buffer("k_cache", shape, pool_dtype_name, kind="kv")
+        sch.add_buffer("v_cache", shape, pool_dtype_name, kind="kv")
+        if qdtype is not None:
+            sch.add_buffer("k_scale", sshape, "float32", kind="scale")
+            sch.add_buffer("v_scale", sshape, "float32", kind="scale")
+        if self.states is not None:
+            sch.add_buffer("gdn_states", self.states.shape, "float32",
+                           kind="state")
 
     def _build_step(self):
         """(Re)jit the decode step from the builder's CURRENT slot
@@ -205,27 +301,48 @@ class MegaKernelEngine:
         jit."""
         kvspec = P(None, None, None, self.axis, None)
         tblspec = P(None)
-        step = self.builder.step_fn()
+        sclspec = P(None, None, self.axis, None)
         # profile=True appends the slot-recorder output (per-rank rows;
         # rank 0's view is what the host keeps).
         prof_spec = (P(None, None),) if self.profile else ()
-        if self.cfg.is_hybrid:
-            stspec = P(None, None, self.axis, None, None)
-            self._step = jax.jit(jax.shard_map(
-                step, mesh=self.mesh,
-                in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
-                          P(None), tblspec, stspec),
-                out_specs=(P(None, self.axis), P(self.axis, None),
-                           kvspec, kvspec, stspec) + prof_spec,
-                check_vma=False), donate_argnums=(0, 1, 2, 6))
-        else:
-            self._step = jax.jit(jax.shard_map(
+
+        def _jit_step(builder, profile):
+            step = builder.step_fn()
+            pspec = prof_spec if profile else ()
+            if self.cfg.is_hybrid:
+                stspec = P(None, None, self.axis, None, None)
+                return jax.jit(jax.shard_map(
+                    step, mesh=self.mesh,
+                    in_specs=(P(self.axis, None), kvspec, kvspec,
+                              P(None), P(None), tblspec, stspec),
+                    out_specs=(P(None, self.axis), P(self.axis, None),
+                               kvspec, kvspec, stspec) + pspec,
+                    check_vma=False), donate_argnums=(0, 1, 2, 6))
+            if builder.kv_quant:
+                return jax.jit(jax.shard_map(
+                    lambda a, kc, vc, tok, ln, tb, ks, vs: step(
+                        a, kc, vc, tok, ln, tb, k_scale=ks,
+                        v_scale=vs),
+                    mesh=self.mesh,
+                    in_specs=(P(self.axis, None), kvspec, kvspec,
+                              P(None), P(None), tblspec, sclspec,
+                              sclspec),
+                    out_specs=(P(None, self.axis), P(self.axis, None),
+                               kvspec, kvspec, sclspec, sclspec)
+                    + pspec,
+                    check_vma=False), donate_argnums=(0, 1, 2, 6, 7))
+            return jax.jit(jax.shard_map(
                 step, mesh=self.mesh,
                 in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
                           P(None), tblspec),
                 out_specs=(P(None, self.axis), P(self.axis, None),
-                           kvspec, kvspec) + prof_spec,
+                           kvspec, kvspec) + pspec,
                 check_vma=False), donate_argnums=(0, 1, 2))
+
+        self._step = _jit_step(self.builder, self.profile)
+        self._verify_step = (None if self.verify_builder is None
+                             else _jit_step(self.verify_builder,
+                                            False))
 
     def expert_counts(self) -> np.ndarray:
         """Cumulative per-expert routed-token counts from the arena's
@@ -239,7 +356,11 @@ class MegaKernelEngine:
         prefill builder reuses the activation region)."""
         if not self.cfg.is_moe:
             raise ValueError("expert_counts() needs a MoE megakernel")
-        b = self.builder
+        # A spec_k engine's serving traffic rides the verification
+        # step exclusively, so ITS counts region is the live one (the
+        # two builders' regions sit at different offsets of the shared
+        # arena — each is scratch to the other's activations).
+        b = self.verify_builder if self.spec_k else self.builder
         rows = np.asarray(self._arena[
             b.moe_counts_off:b.moe_counts_off + b.batch])
         return rows.sum(axis=0)[:self.cfg.num_experts].round(
@@ -252,8 +373,12 @@ class MegaKernelEngine:
         step around the new tables. Infrequent by design — the rebuild
         recompiles on the next decode step, so callers (the serving
         layer's ``rebalance_every``) apply hysteresis and only refresh
-        when the hot-set ranking actually changed."""
+        when the hot-set ranking actually changed. A spec_k engine
+        reprioritizes the verification builder too — under speculation
+        its claim order IS the serving dispatch's."""
         self.builder.reprioritize(load)
+        if self.verify_builder is not None:
+            self.verify_builder.reprioritize(load)
         self._build_step()
 
     def progress(self) -> dict:
@@ -324,6 +449,84 @@ class MegaKernelEngine:
         if self.states is not None:
             self.states = self.states.at[:, slot].set(0.0)
 
+    # -- schema-driven checkpoint/restore -----------------------------
+
+    def snapshot_state(self) -> dict:
+        """Host snapshot of the SERVING-relevant arena regions, by
+        schema name: the KV pools (stored bytes — bit-exact at any
+        ``kv_dtype``), their scale tables, the hybrid GDN state, and
+        the in-arena counter regions (per-rank rows). Weights are NOT
+        snapshot (repacked from params on a fresh engine, the layer
+        path's contract) and activations are per-step scratch.
+        Forces in-flight work to complete (it reads device state)."""
+        out = {"k_cache": np.asarray(self.k_cache),
+               "v_cache": np.asarray(self.v_cache),
+               "k_scale": (None if self.k_scale is None
+                           else np.asarray(self.k_scale)),
+               "v_scale": (None if self.v_scale is None
+                           else np.asarray(self.v_scale)),
+               "states": (None if self.states is None
+                          else np.asarray(self.states)),
+               "counters": {}}
+        cb = self.verify_builder if self.spec_k else self.builder
+        n = self.mesh.shape[self.axis]
+        arena = np.asarray(self._arena)
+        rows_per = arena.shape[0] // n
+        for reg in cb.schema.regions(kind="counter"):
+            out["counters"][reg.name] = arena.reshape(
+                n, rows_per, -1)[:, reg.offset:reg.offset + reg.rows
+                                 ].copy()
+        return out
+
+    def restore_state(self, snap: dict) -> None:
+        """Adopt a :meth:`snapshot_state` snapshot into this (freshly
+        built, identically-configured) engine: pools + scales + GDN
+        state re-pinned to their construction shardings (the jitted
+        steps never re-specialize), counter regions blitted back into
+        every rank's arena shard. Decode then resumes bit-exact."""
+        kvspec = P(None, None, None, self.axis, None)
+        kv_sh = NamedSharding(self.mesh, kvspec)
+        if snap["k_cache"].dtype != np.asarray(self.k_cache).dtype:
+            raise ValueError(
+                f"pool dtype mismatch: snapshot {snap['k_cache'].dtype}"
+                f" vs engine {np.asarray(self.k_cache).dtype} "
+                "(kv_dtype must match)")
+        self.k_cache = jax.device_put(jnp.asarray(snap["k_cache"]),
+                                      kv_sh)
+        self.v_cache = jax.device_put(jnp.asarray(snap["v_cache"]),
+                                      kv_sh)
+        if (snap.get("k_scale") is None) != (self.k_scale is None):
+            raise ValueError("scale-table mismatch: snapshot and "
+                             "engine disagree on quantization")
+        if snap.get("k_scale") is not None:
+            self.k_scale = jax.device_put(
+                jnp.asarray(snap["k_scale"]), self._scale_sharding)
+            self.v_scale = jax.device_put(
+                jnp.asarray(snap["v_scale"]), self._scale_sharding)
+        if (snap.get("states") is None) != (self.states is None):
+            raise ValueError("GDN-state mismatch: snapshot and engine "
+                             "disagree on the hybrid family")
+        if snap.get("states") is not None:
+            self.states = jax.device_put(
+                jnp.asarray(snap["states"]),
+                NamedSharding(self.mesh,
+                              P(None, None, self.axis, None, None)))
+        counters = snap.get("counters") or {}
+        if counters:
+            cb = self.verify_builder if self.spec_k else self.builder
+            n = self.mesh.shape[self.axis]
+            # np.array (not asarray): jax arrays expose a READ-ONLY
+            # buffer — the counter blit below needs a writable copy.
+            arena = np.array(self._arena)
+            rows_per = arena.shape[0] // n
+            view = arena.reshape(n, rows_per, -1)
+            for name, rows in counters.items():
+                reg = cb.schema.region(name)
+                view[:, reg.offset:reg.offset + reg.rows] = rows
+            self._arena = jax.device_put(
+                jnp.asarray(arena),
+                NamedSharding(self.mesh, P(self.axis, None)))
+
     def decode_step(self, token_ids, cache_len) -> jax.Array:
         """token_ids: (B,) → logits (B, vocab). Embedding, the whole
         transformer stack, and the LM head all run inside the
@@ -349,6 +552,16 @@ class MegaKernelEngine:
                 outs = outs[:-1]
             (logits, self._arena, self.k_cache, self.v_cache,
              self.states) = outs
+        elif self.k_scale is not None:
+            outs = self._step(
+                self._arena, self.k_cache, self.v_cache,
+                jnp.asarray(token_ids, jnp.int32), lens,
+                self.block_table, self.k_scale, self.v_scale)
+            if self.profile:
+                self.last_prof = outs[-1]
+                outs = outs[:-1]
+            (logits, self._arena, self.k_cache, self.v_cache,
+             self.k_scale, self.v_scale) = outs
         else:
             outs = self._step(
                 self._arena, self.k_cache, self.v_cache,
@@ -359,6 +572,37 @@ class MegaKernelEngine:
                 outs = outs[:-1]
             logits, self._arena, self.k_cache, self.v_cache = outs
         return self._finish(logits, "megakernel.decode_step")
+
+    def verify_step(self, token_rows, positions) -> jax.Array:
+        """ONE Q-block verification launch (``spec_k`` builds):
+        ``token_rows`` (B, K) or (B·K,) drafted candidates slot-major,
+        ``positions`` (B·K,) each row's cache position (−1 masks a row
+        — over-budget candidates and parked slots write nothing and
+        their logits are garbage the host discards). Writes each valid
+        row's K/V at its own position, attends under the per-query
+        causal mask, and returns logits (B, K, vocab) — row j's logits
+        are bit-identical to what :meth:`decode_step` would have
+        produced at that position, which is what makes greedy
+        acceptance token-exact by construction."""
+        if self._verify_step is None:
+            raise ValueError("engine built without spec_k: the Q-block "
+                             "verification step was never compiled")
+        kq = self.spec_k
+        toks = jnp.asarray(token_rows, jnp.int32).reshape(-1)
+        pos = jnp.asarray(positions, jnp.int32).reshape(-1)
+        if self.k_scale is not None:
+            outs = self._verify_step(
+                self._arena, self.k_cache, self.v_cache, toks, pos,
+                self.block_table, self.k_scale, self.v_scale)
+            (logits, self._arena, self.k_cache, self.v_cache,
+             self.k_scale, self.v_scale) = outs
+        else:
+            outs = self._verify_step(
+                self._arena, self.k_cache, self.v_cache, toks, pos,
+                self.block_table)
+            logits, self._arena, self.k_cache, self.v_cache = outs
+        logits = self._finish(logits, "megakernel.verify_step")
+        return logits.reshape(self.batch, kq, -1)
 
     def prefill_chain(self, prompt_ids):
         """Feed a (B, S) prompt token-by-token (fallback when no

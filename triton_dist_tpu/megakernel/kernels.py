@@ -56,6 +56,18 @@ class KernelConfig:
     gdn_h_loc: int = 0
     gdn_dk: int = 0
     gdn_dv: int = 0
+    # Quantized KV pools (``kv_quant="int8"|"fp8"``, paged only): the
+    # cache arrays store 1 B/elem with one fp32 scale per (layer, page,
+    # kv_head) riding in the k_scale/v_scale operands — quantize fused
+    # into write_kv, dequant into every cache read (the
+    # ops/paged_flash_qblock scheme applied to the persistent lane).
+    # None = the original fp32 pools, bit-identical code path.
+    kv_quant: "str | None" = None
+    qmax: float = 0.0
+    # Q-block verification build (WRITE_KV_QBLOCK/ATTN_QBLOCK): batch
+    # rows are (slot, j) pairs, ``seq`` rows per slot, each at its own
+    # per-row position.
+    qblock: bool = False
 
 
 def _act(arena, off, tiles_b):
@@ -76,6 +88,87 @@ def _kv_slice(cache, refs, cfg, layer, bb, start, span, kv_head):
     pid = tbl_s[bb * cfg.p_max + start // cfg.page]
     return cache.at[layer, pid,
                     pl.ds(jax.lax.rem(start, cfg.page), span), kv_head, :]
+
+
+# ---------------------------------------------------------------------------
+# Quantized-pool helpers (cfg.kv_quant): symmetric max-abs per
+# (layer, page, kv_head), the layer path's PagedKVCache scheme fused
+# into the persistent kernel. Scales live in the k_scale/v_scale
+# operands shaped (layers, num_pages, kv_loc, 1); a (1, 1) VMEM
+# scratch (refs["vscl"]) stages each scalar DMA.
+# ---------------------------------------------------------------------------
+
+def _quant_cast(x, qdtype, qmax):
+    """fp32 → pool storage dtype (int8 rounds-to-nearest, fp8 is a
+    saturating cast) — must track serving.blocks._quantize."""
+    if jnp.dtype(qdtype) == jnp.dtype(jnp.int8):
+        return jnp.clip(jnp.round(x), -qmax, qmax).astype(jnp.int8)
+    return jnp.clip(x, -qmax, qmax).astype(qdtype)
+
+
+def _read_scale(refs, which, layer, pid, kv_head):
+    """One (layer, page, kv_head) scale scalar off the HBM table.
+    ``kv_head`` must be a STATIC int (the quantized bodies run static
+    head loops for exactly this reason)."""
+    vscl = refs["vscl"]
+    pltpu.sync_copy(refs[which].at[layer, pid, pl.ds(kv_head, 1)], vscl)
+    return vscl[0, 0]
+
+
+def _write_scale(refs, which, layer, pid, kv_head, s):
+    vscl = refs["vscl"]
+    vscl[...] = jnp.reshape(s, (1, 1))
+    pltpu.sync_copy(vscl, refs[which].at[layer, pid, pl.ds(kv_head, 1)])
+
+
+def _quant_store_token(cfg, refs, cache, scale_name, layer, pid, off,
+                       kv_head, head_row):
+    """Quantize ONE token's (1, hd) row into a quantized page at
+    ``(pid, off, kv_head)``, maintaining the per-(layer, page, kv_head)
+    running max-abs scale: the page's FIRST position (``off == 0``)
+    RESETS the scale, so a freed-and-reused page never inherits a
+    stale one; a later token whose amax exceeds the running amax grows
+    the scale and RESCALES the already-stored page content to it first
+    — the in-kernel form of the layer path's dequant→merge→requant
+    (double-rounds old tokens exactly like the XLA merge does)."""
+    qmax = cfg.qmax
+    vqd, vqt = refs["vqd"], refs["vqt"]
+    amax = jnp.max(jnp.abs(head_row))
+    s_old = _read_scale(refs, scale_name, layer, pid, kv_head)
+    fresh = off == 0
+    s_tok = jnp.where(amax > 0, amax / qmax, 0.0)
+    s_new = jnp.where(fresh,
+                      jnp.where(amax > 0, amax / qmax, 1.0),
+                      jnp.maximum(s_old, s_tok))
+
+    @pl.when(jnp.logical_and(jnp.logical_not(fresh), s_new > s_old))
+    def _():
+        ratio = s_old / s_new
+        t_tile = vqt.shape[0]
+        for tt in range(cfg.page // t_tile):     # static: t_tile | page
+            sl = cache.at[layer, pid, pl.ds(tt * t_tile, t_tile),
+                          kv_head, :]
+            pltpu.sync_copy(sl, vqt)
+            vqt[...] = _quant_cast(
+                vqt[...].astype(jnp.float32) * ratio, vqt.dtype, qmax)
+            pltpu.sync_copy(vqt, sl)
+
+    vqd[...] = _quant_cast(head_row / s_new, vqd.dtype, qmax)
+    pltpu.sync_copy(vqd, cache.at[layer, pid, pl.ds(off, 1),
+                                  kv_head, :])
+    _write_scale(refs, scale_name, layer, pid, kv_head, s_new)
+
+
+def _dequant_tile(cfg, refs, cache, scale_name, layer, pid, start,
+                  kv_head):
+    """One (t_tile, hd) cache tile dequantized to fp32 — the read half
+    of the fused scheme (start is the in-page offset; the builder's
+    t_tile | page contract keeps the tile inside one page)."""
+    vqt = refs["vqt"]
+    s = _read_scale(refs, scale_name, layer, pid, kv_head)
+    pltpu.sync_copy(cache.at[layer, pid, pl.ds(start, vqt.shape[0]),
+                             kv_head, :], vqt)
+    return vqt[...].astype(jnp.float32) * s
 
 
 # ---------------------------------------------------------------------------
@@ -237,12 +330,70 @@ def _rms_rows(x, w_row, eps):
     return x * jax.lax.rsqrt(var + eps) * w_row[None]
 
 
+def _write_kv_body_quant(cfg, args, refs, len_s):
+    """Quantized form of :func:`write_kv_body` (paged pools only):
+    same per-row append, with quantize-on-write through the running
+    per-(layer, page, kv_head) scales. Loops are STATIC python (the
+    scale DMA needs a static head index); op-for-op the math matches
+    the fp32 body, so the stored values dequantize to the same tokens
+    the unquantized lane would have written, modulo quantization."""
+    arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
+                               refs["v_cache"])
+    va, vb = refs["va"], refs["vb"]
+    tbl_s = refs["tbl_s"]
+    k_off, v_off, layer, knorm_off = args[0], args[1], args[2], args[3]
+    b, hd, kv_loc, w = cfg.batch, cfg.hd, cfg.kv_loc, cfg.w
+    heads_per_tile = w // hd
+    kv_tiles = -(-(kv_loc * hd) // w)
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), len_s[bb], jnp.int32) for bb in range(b)],
+        axis=0)
+
+    pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)], vb.at[pl.ds(0, 1)])
+    wrow = vb[0, :hd].astype(jnp.float32)
+
+    for j in range(kv_tiles):                      # static tile loop
+        pltpu.sync_copy(arena.at[pl.ds(k_off + j * b, b)], va)
+        kt = va[...].astype(jnp.float32)
+        for hh in range(heads_per_tile):
+            kv_head = j * heads_per_tile + hh      # STATIC head index
+            if kv_head >= kv_loc:
+                continue                           # padding head
+            head = kt[:, hh * hd:(hh + 1) * hd]
+            head = _rms_rows(head, wrow, cfg.rms_eps)
+            head = _rope_rows(head, pos_rows, hd, cfg.rope_theta)
+            for bb in range(b):
+                pos = len_s[bb]
+                pid = tbl_s[bb * cfg.p_max + pos // cfg.page]
+                off = jax.lax.rem(pos, cfg.page)
+                _quant_store_token(cfg, refs, k_cache, "k_scale",
+                                   layer, pid, off, kv_head,
+                                   head[bb:bb + 1])
+        pltpu.sync_copy(arena.at[pl.ds(v_off + j * b, b)], va)
+        vt = va[...].astype(jnp.float32)
+        for hh in range(heads_per_tile):
+            kv_head = j * heads_per_tile + hh
+            if kv_head >= kv_loc:
+                continue
+            for bb in range(b):
+                pos = len_s[bb]
+                pid = tbl_s[bb * cfg.p_max + pos // cfg.page]
+                off = jax.lax.rem(pos, cfg.page)
+                _quant_store_token(cfg, refs, v_cache, "v_scale",
+                                   layer, pid, off, kv_head,
+                                   vt[bb:bb + 1, hh * hd:(hh + 1) * hd])
+
+
 def write_kv_body(cfg, args, refs, len_s):
     """Append the new token's K/V (with k-norm + rope on K) to the cache
     at EACH BATCH ROW'S OWN position ``len_s[bb]`` — the live-slot form
     the serving layer drives (a uniform batch passes a broadcast
     vector and degenerates to the old single-position append). Builder
-    guarantees hd | w."""
+    guarantees hd | w. Quantized pools route to the fused
+    quantize-on-write variant; the fp32 path below is untouched (and
+    stays bit-identical to the pre-quantization kernel)."""
+    if cfg.kv_quant:
+        return _write_kv_body_quant(cfg, args, refs, len_s)
     arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
                                refs["v_cache"])
     va, vb, vhd = refs["va"], refs["vb"], refs["vhd"]
@@ -339,6 +490,90 @@ def write_kv_body(cfg, args, refs, len_s):
     jax.lax.fori_loop(0, kv_tiles, per_tile, 0)
 
 
+def _attn_decode_body_quant(cfg, args, refs, len_s):
+    """Quantized form of :func:`attn_decode_body`: the same per-row
+    online-softmax stream with the dequant fused into each (t_tile,
+    hd) page read — pre-gathered scales are impossible here because
+    write_kv of the SAME launch updates them, so each tile reads its
+    page's scale live. Static head loops (scale DMA needs a static
+    head index); per-(1, hd) query math is op-for-op the fp32 body's,
+    so bf16-vs-quant divergence is the quantization error only."""
+    arena, k_cache, v_cache, va = (refs["arena"], refs["k_cache"],
+                                   refs["v_cache"], refs["va"])
+    tbl_s = refs["tbl_s"]
+    q_off, out_off, layer, qnorm_off = args[0], args[1], args[2], args[3]
+    b, hd, w = cfg.batch, cfg.hd, cfg.w
+    h_loc, kv_loc = cfg.h_loc, cfg.kv_loc
+    t_tile = refs["vqt"].shape[0]
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), len_s[bb], jnp.int32) for bb in range(b)],
+        axis=0)
+    group = h_loc // kv_loc
+    heads_per_tile = w // hd
+
+    pltpu.sync_copy(arena.at[pl.ds(qnorm_off, 1)],
+                    refs["vb"].at[pl.ds(0, 1)])
+    qn_row = refs["vb"][0, :hd].astype(jnp.float32)
+
+    q_tiles = -(-(h_loc * hd) // w)
+    for j in range(q_tiles):                       # static tile loop
+        pltpu.sync_copy(arena.at[pl.ds(q_off + j * b, b)], va)
+        qtile = va[...].astype(jnp.float32)
+        col_blocks = []
+        for hh in range(heads_per_tile):
+            h_idx = j * heads_per_tile + hh        # STATIC head index
+            if h_idx >= h_loc:
+                col_blocks.append(jnp.zeros((b, hd), jnp.float32))
+                continue
+            kv_head = h_idx // group
+            q = qtile[:, hh * hd:(hh + 1) * hd]
+            q = _rms_rows(q, qn_row, cfg.rms_eps)
+            q = _rope_rows(q, pos_rows, hd, cfg.rope_theta)
+            q = q / jnp.sqrt(jnp.float32(hd))
+            row_blocks = []
+            for bb in range(b):
+                kv_len = len_s[bb] + 1
+                n_tiles_t = pl.cdiv(kv_len, t_tile)
+
+                def tstep(tt, carry, bb=bb, q=q, kv_head=kv_head,
+                          kv_len=kv_len):
+                    m, l, acc = carry
+                    pid = tbl_s[bb * cfg.p_max
+                                + (tt * t_tile) // cfg.page]
+                    start = jax.lax.rem(tt * t_tile, cfg.page)
+                    kt = _dequant_tile(cfg, refs, k_cache, "k_scale",
+                                       layer, pid, start, kv_head)
+                    s = jnp.dot(q[bb:bb + 1], kt.T,
+                                preferred_element_type=jnp.float32)
+                    tpos = tt * t_tile + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, t_tile), 1)
+                    s = jnp.where(tpos < kv_len, s, -jnp.inf)
+                    m_new = jnp.maximum(
+                        m, jnp.max(s, axis=1, keepdims=True))
+                    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                    p = jnp.where(jnp.isfinite(s),
+                                  jnp.exp(s - m_safe), 0.0)
+                    corr = jnp.where(jnp.isfinite(m),
+                                     jnp.exp(m - m_safe), 0.0)
+                    vt = _dequant_tile(cfg, refs, v_cache, "v_scale",
+                                       layer, pid, start, kv_head)
+                    acc = acc * corr + jnp.dot(
+                        p, vt, preferred_element_type=jnp.float32)
+                    l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+                    return (m_new, l, acc)
+
+                m0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((1, 1), jnp.float32)
+                acc0 = jnp.zeros((1, hd), jnp.float32)
+                m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
+                                              (m0, l0, acc0))
+                row_blocks.append(acc / jnp.maximum(l, 1e-30))
+            col_blocks.append(jnp.concatenate(row_blocks, axis=0))
+        refs["acc"][...] = jnp.concatenate(col_blocks, axis=1)
+        pltpu.sync_copy(refs["acc"],
+                        arena.at[pl.ds(out_off + j * b, b)])
+
+
 def attn_decode_body(cfg, args, refs, len_s):
     """Single-token GQA flash decode over the (already appended) cache.
 
@@ -347,7 +582,11 @@ def attn_decode_body(cfg, args, refs, len_s):
     online-softmax accumulation — at EACH ROW'S OWN length ``len_s[bb]``
     (the live-slot serving form; a uniform batch degenerates to the old
     single-length decode, including the per-row tile-loop trip counts).
+    Quantized pools route to the fused-dequant variant; the fp32 path
+    below is untouched.
     """
+    if cfg.kv_quant:
+        return _attn_decode_body_quant(cfg, args, refs, len_s)
     arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
                                         refs["v_cache"], refs["va"],
                                         refs["vkt"])
@@ -688,6 +927,183 @@ def attn_prefill_body(cfg, args, refs, len_s):
 
     q_tiles = pl.cdiv(cfg.h_loc * hd, w)
     jax.lax.fori_loop(0, q_tiles, per_qtile, 0)
+
+
+def write_kv_qblock_body(cfg, args, refs, len_s):
+    """Q-block (speculative verification) cache append: batch rows are
+    (slot, j) pairs in slot-major order (``cfg.seq`` = K rows per
+    slot); row r appends K/V at its OWN position ``len_s[r]`` through
+    slot ``r // K``'s block-table row. ``len_s[r] < 0`` MASKS the row
+    entirely (over-budget candidates near a request's token budget,
+    parked slots) — masked rows write nothing, so real pages and, on
+    quantized pools, their scales are never touched. Math is
+    op-for-op :func:`write_kv_body`'s per-row path, so on UNQUANTIZED
+    pools a committed candidate's stored K/V is bit-identical to what
+    the sequential decode lane would have written at that position
+    (greedy spec exactness). Quantized pools are token-AGREEING only:
+    an in-budget draft that is later rejected can have grown a page's
+    running scale (rescaling committed tokens once) — exactly the
+    layer path's merge behaviour, bounded by the quantization
+    contract."""
+    arena, k_cache, v_cache = (refs["arena"], refs["k_cache"],
+                               refs["v_cache"])
+    va, vb, vhd = refs["va"], refs["vb"], refs["vhd"]
+    tbl_s = refs["tbl_s"]
+    k_off, v_off, layer, knorm_off = args[0], args[1], args[2], args[3]
+    rows, hd, kv_loc, w = cfg.batch, cfg.hd, cfg.kv_loc, cfg.w
+    kq = cfg.seq
+    heads_per_tile = w // hd
+    kv_tiles = -(-(kv_loc * hd) // w)
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), jnp.maximum(len_s[r], 0), jnp.int32)
+         for r in range(rows)], axis=0)
+
+    pltpu.sync_copy(arena.at[pl.ds(knorm_off, 1)], vb.at[pl.ds(0, 1)])
+    wrow = vb[0, :hd].astype(jnp.float32)
+
+    def _store(cache, scale_name, r, kv_head, head_row):
+        slot = r // kq
+        pos = jnp.maximum(len_s[r], 0)
+        pid = tbl_s[slot * cfg.p_max + pos // cfg.page]
+        off = jax.lax.rem(pos, cfg.page)
+
+        @pl.when(len_s[r] >= 0)
+        def _():
+            if cfg.kv_quant:
+                _quant_store_token(cfg, refs, cache, scale_name, layer,
+                                   pid, off, kv_head, head_row)
+            else:
+                vhd[pl.ds(0, 1), :] = head_row.astype(vhd.dtype)
+                pltpu.sync_copy(
+                    vhd.at[pl.ds(0, 1)],
+                    cache.at[layer, pid, pl.ds(off, 1), kv_head, :])
+
+    for j in range(kv_tiles):                      # static tile loop
+        pltpu.sync_copy(arena.at[pl.ds(k_off + j * rows, rows)], va)
+        kt = va[...].astype(jnp.float32)
+        for hh in range(heads_per_tile):
+            kv_head = j * heads_per_tile + hh      # static head index
+            if kv_head >= kv_loc:
+                continue
+            head = kt[:, hh * hd:(hh + 1) * hd]
+            head = _rms_rows(head, wrow, cfg.rms_eps)
+            head = _rope_rows(head, pos_rows, hd, cfg.rope_theta)
+            for r in range(rows):
+                _store(k_cache, "k_scale", r, kv_head, head[r:r + 1])
+        pltpu.sync_copy(arena.at[pl.ds(v_off + j * rows, rows)], va)
+        vt = va[...].astype(jnp.float32)
+        for hh in range(heads_per_tile):
+            kv_head = j * heads_per_tile + hh
+            if kv_head >= kv_loc:
+                continue
+            for r in range(rows):
+                _store(v_cache, "v_scale", r, kv_head,
+                       vt[r:r + 1, hh * hd:(hh + 1) * hd])
+
+
+def attn_qblock_body(cfg, args, refs, len_s):
+    """Q-block verification attention: each slot's K query rows attend
+    the (just-appended) cache under the PER-QUERY causal mask
+    ``key_pos <= len_s[row]`` — the ``ops/paged_flash_qblock`` mask as
+    a megakernel task. One task covers a whole K-token verification
+    chain's attention for one layer; each query row runs the SAME
+    (1, hd) online-softmax stream as :func:`attn_decode_body`, so a
+    committed candidate's logits are bit-identical to the sequential
+    decode's (the greedy-acceptance exactness contract). Rows with
+    ``len_s[row] < 0`` compute garbage the host discards."""
+    arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
+                                        refs["v_cache"], refs["va"],
+                                        refs["vkt"])
+    tbl_s = refs["tbl_s"]
+    q_off, out_off, layer, qnorm_off = args[0], args[1], args[2], args[3]
+    rows, hd, w = cfg.batch, cfg.hd, cfg.w
+    h_loc, kv_loc = cfg.h_loc, cfg.kv_loc
+    kq = cfg.seq
+    t_tile = (refs["vqt"].shape[0] if cfg.kv_quant else vkt.shape[0])
+    group = h_loc // kv_loc
+    heads_per_tile = w // hd
+    pos_rows = jnp.concatenate(
+        [jnp.full((1, 1), jnp.maximum(len_s[r], 0), jnp.int32)
+         for r in range(rows)], axis=0)
+
+    pltpu.sync_copy(arena.at[pl.ds(qnorm_off, 1)],
+                    refs["vb"].at[pl.ds(0, 1)])
+    qn_row = refs["vb"][0, :hd].astype(jnp.float32)
+
+    q_tiles = -(-(h_loc * hd) // w)
+    for j in range(q_tiles):                       # static tile loop
+        pltpu.sync_copy(arena.at[pl.ds(q_off + j * rows, rows)], va)
+        qtile = va[...].astype(jnp.float32)
+        col_blocks = []
+        for hh in range(heads_per_tile):
+            h_idx = j * heads_per_tile + hh        # static head index
+            if h_idx >= h_loc:
+                col_blocks.append(jnp.zeros((rows, hd), jnp.float32))
+                continue
+            kv_head = h_idx // group
+            q = qtile[:, hh * hd:(hh + 1) * hd]
+            q = _rms_rows(q, qn_row, cfg.rms_eps)
+            q = _rope_rows(q, pos_rows, hd, cfg.rope_theta)
+            q = q / jnp.sqrt(jnp.float32(hd))
+            row_blocks = []
+            for r in range(rows):
+                slot = r // kq
+                kv_len = jnp.maximum(len_s[r], 0) + 1
+                n_tiles_t = pl.cdiv(kv_len, t_tile)
+
+                def tstep(tt, carry, slot=slot, r=r, q=q,
+                          kv_head=kv_head, kv_len=kv_len):
+                    m, l, acc = carry
+                    if cfg.kv_quant:
+                        pid = tbl_s[slot * cfg.p_max
+                                    + (tt * t_tile) // cfg.page]
+                        start = jax.lax.rem(tt * t_tile, cfg.page)
+                        kt = _dequant_tile(cfg, refs, k_cache,
+                                           "k_scale", layer, pid,
+                                           start, kv_head)
+                    else:
+                        pltpu.sync_copy(
+                            _kv_slice(k_cache, refs, cfg, layer, slot,
+                                      tt * t_tile, t_tile, kv_head),
+                            vkt)
+                        kt = vkt[...].astype(jnp.float32)
+                    s = jnp.dot(q[r:r + 1], kt.T,
+                                preferred_element_type=jnp.float32)
+                    tpos = tt * t_tile + jax.lax.broadcasted_iota(
+                        jnp.int32, (1, t_tile), 1)
+                    s = jnp.where(tpos < kv_len, s, -jnp.inf)
+                    m_new = jnp.maximum(
+                        m, jnp.max(s, axis=1, keepdims=True))
+                    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                    p = jnp.where(jnp.isfinite(s),
+                                  jnp.exp(s - m_safe), 0.0)
+                    corr = jnp.where(jnp.isfinite(m),
+                                     jnp.exp(m - m_safe), 0.0)
+                    if cfg.kv_quant:
+                        vt = _dequant_tile(cfg, refs, v_cache,
+                                           "v_scale", layer, pid,
+                                           start, kv_head)
+                    else:
+                        pltpu.sync_copy(
+                            _kv_slice(v_cache, refs, cfg, layer, slot,
+                                      tt * t_tile, t_tile, kv_head),
+                            vkt)
+                        vt = vkt[...].astype(jnp.float32)
+                    acc = acc * corr + jnp.dot(
+                        p, vt, preferred_element_type=jnp.float32)
+                    l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+                    return (m_new, l, acc)
+
+                m0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((1, 1), jnp.float32)
+                acc0 = jnp.zeros((1, hd), jnp.float32)
+                m, l, acc = jax.lax.fori_loop(0, n_tiles_t, tstep,
+                                              (m0, l0, acc0))
+                row_blocks.append(acc / jnp.maximum(l, 1e-30))
+            col_blocks.append(jnp.concatenate(row_blocks, axis=0))
+        refs["acc"][...] = jnp.concatenate(col_blocks, axis=1)
+        pltpu.sync_copy(refs["acc"],
+                        arena.at[pl.ds(out_off + j * rows, rows)])
 
 
 def gdn_decode_body(cfg, args, refs):

@@ -28,6 +28,12 @@ class TaskType(enum.IntEnum):
     MOE_WEIGHTS = 11       # args: rl_off, wout_off, n_experts, cnt_off
     WEIGHTED_ADD = 12      # args: acc_off, part_off, wbe_off, e, tiles, init
     GDN_DECODE = 13        # args: q,k,v,graw,braw,gbias,out offs, gdn_idx
+    # Q-block speculative-verification pair (builder ``qblock=True``):
+    # batch rows are (slot, j) pairs, each row at its OWN cache
+    # position len_s[row] (< 0 masks the row) — the
+    # ops/paged_flash_qblock per-query causal mask as megakernel tasks.
+    ATTN_QBLOCK = 14       # args like ATTN_DECODE; per-row positions
+    WRITE_KV_QBLOCK = 15   # args like WRITE_KV; per-row positions
 
 
 # Task types whose completion unblocks REMOTE peers: every other rank's

@@ -51,7 +51,7 @@ from triton_dist_tpu.resilience import faults
 __all__ = ["ChaosEvent", "ChaosReport", "FleetChaosReport",
            "InvariantViolation",
            "DEFAULT_FAULT_KINDS", "TIER_FAULT_KINDS",
-           "FLEET_FAULT_KINDS",
+           "FLEET_FAULT_KINDS", "MK_FAULT_KINDS",
            "check_invariants", "check_fleet_invariants",
            "run_soak", "run_fleet_soak"]
 
@@ -92,6 +92,20 @@ TIER_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
                         ...] = (
     ("drop_tier_transfer", "tier_transfer", "fail_call"),
     ("wedge_tier_transfer", "tier_transfer", "timeout_call"),
+)
+
+# The megakernel-lane menu (``run_soak`` over a paged
+# ``MegaKernelEngine`` serving factory): the persistent lane has no
+# migration/chunk/worker ops, so only the joint decode dispatch (the
+# prefill LANE rides it too) is injectable — dropped and wedged
+# decode/verification launches. Kept separate so layer-path soaks'
+# seeded schedules stay byte-identical.
+MK_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
+                      ...] = (
+    ("drop_decode", "serving_decode", "fail_call"),
+    ("wedge_decode", "serving_decode", "timeout_call"),
+    ("drop_verify", "spec_verify", "fail_call"),
+    ("wedge_verify", "spec_verify", "timeout_call"),
 )
 
 # The fleet-level menu (``run_fleet_soak`` over a ``FleetRouter``):
@@ -280,6 +294,54 @@ def check_invariants(srv) -> None:
                 f"queued request {h.request.request_id} still holds "
                 f"slot {h.slot}")
     _check_tiers(srv)
+    _check_arena(srv)
+
+
+def _check_arena(srv) -> None:
+    """Arena-coherence sweep (megakernel engines): the described
+    memory layout must stay sound under faults —
+
+    - **region disjointness**: the arena schema's in-arena regions
+      tile [0, rows) with no overlap/gap (``ArenaSchema
+      .check_disjoint``). The schema is build-time-frozen, so this
+      half re-asserts a static invariant — it exists to catch a
+      FUTURE builder change that starts mutating layouts at serve
+      time, not a runtime fault (cheap: pure host arithmetic);
+    - **scale/page consistency** (quantized pools): every
+      per-(layer, page, kv_head) dequant scale is finite and > 0
+      (write_kv's running-amax maintenance can never produce 0 or a
+      NaN — either would silently zero or poison a page's dequant);
+    - **monotonic counters**: the in-arena MoE router counters only
+      ever grow between sweeps (the epilogue accumulates; a decrease
+      means a clobbered counter region).
+    """
+    if not getattr(srv, "mega", False):
+        return
+    eng = srv.engine
+    for b in (eng.builder, getattr(eng, "verify_builder", None)):
+        if b is None:
+            continue
+        try:
+            b.schema.check_disjoint()
+        except ValueError as e:
+            raise InvariantViolation(f"arena schema broke: {e}") from e
+    if getattr(eng, "k_scale", None) is not None:
+        for name in ("k_scale", "v_scale"):
+            a = np.asarray(getattr(eng, name))
+            if not np.isfinite(a).all() or (a <= 0).any():
+                raise InvariantViolation(
+                    f"quantized pool {name} left the sane range "
+                    f"(finite, > 0): min={a.min()}, "
+                    f"finite={np.isfinite(a).all()}")
+    if getattr(srv.cfg, "is_moe", False) and hasattr(eng,
+                                                     "expert_counts"):
+        counts = eng.expert_counts()
+        prev = getattr(srv, "_mk_counts_sweep", None)
+        if prev is not None and (counts < prev).any():
+            raise InvariantViolation(
+                f"megakernel expert counters went BACKWARDS: "
+                f"{prev.tolist()} -> {counts.tolist()}")
+        srv._mk_counts_sweep = counts
 
 
 def _check_tiers(srv) -> None:
@@ -475,7 +537,10 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
     ``factory`` builds the serving engine (a fresh, identically-
     configured one each call — ``restore_at`` uses it again for the
     mid-soak kill/checkpoint/restore drill). Greedy traffic only (the
-    exactness oracle is ``Engine.serve``). Raises
+    exactness oracle is ``Engine.serve``; megakernel factories get a
+    fresh fault-free serving engine instead — pass
+    ``kinds=MK_FAULT_KINDS`` there, and the per-tick sweep adds the
+    arena-coherence check). Raises
     :class:`InvariantViolation` (or the server's own crash) on any
     violation; returns a :class:`ChaosReport` otherwise.
 
@@ -488,9 +553,12 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
     """
     rng = np.random.RandomState(seed)
     srv = factory()
-    if srv.mega:
-        raise NotImplementedError(
-            "the chaos soak drives the layer serving path")
+    # Megakernel engines soak too (pass kinds=MK_FAULT_KINDS — the
+    # persistent lane has no migration/chunk ops): the oracle is a
+    # fresh fault-free serving engine from the same factory (the mk
+    # engine has no Engine.serve), and the per-tick sweep additionally
+    # runs the arena-coherence check (_check_arena).
+    mk_oracle = {"srv": None} if srv.mega else None
     vocab = srv.cfg.vocab_size
     cap = min(srv.p_max * srv.page, srv.max_len)
     max_gen = max(g for g in gen_choices)
@@ -657,7 +725,17 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
     for prompt, gen, h in tracked:
         if h.status != "done":
             continue
-        want = _oracle_tokens(srv.engine, prompt, gen, oracle_cache)
+        if mk_oracle is not None:
+            key = (tuple(prompt), gen)
+            if key not in oracle_cache:
+                if mk_oracle["srv"] is None:
+                    mk_oracle["srv"] = factory()
+                oracle_cache[key] = mk_oracle["srv"].generate(
+                    [list(prompt)], max_new_tokens=gen)[0]
+            want = oracle_cache[key]
+        else:
+            want = _oracle_tokens(srv.engine, prompt, gen,
+                                  oracle_cache)
         if list(h.tokens) != list(want):
             raise InvariantViolation(
                 f"survivor {h.request.request_id} diverged from the "

@@ -364,17 +364,39 @@ class ServingEngine:
         self.chunker = None
 
         if self.mega:
-            if kv_dtype not in (None, "bf16", "native"):
+            # kv_dtype / spec_k are ENGINE knobs on the megakernel lane
+            # (the arena schema, scale tables, and verification builder
+            # are all construction-time): the engine must have been
+            # built with the matching values — this layer validates,
+            # plans capacity, and drives the verification tick.
+            eng_kvd = getattr(engine, "kv_dtype", "bf16")
+            if kv_quant_spec(kv_dtype)[0] != kv_quant_spec(eng_kvd)[0] \
+                    or (kv_quant_spec(kv_dtype)[0] is not None
+                        and kv_dtype != eng_kvd):
                 raise ValueError(
-                    "kv_dtype is a layer-path knob: the megakernel "
-                    "decode lane's write_kv/attn_decode read the raw "
-                    "arena pools and have no per-page scale plumbing "
-                    "yet (docs/serving.md, 'KV quantization')")
-            if self.spec_k:
+                    f"megakernel kv_dtype mismatch: the engine stores "
+                    f"{eng_kvd!r} pools but the serving layer was "
+                    f"asked for {kv_dtype!r} — construct "
+                    f"MegaKernelEngine(kv_dtype={kv_dtype!r}, "
+                    "paged=True) and pass the same value here")
+            self.kv_dtype = eng_kvd
+            # spec_k=1 degenerates to plain decode on BOTH sides (the
+            # engine coerces it at construction) — normalize before
+            # comparing so matching ctor arguments never "mismatch".
+            if self.spec_k == 1:
+                self.spec_k = 0
+            # Both directions: an engine built WITH spec_k but served
+            # without it would drive decode_step while expert_counts
+            # reads the verify builder's (never-written) counter
+            # region — fail loudly like the kv_dtype mismatch does.
+            if (self.spec_k or 0) != (getattr(engine, "spec_k", 0)
+                                      or 0):
                 raise ValueError(
-                    "spec_k is a layer-path knob; the megakernel's "
-                    "persistent step is single-token (its prefill "
-                    "lane already amortizes dispatch overhead)")
+                    f"megakernel spec_k mismatch: the engine was built "
+                    f"with spec_k={getattr(engine, 'spec_k', 0)} but "
+                    f"the serving layer was asked for {self.spec_k} — "
+                    "construct MegaKernelEngine(spec_k=K, paged=True) "
+                    "and pass the same K here")
             if self.prefill_buckets:
                 raise ValueError(
                     "prefill_buckets is a layer-path knob; the "
@@ -408,9 +430,21 @@ class ServingEngine:
                         f">= batch*p_max+1 (= {num_slots * p_max + 1}, "
                         f"got {engine.num_pages})")
                 self.page, self.p_max = page, p_max
-                self.manager = BlockManager(engine.num_pages, page,
-                                            p_max,
-                                            prefix_reuse=prefix_reuse)
+                # Capacity plan off the model geometry (mk pools are
+                # fp32-native): surfaces bytes_per_token and the
+                # quantization capacity ratio in stats, exactly like
+                # the layer path.
+                self.plan = self.cfg.kv_cache_plan(
+                    max_len=self.max_len, page=page,
+                    num_slots=num_slots,
+                    tp=engine.mesh.shape[engine.axis],
+                    dtype_bytes=4, kv_dtype=self.kv_dtype)
+                self.manager = BlockManager(
+                    engine.num_pages, page, p_max,
+                    prefix_reuse=prefix_reuse,
+                    page_bytes=self.plan["page_bytes_per_rank"],
+                    native_page_bytes=self.plan[
+                        "native_page_bytes_per_rank"])
             else:
                 # Dense megakernel cache: each slot owns a (max_len,)
                 # row — no pages to manage, only the live-slot mask.
@@ -820,9 +854,15 @@ class ServingEngine:
         out["chunk_attn"] = None if self.mega else self.chunk_attn
         # KV quantization surface: which storage the pools ride and
         # what a resident token costs (capacity math in the pool dict).
-        out["kv_dtype"] = "bf16" if self.mega else self.kv_dtype
+        out["kv_dtype"] = self.kv_dtype
         if hasattr(self, "plan"):
             out["kv_bytes_per_token"] = self.plan["bytes_per_token"]
+        # Megakernel lane capabilities (nulled, not omitted, on the
+        # layer path) — smoke scripts gate on these instead of
+        # grepping tracebacks for the old NotImplementedError rejects.
+        out["mk_kv_dtype"] = self.kv_dtype if self.mega else None
+        out["mk_spec"] = (self.spec_k or 0) if self.mega else None
+        out["mk_checkpointable"] = True if self.mega else None
         # Speculative-decode surface: draft volume vs accepted volume
         # (tokens beyond the per-dispatch guaranteed one).
         if self.spec_k:
@@ -885,8 +925,11 @@ class ServingEngine:
         batch shape is fixed). With speculation on, the K-token
         verification dispatch IS the decode dispatch (K is static,
         acceptance is data), so the same gate covers it."""
-        fn = (self.engine._step if self.mega
-              else self._verify if self.spec_k else self._decode)
+        if self.mega:
+            fn = (self.engine._verify_step if self.spec_k
+                  else self.engine._step)
+        else:
+            fn = self._verify if self.spec_k else self._decode
         return fn._cache_size()
 
     def compare_greedy(self, pairs) -> float:
@@ -912,11 +955,13 @@ class ServingEngine:
     def _ckpt_meta(self) -> dict:
         return {
             "format": self.CHECKPOINT_FORMAT,
+            "engine_kind": "mega" if self.mega else "layer",
             "kv_dtype": self.kv_dtype, "page": self.page,
             "p_max": self.p_max, "num_slots": self.num_slots,
             "max_len": self.max_len, "spec_k": self.spec_k,
             "vocab_size": self.cfg.vocab_size,
-            "num_pages": self.manager.num_pages,
+            "num_pages": (None if self.manager is None
+                          else self.manager.num_pages),
             "kv_tiers": self.tiers is not None,
         }
 
@@ -957,12 +1002,14 @@ class ServingEngine:
         callbacks cannot cross a process boundary and are dropped:
         reattach via the handles ``restore()`` returns. Pure
         observation — the live engine is not mutated.
+
+        Megakernel engines snapshot by ARENA SCHEMA (KV pools +
+        quantization scales + in-arena counters + GDN state, by
+        region name — ``MegaKernelEngine.snapshot_state``), bit-exact
+        at any kv_dtype, so the persistent lane resumes decode
+        token-exact too (mid-prefill-LANE requests snapshot as
+        queued, exactly like mid-chunk-stream ones).
         """
-        if self.mega:
-            raise NotImplementedError(
-                "checkpoint/restore is a layer-path feature: the "
-                "megakernel's KV lives in its in-kernel arena "
-                "(docs/serving.md, 'Checkpoint/restore')")
         t_ck = self.obs.now()
         running = [h for h in self.sched.running()
                    if h.status == "running"]
@@ -971,26 +1018,34 @@ class ServingEngine:
         # Release in-flight (non-running) slots on a COPY of the
         # allocator state, so the snapshot is self-consistent with
         # their queued status — reusing free_slot keeps the refcount /
-        # staged-prefix algebra identical to the live path.
-        m2 = BlockManager(self.manager.num_pages, self.page,
-                          self.p_max,
-                          prefix_reuse=self.manager.prefix_reuse)
-        m2.load_snapshot(self.manager.snapshot())
+        # staged-prefix algebra identical to the live path. (A dense
+        # megakernel engine has no allocator: the mirrors alone carry
+        # the slot state.)
+        m2 = None
+        if self.manager is not None:
+            m2 = BlockManager(self.manager.num_pages, self.page,
+                              self.p_max,
+                              prefix_reuse=self.manager.prefix_reuse)
+            m2.load_snapshot(self.manager.snapshot())
         lens, live, toks = (self._lens.copy(), self._live.copy(),
                             self._toks.copy())
         for h in inflight:
             if h.slot is not None:
-                m2.free_slot(h.slot)
+                if m2 is not None:
+                    m2.free_slot(h.slot)
                 lens[h.slot] = live[h.slot] = toks[h.slot] = 0
-        c = self.cache
-        cache_np = {
-            "k_pages": np.asarray(c.k_pages),
-            "v_pages": np.asarray(c.v_pages),
-            "k_scale": (None if c.k_scale is None
-                        else np.asarray(c.k_scale)),
-            "v_scale": (None if c.v_scale is None
-                        else np.asarray(c.v_scale)),
-        }
+        if self.mega:
+            cache_np = self.engine.snapshot_state()
+        else:
+            c = self.cache
+            cache_np = {
+                "k_pages": np.asarray(c.k_pages),
+                "v_pages": np.asarray(c.v_pages),
+                "k_scale": (None if c.k_scale is None
+                            else np.asarray(c.k_scale)),
+                "v_scale": (None if c.v_scale is None
+                            else np.asarray(c.v_scale)),
+            }
         handles = ([self._ser_handle(h, keep_slot=True)
                     for h in running]
                    + [self._ser_handle(h, keep_slot=False)
@@ -1003,7 +1058,7 @@ class ServingEngine:
         snap = {
             "meta": self._ckpt_meta(),
             "cache": cache_np,
-            "manager": m2.snapshot(),
+            "manager": (None if m2 is None else m2.snapshot()),
             "handles": handles,
             "lens": lens, "live": live, "toks": toks,
             "counters": dict(self.stats_counters),
@@ -1037,9 +1092,6 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
-        if self.mega:
-            raise NotImplementedError(
-                "checkpoint/restore is a layer-path feature")
         t_rs = self.obs.now()
         meta = snap.get("meta", {})
         if meta.get("format") != self.CHECKPOINT_FORMAT:
@@ -1075,27 +1127,39 @@ class ServingEngine:
                     f"snapshot tier contents do not fit this "
                     f"engine's tier store ({reason}) — restore needs "
                     "an equally-provisioned tier store")
-        c = snap["cache"]
-        if np.dtype(c["k_pages"].dtype) != np.dtype(
-                self.cache.k_pages.dtype):
-            raise ValueError(
-                f"pool dtype mismatch: snapshot {c['k_pages'].dtype} "
-                f"vs engine {self.cache.k_pages.dtype}")
-        cache = _dc.replace(
-            self.cache,
-            k_pages=jnp.asarray(c["k_pages"]),
-            v_pages=jnp.asarray(c["v_pages"]),
-            k_scale=(None if c["k_scale"] is None
-                     else jnp.asarray(c["k_scale"])),
-            v_scale=(None if c["v_scale"] is None
-                     else jnp.asarray(c["v_scale"])))
-        # Re-pin to the pool's one sharding spelling — the decode
-        # dispatch must not re-specialize on the first post-restore
-        # tick.
-        self.cache = jax.tree.map(
-            jax.device_put, cache, self._cache_shardings,
-            is_leaf=lambda x: isinstance(x, jax.Array))
-        self.manager.load_snapshot(snap["manager"])
+        if self.mega:
+            # Schema-driven adoption: pools + scales + counters + GDN
+            # state land back in the engine, re-pinned to their
+            # construction shardings (the persistent step never
+            # re-specializes); counters telemetry restarts from the
+            # restored baseline.
+            self.engine.restore_state(snap["cache"])
+            self._mk_counts_base = None
+            self._mk_load_sig = None
+        else:
+            c = snap["cache"]
+            if np.dtype(c["k_pages"].dtype) != np.dtype(
+                    self.cache.k_pages.dtype):
+                raise ValueError(
+                    f"pool dtype mismatch: snapshot "
+                    f"{c['k_pages'].dtype} vs engine "
+                    f"{self.cache.k_pages.dtype}")
+            cache = _dc.replace(
+                self.cache,
+                k_pages=jnp.asarray(c["k_pages"]),
+                v_pages=jnp.asarray(c["v_pages"]),
+                k_scale=(None if c["k_scale"] is None
+                         else jnp.asarray(c["k_scale"])),
+                v_scale=(None if c["v_scale"] is None
+                         else jnp.asarray(c["v_scale"])))
+            # Re-pin to the pool's one sharding spelling — the decode
+            # dispatch must not re-specialize on the first
+            # post-restore tick.
+            self.cache = jax.tree.map(
+                jax.device_put, cache, self._cache_shardings,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+        if self.manager is not None and snap["manager"] is not None:
+            self.manager.load_snapshot(snap["manager"])
         self._lens = np.asarray(snap["lens"], np.int32).copy()
         self._live = np.asarray(snap["live"], np.int32).copy()
         self._toks = np.asarray(snap["toks"], np.int32).copy()
@@ -2038,6 +2102,8 @@ class ServingEngine:
             CommTimeoutError, block_until_ready)
         from triton_dist_tpu.serving.spec import accept_greedy
 
+        if self.mega:
+            return self._spec_tick_mega()
         active = [h for h in self.sched.running()
                   if h.status == "running"]
         if not active:
@@ -2150,6 +2216,158 @@ class ServingEngine:
                 self.stats_counters["spec_accepted"] += m - 1
             # Commit the accepted prefix BEFORE emitting (an emission
             # may retire the request and free the slot's pages).
+            base = int(self._lens[slot])
+            self._lens[slot] = base + m
+            self.manager.truncate_to(slot, base + m)
+            rolled = int(budget[slot]) - m
+            if rolled > 0:
+                self.obs.event("spec_rollback",
+                               request_id=h.request.request_id,
+                               slot=slot, accepted=m, rolled=rolled)
+            self.stats_counters["decode_tokens"] += m
+            for j in range(m):
+                if h.done:
+                    break
+                tok = (picks[j] if greedy else
+                       self._pick(logits[slot, j], h.request,
+                                  len(h.tokens)))
+                self._emit(h, tok)
+        return len(active)
+
+    def _spec_tick_mega(self) -> int:
+        """The megakernel speculative tick: every decode-side dispatch
+        is ONE Q-block verification launch
+        (:meth:`MegaKernelEngine.verify_step`) — running slots feed
+        their K drafted candidates at per-row positions, PREFILL-LANE
+        slots ride row (slot, 0) with the lane's next token (rows
+        1..K-1 masked), so the jitted step count stays at one entry.
+        Acceptance/rollback/draft logic is the layer tick's,
+        token-exact with the non-spec megakernel run by construction
+        (the verification rows' logits are bit-identical to the
+        sequential decode body's)."""
+        import jax.numpy as jnp
+        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.watchdog import CommTimeoutError
+        from triton_dist_tpu.serving.spec import accept_greedy
+
+        kk = self.spec_k
+        active = [h for h in self.sched.running()
+                  if h.status in ("running", "prefill")]
+        if not active:
+            return 0
+        preempted = []
+        drafts: dict = {}
+        budget = np.zeros((self.num_slots,), np.int32)
+        pos = np.full((self.num_slots * kk,), -1, np.int32)
+        toks = np.zeros((self.num_slots, kk), np.int32)
+        draft_span = self.obs.span("spec_draft", batch=len(active),
+                                   k=kk)
+        draft_span.__enter__()
+        for h in active:
+            slot = h.slot
+            if h.status == "prefill":
+                # Prefill lane: one lane token this tick through row
+                # (slot, 0); its pages were reserved at admission.
+                toks[slot, 0] = h.lane[h.prompt_pos]
+                pos[slot * kk] = int(self._lens[slot])
+                continue
+            base = int(self._lens[slot])
+            rem = h.request.max_new_tokens - len(h.tokens)
+            n_pre = max(1, min(kk, rem))
+            try:
+                for j in range(n_pre):
+                    self.manager.append(slot, base + j)
+            except OutOfPagesError as e:
+                self._preempt(h, e)
+                preempted.append(h)
+                continue
+            hist = list(h.request.prompt) + [int(t) for t in h.tokens]
+            d = [int(h.tokens[-1])]
+            if kk > 1:
+                if h.request.temperature <= 0.0:
+                    d += self._draft.propose(hist, kk - 1)
+                    self.stats_counters["spec_drafted"] += n_pre - 1
+                else:
+                    d += [d[-1]] * (kk - 1)   # sampled: 1 commit max
+            drafts[slot] = d
+            budget[slot] = n_pre
+            toks[slot] = d
+            # Over-budget rows stay at -1: the kernel MASKS them, so
+            # they never touch real pages (or, quantized, scales).
+            for j in range(n_pre):
+                pos[slot * kk + j] = base + j
+        draft_span.__exit__(None, None, None)
+        if preempted:
+            active = [h for h in active if h not in preempted]
+            if not active:
+                return 0
+        tbl = np.zeros((self.num_slots, self.p_max), np.int32)
+        for h in active:
+            tbl[h.slot] = self.manager.table_row(h.slot)
+        self.engine.block_table = jnp.asarray(tbl.reshape(-1),
+                                              jnp.int32)
+        if (self._mk_counts_base is None
+                and hasattr(self.engine, "expert_counts")
+                and getattr(self.cfg, "is_moe", False)):
+            # The verification dispatch carries the in-arena router
+            # counters exactly like the decode dispatch — same
+            # pre-serving-warmup baseline discipline as _dispatch.
+            self._mk_counts_base = self.engine.expert_counts()
+
+        t0 = time.perf_counter()
+        try:
+            with self.obs.span(
+                    "spec_verify",
+                    step=self.stats_counters["decode_dispatches"],
+                    batch=len(active), k=kk), \
+                    faults.on_op_call("spec_verify"):
+                logits = np.asarray(self.engine.verify_step(
+                    jnp.asarray(toks.reshape(-1)), jnp.asarray(pos)))
+        except (CommTimeoutError, faults.InjectedFault) as e:
+            if isinstance(e, CommTimeoutError):
+                self.stats_counters["comm_timeouts"] += 1
+            for victim in self.sched.timeout_victims():
+                self._fail(victim,
+                           "timeout" if isinstance(e, CommTimeoutError)
+                           else "failed", e)
+            return 0
+        self.stats_counters["decode_time_s"] += time.perf_counter() - t0
+        self.stats_counters["decode_dispatches"] += 1
+        if self._mk_counts_base is not None:
+            total = self.engine.expert_counts()
+            self._note_expert_counts(total - self._mk_counts_base)
+            self._mk_counts_base = total
+        self._maybe_rebalance()
+
+        for h in active:
+            slot = h.slot
+            if h.status == "prefill":
+                self._lens[slot] += 1
+                h.prompt_pos += 1
+                if h.prompt_pos < len(h.lane):
+                    continue
+                h.status = "running"   # last lane token's logits
+                if self.manager is not None:
+                    self.manager.commit_prefix(slot)
+                if h.tokens:
+                    continue           # resumed lane: next token known
+                h.decode_steps += 1
+                self.stats_counters["decode_tokens"] += 1
+                first = self._pick(logits[slot, 0], h.request, 0)
+                self._emit(h, first)
+                continue
+            d = drafts[slot]
+            h.decode_steps += 1
+            greedy = h.request.temperature <= 0.0
+            if greedy:
+                picks = [int(np.argmax(logits[slot, j]))
+                         for j in range(kk)]
+                m = accept_greedy(d, picks)
+            else:
+                m = 1
+            m = min(m, int(budget[slot]))
+            if kk > 1 and greedy:
+                self.stats_counters["spec_accepted"] += m - 1
             base = int(self._lens[slot])
             self._lens[slot] = base + m
             self.manager.truncate_to(slot, base + m)
