@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
 	qblock-smoke obs-smoke tier-smoke fleet-smoke \
-	mega-parity-smoke apicheck ci bench-all
+	mega-parity-smoke supervise-smoke apicheck ci bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -121,6 +121,17 @@ fleet-smoke: csrc
 # "Arena schema").
 mega-parity-smoke: csrc
 	bash scripts/mega_parity_smoke.sh
+
+# Supervised-serving battery: checkpoint-envelope + keep-last-K ring
+# corruption fallback, parent-side ack dedupe/divergence/gap units,
+# real-child crash + stall recovery token-exact, the three-boundary
+# payload-integrity drill (tier put / migration send / fleet
+# handoff), the >= 6-fault supervised soak, a SIGKILL-mid-stream
+# crash/resume e2e, and the non-null crash_recovery_ms /
+# supervised_survived_faults / integrity_checks bench gate
+# (docs/resilience.md, "Process supervision").
+supervise-smoke: csrc
+	bash scripts/supervise_smoke.sh
 
 # docs/api.md is generated; fail CI when it drifts from the source.
 apicheck:

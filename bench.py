@@ -822,6 +822,41 @@ def _interpret_chaos() -> dict:
     }
 
 
+def _interpret_supervised() -> dict:
+    """Process-level fault domain on the CPU mesh — the
+    ``crash_recovery_ms`` / ``supervised_survived_faults`` /
+    ``integrity_checks`` surface (non-null gate in
+    scripts/supervise_smoke.sh): a short seeded supervised soak (a
+    REAL child process SIGKILLed and stalled mid-serve, streams
+    resumed token-exact from the checkpoint ring) plus the in-process
+    integrity drill (seeded payload corruption at the tier /
+    migration / handoff boundaries, each detected and recovered).  A
+    completed run IS the result — divergence or a missed detection
+    raises and nulls the keys."""
+    import tempfile
+
+    from triton_dist_tpu.resilience import chaos
+
+    rep = chaos.run_supervised_soak(
+        checkpoint_dir=tempfile.mkdtemp(prefix="tdt-sup-bench-"),
+        seed=11, n_requests=3, n_faults=2,
+        kinds=(("kill_child", None, None),
+               ("stall_child", None, None)),
+        gen_choices=(4, 6), deadline_s=300.0)
+    drill = chaos.run_integrity_drill()
+    rec = rep.supervisor.get("last_recovery_ms")
+    return {
+        "crash_recovery_ms": round(rec, 1) if rec else None,
+        "supervised_survived_faults": rep.survived_faults,
+        "supervised_restarts": rep.supervisor["restarts"],
+        "supervised_dedup_dropped": rep.supervisor["dedup_dropped"],
+        "integrity_checks": (drill["tier_checks"]
+                            + drill["migration_integrity_failures"]
+                            + drill["handoff_integrity_failures"]),
+        "integrity_quarantined": drill["tier_quarantined"],
+    }
+
+
 def _interpret_tiers() -> dict:
     """Tiered KV memory hierarchy on the CPU mesh — the
     ``kv_hot_hit_rate`` / ``session_resume_ms`` / ``offloaded_pages``
@@ -1084,6 +1119,14 @@ def _interpret_bench(reason: str) -> None:
         ch = {"chaos_survived_faults": None,
               "chaos_error": str(e)[:300]}
     try:
+        sp = _interpret_supervised()
+    except Exception as e:  # supervised soak must not sink the record
+        # Nulled, NOT omitted: the supervise_smoke gate greps these.
+        sp = {"crash_recovery_ms": None,
+              "supervised_survived_faults": None,
+              "integrity_checks": None,
+              "supervise_error": str(e)[:300]}
+    try:
         ti = _interpret_tiers()
     except Exception as e:  # tier bench must not sink the record
         # Nulled, NOT omitted: the tier_smoke gate greps these keys.
@@ -1130,6 +1173,7 @@ def _interpret_bench(reason: str) -> None:
             **ep,
             **qb,
             **ch,
+            **sp,
             **ti,
             **fl,
             **mp,

@@ -163,7 +163,7 @@ def test_quantized_disagg_migration_bit_exact():
             break
         srv.step()
     assert srv._pending, "migration never issued"
-    _, _, payload, dst_ids, _, _ = srv._pending[0]
+    _, _, payload, dst_ids, _, _, _ = srv._pending[0]
     k_pay = np.asarray(payload[0])
     ks_pay = np.asarray(payload[2])
     # Collect the migration and compare BEFORE any decode append can

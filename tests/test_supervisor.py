@@ -1,0 +1,397 @@
+"""Process-level fault domain battery (docs/resilience.md, "Process
+supervision" / "Payload integrity"): the ServingSupervisor's
+crash/stall recovery with token-exact stream resume, the journaled
+checkpoint ring incl. corrupt-newest fallback, the parent-side ack
+dedupe protocol, end-to-end payload-integrity detection at every
+serialization boundary, and the supervised chaos soak (slow).
+
+The subprocess tests spawn REAL children (the tiny-model factory in
+``chaos.supervised_tiny_factory``) — each spawn pays a JAX import +
+compile, so they share one module-scoped checkpoint-dir tree and keep
+streams short.  Everything parent-protocol-level (dedupe, ring walk,
+envelope) runs in-process and is fast.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import Engine, ModelConfig
+from triton_dist_tpu.resilience import chaos
+from triton_dist_tpu.resilience.integrity import (
+    CheckpointCorruptError, IntegrityError, payload_digest,
+    verify_payload)
+from triton_dist_tpu.resilience.supervisor import (
+    CheckpointRing, ServingSupervisor, SupervisedHandle,
+    SupervisorProtocolError)
+from triton_dist_tpu.serving import FleetRouter, Request, ServingEngine
+from triton_dist_tpu.serving.server import (
+    load_checkpoint, save_checkpoint)
+
+FACTORY = "triton_dist_tpu.resilience.chaos:supervised_tiny_factory"
+
+CFG = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                       intermediate_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=4,
+                       head_dim=8)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    return Engine(CFG, mesh, mode="xla", max_len=32, seed=0)
+
+
+def _oracle(engine, prompt, gen):
+    import jax.numpy as jnp
+    ids = jnp.asarray(np.asarray([list(prompt)], np.int32))
+    return np.asarray(engine.serve(ids, gen_len=gen))[0].tolist()
+
+
+def _wait(sup, pred, *, deadline_s=240.0, what="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        sup.pump()
+        if time.monotonic() - t0 > deadline_s:
+            raise TimeoutError(
+                f"{what} not reached in {deadline_s}s "
+                f"(stats={sup.stats()})")
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint envelope hardening (in-process)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_envelope_detects_bit_flip(tmp_path):
+    """A flipped byte anywhere in the checkpoint file surfaces as
+    CheckpointCorruptError — never a raw pickle traceback."""
+    path = str(tmp_path / "snap.pkl")
+    save_checkpoint({"anything": [1, 2, 3]}, path)
+    assert load_checkpoint(path) == {"anything": [1, 2, 3]}
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x40
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        load_checkpoint(path)
+    assert ei.value.path == path
+
+
+def test_checkpoint_envelope_detects_truncation(tmp_path):
+    path = str(tmp_path / "snap.pkl")
+    save_checkpoint({"x": list(range(100))}, path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+    # Absence is NOT corruption — callers distinguish the two.
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "never-written.pkl"))
+
+
+def test_checkpoint_ring_prunes_and_orders(tmp_path):
+    ring = CheckpointRing(str(tmp_path), keep=2)
+    p0 = ring.append({"n": 0}, tick=1)
+    p1 = ring.append({"n": 1}, tick=2)
+    p2 = ring.append({"n": 2}, tick=3)
+    assert not os.path.exists(p0)          # pruned past keep
+    ents = ring.entries()
+    assert [e["seq"] for e in ents] == [2, 1]   # newest first
+    assert ring.newest_good() == p2
+    assert load_checkpoint(p1) == {"n": 1}
+
+
+def test_ring_corrupt_newest_falls_back_to_predecessor(tmp_path):
+    """The restore walk skips a corrupted newest snapshot and lands on
+    its ring predecessor (the supervisor's restore_fallbacks path)."""
+    ring = CheckpointRing(str(tmp_path), keep=3)
+    ring.append({"n": 0}, tick=1)
+    p1 = ring.append({"n": 1}, tick=2)
+    p2 = ring.append({"n": 2}, tick=3)
+    raw = bytearray(open(p2, "rb").read())
+    raw[-3] ^= 0x01
+    open(p2, "wb").write(bytes(raw))
+    skipped = []
+    assert ring.newest_good(
+        on_fallback=lambda p, e: skipped.append((p, type(e)))) == p1
+    assert skipped == [(p2, CheckpointCorruptError)]
+    # All corrupt -> None (the supervisor then restarts from scratch).
+    # A different byte than above — re-XORing the same bit on the
+    # already-corrupt newest would RESTORE it.
+    for ent in ring.entries():
+        p = os.path.join(str(tmp_path), ent["file"])
+        raw = bytearray(open(p, "rb").read())
+        raw[10] ^= 0x80
+        open(p, "wb").write(bytes(raw))
+    assert ring.newest_good() is None
+
+
+# ---------------------------------------------------------------------------
+# Ack dedupe protocol (in-process: pure parent logic)
+# ---------------------------------------------------------------------------
+
+def _parent_only(tmp_path) -> ServingSupervisor:
+    sup = ServingSupervisor(FACTORY, checkpoint_dir=str(tmp_path))
+    h = SupervisedHandle("r1", [1, 2], {"max_new_tokens": 4},
+                         stream_cb=None)
+    sup.handles["r1"] = h
+    sup._order.append("r1")
+    return sup
+
+
+def test_ack_dedupe_never_double_emits(tmp_path):
+    """A restored child re-emits its FULL token history; the parent
+    must fire the client callback exactly once per index no matter how
+    many times an index is replayed."""
+    sup = _parent_only(tmp_path)
+    seen = []
+    sup.handles["r1"].stream_cb = seen.append
+    for i, tok in enumerate([7, 8, 9]):
+        sup._on_tok("r1", i, tok)
+    # Full-history replay after a simulated restart.
+    for i, tok in enumerate([7, 8, 9]):
+        sup._on_tok("r1", i, tok)
+    sup._on_tok("r1", 3, 11)
+    assert seen == [7, 8, 9, 11]
+    assert sup.handles["r1"].tokens == [7, 8, 9, 11]
+    assert sup.counters["dedup_dropped"] == 3
+    assert sup.counters["acked_tokens"] == 4
+
+
+def test_ack_replay_divergence_raises(tmp_path):
+    """A replayed index carrying a DIFFERENT token is a divergence bug
+    — the parent raises instead of silently re-emitting."""
+    sup = _parent_only(tmp_path)
+    sup._on_tok("r1", 0, 7)
+    with pytest.raises(SupervisorProtocolError, match="diverged"):
+        sup._on_tok("r1", 0, 8)
+
+
+def test_ack_gap_raises(tmp_path):
+    """Acks flush before the checkpoint containing them is written, so
+    a restored child can never legitimately skip ahead — a gap is a
+    protocol bug."""
+    sup = _parent_only(tmp_path)
+    sup._on_tok("r1", 0, 7)
+    with pytest.raises(SupervisorProtocolError, match="gap"):
+        sup._on_tok("r1", 2, 9)
+
+
+# ---------------------------------------------------------------------------
+# Live-child recovery (subprocess)
+# ---------------------------------------------------------------------------
+
+def test_crash_mid_decode_resumes_token_exact(tmp_path, engine):
+    """SIGKILL the child mid-decode: the parent restores the newest
+    ring snapshot into a fresh child and the client stream resumes
+    token-exact with no double emission (docs/resilience.md)."""
+    sup = ServingSupervisor(
+        FACTORY, checkpoint_dir=str(tmp_path / "ring"),
+        heartbeat_timeout_s=120.0, checkpoint_every=2,
+        tick_throttle_s=0.05)
+    seen = []
+    with sup:
+        h = sup.submit([3, 1, 2], max_new_tokens=12,
+                       stream_cb=seen.append)
+        _wait(sup, lambda: sup.counters["acked_tokens"] >= 3,
+              what="3 acked tokens")
+        sup.kill_child()
+        sup.run_until_done(deadline_s=240)
+        st = sup.stats()
+    want = _oracle(engine, [3, 1, 2], 12)
+    assert h.status == "done"
+    assert h.tokens == want
+    assert seen == want                      # exactly-once delivery
+    assert st["crashes"] == 1 and st["restarts"] == 1
+    assert st["checkpoints"] >= 1
+    assert st["last_recovery_ms"] is not None
+
+
+def test_stall_detection_kills_and_restores(tmp_path, engine):
+    """A child that stops heartbeating (wedged thread model) is
+    detected by heartbeat silence, SIGKILLed, and restored — the
+    in-flight stream still finishes token-exact."""
+    sup = ServingSupervisor(
+        FACTORY, checkpoint_dir=str(tmp_path / "ring"),
+        heartbeat_timeout_s=120.0, checkpoint_every=2,
+        tick_throttle_s=0.05)
+    with sup:
+        h = sup.submit([5, 5, 5], max_new_tokens=10)
+        # Warm first (compile gaps would false-trigger a tight
+        # timeout), then tighten ONLY for the stall window.
+        _wait(sup, lambda: sup.counters["acked_tokens"] >= 2,
+              what="warm child")
+        sup.heartbeat_timeout_s = 2.0
+        sup.inject_stall()
+        _wait(sup, lambda: sup.counters["stalls"] >= 1,
+              deadline_s=60.0, what="stall detection")
+        # Relax before the restored child's cold compile gap can
+        # false-trigger again.
+        sup.heartbeat_timeout_s = 120.0
+        sup.run_until_done(deadline_s=240)
+        st = sup.stats()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, [5, 5, 5], 10)
+    assert st["stalls"] == 1 and st["restarts"] == 1
+
+
+def test_corrupt_newest_checkpoint_restores_ring_predecessor(
+        tmp_path, engine):
+    """Crash with a corrupted NEWEST snapshot: the parent's restore
+    walk skips it (restore_fallbacks) and resumes from the ring
+    predecessor — still token-exact."""
+    ring_dir = str(tmp_path / "ring")
+    sup = ServingSupervisor(
+        FACTORY, checkpoint_dir=ring_dir, heartbeat_timeout_s=120.0,
+        checkpoint_every=2, ring_k=3, tick_throttle_s=0.05)
+    with sup:
+        h = sup.submit([2, 4, 6], max_new_tokens=14)
+        _wait(sup, lambda: sup.counters["checkpoints"] >= 2,
+              what="two ring checkpoints")
+        sup.kill_child()
+        # Corrupt the newest snapshot ON DISK before the parent's
+        # next pump runs recovery.
+        newest = CheckpointRing(ring_dir).entries()[0]
+        p = os.path.join(ring_dir, newest["file"])
+        raw = bytearray(open(p, "rb").read())
+        raw[len(raw) // 2] ^= 0x10
+        open(p, "wb").write(bytes(raw))
+        sup.run_until_done(deadline_s=240)
+        st = sup.stats()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, [2, 4, 6], 14)
+    assert st["crashes"] == 1
+    assert st["restore_fallbacks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Payload integrity at every serialization boundary (in-process)
+# ---------------------------------------------------------------------------
+
+def test_payload_digest_detects_any_flip():
+    a = np.arange(64, dtype=np.float32).reshape(8, 8)
+    s = np.ones((4,), np.float32)
+    d = payload_digest([a, s])
+    assert verify_payload([a, s], d, boundary="unit") == d
+    b = a.copy()
+    b[3, 3] += 1e-6
+    with pytest.raises(IntegrityError) as ei:
+        verify_payload([b, s], d, boundary="unit", key="k1")
+    assert ei.value.boundary == "unit" and ei.value.key == "k1"
+    # Digest covers dtype/shape headers too, not just bytes.
+    with pytest.raises(IntegrityError):
+        verify_payload([a.reshape(4, 16), s], d, boundary="unit")
+    # want=None is the pre-digest vacuous case.
+    verify_payload([b, s], None, boundary="unit")
+
+
+def test_integrity_drill_all_three_boundaries(engine):
+    """Seeded corruption at tier-transfer, page-migration, and
+    fleet-handoff: each is DETECTED (quarantine / integrity counters
+    move) and RECOVERED token-exact — never a wrong token."""
+    out = chaos.run_integrity_drill(engine)
+    assert out["tier_quarantined"] >= 1
+    assert out["migration_integrity_failures"] >= 1
+    assert out["handoff_integrity_failures"] >= 1
+    assert out["token_exact_requests"] == 3
+    assert out["wrong_tokens"] == 0
+
+
+def test_tier_corruption_quarantines_and_recomputes(engine):
+    """Finer-grained than the drill: the corrupted tier entry is
+    evicted (quarantined), the integrity span lands in telemetry, and
+    the request recovers through the recompute path."""
+    from triton_dist_tpu.resilience import faults
+
+    srv = ServingEngine(engine, num_slots=2, page=4, num_pages=16,
+                        prefix_reuse=True,
+                        kv_tiers={"host_pages": 128},
+                        telemetry="spans")
+    h = srv.submit([5, 3, 5, 3, 5, 3], max_new_tokens=6)
+    for _ in range(64):
+        if h.status == "running" and h.tokens:
+            break
+        srv.step()
+    srv.park(h)
+    key = ("session", h.request.request_id)
+    assert key in srv.tiers
+    srv.resume(h)
+    plan = faults.get_plan("corrupt_payload", op="tier_transfer",
+                           k=None)
+    with faults.inject(plan):
+        srv.step()
+    assert key not in srv.tiers              # quarantined, not served
+    assert srv.tiers.stats_counters["integrity_quarantined"] >= 1
+    assert srv.stats_counters["integrity_failures"] >= 1
+    kinds = [s.kind for s in srv.obs.log.spans()]
+    assert "integrity_check" in kinds
+    srv.run()
+    assert h.status == "done"
+    assert h.tokens == _oracle(engine, [5, 3, 5, 3, 5, 3], 6)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: one injectable clock across the fleet topology
+# ---------------------------------------------------------------------------
+
+def test_fleet_router_single_injectable_clock(engine):
+    """The router's clock governs EVERY fleet's scheduler and
+    telemetry — including fleets added by scale_to — so a fake clock
+    drives deadline expiry deterministically across the topology."""
+    t = {"now": 100.0}
+
+    def clock():
+        return t["now"]
+
+    def factory():
+        return ServingEngine(engine, num_slots=2, page=4,
+                             num_pages=16, prefix_reuse=True)
+
+    router = FleetRouter(factory, fleets=2, clock=clock)
+    router.scale_to(3)
+    for f in router.fleets:
+        assert f.engine.sched.clock is clock
+        assert f.engine.obs.clock is clock
+    h = router.submit(Request(prompt=[1, 2], max_new_tokens=4,
+                              deadline=105.0))
+    router.step()
+    assert not h.done
+    t["now"] = 106.0                       # fake time passes; no wall
+    for _ in range(4):
+        router.step()
+    assert h.status == "timeout"
+
+
+# ---------------------------------------------------------------------------
+# The supervised soak (slow: several real child lifecycles)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_supervised_soak_survives_kills_and_stalls(tmp_path):
+    """The acceptance soak: >= 6 seeded child kills/stalls in one run,
+    every finished stream token-exact vs the in-process oracle."""
+    rep = chaos.run_supervised_soak(
+        checkpoint_dir=str(tmp_path / "ring"), seed=7, n_requests=8,
+        n_faults=6, kinds=chaos.SUPERVISED_FAULT_KINDS[:3],
+        deadline_s=480.0)
+    assert rep.survived_faults >= 6
+    assert rep.requests["done"] == rep.requests["submitted"] == 8
+    assert rep.token_exact_requests == 8
+    assert rep.supervisor["restarts"] >= 1
+
+
+def test_supervised_mini_soak(tmp_path):
+    """Tier-1 mini soak: a short seeded schedule with one hard kill —
+    the cheap always-on cousin of the slow acceptance soak."""
+    rep = chaos.run_supervised_soak(
+        checkpoint_dir=str(tmp_path / "ring"), seed=11, n_requests=3,
+        n_faults=2, kinds=(("kill_child", None, None),),
+        gen_choices=(4, 6), deadline_s=300.0)
+    assert rep.survived_faults >= 1
+    assert rep.requests["done"] == 3
+    assert rep.token_exact_requests == 3

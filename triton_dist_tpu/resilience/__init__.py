@@ -24,6 +24,22 @@ seeded SOAK over live serving traffic — randomized fault schedules
 with an invariant sweep after every tick and token-exactness vs the
 fault-free oracle (imported lazily: ``from triton_dist_tpu.resilience
 import chaos``).
+
+The process-level fault domain (ISSUE 16) adds two more:
+
+- :mod:`~triton_dist_tpu.resilience.integrity` — per-payload crc32c
+  digests computed at every serialization boundary (tier put,
+  migration send, fleet handoff, checkpoint write) and verified at the
+  consuming edge; mismatch raises :class:`IntegrityError` into the
+  boundary's existing recovery path.
+- :mod:`~triton_dist_tpu.resilience.supervisor` — the serving engine
+  tick loop in a CHILD process under
+  :class:`~triton_dist_tpu.resilience.supervisor.ServingSupervisor`:
+  per-tick heartbeats + token acks out, requests in; on crash or
+  heartbeat stall the parent SIGKILLs, restores the newest good
+  snapshot from a journaled keep-last-K checkpoint ring, and
+  re-submits unacked work deduped by ``(request_id, token_index)`` —
+  client streams resume token-exact.
 """
 
 from triton_dist_tpu.resilience.faults import (  # noqa: F401
@@ -34,8 +50,16 @@ from triton_dist_tpu.resilience.faults import (  # noqa: F401
     battery,
     get_plan,
     inject,
+    corrupt_fault,
     on_op_call,
     register_plan,
+)
+from triton_dist_tpu.resilience.integrity import (  # noqa: F401
+    CheckpointCorruptError,
+    IntegrityError,
+    maybe_corrupt,
+    payload_digest,
+    verify_payload,
 )
 from triton_dist_tpu.resilience.watchdog import (  # noqa: F401
     CommTimeoutError,

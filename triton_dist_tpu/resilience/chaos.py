@@ -41,6 +41,7 @@ Usage (the tier-1 subset in ``tests/test_chaos.py`` and the
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import Counter
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -49,11 +50,13 @@ import numpy as np
 from triton_dist_tpu.resilience import faults
 
 __all__ = ["ChaosEvent", "ChaosReport", "FleetChaosReport",
-           "InvariantViolation",
+           "SupervisedChaosReport", "InvariantViolation",
            "DEFAULT_FAULT_KINDS", "TIER_FAULT_KINDS",
            "FLEET_FAULT_KINDS", "MK_FAULT_KINDS",
+           "INTEGRITY_FAULT_KINDS", "SUPERVISED_FAULT_KINDS",
            "check_invariants", "check_fleet_invariants",
-           "run_soak", "run_fleet_soak"]
+           "run_soak", "run_fleet_soak", "run_integrity_drill",
+           "run_supervised_soak", "supervised_tiny_factory"]
 
 
 class InvariantViolation(AssertionError):
@@ -125,6 +128,41 @@ FLEET_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
     ("wedge_handoff", "fleet_handoff", "timeout_call"),
 )
 
+# The payload-integrity menu (ISSUE 16): a seeded single-bit flip on
+# the payload crossing each serialization boundary, detected by the
+# crc32c digest check at the consuming edge (never by luck) and routed
+# into that boundary's existing recovery path — tier get quarantines
+# the entry and recomputes, a corrupted migration retries then
+# re-prefills, a corrupted handoff hop retries against the victim's
+# still-authoritative entry then re-prefills. Transient events (k=0)
+# corrupt only the first attempt; hard ones (k=None) every attempt.
+# Kept separate so existing soaks' seeded schedules stay
+# byte-identical; compose per engine shape (tier kinds need
+# ``kv_tiers``, handoff kinds a :class:`FleetRouter`).
+INTEGRITY_FAULT_KINDS: Tuple[Tuple[str, Optional[str], Optional[str]],
+                             ...] = (
+    ("corrupt_tier_transfer", "tier_transfer", "corrupt_payload"),
+    ("corrupt_migration", "page_migration", "corrupt_payload"),
+    ("corrupt_handoff", "fleet_handoff", "corrupt_payload"),
+)
+
+# The process-level menu (``run_supervised_soak`` over a
+# :class:`~triton_dist_tpu.resilience.supervisor.ServingSupervisor`):
+# events fire at seeded ACK-COUNT thresholds (real child processes
+# make tick counts nondeterministic; the acked-token stream is the
+# deterministic clock the parent actually observes). ``kill_child``
+# is a parent-side SIGKILL (the OOM-killer model), ``crash_child`` an
+# in-child ``os._exit`` (the segfault model — exercises the nonzero
+# exit path), ``stall_child`` a heartbeat stall (wedged thread),
+# ``corrupt_migration`` a one-tick in-child payload corruption.
+SUPERVISED_FAULT_KINDS: Tuple[Tuple[str, Optional[str],
+                                    Optional[str]], ...] = (
+    ("kill_child", None, None),
+    ("crash_child", None, None),
+    ("stall_child", None, None),
+    ("corrupt_migration", "page_migration", "corrupt_payload"),
+)
+
 
 @dataclasses.dataclass
 class ChaosEvent:
@@ -182,6 +220,24 @@ class FleetChaosReport:
     invariant_checks: int
     token_exact_requests: int
     scaled_at: Optional[int]
+
+
+@dataclasses.dataclass
+class SupervisedChaosReport:
+    """What a completed supervised soak measured (completion already
+    means: every request ``done`` and token-exact vs the in-process
+    oracle across every child kill/stall/corruption — violations
+    raise).  ``supervisor`` is the parent's final counter view
+    (restarts, crashes, stalls, dedup_dropped, restore_fallbacks,
+    acked_tokens, last_recovery_ms...)."""
+
+    seed: int
+    events: List["ChaosEvent"]
+    faults_injected: int
+    survived_faults: int
+    requests: Dict[str, int]
+    supervisor: Dict[str, object]
+    token_exact_requests: int
 
 
 # ---------------------------------------------------------------------------
@@ -935,3 +991,341 @@ def run_fleet_soak(factory: Callable[[], object], *,
         invariant_checks=invariant_checks,
         token_exact_requests=token_exact,
         scaled_at=scaled_tick)
+
+
+# ---------------------------------------------------------------------------
+# Supervised soak: a REAL child process under seeded kills / stalls /
+# corruption (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def supervised_tiny_factory(num_slots: int = 2, max_len: int = 32,
+                            page: int = 8):
+    """Importable child-side factory for the supervised soak: the
+    tiny-model colocated disagg engine on one CPU device (chunked
+    prefill + migration + retry reachable, deterministic greedy
+    decode).  Module-level on purpose — the supervisor child resolves
+    it by ``module:qualname`` string."""
+    import jax
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.resilience.policy import RetryPolicy
+    from triton_dist_tpu.serving import DisaggServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4,
+                           num_key_value_heads=4, head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(cfg, mesh, mode="xla", max_len=max_len, seed=0)
+    return DisaggServingEngine(
+        eng, num_slots=num_slots, page=page, prefill_buckets=(4, 8),
+        prefix_reuse=True, retry=RetryPolicy(max_attempts=2),
+        worker_fail_threshold=2)
+
+
+def run_supervised_soak(
+        *, checkpoint_dir: str, seed: int = 0, n_requests: int = 8,
+        n_faults: int = 6,
+        factory: str = ("triton_dist_tpu.resilience.chaos:"
+                        "supervised_tiny_factory"),
+        factory_kwargs: Optional[Dict] = None, vocab: int = 64,
+        gen_choices: Sequence[int] = (3, 4, 6, 8),
+        kinds: Sequence = SUPERVISED_FAULT_KINDS,
+        checkpoint_every: int = 2, heartbeat_timeout_s: float = 60.0,
+        stall_detect_s: float = 2.0, tick_throttle_s: float = 0.04,
+        deadline_s: float = 600.0) -> SupervisedChaosReport:
+    """Drive a REAL supervised child process through ``n_faults``
+    seeded kills / crashes / stalls / corruptions while it serves
+    ``n_requests`` streams, then gate every finished stream token-
+    exact against the in-process oracle (``Engine.serve`` on the same
+    factory's weights — same seed, same weights by construction).
+
+    Events fire when the parent's acked-token count crosses seeded
+    thresholds (real process timing makes tick counts nondeterministic
+    — the ack stream is the clock the parent actually observes), so
+    one ``seed`` fixes the traffic AND where in each stream every
+    fault lands.  A ``stall_child`` event tightens the heartbeat
+    timeout to ``stall_detect_s`` until the recovery lands (child
+    startup/compile gaps make a permanently-tight timeout
+    false-trigger); a false stall during that window just becomes one
+    more survived restart — the gate is token-exactness, not fault
+    attribution.
+
+    Raises :class:`InvariantViolation` on any non-``done`` request or
+    token divergence; returns a :class:`SupervisedChaosReport`.
+    """
+    from triton_dist_tpu.resilience.supervisor import (
+        ServingSupervisor, _resolve_factory)
+
+    rng = np.random.RandomState(seed)
+    fkw = dict(factory_kwargs or {})
+
+    # Seeded traffic first (all rng draws in a fixed order).
+    gen_choices = list(gen_choices)
+    reqs = []
+    for i in range(n_requests):
+        n = int(rng.randint(1, 9))
+        prompt = [int(x) for x in rng.randint(0, vocab, n)]
+        gen = int(gen_choices[int(rng.randint(len(gen_choices)))])
+        reqs.append((f"soak-{i}", prompt, gen))
+    total = sum(g for _, _, g in reqs)
+    # Thresholds stay under ~85% of the total stream so every event
+    # fires while work is still in flight.
+    hi = max(2, int(total * 0.85))
+    thresholds = sorted(int(t) for t in rng.choice(
+        np.arange(1, hi), size=min(n_faults, hi - 1), replace=False))
+    events = []
+    for t in thresholds:
+        name, op, kind = kinds[int(rng.randint(len(kinds)))]
+        events.append(ChaosEvent(tick=t, name=name, op=op, kind=kind,
+                                 transient=True))
+
+    # In-process oracle: same factory, same seed -> same weights.
+    oracle_srv = _resolve_factory(factory)(**fkw)
+    oracle_cache: Dict = {}
+    want = {rid: _oracle_tokens(oracle_srv.engine, prompt, gen,
+                                oracle_cache)
+            for rid, prompt, gen in reqs}
+
+    sup = ServingSupervisor(
+        factory, checkpoint_dir=checkpoint_dir,
+        heartbeat_timeout_s=heartbeat_timeout_s,
+        checkpoint_every=checkpoint_every, factory_kwargs=fkw,
+        tick_throttle_s=tick_throttle_s)
+    sup.start()
+    handles = {}
+    stall_restore_at: Optional[int] = None
+    try:
+        for rid, prompt, gen in reqs:
+            handles[rid] = sup.submit(prompt, request_id=rid,
+                                      max_new_tokens=gen)
+        pending = list(events)
+        t0 = time.monotonic()
+        while True:
+            sup.pump()
+            if (stall_restore_at is not None
+                    and sup.counters["restarts"] >= stall_restore_at):
+                # The stall (or a coincident crash) was detected and
+                # recovered — relax the timeout before the restored
+                # child's cold compile gap can false-trigger again.
+                sup.heartbeat_timeout_s = heartbeat_timeout_s
+                stall_restore_at = None
+            acked = sup.counters["acked_tokens"]
+            all_done = all(h.done for h in handles.values())
+            while (pending and pending[0].tick <= acked
+                   and not all_done):
+                ev = pending.pop(0)
+                ev.fired = True
+                if ev.name == "kill_child":
+                    sup.kill_child()
+                elif ev.name == "crash_child":
+                    sup.inject_crash()
+                elif ev.name == "stall_child":
+                    stall_restore_at = sup.counters["restarts"] + 1
+                    sup.heartbeat_timeout_s = stall_detect_s
+                    sup.inject_stall()
+                else:
+                    sup.inject_fault(
+                        "corrupt_payload", op=ev.op,
+                        k=0 if ev.transient else None)
+            if all_done and not pending:
+                break
+            if all_done and pending:
+                # Streams finished under the last thresholds — the
+                # remaining events have nothing left to disrupt.
+                break
+            if time.monotonic() - t0 > deadline_s:
+                open_rids = [r for r, h in handles.items()
+                             if not h.done]
+                raise InvariantViolation(
+                    f"supervised soak exceeded {deadline_s}s with "
+                    f"open requests {open_rids[:8]} "
+                    f"(stats={sup.stats()})")
+            time.sleep(0.02)
+
+        statuses = Counter(h.status for h in handles.values())
+        token_exact = 0
+        for rid, prompt, gen in reqs:
+            h = handles[rid]
+            if h.status != "done":
+                raise InvariantViolation(
+                    f"supervised request {rid} ended {h.status!r} "
+                    f"(error={h.error!r})")
+            if list(h.tokens) != list(want[rid]):
+                raise InvariantViolation(
+                    f"supervised stream {rid} diverged from the "
+                    f"oracle across restarts: {h.tokens} != "
+                    f"{want[rid]} (prompt={prompt})")
+            token_exact += 1
+        stats = sup.stats()
+    finally:
+        sup.stop()
+
+    return SupervisedChaosReport(
+        seed=seed, events=events, faults_injected=len(events),
+        survived_faults=sum(1 for e in events if e.fired),
+        requests={"submitted": len(reqs), **{
+            k: statuses.get(k, 0)
+            for k in ("done", "failed", "timeout")}},
+        supervisor=stats, token_exact_requests=token_exact)
+
+
+# ---------------------------------------------------------------------------
+# Integrity drill: deterministic corruption at each serialization
+# boundary, in-process (the bench's integrity evidence)
+# ---------------------------------------------------------------------------
+
+def run_integrity_drill(engine=None, *, seed: int = 0) -> Dict:
+    """Deterministically corrupt the KV payload at each of the three
+    serving serialization boundaries — tier transfer (park/resume
+    round trip), page migration (prefill->decode handoff), and the
+    cross-fleet session handoff — and prove each one is DETECTED at
+    the consuming edge (quarantine / integrity counters move) and
+    RECOVERED through that boundary's existing path with the final
+    stream token-exact.  Raises :class:`InvariantViolation` on a
+    missed detection or a wrong token; returns the evidence counters
+    (the ``integrity_checks`` bench key sums them).
+
+    ``engine`` (optional) is a prebuilt tiny layer
+    :class:`~triton_dist_tpu.models.Engine` to reuse (the tests pass
+    their module fixture); built fresh otherwise.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.serving import (
+        DisaggServingEngine, FleetRouter, ServingEngine)
+
+    if engine is None:
+        cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                               intermediate_size=32,
+                               num_hidden_layers=2,
+                               num_attention_heads=4,
+                               num_key_value_heads=4, head_dim=8)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+        engine = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+
+    def oracle(prompt, gen):
+        ids = jnp.asarray(np.asarray([list(prompt)], np.int32))
+        return np.asarray(engine.serve(ids, gen_len=gen))[0].tolist()
+
+    def corrupt_plan(op, k=None):
+        return faults.FaultPlan(
+            name=f"drill-corrupt-{op}",
+            faults=(faults.Fault("corrupt_payload", op=op, k=k,
+                                 iters=seed),))
+
+    out = {"tier_checks": 0, "tier_quarantined": 0,
+           "migration_integrity_failures": 0,
+           "handoff_integrity_failures": 0,
+           "token_exact_requests": 0, "wrong_tokens": 0}
+
+    # -- boundary 1: tier transfer (park -> corrupt resume fetch) ----
+    srv = ServingEngine(engine, num_slots=2, page=4, num_pages=16,
+                        prefix_reuse=True,
+                        kv_tiers={"host_pages": 128})
+    prompt, gen = [5, 3, 5, 3, 5, 3], 6
+    h = srv.submit(prompt, max_new_tokens=gen)
+    for _ in range(64):
+        if h.status == "running" and h.tokens:
+            break
+        srv.step()
+    srv.park(h)
+    srv.resume(h)
+    with faults.inject(corrupt_plan("tier_transfer")):
+        # The admit-side tier get sees a corrupted payload: digest
+        # mismatch -> quarantine -> miss -> deterministic re-prefill.
+        srv.step()
+    srv.run()
+    if h.status != "done":
+        raise InvariantViolation(
+            f"tier-corruption drill ended {h.status!r}: {h.error!r}")
+    if list(h.tokens) != oracle(prompt, gen):
+        out["wrong_tokens"] += 1
+        raise InvariantViolation(
+            f"tier-corruption drill emitted wrong tokens: "
+            f"{h.tokens} != {oracle(prompt, gen)}")
+    out["token_exact_requests"] += 1
+    out["tier_checks"] = srv.tiers.stats_counters["integrity_checks"]
+    out["tier_quarantined"] = \
+        srv.tiers.stats_counters["integrity_quarantined"]
+    if out["tier_quarantined"] < 1:
+        raise InvariantViolation(
+            "tier-corruption drill: the corrupted payload was never "
+            "quarantined — detection missed")
+
+    # -- boundary 2: page migration (prefill -> decode handoff) ------
+    dsrv = DisaggServingEngine(engine, num_slots=2, page=8,
+                               prefill_buckets=(4, 8))
+    prompt2, gen2 = [7, 1, 7, 1], 6
+    h2 = dsrv.submit(prompt2, max_new_tokens=gen2)
+    for _ in range(64):
+        if dsrv._pending:
+            break
+        dsrv.step()
+    with faults.inject(corrupt_plan("page_migration")):
+        # Every migration attempt this tick is corrupted (k=None):
+        # verify fails at the consuming edge before anything reaches
+        # the decode pool, retries exhaust, the request re-queues for
+        # a clean re-prefill.
+        dsrv.step()
+    dsrv.run()
+    if h2.status != "done":
+        raise InvariantViolation(
+            f"migration-corruption drill ended {h2.status!r}: "
+            f"{h2.error!r}")
+    if list(h2.tokens) != oracle(prompt2, gen2):
+        out["wrong_tokens"] += 1
+        raise InvariantViolation(
+            f"migration-corruption drill emitted wrong tokens: "
+            f"{h2.tokens} != {oracle(prompt2, gen2)}")
+    out["token_exact_requests"] += 1
+    out["migration_integrity_failures"] = \
+        dsrv.stats_counters["integrity_failures"]
+    if out["migration_integrity_failures"] < 1:
+        raise InvariantViolation(
+            "migration-corruption drill: no integrity failure was "
+            "recorded — detection missed")
+
+    # -- boundary 3: cross-fleet session handoff ---------------------
+    def fleet_factory():
+        return ServingEngine(engine, num_slots=2, page=4,
+                             num_pages=16, prefix_reuse=True,
+                             kv_tiers={"host_pages": 128})
+
+    router = FleetRouter(fleet_factory, fleets=2)
+    prompt3, gen3 = [9, 2, 9, 2, 9, 2, 9, 2], 8
+    h3 = router.submit(prompt3, max_new_tokens=gen3)
+    for _ in range(64):
+        if h3.status == "running" and h3.tokens:
+            break
+        router.step()
+    victim = router._fleet_of(h3)
+    with faults.inject(corrupt_plan("fleet_handoff")):
+        # kill_fleet fails the victim's sessions over SYNCHRONOUSLY,
+        # so the handoff hop happens inside this scope: every hop is
+        # corrupted, the survivor's verify rejects the payload,
+        # retries exhaust, and failover falls back to the
+        # deterministic re-prefill path.
+        router.kill_fleet(victim.id, reachable=True)
+    router.run()
+    if h3.status != "done":
+        raise InvariantViolation(
+            f"handoff-corruption drill ended {h3.status!r}: "
+            f"{h3.error!r}")
+    if list(h3.tokens) != oracle(prompt3, gen3):
+        out["wrong_tokens"] += 1
+        raise InvariantViolation(
+            f"handoff-corruption drill emitted wrong tokens: "
+            f"{h3.tokens} != {oracle(prompt3, gen3)}")
+    out["token_exact_requests"] += 1
+    out["handoff_integrity_failures"] = \
+        router.counters["integrity_failures"]
+    if out["handoff_integrity_failures"] < 1:
+        raise InvariantViolation(
+            "handoff-corruption drill: no integrity failure was "
+            "recorded — detection missed")
+    return out

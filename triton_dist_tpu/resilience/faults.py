@@ -52,6 +52,14 @@ Fault kinds (``Fault.kind``):
   *detected* outcome instead; the genuine-deadlock plans stay in the
   subprocess harness). The serving retry/backoff and containment
   paths treat it exactly like a watchdog miss.
+- ``"corrupt_payload"`` — the ``k``-th host-staged payload of ``op``
+  (``tier_transfer`` / ``page_migration`` / ``fleet_handoff``) gets a
+  seeded bit flip applied to a COPY of its staged bytes before the
+  consuming edge verifies the digest (``iters`` seeds which bit;
+  ``k=None`` = every staged payload). Consulted via
+  :func:`corrupt_fault` by ``resilience.integrity.maybe_corrupt`` —
+  the model of silent wire/storage corruption the end-to-end payload
+  digests exist to catch (docs/resilience.md, "Payload integrity").
 """
 
 from __future__ import annotations
@@ -63,7 +71,8 @@ from typing import Dict, Optional, Tuple
 
 __all__ = [
     "Fault", "FaultPlan", "InjectedFault", "inject", "active_plan",
-    "on_op_call", "register_plan", "get_plan", "battery",
+    "on_op_call", "corrupt_fault", "register_plan", "get_plan",
+    "battery",
 ]
 
 
@@ -118,6 +127,9 @@ def _st():
         _STATE.op_stack = []
         _STATE.call_counts = {}
         _STATE.put_counts = {}
+        _STATE.corrupt_counts = {}
+    if not hasattr(_STATE, "corrupt_counts"):   # upgraded mid-thread
+        _STATE.corrupt_counts = {}
     return _STATE
 
 
@@ -138,6 +150,7 @@ def inject(plan: FaultPlan):
     st.plan = plan
     st.call_counts = {}
     st.put_counts = {}
+    st.corrupt_counts = {}
     try:
         yield plan
     finally:
@@ -193,6 +206,32 @@ def on_op_call(op: str):
                 detail="injected wedge (timeout_call fault): the "
                        "deterministic stand-in for a watchdog miss")
     return _op_scope(op)
+
+
+def corrupt_fault(op: str) -> Optional[Fault]:
+    """``corrupt_payload`` fault (if any) targeting the host-staged
+    payload of ``op`` being serialized right now.
+
+    Counts payload stagings per op (its OWN counter — independent of
+    the call/put counters, so a retried transfer that re-stages the
+    payload advances it) and returns the matching fault, whose
+    ``iters`` field seeds the bit flip. Consumed by
+    ``resilience.integrity.maybe_corrupt``; free when no plan is
+    active.
+    """
+    st = _st()
+    plan = st.plan
+    if plan is None:
+        return None
+    faults = plan.faults_of("corrupt_payload", op)
+    if not faults:
+        return None
+    idx = st.corrupt_counts.get(op, 0)
+    st.corrupt_counts[op] = idx + 1
+    for f in faults:
+        if f.k is None or f.k == idx:
+            return f
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -373,6 +412,14 @@ def _wedge_kth_call(op="*", k=0, **_):
         faults=(Fault("timeout_call", op=op, k=k),))
 
 
+def _corrupt_payload(op="tier_transfer", k=0, iters=0, **_):
+    # ``iters`` seeds the flipped bit (integrity.maybe_corrupt);
+    # k=None corrupts every staged payload of the op.
+    return FaultPlan(
+        name="corrupt_payload",
+        faults=(Fault("corrupt_payload", op=op, k=k, iters=iters),))
+
+
 register_plan("delayed_dma", _delayed_dma)
 register_plan("dropped_signal", _dropped_signal)
 register_plan("dup_signal", _dup_signal)
@@ -380,3 +427,4 @@ register_plan("skewed_barrier", _skewed_barrier)
 register_plan("dropped_edge", _dropped_edge)
 register_plan("fail_kth_call", _fail_kth_call)
 register_plan("wedge_kth_call", _wedge_kth_call)
+register_plan("corrupt_payload", _corrupt_payload)

@@ -1,0 +1,161 @@
+"""End-to-end payload integrity for every host serialization boundary.
+
+The serving stack moves KV pages across four boundaries where the
+bytes leave the producing array and are reconstructed later: the tier
+store (host spill / disk spill / promote), the disaggregated page
+migration (prefill worker → decode pool, optionally through the p2p
+bridge), the fleet session handoff (victim tier → target tier), and
+the checkpoint pickle. None of those paths previously verified what
+arrived — a flipped bit in a spilled page would be scattered back into
+the decode pool and *served*. This module provides the digest contract
+(ISSUE 16 / docs/resilience.md "Payload integrity"):
+
+- :func:`payload_digest` — crc32c over dtype + shape + raw bytes of
+  every array in the payload (quant scale planes included), computed
+  ONCE at the producing edge and carried alongside the payload (tier
+  ``TierEntry.meta["digest"]``, migration tuple, handoff meta,
+  checkpoint envelope). Uses the ``crc32c`` library when the
+  environment ships it, else ``zlib.crc32`` — same contract (a fixed
+  32-bit checksum), and both sides of every boundary run in the same
+  environment so the constant never mixes.
+- :func:`verify_payload` — recompute at the consuming edge, raise
+  :class:`IntegrityError` on mismatch. The *caller* routes the error
+  into the recovery path that already exists at that boundary: tier
+  get → quarantine + miss (recompute via re-prefill), migration →
+  retry (source pool still authoritative) then re-prefill, handoff →
+  retry then ``fleet_handoff_failed`` re-prefill, checkpoint restore →
+  previous ring snapshot.
+- :func:`maybe_corrupt` — the adversary: consults the active
+  :class:`~triton_dist_tpu.resilience.faults.FaultPlan` for a
+  ``corrupt_payload`` fault on the boundary's op and returns a COPY of
+  the payload with one seeded bit flipped. Always a copy, never in
+  place — ``tiers.get`` may alias the stored entry's arrays, and the
+  fault models the *wire*, not the source of truth.
+
+A digest is a detection contract, not a cryptographic one: crc32c
+catches the silent bit flips and truncations this layer models; it is
+not tamper-proofing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from triton_dist_tpu.resilience import faults
+
+__all__ = [
+    "IntegrityError", "CheckpointCorruptError", "payload_digest",
+    "digest_bytes", "verify_payload", "maybe_corrupt",
+]
+
+try:                                    # pragma: no cover - env-dependent
+    from crc32c import crc32c as _crc32
+except Exception:                       # noqa: BLE001 — any import issue
+    from zlib import crc32 as _crc32
+
+
+class IntegrityError(RuntimeError):
+    """A payload failed its digest check at a consuming edge.
+
+    ``boundary`` names the serialization boundary (``"tier_get"``,
+    ``"page_migration"``, ``"fleet_handoff"``, ``"checkpoint"``);
+    ``key`` identifies the payload when the boundary has one (tier
+    key, request id, checkpoint path)."""
+
+    def __init__(self, boundary: str, *, key=None,
+                 want: Optional[int] = None, got: Optional[int] = None,
+                 detail: str = ""):
+        self.boundary = boundary
+        self.key = key
+        self.want = want
+        self.got = got
+        msg = (f"payload integrity violation at {boundary!r}"
+               + (f" key={key!r}" if key is not None else "")
+               + (f": digest {got:#010x} != expected {want:#010x}"
+                  if want is not None and got is not None else "")
+               + (f" ({detail})" if detail else ""))
+        super().__init__(msg)
+
+
+class CheckpointCorruptError(IntegrityError):
+    """A checkpoint file is truncated, unpicklable, or fails its
+    envelope digest — raised by ``serving.server.load_checkpoint``
+    instead of a raw pickle traceback, so the supervisor's ring can
+    fall back to the previous snapshot."""
+
+    def __init__(self, path, detail: str = "", *, want=None, got=None):
+        super().__init__("checkpoint", key=str(path), want=want,
+                         got=got, detail=detail)
+        self.path = path
+
+
+def digest_bytes(data: bytes, crc: int = 0) -> int:
+    """Fold ``data`` into a running 32-bit digest."""
+    return _crc32(data, crc) & 0xFFFFFFFF
+
+
+def payload_digest(arrays: Sequence) -> int:
+    """crc32c over dtype, shape, and raw bytes of every array.
+
+    Accepts numpy or jax arrays (jax arrays are pulled to host — the
+    producing edges already stage on host, so this is free there).
+    Folding dtype+shape means a reinterpreted or resliced payload of
+    identical bytes still mismatches."""
+    crc = 0
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        crc = digest_bytes(
+            f"{a.dtype.str}:{a.shape};".encode("ascii"), crc)
+        crc = digest_bytes(a.tobytes(), crc)
+    return crc
+
+
+def verify_payload(arrays: Sequence, want: Optional[int], *,
+                   boundary: str, key=None) -> int:
+    """Recompute the payload digest and compare against ``want``.
+
+    Returns the recomputed digest. ``want=None`` (a payload produced
+    before digests existed, e.g. a pre-upgrade tier entry) verifies
+    vacuously — the digest contract is adopted at the producing edge,
+    enforced at the consuming edge."""
+    got = payload_digest(arrays)
+    if want is not None and got != want:
+        raise IntegrityError(boundary, key=key, want=want, got=got)
+    return got
+
+
+def _flip_one_bit(arrays: Tuple[np.ndarray, ...], seed: int):
+    """Deterministically flip one bit across the payload's bytes."""
+    sizes = [a.nbytes for a in arrays]
+    total = sum(sizes)
+    if total == 0:
+        return arrays
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    bit = int(rng.randint(0, total * 8))
+    byte, bitpos = divmod(bit, 8)
+    for a, n in zip(arrays, sizes):
+        if byte < n:
+            flat = a.reshape(-1).view(np.uint8)
+            flat[byte] ^= np.uint8(1 << bitpos)
+            break
+        byte -= n
+    return arrays
+
+
+def maybe_corrupt(arrays: Sequence, op: str) -> Tuple:
+    """Apply an active ``corrupt_payload`` fault for ``op`` — the
+    seeded adversary at a staging hop.
+
+    Fault-free (the common case): returns ``arrays`` as a tuple,
+    untouched and unconverted. Under a matching fault: returns DEEP
+    COPIES with one bit flipped (seeded by ``Fault.iters``), so the
+    producing side's arrays — which ``tiers.get`` may alias — stay
+    pristine; only the simulated wire is corrupted."""
+    f = faults.corrupt_fault(op)
+    if f is None:
+        return tuple(arrays)
+    copies = tuple(
+        np.array(np.ascontiguousarray(np.asarray(a))) for a in arrays)
+    return _flip_one_bit(copies, int(f.iters) + 1)

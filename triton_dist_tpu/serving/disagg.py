@@ -365,6 +365,7 @@ class DisaggServingEngine(ServingEngine):
         if self.tiers is None or self._prefiller is self:
             return 0
         from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.integrity import IntegrityError
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
 
         pw = self._prefiller
@@ -387,6 +388,11 @@ class DisaggServingEngine(ServingEngine):
                 break
             try:
                 arrays = self._tier_fetch_prefix(key)
+            except IntegrityError as e:
+                # Quarantined: a miss — the chunk stream recomputes.
+                self._note_integrity_failure(
+                    "tier_get", e, request_id=h.request.request_id)
+                arrays = None
             except (CommTimeoutError, faults.InjectedFault):
                 arrays = None            # faulted past retries: a miss
             if arrays is None:
@@ -465,9 +471,15 @@ class DisaggServingEngine(ServingEngine):
         # the allocation are payload padding — both land in scratch.
         dst_ids[hits:len(pages)] = pages[hits:]
         payload = pw.extract(src_ids)   # (K, V[, K_scale, V_scale])
+        # Producing-edge digest (docs/resilience.md, "Payload
+        # integrity"): computed over the extracted bytes before the
+        # hop; _complete_migrations re-verifies at the scatter edge.
+        from triton_dist_tpu.resilience.integrity import payload_digest
+
+        digest = payload_digest(payload)
         h.status = "migrating"
         self._pending.append((h, logits, payload, dst_ids,
-                              len(pages) - hits, pw))
+                              len(pages) - hits, pw, digest))
 
     def step(self) -> int:
         # Collect LAST tick's migrations first: their extracts (and
@@ -485,7 +497,7 @@ class DisaggServingEngine(ServingEngine):
         return n
 
     def _complete_migrations(self):
-        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience import faults, integrity
         from triton_dist_tpu.resilience.watchdog import (
             CommTimeoutError, block_until_ready)
 
@@ -494,22 +506,34 @@ class DisaggServingEngine(ServingEngine):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         pending, self._pending = self._pending, []
-        for h, logits, payload, dst_ids, n_mig, pw in pending:
+        for h, logits, payload, dst_ids, n_mig, pw, digest in pending:
             if h.status != "migrating":
                 continue    # failed/requeued meanwhile (deadline,
                             # worker failover)
             slot = h.slot
 
             def _attempt(payload=payload, dst_ids=dst_ids, pw=pw,
-                         slot=slot, h=h, n_mig=n_mig):
+                         slot=slot, h=h, n_mig=n_mig, digest=digest):
                 # Replay-idempotent: re-staging the same source pages
                 # and re-scattering the same bytes (+ scales) into the
                 # same dst ids — prefix rows stay scratch-routed, and
                 # the two-phase prefix publication means no other
                 # request can be reading the target pages yet. One
                 # span per ATTEMPT (retries repeat it).
-                k_pay, v_pay = payload[:2]
-                scales = payload[2:]    # () or (k_scale, v_scale)
+                # Consuming-edge digest check against the extract-time
+                # digest, AFTER the (corruptible) staging hop and
+                # BEFORE anything reaches the decode pool: a flipped
+                # bit raises IntegrityError and the retry re-stages
+                # from the worker's still-authoritative staging pool
+                # (maybe_corrupt's per-op counter advances per
+                # attempt, so a k=0 fault corrupts only once).
+                staged = integrity.maybe_corrupt(
+                    payload, "page_migration")
+                integrity.verify_payload(
+                    staged, digest, boundary="page_migration",
+                    key=h.request.request_id)
+                k_pay, v_pay = staged[:2]
+                scales = staged[2:]     # () or (k_scale, v_scale)
                 with self.obs.span(
                         "migration", request_id=h.request.request_id,
                         slot=slot, tenant=h.request.tenant,
@@ -545,7 +569,21 @@ class DisaggServingEngine(ServingEngine):
                                         "migrated_pages"]})
 
             try:
-                self._run_op_with_retry("page_migration", _attempt)
+                self._run_op_with_retry(
+                    "page_migration", _attempt,
+                    retry_on=(CommTimeoutError, faults.InjectedFault,
+                              integrity.IntegrityError))
+            except integrity.IntegrityError as e:
+                # Corruption survived every retry (a persistent
+                # corruptor, or no retry policy): never scatter the
+                # bytes — requeue token-preserving for the
+                # deterministic re-prefill (docs/resilience.md,
+                # "Payload integrity").
+                self._note_integrity_failure(
+                    "page_migration", e,
+                    request_id=h.request.request_id)
+                self._requeue_corrupt_migration(h, pw)
+                continue
             except (CommTimeoutError, faults.InjectedFault) as e:
                 # Retries exhausted. A worker being declared dead
                 # fails over (this handle requeues, token-preserving);
@@ -567,6 +605,25 @@ class DisaggServingEngine(ServingEngine):
             self._note_role_ok("prefill")
             self.stats_counters["migrated_pages"] += n_mig
             self._activate(h, logits)
+
+    def _requeue_corrupt_migration(self, h, pw) -> None:
+        """A migration payload failed its digest past retries: requeue
+        the ONE affected handle token-preserving at the queue head —
+        the per-handle slice of the failover requeue. Its re-prefill
+        re-derives the KV deterministically (token-exact, the PR-4
+        preemption contract); the suspect staging copy is abandoned
+        and the decode pages claimed at handoff are released."""
+        slot = h.slot
+        pw.release(slot)
+        self.sched.slots.pop(slot, None)
+        h.slot = None
+        self.manager.free_slot(slot)
+        self._lens[slot] = self._live[slot] = self._toks[slot] = 0
+        h.status = "queued"
+        h.queued_at = self.sched.now()
+        h.prompt_pos, h.lane, h.resident = 0, None, 0
+        h.chunks = []
+        self.sched.queue.appendleft(h)
 
     # -- prefill-worker failover --------------------------------------
 

@@ -161,6 +161,7 @@ class FleetRouter:
             "drain_resumed": 0, "drain_reprefilled": 0,
             "scale_ups": 0, "scale_downs": 0,
             "router_retries": 0, "comm_timeouts": 0,
+            "integrity_failures": 0,
         }
         for _ in range(fleets):
             self.fleets.append(self._make_fleet(factory()))
@@ -203,6 +204,17 @@ class FleetRouter:
 
         health = HealthTracker(fail_threshold=self.fleet_fail_threshold,
                                clock=self.clock, on_event=_on_event)
+        # ONE clock governs the whole topology: the router queue,
+        # every fleet's scheduler deadlines, and every fleet's
+        # telemetry stamps. Factory-built engines default to
+        # time.monotonic — rebinding here (clock is a plain attribute
+        # on both) makes deadline/shed decisions consistent across
+        # fleets and lets tests drive the full fleet with one fake
+        # clock (the PR-13 known limit: the router used to borrow
+        # fleet 0's scheduler clock while other fleets kept their
+        # own).
+        engine.sched.clock = self.clock
+        engine.obs.clock = self.clock
         return _Fleet(id=fid, engine=engine, health=health)
 
     def _live_fleets(self, exclude: Optional[_Fleet] = None
@@ -281,8 +293,15 @@ class FleetRouter:
             if isinstance(exc, CommTimeoutError):
                 self.counters["comm_timeouts"] += 1
 
+        from triton_dist_tpu.resilience.integrity import IntegrityError
+
+        # IntegrityError is retryable here: a corrupted HANDOFF hop
+        # re-fetches from the victim's still-authoritative tier entry
+        # (a corrupted victim GET quarantines inside the store and
+        # surfaces as LookupError on the retry — the re-prefill path).
         return pol.run(fn, op=f"router.{op}",
-                       retry_on=(CommTimeoutError, faults.InjectedFault),
+                       retry_on=(CommTimeoutError, faults.InjectedFault,
+                                 IntegrityError),
                        on_retry=_note,
                        event_cb=(self.obs.event if self.obs.spans_on
                                  else None))
@@ -504,7 +523,7 @@ class FleetRouter:
         moves the payload without overriding the caller's intent (a
         later ``router.resume(h)`` finds it). False → the caller
         falls back to re-prefill."""
-        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience import faults, integrity
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
         from triton_dist_tpu.serving.tiers import TierFullError
 
@@ -523,7 +542,19 @@ class FleetRouter:
                     arrays = victim.engine.tiers.get(key)
                     if arrays is None:
                         raise LookupError(key)
-                    t.engine.tiers.put(key, arrays, pages=entry.pages,
+                    # The cross-fleet hop is its own corruptible wire;
+                    # verify against the entry's producing-edge digest
+                    # BEFORE the target put (which forwards that same
+                    # digest in meta — the end-to-end check, not a
+                    # per-hop re-stamp). A flipped bit raises
+                    # IntegrityError; the retry re-fetches from the
+                    # victim's still-authoritative entry.
+                    staged = integrity.maybe_corrupt(
+                        arrays, "fleet_handoff")
+                    integrity.verify_payload(
+                        staged, entry.meta.get("digest"),
+                        boundary="fleet_handoff", key=key)
+                    t.engine.tiers.put(key, staged, pages=entry.pages,
                                        pinned=True,
                                        meta=dict(entry.meta))
 
@@ -533,9 +564,20 @@ class FleetRouter:
                 continue          # pinned-full target: next survivor
             except LookupError:
                 return False
-            except (CommTimeoutError, faults.InjectedFault) as e:
+            except (CommTimeoutError, faults.InjectedFault,
+                    integrity.IntegrityError) as e:
                 if isinstance(e, CommTimeoutError):
                     self.counters["comm_timeouts"] += 1
+                if isinstance(e, integrity.IntegrityError):
+                    # Never hand corrupt bytes to the target fleet —
+                    # count the detection and fall back to the
+                    # deterministic re-prefill (token-exact).
+                    self.counters["integrity_failures"] = (
+                        self.counters.get("integrity_failures", 0) + 1)
+                    self.obs.complete_span(
+                        "integrity_check", self.obs.now(),
+                        boundary="fleet_handoff", ok=False,
+                        request_id=rid)
                 self.obs.event("fleet_handoff_failed",
                                request_id=rid, fleet=target.id,
                                error=type(e).__name__)

@@ -50,28 +50,78 @@ from triton_dist_tpu.serving.scheduler import (
 __all__ = ["ServingEngine", "save_checkpoint", "load_checkpoint"]
 
 
+# On-disk checkpoint FILE format (distinct from the in-memory snapshot
+# format ``CHECKPOINT_FORMAT``): a versioned envelope around the
+# pickled snapshot bytes plus their digest, so a truncated, bit-flipped
+# or half-written file is DETECTED at load instead of surfacing as a
+# raw pickle traceback (or worse, restoring silently wrong state).
+CKPT_FILE_FORMAT = "tdt-serving-ckpt-file-v2"
+
+
 def save_checkpoint(snap: dict, path: str) -> str:
     """Persist a :meth:`ServingEngine.checkpoint` snapshot to ``path``
     (pickle; numpy pools incl. ml_dtypes fp8 round-trip bit-exact).
-    Atomic: written to a temp file and renamed, so a SIGKILL mid-write
-    leaves the previous checkpoint intact. Returns ``path``."""
+    The snapshot bytes ride a versioned envelope with their payload
+    digest (:data:`CKPT_FILE_FORMAT`) — :func:`load_checkpoint`
+    verifies it. Atomic: written to a temp file and renamed, so a
+    SIGKILL mid-write leaves the previous checkpoint intact. Returns
+    ``path``."""
     import os
     import pickle
 
+    from triton_dist_tpu.resilience.integrity import digest_bytes
+
+    payload = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+    env = {"format": CKPT_FILE_FORMAT,
+           "digest": digest_bytes(payload),
+           "payload": payload}
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
-        pickle.dump(snap, f, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dump(env, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, path)
     return path
 
 
 def load_checkpoint(path: str) -> dict:
     """Read a snapshot :func:`save_checkpoint` wrote (feed it to
-    :meth:`ServingEngine.restore` on a freshly-built engine)."""
+    :meth:`ServingEngine.restore` on a freshly-built engine).
+
+    Raises :class:`~triton_dist_tpu.resilience.integrity.
+    CheckpointCorruptError` when the file is truncated, unpicklable,
+    or its payload digest mismatches the envelope — the supervisor's
+    ring catches it and falls back to the previous snapshot. A
+    pre-envelope file (a raw snapshot dict) still loads; a missing
+    file raises ``FileNotFoundError`` (absence is not corruption)."""
     import pickle
 
+    from triton_dist_tpu.resilience.integrity import (
+        CheckpointCorruptError, digest_bytes)
+
     with open(path, "rb") as f:
-        return pickle.load(f)
+        try:
+            obj = pickle.load(f)
+        except Exception as e:       # noqa: BLE001 — truncation, junk
+            raise CheckpointCorruptError(
+                path, f"unreadable envelope: {e!r}") from e
+    if isinstance(obj, dict) and obj.get("format") == CKPT_FILE_FORMAT:
+        payload = obj.get("payload")
+        if not isinstance(payload, (bytes, bytearray)):
+            raise CheckpointCorruptError(path, "envelope has no payload")
+        got = digest_bytes(bytes(payload))
+        if got != obj.get("digest"):
+            raise CheckpointCorruptError(
+                path, "payload digest mismatch",
+                want=obj.get("digest"), got=got)
+        try:
+            return pickle.loads(bytes(payload))
+        except Exception as e:       # noqa: BLE001
+            raise CheckpointCorruptError(
+                path, f"unpicklable payload: {e!r}") from e
+    if isinstance(obj, dict) and "meta" in obj:
+        return obj                   # legacy pre-envelope snapshot
+    raise CheckpointCorruptError(
+        path, f"not a checkpoint envelope (top-level "
+              f"{type(obj).__name__})")
 
 
 class ServingEngine:
@@ -164,13 +214,19 @@ class ServingEngine:
         RetryPolicy` (applied to every retryable serving op), or a
         ``{op: RetryPolicy}`` dict, or ``None`` (no retries — the
         pre-existing fail-one behaviour). Retryable ops today:
-        ``"page_migration"`` (the disaggregated KV handoff) and
-        ``"chunked_prefill"`` (the bucketed chunk dispatch) — both are
+        ``"page_migration"`` (the disaggregated KV handoff),
+        ``"chunked_prefill"`` (the bucketed chunk dispatch),
+        ``"tier_transfer"`` (the tier hop), and the shared
+        ``"serving_decode"`` / ``"spec_verify"`` dispatches — all are
         replay-idempotent (staging pages, two-phase prefix
-        publication, position-keyed appends), so a dropped or
-        timed-out transfer is retried with deterministic exponential
-        backoff before the request is failed. Each absorbed transient
-        increments ``stats()["retries"]``.
+        publication, position-keyed appends; the decode/verify length
+        mirrors only advance on success), so a dropped transfer or a
+        TRANSIENT dropped dispatch is retried with deterministic
+        exponential backoff before the request is failed. A WEDGED
+        dispatch (``CommTimeoutError``) is never retried on the
+        decode/verify ops — a wedge blocks its own replay — and goes
+        straight to the fail-one containment (docs/resilience.md).
+        Each absorbed transient increments ``stats()["retries"]``.
 
         ``kv_tiers`` (layer path): the tier BELOW the paged HBM pool —
         a :class:`~triton_dist_tpu.serving.tiers.KVTierStore` (or
@@ -213,7 +269,9 @@ class ServingEngine:
             self.retry_policies = {op: retry for op in
                                    ("page_migration",
                                     "chunked_prefill",
-                                    "tier_transfer")}
+                                    "tier_transfer",
+                                    "serving_decode",
+                                    "spec_verify")}
         elif isinstance(retry, dict):
             for op, pol in retry.items():
                 if not isinstance(pol, RetryPolicy):
@@ -352,6 +410,7 @@ class ServingEngine:
             "tier_hits": 0, "tier_misses": 0, "offloaded_pages": 0,
             "prefetched_pages": 0, "parks": 0, "resumes": 0,
             "router_prefetched_pages": 0, "worker_prefetched_pages": 0,
+            "integrity_failures": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -1449,20 +1508,25 @@ class ServingEngine:
             if h.status == "prefill":
                 self._advance_chunk(h)
 
-    def _run_op_with_retry(self, op: str, fn):
+    def _run_op_with_retry(self, op: str, fn, retry_on=None):
         """Run one retryable serving op under its configured
         :class:`~triton_dist_tpu.resilience.policy.RetryPolicy` (none
         configured = one attempt). Retries only the transient fault
-        types (a watchdog miss, an injected fault) — every attempt
-        re-enters the op's fault scope, so a ``fail_kth_call`` plan's
-        call index advances per attempt and a transient at k=0 is
-        absorbed. Each retry increments the ``retries`` counter."""
+        types — by default a watchdog miss or an injected fault;
+        ``retry_on`` narrows that per call site (the decode/verify
+        dispatches pass ``(InjectedFault,)`` because a WEDGE blocks
+        its own replay). Every attempt re-enters the op's fault
+        scope, so a ``fail_kth_call`` plan's call index advances per
+        attempt and a transient at k=0 is absorbed. Each retry
+        increments the ``retries`` counter."""
         from triton_dist_tpu.resilience import faults
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
 
         pol = self.retry_policies.get(op)
         if pol is None:
             return fn()
+        if retry_on is None:
+            retry_on = (CommTimeoutError, faults.InjectedFault)
 
         def _note(attempt, exc):
             self.stats_counters["retries"] += 1
@@ -1475,10 +1539,21 @@ class ServingEngine:
                 self.stats_counters["comm_timeouts"] += 1
 
         return pol.run(fn, op=f"serving.{op}",
-                       retry_on=(CommTimeoutError, faults.InjectedFault),
+                       retry_on=retry_on,
                        on_retry=_note,
                        event_cb=(self.obs.event if self.obs.spans_on
                                  else None))
+
+    def _note_integrity_failure(self, boundary: str, exc, *,
+                                request_id=None) -> None:
+        """Account one detected payload-digest violation (the
+        ``integrity_check`` span row in docs/observability.md) — the
+        caller then routes into the boundary's recovery path."""
+        self.stats_counters["integrity_failures"] += 1
+        self.obs.complete_span(
+            "integrity_check", self.obs.now(), boundary=boundary,
+            ok=False, request_id=request_id,
+            key=str(getattr(exc, "key", None)))
 
     def _tier_worker_fetch(self, h: RequestHandle, slot: int) -> int:
         """Staging-pool tier fetch hook — a no-op on the in-place
@@ -1653,6 +1728,7 @@ class ServingEngine:
         admission-time fetch is unchanged when routing is off).
         Returns the page count warmed."""
         from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.integrity import IntegrityError
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
 
         if (self.tiers is None or self.manager is None
@@ -1671,6 +1747,11 @@ class ServingEngine:
                 arrays = self._run_op_with_retry(
                     "tier_transfer",
                     lambda k=key: self.tiers.get(("prefix", k)))
+            except IntegrityError as e:
+                # Corrupt payload: quarantined by the store — a miss
+                # (the content recomputes); never served.
+                self._note_integrity_failure("tier_get", e)
+                break
             except (CommTimeoutError, faults.InjectedFault):
                 break                 # faulted past retries: a miss
             if arrays is None:
@@ -1725,6 +1806,7 @@ class ServingEngine:
         if not pend:
             return 0
         from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.integrity import IntegrityError
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
 
         pend_by_pid = {pid: key for key, pid in pend}
@@ -1745,6 +1827,12 @@ class ServingEngine:
                 break
             try:
                 arrays = self._tier_fetch_prefix(key)
+            except IntegrityError as e:
+                # Quarantined by the store: a miss — the prefix
+                # content recomputes through the normal chunk stream.
+                self._note_integrity_failure(
+                    "tier_get", e, request_id=h.request.request_id)
+                arrays = None
             except (CommTimeoutError, faults.InjectedFault):
                 arrays = None            # faulted past retries: a miss
             if arrays is None:
@@ -1879,6 +1967,7 @@ class ServingEngine:
         the deterministic re-prefill contract, which is equally
         token-exact, just slower."""
         from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience.integrity import IntegrityError
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
         from triton_dist_tpu.serving.tiers import (
             dequantize_park_payload)
@@ -1904,6 +1993,13 @@ class ServingEngine:
         try:
             arrays = self._run_op_with_retry(
                 "tier_transfer", lambda: self.tiers.get(key))
+        except IntegrityError as e:
+            # Corrupt parked payload: quarantined — fall through to
+            # the deterministic re-prefill (token-exact, never serves
+            # the corrupted bytes).
+            self._note_integrity_failure(
+                "tier_get", e, request_id=h.request.request_id)
+            arrays = None
         except (CommTimeoutError, faults.InjectedFault):
             arrays = None
         if arrays is None:
@@ -2027,12 +2123,26 @@ class ServingEngine:
             # and the containment below fails the victim, not the
             # server (survivors redo the identical dispatch — length
             # mirrors never advanced).
-            with self.obs.span(
-                    "decode",
-                    step=self.stats_counters["decode_dispatches"],
-                    batch=len(active)), \
-                    faults.on_op_call("serving_decode"):
-                logits = self._dispatch(tbl)
+            # A TRANSIENT drop (InjectedFault — raised at the fault
+            # scope's entry, before the dispatch mutates anything) is
+            # absorbed by one retry pass when a serving_decode
+            # RetryPolicy is armed: the length mirrors only advance on
+            # success, so the replayed joint dispatch is byte-
+            # identical. A WEDGE (CommTimeoutError) is deliberately
+            # NOT in retry_on — a wedged joint dispatch blocks its own
+            # replay (docs/resilience.md) — and goes straight to the
+            # fail-one containment below.
+            def _attempt():
+                with self.obs.span(
+                        "decode",
+                        step=self.stats_counters["decode_dispatches"],
+                        batch=len(active)), \
+                        faults.on_op_call("serving_decode"):
+                    return self._dispatch(tbl)
+
+            logits = self._run_op_with_retry(
+                "serving_decode", _attempt,
+                retry_on=(faults.InjectedFault,))
         except Exception as e:  # noqa: BLE001 — route through policy
             from triton_dist_tpu.resilience.watchdog import (
                 CommTimeoutError)
@@ -2161,35 +2271,44 @@ class ServingEngine:
 
         t0 = time.perf_counter()
         try:
-            with self.obs.span(
-                    "spec_verify",
-                    step=self.stats_counters["decode_dispatches"],
-                    batch=len(active), k=kk), \
-                    faults.on_op_call("spec_verify"):
-                cache = _dc.replace(self.cache,
-                                    block_table=jnp.asarray(tbl),
-                                    lens=jnp.asarray(self._lens),
-                                    live=jnp.asarray(self._live))
-                logits, self.cache = self._verify(
-                    self.engine.params, jnp.asarray(toks),
-                    jnp.asarray(budget), cache)
-                if self.timeout_s is not None:
-                    logits = block_until_ready(
-                        logits, timeout_s=self.timeout_s,
-                        op="serving.spec_verify",
-                        progress_fn=lambda: {
-                            "lens": self._lens.tolist(),
-                            "live": self._live.tolist(),
-                            "spec_k": kk,
-                            **{k: self.stats_counters[k] for k in
-                               ("decode_dispatches",
-                                "spec_accepted")}})
-            logits = np.asarray(logits)
+            # Transient drop (InjectedFault at the fault scope's
+            # entry, nothing mutated) → one retry pass when a
+            # spec_verify RetryPolicy is armed; a wedge is NOT
+            # retried — straight to fail-one (docs/resilience.md).
+            def _attempt():
+                with self.obs.span(
+                        "spec_verify",
+                        step=self.stats_counters["decode_dispatches"],
+                        batch=len(active), k=kk), \
+                        faults.on_op_call("spec_verify"):
+                    cache = _dc.replace(self.cache,
+                                        block_table=jnp.asarray(tbl),
+                                        lens=jnp.asarray(self._lens),
+                                        live=jnp.asarray(self._live))
+                    logits, self.cache = self._verify(
+                        self.engine.params, jnp.asarray(toks),
+                        jnp.asarray(budget), cache)
+                    if self.timeout_s is not None:
+                        logits = block_until_ready(
+                            logits, timeout_s=self.timeout_s,
+                            op="serving.spec_verify",
+                            progress_fn=lambda: {
+                                "lens": self._lens.tolist(),
+                                "live": self._live.tolist(),
+                                "spec_k": kk,
+                                **{k: self.stats_counters[k] for k in
+                                   ("decode_dispatches",
+                                    "spec_accepted")}})
+                return logits
+
+            logits = np.asarray(self._run_op_with_retry(
+                "spec_verify", _attempt,
+                retry_on=(faults.InjectedFault,)))
         except (CommTimeoutError, faults.InjectedFault) as e:
-            # A wedged collective or a dropped verification fails the
-            # scheduler's victim(s), never the server: no length
-            # mirror advanced, so survivors redo the identical
-            # dispatch token-exactly.
+            # A wedged collective or a dropped verification (past any
+            # armed retry) fails the scheduler's victim(s), never the
+            # server: no length mirror advanced, so survivors redo
+            # the identical dispatch token-exactly.
             if isinstance(e, CommTimeoutError):
                 self.stats_counters["comm_timeouts"] += 1
             for victim in self.sched.timeout_victims():
@@ -2316,13 +2435,23 @@ class ServingEngine:
 
         t0 = time.perf_counter()
         try:
-            with self.obs.span(
-                    "spec_verify",
-                    step=self.stats_counters["decode_dispatches"],
-                    batch=len(active), k=kk), \
-                    faults.on_op_call("spec_verify"):
-                logits = np.asarray(self.engine.verify_step(
-                    jnp.asarray(toks.reshape(-1)), jnp.asarray(pos)))
+            # Same transient-retry contract as the layer spec tick:
+            # the fault raises at scope entry (the in-arena verify
+            # never launched — positions unchanged), so one replay is
+            # byte-identical; wedges stay fail-one.
+            def _attempt():
+                with self.obs.span(
+                        "spec_verify",
+                        step=self.stats_counters["decode_dispatches"],
+                        batch=len(active), k=kk), \
+                        faults.on_op_call("spec_verify"):
+                    return np.asarray(self.engine.verify_step(
+                        jnp.asarray(toks.reshape(-1)),
+                        jnp.asarray(pos)))
+
+            logits = self._run_op_with_retry(
+                "spec_verify", _attempt,
+                retry_on=(faults.InjectedFault,))
         except (CommTimeoutError, faults.InjectedFault) as e:
             if isinstance(e, CommTimeoutError):
                 self.stats_counters["comm_timeouts"] += 1

@@ -157,6 +157,7 @@ class KVTierStore:
             "puts": 0, "gets": 0, "hits": 0, "misses": 0,
             "offloaded_pages": 0, "fetched_pages": 0,
             "spills": 0, "dropped_entries": 0,
+            "integrity_checks": 0, "integrity_quarantined": 0,
         }
 
     # -- capacity ----------------------------------------------------
@@ -270,7 +271,7 @@ class KVTierStore:
         or the one-sided p2p put when a bridge is configured (the K/V
         bulk rides the put; scale planes stage host-side beside it,
         exactly like the disagg migration)."""
-        from triton_dist_tpu.resilience import faults
+        from triton_dist_tpu.resilience import faults, integrity
 
         with faults.on_op_call("tier_transfer"):
             if self.bridge is not None and len(arrays) >= 2:
@@ -279,9 +280,16 @@ class KVTierStore:
                 mesh, axis, src, dst = self.bridge
                 k, v = tier_pages_host(arrays[0], arrays[1], mesh,
                                        axis=axis, src=src, dst=dst)
-                return (k, v) + tuple(np.asarray(a)
-                                      for a in arrays[2:])
-            return tuple(np.asarray(a) for a in arrays)
+                out = (k, v) + tuple(np.asarray(a)
+                                     for a in arrays[2:])
+            else:
+                out = tuple(np.asarray(a) for a in arrays)
+            # The corrupt_payload adversary models the WIRE (this
+            # staging hop), never the source arrays — maybe_corrupt
+            # copies before flipping, so a faulted put leaves the
+            # caller's HBM payload authoritative and a faulted get
+            # leaves the tier entry intact for quarantine accounting.
+            return integrity.maybe_corrupt(out, "tier_transfer")
 
     # -- the tier API ------------------------------------------------
 
@@ -296,8 +304,16 @@ class KVTierStore:
         too large for the host tier commits straight to the disk tier
         when one is configured; :class:`TierFullError` only when
         pinned payloads genuinely leave no room anywhere."""
+        from triton_dist_tpu.resilience import integrity
+
         entry = TierEntry(key=key, pages=int(pages), pinned=pinned,
                           meta=dict(meta or {}))
+        # Producing-edge digest, computed over the INPUT arrays before
+        # the transfer hop — a caller-provided digest (a fleet handoff
+        # forwarding the victim's entry) is kept, so the check spans
+        # the full producer→consumer path, not just the last hop.
+        if "digest" not in entry.meta:
+            entry.meta["digest"] = integrity.payload_digest(arrays)
         self._staged[key] = entry
         # A same-key replace must not double-count its own old copy
         # during room-making: hold it aside, restore on failure.
@@ -331,16 +347,42 @@ class KVTierStore:
         self.stats_counters["puts"] += 1
         self.stats_counters["offloaded_pages"] += entry.pages
 
+    def _verify_get(self, e: TierEntry, out) -> None:
+        """Consuming-edge digest check (docs/resilience.md, "Payload
+        integrity"): the fetched bytes must match the digest stamped
+        at the producing edge. A mismatch QUARANTINES the entry
+        (removed — its bytes are unserveable; prefix/session content
+        is recomputable by the caller's recovery contract) and raises
+        :class:`~triton_dist_tpu.resilience.integrity.IntegrityError`,
+        which callers route like a miss (recompute / re-prefill)."""
+        from triton_dist_tpu.resilience import integrity
+
+        want = e.meta.get("digest")
+        if want is None:    # pre-digest entry — vacuous by contract
+            return
+        self.stats_counters["integrity_checks"] += 1
+        try:
+            integrity.verify_payload(out, want, boundary="tier_get",
+                                     key=e.key)
+        except integrity.IntegrityError:
+            self.pop(e.key, None)
+            self.stats_counters["integrity_quarantined"] += 1
+            raise
+
     def get(self, key: tuple) -> Optional[Tuple[np.ndarray, ...]]:
         """Fetch a payload (host hit, or disk hit promoted to host).
         Returns None on a miss; the entry STAYS tier-resident — the
         caller :meth:`pop`\\ s it only once the HBM copy is live (the
         promote half of the two-phase discipline). A faulted transfer
-        re-raises with the entry intact (retry-safe)."""
+        re-raises with the entry intact (retry-safe); a digest
+        mismatch quarantines the entry and raises
+        :class:`~triton_dist_tpu.resilience.integrity.IntegrityError`
+        (see :meth:`_verify_get`)."""
         self.stats_counters["gets"] += 1
         e = self._host.get(key)
         if e is not None:
             out = self._transfer(e.arrays)
+            self._verify_get(e, out)
             self._host.move_to_end(key)
             self.stats_counters["hits"] += 1
             self.stats_counters["fetched_pages"] += e.pages
@@ -349,6 +391,7 @@ class KVTierStore:
         if e is not None:
             arrays = _unspill(e)
             out = self._transfer(arrays)
+            self._verify_get(e, out)
             # Promote to the host tier when it fits (LRU warmth);
             # serve straight from disk otherwise. The fetch guard
             # keeps the room-making's spill cascade from evicting
