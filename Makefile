@@ -5,7 +5,8 @@ PY ?= python
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
 	qblock-smoke obs-smoke tier-smoke fleet-smoke \
-	mega-parity-smoke supervise-smoke apicheck ci bench-all
+	mega-parity-smoke mkchunk-smoke supervise-smoke apicheck ci \
+	bench-all
 
 csrc:
 	$(MAKE) -C csrc
@@ -121,6 +122,16 @@ fleet-smoke: csrc
 # "Arena schema").
 mega-parity-smoke: csrc
 	bash scripts/mega_parity_smoke.sh
+
+# Megakernel chunked-prefill battery: bucket-edge token-exactness vs
+# the one-token lane and the layer ChunkedPrefill, quantized chunk
+# writes, prefix-hit skip of resident pages, the chunk-step no-growth
+# gates, a bit-identical-streams chat e2e with --megakernel
+# --mk-chunked, and the non-null megakernel_prefill_chunk_ms /
+# megakernel_tokens_per_s_prefill_heavy (>= 2x one-token lane) bench
+# gate (docs/megakernel.md, "Chunked prefill").
+mkchunk-smoke: csrc
+	bash scripts/mkchunk_smoke.sh
 
 # Supervised-serving battery: checkpoint-envelope + keep-last-K ring
 # corruption fallback, parent-side ack dedupe/divergence/gap units,

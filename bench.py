@@ -500,6 +500,63 @@ def _interpret_mega_parity() -> dict:
     return out
 
 
+def _interpret_mega_chunked() -> dict:
+    """Megakernel chunked prefill on the interpret mesh: per-chunk
+    dispatch wall time plus prefill-heavy tokens/s for the bucketed
+    WRITE_KV_CHUNK/ATTN_CHUNK lane vs the one-token-per-tick prefill
+    lane on the SAME engine shape and workload (long prompts, two
+    generated tokens). Interpret overhead, not silicon — the
+    chunked / onetok RATIO is the signal and the mkchunk_smoke gate
+    checks it ≥ 2x (one chunk dispatch retires a bucket of prompt
+    tokens; one prefill tick retires exactly one)."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend warmup
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.megakernel.engine import MegaKernelEngine
+    from triton_dist_tpu.models.config import ModelConfig
+    from triton_dist_tpu.ops.chunked_prefill import plan_chunks
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    buckets = (16,)
+    kw = dict(batch=2, max_len=48, tile_w=16, t_tile=16, paged=True,
+              page=16, num_pages=7)
+    # Prefill-heavy: ~30 prompt tokens per request, 2 generated.
+    prompts = [[(7 * i + j) % 60 + 1 for j in range(30)]
+               for i in range(2)]
+    n_chunks = sum(len(plan_chunks(len(p), buckets)) for p in prompts)
+
+    out = {"megakernel_prefill_chunk_ms": None,
+           "megakernel_tokens_per_s_prefill_heavy": {}}
+    for name, bk in (("onetok", None), ("chunked", buckets)):
+        mk = MegaKernelEngine(cfg, mesh, prefill_buckets=bk, **kw)
+        s = ServingEngine(mk, prefill_buckets=bk)
+        s.generate([p[:18] for p in prompts],
+                   max_new_tokens=2)               # compile warmup
+        t0 = time.perf_counter()
+        toks = s.generate(prompts, max_new_tokens=2)
+        dt = time.perf_counter() - t0
+        n_tok = sum(len(p) for p in prompts) + sum(len(t) for t in toks)
+        out["megakernel_tokens_per_s_prefill_heavy"][name] = round(
+            n_tok / max(dt, 1e-9), 2)
+        if bk:
+            # Whole-run wall over the chunk count: prefill dominates
+            # this workload, so this upper-bounds the per-chunk cost.
+            out["megakernel_prefill_chunk_ms"] = round(
+                dt * 1e3 / max(n_chunks, 1), 3)
+            assert s.prefill_cache_size() <= len(bk), (
+                "chunk jit cache outgrew the bucket count")
+    h = out["megakernel_tokens_per_s_prefill_heavy"]
+    out["megakernel_prefill_chunk_speedup"] = round(
+        h["chunked"] / max(h["onetok"], 1e-9), 2)
+    return out
+
+
 def _interpret_serving_times() -> dict:
     """Serving throughput on the CPU mesh: the continuous-batching
     ServingEngine vs gang ("static") batching over the SAME engine and
@@ -1149,6 +1206,14 @@ def _interpret_bench(reason: str) -> None:
               "megakernel_tokens_per_s_spec": None,
               "megakernel_spec_accept_rate": None,
               "mega_error": str(e)[:300]}
+    try:
+        mc = _interpret_mega_chunked()
+    except Exception as e:  # mk chunked bench must not sink the record
+        # Nulled, NOT omitted: the mkchunk_smoke gate greps these.
+        mc = {"megakernel_prefill_chunk_ms": None,
+              "megakernel_tokens_per_s_prefill_heavy": None,
+              "megakernel_prefill_chunk_speedup": None,
+              "mega_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -1177,6 +1242,7 @@ def _interpret_bench(reason: str) -> None:
             **ti,
             **fl,
             **mp,
+            **mc,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),

@@ -148,6 +148,13 @@ def main():
                     choices=["dense", "moe", "hybrid"],
                     help="--megakernel only: which family the one-"
                          "kernel runtime serves")
+    ap.add_argument("--mk-chunked", action="store_true",
+                    help="--megakernel: admit prompts through the "
+                         "bucketed WRITE_KV_CHUNK/ATTN_CHUNK prefill-"
+                         "chunk tasks (chunk lengths from --buckets) "
+                         "instead of the one-token-per-tick prefill "
+                         "lane (see docs/megakernel.md, 'Chunked "
+                         "prefill')")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -177,10 +184,14 @@ def main():
                  "EP decode dispatch; the megakernel serves experts "
                  "in-kernel (use --moe-ep without --megakernel)")
     if args.megakernel and args.mk_model == "hybrid" and (
-            args.kv_quant != "bf16" or args.spec):
-        sys.exit("--kv-quant/--spec cover the attention families; the "
-                 "hybrid GDN recurrent state is neither paged nor "
-                 "rewindable (see docs/serving.md)")
+            args.kv_quant != "bf16" or args.spec or args.mk_chunked):
+        sys.exit("--kv-quant/--spec/--mk-chunked cover the attention "
+                 "families; the hybrid GDN recurrent state is neither "
+                 "paged nor rewindable (see docs/serving.md)")
+    if args.mk_chunked and not args.megakernel:
+        sys.exit("--mk-chunked routes the megakernel's prefill-chunk "
+                 "tasks; the layer path gets chunked prefill from "
+                 "--disagg or ServingEngine(prefill_buckets=...)")
     if args.megakernel and args.attn_impl != "ref":
         sys.exit("--attn-impl routes the layer path's paged "
                  "attention; the megakernel's attention task has its "
@@ -319,7 +330,9 @@ def main():
         # schema snapshots); the plain run keeps the original dense
         # cache.
         mk_paged = bool(args.kv_quant != "bf16" or args.spec
-                        or args.checkpoint_dir)
+                        or args.checkpoint_dir or args.mk_chunked)
+        mk_buckets = (tuple(int(b) for b in args.buckets.split(","))
+                      if args.mk_chunked else None)
         mk_kw = {}
         if mk_paged:
             page = 16
@@ -330,7 +343,8 @@ def main():
             mk_kw = dict(paged=True, page=page,
                          num_pages=args.tp * (args.max_len // page) + 1,
                          kv_dtype=args.kv_quant,
-                         spec_k=args.spec_k if args.spec else 0)
+                         spec_k=args.spec_k if args.spec else 0,
+                         prefill_buckets=mk_buckets)
             if args.spec:
                 # The scoreboard claims hot verification chains first.
                 mk_kw["schedule"] = "dynamic"
@@ -340,7 +354,8 @@ def main():
                               profile=bool(args.trace_out), **mk_kw)
         srv = ServingEngine(mk, telemetry=telemetry,
                             kv_dtype=args.kv_quant,
-                            spec_k=args.spec_k if args.spec else 0)
+                            spec_k=args.spec_k if args.spec else 0,
+                            prefill_buckets=mk_buckets)
     elif args.disagg:
         from triton_dist_tpu.models import dense
 
@@ -585,7 +600,8 @@ def main():
         # grepping tracebacks for the old layer-path-only rejects.
         line += (f", mk: kv_dtype={st['mk_kv_dtype']} "
                  f"spec={st['mk_spec']} checkpointable="
-                 f"{'yes' if st['mk_checkpointable'] else 'no'}")
+                 f"{'yes' if st['mk_checkpointable'] else 'no'} "
+                 f"chunked={st['mk_chunked_prefill'] or 'no'}")
     if args.kv_tiers:
         rate = st.get("kv_hot_hit_rate")
         line += (f", tiers: offloaded={st['offloaded_pages']} "

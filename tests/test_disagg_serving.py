@@ -497,5 +497,5 @@ def test_disagg_rejects_megakernel():
                           t_tile=16)
     with pytest.raises(ValueError, match="megakernel"):
         DisaggServingEngine(mk)
-    with pytest.raises(ValueError, match="prefill lane"):
+    with pytest.raises(ValueError, match="prefill_buckets mismatch"):
         ServingEngine(mk, prefill_buckets=(4,))

@@ -603,7 +603,12 @@ def test_megakernel_rejects_kv_tiers(mesh):
                            head_dim=8)
     mk = MegaKernelEngine(cfg, mesh, batch=2, max_len=32, tile_w=16,
                           t_tile=16)
-    with pytest.raises(ValueError, match="layer-path knob"):
+    # A proper NotImplementedError naming the arena-tier limitation
+    # and the ROADMAP item tracking it (Open item 3).
+    with pytest.raises(NotImplementedError,
+                       match="arena-tier limitation"):
+        ServingEngine(mk, kv_tiers=True)
+    with pytest.raises(NotImplementedError, match="Open item 3"):
         ServingEngine(mk, kv_tiers=True)
 
 

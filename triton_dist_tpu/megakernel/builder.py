@@ -232,7 +232,7 @@ class ModelBuilder:
                  page: Optional[int] = None, profile: bool = False,
                  cost_table: Optional[dict] = None,
                  expert_load=None, kv_quant: Optional[str] = None,
-                 qblock: bool = False):
+                 qblock: bool = False, chunk: bool = False):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -313,9 +313,20 @@ class ModelBuilder:
         # megakernel launch.
         self.seq = seq
         self.qblock = bool(qblock)
+        # chunk=True selects the PREFILL-CHUNK pair (WRITE_KV_CHUNK/
+        # ATTN_CHUNK): one C-row prompt chunk per launch (batch = seq
+        # = C, one slot), per-row positions sign-encoded in the
+        # cache_len vector (kernels._chunk_apos) — the bucketed
+        # chunked-prefill contract (ops/chunked_prefill) as megakernel
+        # tasks.
+        self.chunk = bool(chunk)
         if batch % seq:
             raise ValueError(f"batch rows {batch} not divisible by "
                              f"seq {seq}")
+        if self.qblock and self.chunk:
+            raise ValueError("qblock and chunk are mutually exclusive "
+                             "task-set selectors (verification rows vs "
+                             "prompt-chunk rows)")
         if self.qblock:
             if seq < 2:
                 raise ValueError("qblock builds verify K >= 2 "
@@ -324,6 +335,16 @@ class ModelBuilder:
                 raise ValueError("the Q-block verification task set "
                                  "addresses the cache through block "
                                  "tables — build with paged=True")
+        if self.chunk:
+            if batch != seq:
+                raise ValueError(
+                    "chunk builds run ONE prompt chunk per launch: "
+                    f"batch ({batch}) must equal seq ({seq}) — the "
+                    "chunk rows ARE the batch rows")
+            if not paged:
+                raise ValueError("the prefill-chunk task set addresses "
+                                 "the cache through block tables — "
+                                 "build with paged=True")
         # kv_quant: int8/fp8 pools with per-(layer, page, kv_head)
         # fp32 scale tables riding as extra aliased operands —
         # quantize fused into write_kv, dequant into every cache read.
@@ -344,11 +365,11 @@ class ModelBuilder:
                 raise ValueError(
                     "quantized megakernel KV needs paged=True (scales "
                     "are per (layer, page, kv_head))")
-            if seq > 1 and not self.qblock:
+            if seq > 1 and not (self.qblock or self.chunk):
                 raise NotImplementedError(
                     "the batched-prefill bodies have no fused-quant "
                     "write; quantized engines stream prompts through "
-                    "the prefill lane (decode kernel)")
+                    "the prefill lane (decode kernel) or chunk tasks")
         self.kv_quant = kv_quant
         hd = cfg.head_dim
         self.w = tile_w or max(128, hd)
@@ -368,10 +389,10 @@ class ModelBuilder:
         self.p_max = 0
         if paged:
             self.page = page or max(self.t_tile, seq)
-            # qblock rows write one position each (never a seq-span
-            # block store), so only the t_tile and max_len alignment
-            # applies there.
-            seq_align = seq > 1 and not self.qblock
+            # qblock/chunk rows write one position each (never a
+            # seq-span block store), so only the t_tile and max_len
+            # alignment applies there.
+            seq_align = seq > 1 and not (self.qblock or self.chunk)
             if (self.page % self.t_tile
                     or (seq_align and self.page % seq)
                     or max_len % self.page):
@@ -403,6 +424,11 @@ class ModelBuilder:
                     "Q-block verification needs position-addressed KV; "
                     "the hybrid GDN recurrent state cannot rewind a "
                     "rejected draft")
+            if self.chunk:
+                raise NotImplementedError(
+                    "prefill-chunk tasks need position-addressed KV; "
+                    "the hybrid GDN recurrent state is sequential — "
+                    "prefill via prefill_chain")
             if self.seq > 1:
                 raise ValueError("hybrid megakernel is decode-only "
                                  "(seq == 1); prefill via prefill_chain")
@@ -643,7 +669,10 @@ class ModelBuilder:
                              in_rows=d_t * b, w_rows=d_t * kv_t * w)
                 kv_layer = (self.layer_kinds[li][1] if self.hybrid
                             else li)
-                if self.qblock:
+                if self.chunk:
+                    wk_type = TaskType.WRITE_KV_CHUNK
+                    at_type = TaskType.ATTN_CHUNK
+                elif self.qblock:
                     wk_type = TaskType.WRITE_KV_QBLOCK
                     at_type = TaskType.ATTN_QBLOCK
                 elif self.seq == 1:
@@ -973,9 +1002,15 @@ class ModelBuilder:
         if t.task_type == TaskType.ATTN_QBLOCK:
             # K per-row online-softmax streams per slot.
             return 4 * self.d_tiles * self.seq
+        if t.task_type == TaskType.ATTN_CHUNK:
+            # C per-row online-softmax streams — the chunk heavyweight
+            # (same per-row stream as the Q-block verify body).
+            return 4 * self.d_tiles * self.seq
         if t.task_type == TaskType.WRITE_KV_PREFILL:
             return 2 * max(self.seq // 8, 1)
         if t.task_type == TaskType.WRITE_KV_QBLOCK:
+            return 2 * self.seq
+        if t.task_type == TaskType.WRITE_KV_CHUNK:
             return 2 * self.seq
         if t.task_type == TaskType.ALLREDUCE:
             return 2 * int(t.args[1])
@@ -1092,7 +1127,8 @@ class ModelBuilder:
             gdn_dv=self.cfg.gdn_head_dim_v,
             kv_quant=self.kv_quant,
             qmax=self.kv_qmax,
-            qblock=self.qblock)
+            qblock=self.qblock,
+            chunk=self.chunk)
 
     def _n_state(self) -> int:
         """Aliased state operands: arena + K/V pools, plus the scale
@@ -1215,6 +1251,8 @@ class ModelBuilder:
             if self.hybrid else (lambda: None),
             lambda: K.attn_qblock_body(cfg, args, refs, len_s),
             lambda: K.write_kv_qblock_body(cfg, args, refs, len_s),
+            lambda: K.attn_chunk_body(cfg, args, refs, len_s),
+            lambda: K.write_kv_chunk_body(cfg, args, refs, len_s),
         ]
         # lax.switch traces EVERY branch, scheduled or not — and a body
         # whose geometry does not fit this build (the decode cache

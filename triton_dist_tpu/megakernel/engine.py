@@ -32,7 +32,7 @@ class MegaKernelEngine:
                  paged: bool = False, page=None, num_pages=None,
                  cost_table=None, timeout_s=None,
                  profile: bool = False, kv_dtype: str = "bf16",
-                 spec_k: int = 0):
+                 spec_k: int = 0, prefill_buckets=None):
         """``timeout_s`` arms a per-step watchdog: every
         :meth:`decode_step` / :meth:`prefill` blocks on its result
         under a deadline and raises a structured
@@ -75,7 +75,18 @@ class MegaKernelEngine:
         drafted tokens per slot under the per-query causal mask —
         the serving layer's speculative decode on the megakernel
         lane. Same constraints as ``kv_dtype`` (paged, non-hybrid,
-        no ``prefill_seq``)."""
+        no ``prefill_seq``).
+
+        ``prefill_buckets=(C1, C2, ...)`` additionally builds one
+        PREFILL-CHUNK step per bucket (:meth:`prefill_chunk`): one
+        launch ingests a C-token prompt chunk for one slot through the
+        WRITE_KV_CHUNK/ATTN_CHUNK task pair (per-row sign-encoded
+        positions, per-query causal mask) — the serving layer's
+        bucketed chunked prefill on the megakernel lane, replacing the
+        one-token-per-tick prefill lane for prompt ingestion. Same
+        constraints as ``kv_dtype`` (paged, non-hybrid, no
+        ``prefill_seq``); composes with both ``kv_dtype`` (fused
+        quantize-on-write) and ``spec_k``."""
         from triton_dist_tpu.serving.blocks import kv_quant_spec
 
         qdtype, _ = kv_quant_spec(kv_dtype)
@@ -83,13 +94,21 @@ class MegaKernelEngine:
         self.spec_k = int(spec_k or 0)
         if self.spec_k == 1:
             self.spec_k = 0            # K=1 degenerates to plain decode
+        self.prefill_buckets = (tuple(sorted(set(
+            int(c) for c in prefill_buckets)))
+            if prefill_buckets else None)
+        if self.prefill_buckets and self.prefill_buckets[0] < 1:
+            raise ValueError(f"prefill buckets must be positive ints, "
+                             f"got {prefill_buckets!r}")
         for knob, on in (("kv_dtype", qdtype is not None),
-                         ("spec_k", bool(self.spec_k))):
+                         ("spec_k", bool(self.spec_k)),
+                         ("prefill_buckets",
+                          bool(self.prefill_buckets))):
             if not on:
                 continue
             if not paged:
                 raise ValueError(f"{knob} needs paged=True (per-page "
-                                 "scales / block-table verification)")
+                                 "scales / block-table addressing)")
             if cfg.is_hybrid:
                 raise NotImplementedError(
                     f"{knob} covers the attention families; the hybrid "
@@ -153,6 +172,18 @@ class MegaKernelEngine:
                 strategy=strategy, schedule=self.schedule, paged=True,
                 page=page, cost_table=cost_table,
                 kv_quant=self._kv_quant)
+        # Prefill-chunk builders: ONE per bucket (the build cache is
+        # bounded by the bucket count by construction), each a C-row
+        # single-slot chunk launch (batch = seq = C) sharing the
+        # decode arena's weight region like the verify/prefill builds.
+        self.chunk_builders = {}
+        for c in (self.prefill_buckets or ()):
+            self.chunk_builders[c] = ModelBuilder(
+                cfg, mesh, batch=c, max_len=max_len, axis=axis,
+                tile_w=tile_w, t_tile=t_tile, seq=c, chunk=True,
+                num_cores=num_cores, strategy=strategy,
+                schedule=self.schedule, paged=True, page=page,
+                cost_table=cost_table, kv_quant=self._kv_quant)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
             # buffer; prefill runs via prefill_chain (decode-only
@@ -214,11 +245,20 @@ class MegaKernelEngine:
         # footprint sizes and packs it.
         pack_builder = max(
             [b for b in (self.builder, self.prefill_builder,
-                         self.verify_builder) if b is not None],
+                         self.verify_builder,
+                         *self.chunk_builders.values())
+             if b is not None],
             key=lambda b: b.arena_rows)
         self._arena = jax.jit(jax.shard_map(
             pack_builder.pack_arena, mesh=mesh, in_specs=(specs,),
             out_specs=P(axis, None), check_vma=False))(placed)
+        # Re-pin to the verbatim spec spelling the jitted steps PIN
+        # their outputs to (_build_step out_shardings): the pack jit's
+        # normalized output spelling would otherwise differ from the
+        # steady-state one and cost every step function one
+        # transitional cache entry on its first dispatch.
+        self._arena = jax.device_put(
+            self._arena, NamedSharding(mesh, P(axis, None)))
         # After packing, decode no longer reads the params; keeping them
         # doubles weight HBM (useful only for tests/oracles).
         self.params = placed if keep_params else None
@@ -306,9 +346,28 @@ class MegaKernelEngine:
         # rank 0's view is what the host keeps).
         prof_spec = (P(None, None),) if self.profile else ()
 
+        # Output shardings PINNED to the construction placements (the
+        # serving ChunkedPrefill out_shardings idiom): a step's pool
+        # outputs feed the next step's inputs, and without pinning the
+        # first dispatch re-spells the pool shardings (shard_map's
+        # normalized output spelling differs from device_put's
+        # verbatim one), costing every step function one transitional
+        # jit entry. Pinning makes call 0 the fixed point — exactly
+        # one entry per step function, which the serving
+        # no-recompilation gates and the chunk bucket-count bound
+        # (chunk_cache_size <= len(prefill_buckets)) rely on.
+        def _sh(spec):
+            return NamedSharding(self.mesh, spec)
+
+        logit_sh = _sh(P(None, self.axis))
+        prof_sh = (_sh(P(None, None)),) if self.profile else ()
+
         def _jit_step(builder, profile):
             step = builder.step_fn()
             pspec = prof_spec if profile else ()
+            psh = prof_sh if profile else ()
+            buf_sh = (_sh(P(self.axis, None)), _sh(kvspec),
+                      _sh(kvspec))
             if self.cfg.is_hybrid:
                 stspec = P(None, None, self.axis, None, None)
                 return jax.jit(jax.shard_map(
@@ -317,7 +376,9 @@ class MegaKernelEngine:
                               P(None), P(None), tblspec, stspec),
                     out_specs=(P(None, self.axis), P(self.axis, None),
                                kvspec, kvspec, stspec) + pspec,
-                    check_vma=False), donate_argnums=(0, 1, 2, 6))
+                    check_vma=False), donate_argnums=(0, 1, 2, 6),
+                    out_shardings=(logit_sh, *buf_sh, _sh(stspec))
+                    + psh)
             if builder.kv_quant:
                 return jax.jit(jax.shard_map(
                     lambda a, kc, vc, tok, ln, tb, ks, vs: step(
@@ -330,19 +391,28 @@ class MegaKernelEngine:
                     out_specs=(P(None, self.axis), P(self.axis, None),
                                kvspec, kvspec, sclspec, sclspec)
                     + pspec,
-                    check_vma=False), donate_argnums=(0, 1, 2, 6, 7))
+                    check_vma=False), donate_argnums=(0, 1, 2, 6, 7),
+                    out_shardings=(logit_sh, *buf_sh, _sh(sclspec),
+                                   _sh(sclspec)) + psh)
             return jax.jit(jax.shard_map(
                 step, mesh=self.mesh,
                 in_specs=(P(self.axis, None), kvspec, kvspec, P(None),
                           P(None), tblspec),
                 out_specs=(P(None, self.axis), P(self.axis, None),
                            kvspec, kvspec) + pspec,
-                check_vma=False), donate_argnums=(0, 1, 2))
+                check_vma=False), donate_argnums=(0, 1, 2),
+                out_shardings=(logit_sh, *buf_sh) + psh)
 
         self._step = _jit_step(self.builder, self.profile)
         self._verify_step = (None if self.verify_builder is None
                              else _jit_step(self.verify_builder,
                                             False))
+        # One jitted chunk step per bucket — each holds exactly one
+        # cache entry after warmup (the chunk shape IS the bucket), so
+        # the step-cache total is bounded by the bucket count
+        # (chunk_cache_size, gated inline by prefill_chunk).
+        self._chunk_steps = {c: _jit_step(b, False)
+                             for c, b in self.chunk_builders.items()}
 
     def expert_counts(self) -> np.ndarray:
         """Cumulative per-expert routed-token counts from the arena's
@@ -379,6 +449,8 @@ class MegaKernelEngine:
         self.builder.reprioritize(load)
         if self.verify_builder is not None:
             self.verify_builder.reprioritize(load)
+        for b in self.chunk_builders.values():
+            b.reprioritize(load)
         self._build_step()
 
     def progress(self) -> dict:
@@ -603,6 +675,61 @@ class MegaKernelEngine:
             logits, self._arena, self.k_cache, self.v_cache = outs
         logits = self._finish(logits, "megakernel.verify_step")
         return logits.reshape(self.batch, kq, -1)
+
+    def prefill_chunk(self, token_row, codes, table_row) -> jax.Array:
+        """ONE prefill-chunk launch (``prefill_buckets`` builds):
+        ``token_row`` (C,) int32 chunk tokens padded to a bucket
+        length; ``codes`` (C,) sign-encoded per-row positions
+        (:func:`~triton_dist_tpu.ops.chunked_prefill.chunk_row_codes`
+        — ``>= 0`` write+attend there, ``<= -2`` attend-only at
+        ``-code-2`` (prefix-resident positions, never re-blitted),
+        ``-1`` dead padding); ``table_row`` (p_max,) int32 — the
+        slot's block-table row. Writes each writable row's K/V (fused
+        quantize on int8/fp8 pools), attends under the per-query
+        causal mask, and returns logits (C, vocab) — row r's logits
+        are bit-identical to what the one-token prefill lane
+        (:meth:`decode_step`) would have produced at that position.
+        Scalars ride as DATA, so the jit cache is keyed only on the
+        bucket length — the inline gate below raises if it ever grows
+        past the bucket count (the megakernel half of the serving
+        no-recompilation contract)."""
+        toks = jnp.asarray(token_row, jnp.int32).reshape(-1)
+        c = int(toks.shape[0])
+        step = self._chunk_steps.get(c)
+        if step is None:
+            raise ValueError(
+                f"no chunk step for bucket {c}: engine built with "
+                f"prefill_buckets={self.prefill_buckets} — pad chunks "
+                "to a configured bucket (ops.chunked_prefill."
+                "plan_chunks)")
+        enc = jnp.asarray(codes, jnp.int32).reshape(-1)
+        tbl = jnp.asarray(table_row, jnp.int32).reshape(-1)
+        if self.k_scale is not None:
+            outs = step(self._arena, self.k_cache, self.v_cache, toks,
+                        enc, tbl, self.k_scale, self.v_scale)
+            (logits, self._arena, self.k_cache, self.v_cache,
+             self.k_scale, self.v_scale) = outs
+        else:
+            outs = step(self._arena, self.k_cache, self.v_cache, toks,
+                        enc, tbl)
+            logits, self._arena, self.k_cache, self.v_cache = outs
+        logits = self._finish(logits, "megakernel.prefill_chunk")
+        n = self.chunk_cache_size()
+        if n > len(self.prefill_buckets):
+            raise RuntimeError(
+                f"megakernel chunk-step jit cache grew to {n} entries "
+                f"> {len(self.prefill_buckets)} buckets "
+                f"{self.prefill_buckets} — a chunk dispatch "
+                "re-specialized on something other than the bucket "
+                "length")
+        return logits
+
+    def chunk_cache_size(self) -> int:
+        """Total jit-cache entries across the per-bucket chunk steps
+        (≤ bucket count) — the megakernel half of the serving
+        no-recompilation gate."""
+        return sum(fn._cache_size()
+                   for fn in self._chunk_steps.values())
 
     def prefill_chain(self, prompt_ids):
         """Feed a (B, S) prompt token-by-token (fallback when no

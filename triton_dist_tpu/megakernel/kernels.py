@@ -68,6 +68,11 @@ class KernelConfig:
     # rows are (slot, j) pairs, ``seq`` rows per slot, each at its own
     # per-row position.
     qblock: bool = False
+    # Prefill-chunk build (WRITE_KV_CHUNK/ATTN_CHUNK): one C-row prompt
+    # chunk per launch, per-row positions SIGN-ENCODED in the cache_len
+    # vector (see ``_chunk_apos``) so resident-prefix rows attend
+    # without re-writing and bucket-padding rows are dead.
+    chunk: bool = False
 
 
 def _act(arena, off, tiles_b):
@@ -929,6 +934,25 @@ def attn_prefill_body(cfg, args, refs, len_s):
     jax.lax.fori_loop(0, q_tiles, per_qtile, 0)
 
 
+def _chunk_apos(enc):
+    """Decode one chunk row's sign-encoded position to its ATTEND
+    position (clamped ≥ 0 for rope/mask arithmetic). The encoding —
+    shared with :func:`ops.chunked_prefill.chunk_row_codes` — packs the
+    chunk task's three row kinds into the existing per-row cache_len
+    vector, so no extra prefetch operand exists:
+
+    - ``enc >= 0``       write + attend at position ``enc``;
+    - ``enc <= -2``      attend-only at position ``-enc - 2`` (prefix-
+      resident positions below ``wfrom`` — their K/V was written by the
+      first sharer and is never re-blitted, exactly the
+      ``chunk_write_ids`` scratch-routing rule);
+    - ``enc == -1``      dead row (bucket padding) — decodes to
+      position 0, computes garbage the host discards, and the write
+      body's ``enc >= 0`` store mask keeps it out of every page.
+    """
+    return jnp.maximum(jnp.where(enc >= 0, enc, -enc - 2), 0)
+
+
 def write_kv_qblock_body(cfg, args, refs, len_s):
     """Q-block (speculative verification) cache append: batch rows are
     (slot, j) pairs in slot-major order (``cfg.seq`` = K rows per
@@ -1001,6 +1025,22 @@ def write_kv_qblock_body(cfg, args, refs, len_s):
                        vt[r:r + 1, hh * hd:(hh + 1) * hd])
 
 
+def write_kv_chunk_body(cfg, args, refs, len_s):
+    """Prefill-chunk cache append: store row r's K/V iff its encoded
+    position is non-negative — which under the :func:`_chunk_apos`
+    encoding is EXACTLY the Q-block body's ``len_s[r] >= 0`` store
+    mask, so this delegates verbatim. Attend-only rows (prefix-resident
+    positions, encoded ``<= -2``) and dead padding rows (``-1``) never
+    touch a page or, on quantized pools, a scale — the in-kernel form
+    of ``chunk_write_ids``'s scratch routing. Rows store one token
+    each, in ascending-position row order per (layer, page, kv_head),
+    so a quantized page's running-scale evolution (and the ``off == 0``
+    page-start reset that handles ragged chunk tails reusing freed
+    pages) is the same per-head sequence the one-token lane produces.
+    """
+    write_kv_qblock_body(cfg, args, refs, len_s)
+
+
 def attn_qblock_body(cfg, args, refs, len_s):
     """Q-block verification attention: each slot's K query rows attend
     the (just-appended) cache under the PER-QUERY causal mask
@@ -1011,6 +1051,31 @@ def attn_qblock_body(cfg, args, refs, len_s):
     committed candidate's logits are bit-identical to the sequential
     decode's (the greedy-acceptance exactness contract). Rows with
     ``len_s[row] < 0`` compute garbage the host discards."""
+    _attn_rowpos_body(cfg, args, refs,
+                      [jnp.maximum(len_s[r], 0)
+                       for r in range(cfg.batch)])
+
+
+def attn_chunk_body(cfg, args, refs, len_s):
+    """Prefill-chunk attention: the Q-block per-query causal stream
+    over one C-token prompt chunk, row positions decoded from the
+    sign-encoded cache_len vector (:func:`_chunk_apos`). Row r attends
+    keys at positions ``<= apos[r]`` — :func:`ops.chunked_prefill.
+    chunk_attend`'s global causal mask — which covers earlier chunks,
+    the shared prefix, AND this chunk's own earlier rows (the paired
+    WRITE_KV_CHUNK task already appended them; the task dep enforces
+    the order), so chunk boundaries are invisible to the math. Dead
+    (padding) rows compute garbage the host discards."""
+    _attn_rowpos_body(cfg, args, refs,
+                      [_chunk_apos(len_s[r]) for r in range(cfg.batch)])
+
+
+def _attn_rowpos_body(cfg, args, refs, row_pos):
+    """Shared per-row-position attention core of
+    :func:`attn_qblock_body` / :func:`attn_chunk_body`: ``row_pos`` is
+    a python list of ``cfg.batch`` traced int32 scalars (≥ 0), row r's
+    query rope position and causal horizon (``kv_len = row_pos[r]+1``).
+    """
     arena, k_cache, v_cache, va, vkt = (refs["arena"], refs["k_cache"],
                                         refs["v_cache"], refs["va"],
                                         refs["vkt"])
@@ -1023,7 +1088,7 @@ def attn_qblock_body(cfg, args, refs, len_s):
     group = h_loc // kv_loc
     heads_per_tile = w // hd
     pos_rows = jnp.concatenate(
-        [jnp.full((1, 1), jnp.maximum(len_s[r], 0), jnp.int32)
+        [jnp.full((1, 1), row_pos[r], jnp.int32)
          for r in range(rows)], axis=0)
 
     pltpu.sync_copy(arena.at[pl.ds(qnorm_off, 1)],
@@ -1048,7 +1113,7 @@ def attn_qblock_body(cfg, args, refs, len_s):
             row_blocks = []
             for r in range(rows):
                 slot = r // kq
-                kv_len = jnp.maximum(len_s[r], 0) + 1
+                kv_len = row_pos[r] + 1
                 n_tiles_t = pl.cdiv(kv_len, t_tile)
 
                 def tstep(tt, carry, slot=slot, r=r, q=q,

@@ -34,6 +34,14 @@ class TaskType(enum.IntEnum):
     # ops/paged_flash_qblock per-query causal mask as megakernel tasks.
     ATTN_QBLOCK = 14       # args like ATTN_DECODE; per-row positions
     WRITE_KV_QBLOCK = 15   # args like WRITE_KV; per-row positions
+    # Prefill-chunk pair (builder ``chunk=True``): batch rows are one
+    # C-token prompt chunk for one slot, per-row global positions
+    # SIGN-ENCODED in the cache_len vector (kernels._chunk_apos:
+    # >= 0 write+attend, <= -2 attend-only resident prefix, -1 dead
+    # padding) — the ops/chunked_prefill bucket contract as megakernel
+    # tasks.
+    ATTN_CHUNK = 16        # args like ATTN_QBLOCK; encoded positions
+    WRITE_KV_CHUNK = 17    # args like WRITE_KV_QBLOCK; encoded positions
 
 
 # Task types whose completion unblocks REMOTE peers: every other rank's

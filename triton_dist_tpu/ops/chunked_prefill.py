@@ -112,6 +112,29 @@ def chunk_write_ids(positions, table_row, valid, wfrom, *, page: int):
     return pids, positions % page
 
 
+def chunk_row_codes(start: int, bucket: int, valid, wfrom):
+    """Sign-encoded per-row positions for ONE megakernel prefill chunk
+    (host-side numpy — the codes ride the chunk step as data, so the
+    trace is keyed only on the bucket length).
+
+    The encoding packs :func:`chunk_write_ids`'s write rule and
+    :func:`chunk_attend`'s mask positions into one (bucket,) int32
+    vector (decoded in-kernel by ``megakernel.kernels._chunk_apos``):
+    row i's global position is ``start + i``; rows ``>= valid`` are
+    bucket padding (code ``-1`` — dead); positions ``< wfrom`` are
+    already resident (prefix-shared pages — attend-only, code
+    ``-(pos + 2)``, never re-blitted); the rest write + attend at
+    their position (code ``pos``).
+    """
+    import numpy as np
+
+    i = np.arange(int(bucket), dtype=np.int64)
+    pos = int(start) + i
+    codes = np.where(pos >= int(wfrom), pos, -(pos + 2))
+    codes = np.where(i < int(valid), codes, -1)
+    return codes.astype(np.int32)
+
+
 def chunk_attend(q, k_dense, v_dense, positions):
     """Causal chunk attention over a gathered position-major KV view.
 

@@ -740,9 +740,12 @@ class BlockManager:
     def commit_prefix(self, slot: int):
         """Publish ``slot``'s staged prefix pages into the
         content-addressed cache — call exactly when their KV content is
-        RESIDENT (end of the monolithic blit, the last chunk of a chunk
-        stream, the megakernel lane's final token, or the migration
-        scatter on a receiving pool). Until then a same-prefix request
+        RESIDENT (end of the monolithic blit, the last chunk of a
+        chunk stream — layer `prefill_chunk_paged` or the megakernel
+        WRITE_KV_CHUNK lane, whose sharers then ride attend-only
+        position codes over these pages — the one-token mk lane's
+        final token, or the migration scatter on a receiving pool).
+        Until then a same-prefix request
         simply misses and computes its own copy — losing the sharing
         for the overlap window, never reading unwritten pages. If
         another sharer committed the same content first, its entry

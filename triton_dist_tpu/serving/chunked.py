@@ -26,7 +26,7 @@ import numpy as np
 
 from triton_dist_tpu.ops.chunked_prefill import plan_chunks
 
-__all__ = ["ChunkedPrefill", "DEFAULT_BUCKETS"]
+__all__ = ["ChunkedPrefill", "MegaChunkedPrefill", "DEFAULT_BUCKETS"]
 
 # Production default (the e.g. of ROADMAP Open item 1); tests and tiny
 # models pass their own. Sizing guidance in docs/serving.md.
@@ -151,3 +151,62 @@ class ChunkedPrefill:
         """Jit-cache entries of the chunk dispatch (≤ bucket count) —
         the prefill half of the serving no-recompilation gate."""
         return self._chunk._cache_size()
+
+
+class MegaChunkedPrefill:
+    """Chunk driver over a megakernel engine's in-kernel chunk steps —
+    the :class:`ChunkedPrefill` duck type the serving chunk stream
+    drives (same ``buckets``/``plan``/``next_chunk``/``step``/
+    ``cache_size`` surface), for a
+    :class:`~triton_dist_tpu.megakernel.engine.MegaKernelEngine` built
+    with ``prefill_buckets=...``. The KV pool lives inside the engine
+    (its aliased step operands), so the layer-path ``params``/``cache``
+    arguments are ignored and the cache is returned untouched; the
+    chunk's scalar cursors become the sign-encoded per-row position
+    codes the WRITE_KV_CHUNK/ATTN_CHUNK tasks decode
+    (:func:`~triton_dist_tpu.ops.chunked_prefill.chunk_row_codes`).
+    """
+
+    def __init__(self, engine, telemetry=None):
+        buckets = getattr(engine, "prefill_buckets", None)
+        if not buckets:
+            raise ValueError(
+                "MegaChunkedPrefill needs a MegaKernelEngine built "
+                "with prefill_buckets=(...) — the chunk task pair is "
+                "compiled at engine construction")
+        self.engine = engine
+        self.buckets = tuple(buckets)
+        self.telemetry = telemetry
+
+    def plan(self, n_tokens: int) -> List[Tuple[int, int]]:
+        """Deterministic ``[(bucket, valid), ...]`` cover of
+        ``n_tokens`` — the SAME :func:`plan_chunks` cover as the layer
+        path, so the two lanes chunk a prompt identically."""
+        return plan_chunks(n_tokens, self.buckets)
+
+    def next_chunk(self, remaining: int) -> Tuple[int, int]:
+        """The next (bucket, valid) for ``remaining`` tokens."""
+        return self.plan(remaining)[0]
+
+    def step(self, params, toks: np.ndarray, cache, table_row,
+             start: int, wfrom: int, valid: int):
+        """Dispatch one chunk through the megakernel chunk task pair;
+        returns ``(logits (vocab,), cache)`` — the last VALID row's
+        logits, bit-identical to the one-token prefill lane's at that
+        position."""
+        from triton_dist_tpu.ops.chunked_prefill import chunk_row_codes
+
+        tel = self.telemetry
+        t0 = tel.now() if tel is not None and tel.enabled else None
+        codes = chunk_row_codes(start, len(toks), valid, wfrom)
+        logits = self.engine.prefill_chunk(toks, codes, table_row)
+        if t0 is not None:
+            tel.observe("chunk_dispatch", tel.now() - t0)
+            tel.count(f"chunk_bucket_{len(toks)}")
+        return logits[int(valid) - 1], cache
+
+    def cache_size(self) -> int:
+        """Jit-cache entries across the per-bucket chunk steps (≤
+        bucket count) — the engine gates this inline after every
+        dispatch."""
+        return self.engine.chunk_cache_size()

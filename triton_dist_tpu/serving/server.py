@@ -405,6 +405,7 @@ class ServingEngine:
             "preemptions": 0, "comm_timeouts": 0, "decode_time_s": 0.0,
             "decode_tokens": 0, "prefill_chunks": 0, "migrated_pages": 0,
             "spec_drafted": 0, "spec_accepted": 0,
+            "spec_sampled_fallbacks": 0,
             "greedy_agree_tokens": 0, "greedy_ref_tokens": 0,
             "retries": 0, "failovers": 0, "restored_requests": 0,
             "tier_hits": 0, "tier_misses": 0, "offloaded_pages": 0,
@@ -456,11 +457,18 @@ class ServingEngine:
                     f"the serving layer was asked for {self.spec_k} — "
                     "construct MegaKernelEngine(spec_k=K, paged=True) "
                     "and pass the same K here")
-            if self.prefill_buckets:
+            # prefill_buckets is an ENGINE knob here too (the chunk
+            # task pair is compiled at engine construction): validate
+            # both directions like kv_dtype/spec_k above.
+            eng_buckets = getattr(engine, "prefill_buckets", None)
+            if (self.prefill_buckets or None) != (eng_buckets or None):
                 raise ValueError(
-                    "prefill_buckets is a layer-path knob; the "
-                    "megakernel streams prompts through its own "
-                    "prefill lane (already fixed-shape)")
+                    f"megakernel prefill_buckets mismatch: the engine "
+                    f"was built with prefill_buckets={eng_buckets} "
+                    f"but the serving layer was asked for "
+                    f"{self.prefill_buckets} — construct "
+                    "MegaKernelEngine(prefill_buckets=..., paged=True) "
+                    "and pass the same buckets here")
             if self.replica_slots:
                 raise ValueError(
                     "replica_slots is a layer-path EP knob; the "
@@ -473,11 +481,13 @@ class ServingEngine:
                     "megakernel's attention rides its own in-arena "
                     "task lane (docs/serving.md)")
             if self.tiers is not None:
-                raise ValueError(
-                    "kv_tiers is a layer-path knob: the megakernel's "
-                    "KV lives in its in-kernel arena, which the tier "
-                    "gather/scatter path cannot address "
-                    "(docs/serving.md, 'KV memory hierarchy')")
+                raise NotImplementedError(
+                    "kv_tiers on the megakernel lane: the tier "
+                    "gather/scatter path addresses layer-shaped pool "
+                    "leaves, but the megakernel's KV lives in its "
+                    "in-kernel arena (the arena-tier limitation) — "
+                    "tracked by ROADMAP Open item 3, 'Megakernel "
+                    "serving parity — remainder'")
             num_slots = engine.batch
             if engine.paged:
                 page = engine.builder.page
@@ -504,6 +514,22 @@ class ServingEngine:
                     page_bytes=self.plan["page_bytes_per_rank"],
                     native_page_bytes=self.plan[
                         "native_page_bytes_per_rank"])
+                if self.prefill_buckets:
+                    # Chunked admission over the megakernel chunk task
+                    # pair: the SAME _admit_chunked/_advance_chunk
+                    # stream as the layer path, driving
+                    # MegaChunkedPrefill instead of ChunkedPrefill.
+                    from triton_dist_tpu.serving.chunked import (
+                        MegaChunkedPrefill)
+                    self.chunker = MegaChunkedPrefill(
+                        engine, telemetry=self.obs)
+                    self._prefiller = self
+                    # _advance_chunk threads p.cache through the
+                    # chunker; the mk pool lives inside the engine's
+                    # aliased step operands, so the serving-layer
+                    # handle is a placeholder the adapter returns
+                    # untouched.
+                    self.cache = None
             else:
                 # Dense megakernel cache: each slot owns a (max_len,)
                 # row — no pages to manage, only the live-slot mask.
@@ -922,6 +948,8 @@ class ServingEngine:
         out["mk_kv_dtype"] = self.kv_dtype if self.mega else None
         out["mk_spec"] = (self.spec_k or 0) if self.mega else None
         out["mk_checkpointable"] = True if self.mega else None
+        out["mk_chunked_prefill"] = (
+            list(self.prefill_buckets or ()) if self.mega else None)
         # Speculative-decode surface: draft volume vs accepted volume
         # (tokens beyond the per-dispatch guaranteed one).
         if self.spec_k:
@@ -930,6 +958,13 @@ class ServingEngine:
                 "k": self.spec_k,
                 "drafted": drafted,
                 "accepted": self.stats_counters["spec_accepted"],
+                # Dispatches where a sampled (temperature > 0) request
+                # rode the degenerate repeat-draft — it commits at most
+                # one token, so a high count here means the speculative
+                # lane is paying K-row verification for one-token
+                # progress (ROADMAP item 5b visibility).
+                "sampled_fallbacks": self.stats_counters[
+                    "spec_sampled_fallbacks"],
                 "accept_rate": (
                     self.stats_counters["spec_accepted"] / drafted
                     if drafted else None),
@@ -1278,8 +1313,10 @@ class ServingEngine:
         entries, bounded by the bucket count (asserted inline after
         every chunk). Monolithic layer path: the engine's prefill
         entries — grows per distinct prompt/resume length (the PR-4
-        known limit this surfaces). Megakernel: ``None`` (the prefill
-        lane IS the decode dispatch)."""
+        known limit this surfaces). Megakernel: chunked (the engine's
+        per-bucket chunk steps) when built with ``prefill_buckets``,
+        else ``None`` (the one-token prefill lane IS the decode
+        dispatch)."""
         if self._prefiller is not None:
             return self._prefiller.chunker.cache_size()
         if self.mega:
@@ -1370,9 +1407,12 @@ class ServingEngine:
         # from the prompt PLUS every already-fed generated token; the
         # last generated token was never fed and re-enters via decode.
         seq = list(h.request.prompt) + [int(t) for t in h.tokens[:-1]]
-        if self.mega:
+        if self.mega and self._prefiller is None:
             # Prefill lane: ``seq`` streams through the shared decode
             # kernel one token per tick. Fresh slot state now.
+            # (With prefill_buckets the megakernel admits through
+            # _admit_chunked below instead — bucketed chunk tasks,
+            # not one token per tick.)
             if self.manager is not None:
                 try:
                     self.manager.alloc_prefill(slot, seq)
@@ -1873,8 +1913,11 @@ class ServingEngine:
         TierFullError`) leaves the request RUNNING, untouched."""
         if self.mega:
             raise NotImplementedError(
-                "park/resume is a layer-path feature: the megakernel's "
-                "KV lives in its in-kernel arena (docs/serving.md)")
+                "park/resume on the megakernel lane: the park payload "
+                "is gathered from layer-shaped pool leaves, but the "
+                "megakernel's KV lives in its in-kernel arena (the "
+                "arena-tier limitation) — tracked by ROADMAP Open "
+                "item 3, 'Megakernel serving parity — remainder'")
         if self.tiers is None:
             raise RuntimeError(
                 "park() needs kv_tiers — the tier store holds the "
@@ -2076,7 +2119,8 @@ class ServingEngine:
         # prefill lane rides the decode dispatch itself.
         active = [h for h in self.sched.running()
                   if h.status == "running"
-                  or (self.mega and h.status == "prefill")]
+                  or (self.mega and self._prefiller is None
+                      and h.status == "prefill")]
         if not active:
             return 0
         preempted = []
@@ -2257,6 +2301,7 @@ class ServingEngine:
                     self.stats_counters["spec_drafted"] += n_pre - 1
                 else:
                     d += [d[-1]] * (kk - 1)   # sampled: 1 commit max
+                    self.stats_counters["spec_sampled_fallbacks"] += 1
             drafts[slot] = d
         draft_span.__exit__(None, None, None)
         if preempted:
@@ -2371,7 +2416,9 @@ class ServingEngine:
 
         kk = self.spec_k
         active = [h for h in self.sched.running()
-                  if h.status in ("running", "prefill")]
+                  if h.status == "running"
+                  or (h.status == "prefill"
+                      and self._prefiller is None)]
         if not active:
             return 0
         preempted = []
@@ -2408,6 +2455,7 @@ class ServingEngine:
                     self.stats_counters["spec_drafted"] += n_pre - 1
                 else:
                     d += [d[-1]] * (kk - 1)   # sampled: 1 commit max
+                    self.stats_counters["spec_sampled_fallbacks"] += 1
             drafts[slot] = d
             budget[slot] = n_pre
             toks[slot] = d
