@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke disagg-smoke spec-smoke chaos-smoke \
+	serve-smoke ep-smoke ep2d-smoke disagg-smoke spec-smoke chaos-smoke \
 	qblock-smoke obs-smoke tier-smoke fleet-smoke \
 	mega-parity-smoke mkchunk-smoke supervise-smoke apicheck ci \
 	bench-all
@@ -55,6 +55,14 @@ serve-smoke: csrc
 # (docs/serving.md EP-decode section).
 ep-smoke: csrc
 	bash scripts/ep_smoke.sh
+
+# Hierarchical EP decode battery: 2-hop ll2d token-exactness + the
+# asserted DCN put-coalescing gate on the CPU mesh, a forced-2D-mesh
+# chat e2e gating the transport=ll2d exit line, and the non-null
+# bench.py ep_dispatch_2d_ms / ep2d_dcn_puts gate (docs/serving.md
+# EP-decode hierarchy section).
+ep2d-smoke: csrc
+	bash scripts/ep2d_smoke.sh
 
 # Disaggregated-serving battery: chunked-prefill bucket gates + page
 # migration on the CPU mesh, a split-role chat e2e, and the non-null

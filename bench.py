@@ -782,6 +782,73 @@ def _interpret_ep_times() -> dict:
                                   "experts": e}}
 
 
+def _interpret_ep2d() -> dict:
+    """Hierarchical 2-hop EP decode dispatch, ``ar`` vs ``ll2d``, on
+    the interpret mesh — the ``detail.ep_dispatch_2d_ms`` surface a
+    CPU-only host must still fill (non-null gate in
+    scripts/ep2d_smoke.sh). One device plays a degenerate 1×1
+    (dcn, ici) hierarchy: both hops still trace, so the trace-time put
+    ledger records the real hop schedule, and the ``ep2d_dcn_puts``
+    block reports the canonical 2×4 arithmetic the schedule implies —
+    1 DCN slab put per dispatch where the flat ``ll`` pays 4.
+    Interpreter-step overhead, not silicon — presence + relative shape
+    only."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.layers import ep_moe
+    from triton_dist_tpu.models.config import ModelConfig
+    from triton_dist_tpu.ops.ep_a2a import create_ep2d_context
+    from triton_dist_tpu.ops.ll_a2a_2d import record_dispatch_puts
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.utils.testing import spmd
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("dcn", "ici"))
+    mctx = MeshContext.from_mesh(mesh)
+    b, k, d, e = 4, 2, 32, 8
+    cfg = ModelConfig.tiny_moe(hidden_size=d, moe_intermediate_size=16,
+                               num_experts=e, num_experts_per_tok=k)
+    ctx = create_ep2d_context(mctx, num_experts=e, topk=k,
+                              outer_axis="dcn", inner_axis="ici")
+    params = ep_moe.init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, d), jnp.float32)
+    axis = ("dcn", "ici")
+    pspecs = {name: ep_moe.param_specs(axis)[name] for name in params}
+
+    def step_for(tr):
+        return spmd(mesh,
+                    lambda p, v, _tr=tr: ep_moe.fwd_decode(
+                        p, v, topk=k, axis=axis, transport=_tr,
+                        ep_ctx=ctx),
+                    (pspecs, P(None, None)), P(None, None))
+
+    out = {}
+    for tr in ("ar", "ll2d"):
+        step = step_for(tr)
+        np.asarray(step(params, x))                     # warmup
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(step(params, x))
+            best = min(best, time.perf_counter() - t0)
+        out[tr] = round(best * 1e3, 3)
+
+    # The put schedule, read off an actual dispatch trace (hop order
+    # and per-hop put arithmetic are shape-static, so the degenerate
+    # mesh records the same 2-hop schedule a real hierarchy issues).
+    with record_dispatch_puts() as led:
+        jax.eval_shape(step_for("ll2d"), params, x)
+    puts = {"hops_traced": [ev["hop"] for ev in led],
+            # canonical 2 nodes x 4 chips: (n_out-1) vs (n_out-1)*n_in
+            "hierarchy": "2x4", "ll2d": 1, "flat_ll": 4}
+    return {"ep_dispatch_2d_ms": out,
+            "ep2d_dcn_puts": puts,
+            "ep_dispatch_2d_shape": {"batch": b, "topk": k, "hidden": d,
+                                     "experts": e}}
+
+
 def _interpret_qblock_times() -> dict:
     """Paged Q-block attention, flash kernel vs gather ref, on the
     interpret mesh — the ``chunk_attend_ms`` / ``verify_attend_ms``
@@ -1165,6 +1232,12 @@ def _interpret_bench(reason: str) -> None:
     except Exception as e:  # ep bench must not sink the record
         ep = {"ep_dispatch_ms": None, "ep_error": str(e)[:200]}
     try:
+        e2 = _interpret_ep2d()
+    except Exception as e:  # ep2d bench must not sink the record
+        # Nulled, NOT omitted: the ep2d_smoke gate greps these keys.
+        e2 = {"ep_dispatch_2d_ms": None, "ep2d_dcn_puts": None,
+              "ep2d_error": str(e)[:200]}
+    try:
         qb = _interpret_qblock_times()
     except Exception as e:  # qblock bench must not sink the record
         # Nulled, NOT omitted: a consumer greps the keys either way.
@@ -1236,6 +1309,7 @@ def _interpret_bench(reason: str) -> None:
             **mk,
             **sv,
             **ep,
+            **e2,
             **qb,
             **ch,
             **sp,

@@ -40,9 +40,15 @@ def main():
                     help="serve the tiny MoE model with experts "
                          "sharded over the mesh (EP decode dispatch)")
     ap.add_argument("--transport", default=None,
-                    choices=["ar", "ragged", "ll", "auto"],
+                    choices=["ar", "ragged", "ll", "ll2d", "auto"],
                     help="EP decode dispatch transport (--moe-ep / MoE "
                          "checkpoints; see docs/serving.md)")
+    ap.add_argument("--ep-nodes", type=int, default=1,
+                    help="--moe-ep: split the --tp devices into this "
+                         "many nodes — a (nodes, tp/nodes) (dp, tp) "
+                         "hierarchy whose decode dispatch rides the "
+                         "2-hop ll2d transport (docs/serving.md, "
+                         "EP-decode hierarchy)")
     ap.add_argument("--replica-slots", type=int, default=0,
                     help="hot-expert replica slots per MoE layer "
                          "(EP decode, transport=ll)")
@@ -303,10 +309,24 @@ def main():
         # --transport / --replica-slots imply the EP-MoE tiny model:
         # silently serving the dense model would drop the knobs.
         cfg = ModelConfig.tiny_moe(vocab_size=128, num_experts=8)
-        mesh = tdt.make_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
+        ep_kw = {}
+        if args.ep_nodes > 1:
+            # Forced (nodes, chips) hierarchy on the host mesh: dp
+            # plays the DCN axis, tp the ICI axis — the decode
+            # dispatch resolves to the 2-hop ll2d transport.
+            if args.tp % args.ep_nodes:
+                sys.exit(f"--ep-nodes {args.ep_nodes} must divide "
+                         f"--tp {args.tp}")
+            mesh = tdt.make_mesh(dp=args.ep_nodes,
+                                 tp=args.tp // args.ep_nodes,
+                                 devices=jax.devices()[:args.tp])
+            ep_kw["ep_axis"] = ("dp", "tp")
+        else:
+            mesh = tdt.make_mesh(tp=args.tp,
+                                 devices=jax.devices()[:args.tp])
         eng = Engine(cfg, mesh, mode="xla", max_len=args.max_len,
                      model=qwen_moe, moe_impl="ep",
-                     ep_transport=args.transport)
+                     ep_transport=args.transport, **ep_kw)
         srv = ServingEngine(eng, num_slots=args.slots, page=args.page,
                             replica_slots=args.replica_slots,
                             **serve_kw)

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Hierarchical EP decode smoke battery on the CPU interpret mesh
+# (no TPU):
+#
+#  1. tests/test_ep2d.py — the 2-hop ll_a2a_2d vs the flat wire
+#     oracle (int8 + fp8), fwd_decode ll2d-vs-ar parity under uniform
+#     and skewed routing, the ASSERTED DCN put-coalescing claim (puts
+#     per dispatch == peer-NODE count), per-hop fault containment,
+#     the 2D-keyed tune round-trip, serving token-exactness + jit
+#     no-growth, and the chunked-prefill expert_counts fix;
+#  2. the chat server end-to-end on a FORCED 2-node hierarchy
+#     (--ep-nodes 2 over 8 host devices) with the transport knob
+#     UNSET, gating the `transport=ll2d` exit-summary line — the
+#     untuned hierarchical mesh must resolve to the 2-hop path, never
+#     silently fall back to "ar";
+#  3. a bench.py (interpret) pass gating NON-NULL
+#     detail.ep_dispatch_2d_ms for both ar and ll2d plus the
+#     ep2d_dcn_puts block — a CPU-only host must still yield the
+#     hierarchical-dispatch comparison.
+#
+# Sibling of scripts/ep_smoke.sh: tier-1-adjacent, wired as
+# `make ep2d-smoke`. A broken hop composition, a resurrected ll→ar
+# fallback, or an un-coalesced DCN schedule fails here in minutes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PY=${PY:-python}
+
+echo "== EP 2D battery (CPU mesh) =="
+$PY -m pytest tests/test_ep2d.py -q
+
+echo "== EP chat server e2e (forced 2x4 hierarchy, transport unset) =="
+out=$(printf '1 2 3\n9 8 7\n' | \
+      XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+      timeout 600 $PY examples/chat_server.py \
+      --tp 8 --ep-nodes 2 --gen-len 4 --moe-ep)
+echo "$out"
+echo "$out" | grep -q "transport=ll2d" \
+  || { echo "hierarchical mesh fell back off ll2d"; exit 1; }
+
+echo "== bench.py ep_dispatch_2d_ms non-null gate (interpret) =="
+bench_out=$(mktemp)
+BENCH_BACKEND=cpu timeout 600 $PY bench.py 2>/dev/null > "$bench_out"
+$PY - "$bench_out" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    rec = json.loads(f.read().strip().splitlines()[-1])
+e2 = rec["detail"].get("ep_dispatch_2d_ms")
+assert isinstance(e2, dict), \
+    f"ep_dispatch_2d_ms missing: {rec['detail'].get('ep2d_error')}"
+for k in ("ar", "ll2d"):
+    assert isinstance(e2.get(k), (int, float)) and e2[k] > 0, (k, e2)
+puts = rec["detail"].get("ep2d_dcn_puts")
+assert isinstance(puts, dict) and puts.get("ll2d") == 1 \
+    and puts.get("flat_ll") == 4, puts
+print("ep_dispatch_2d_ms:", e2)
+print("ep2d_dcn_puts:", puts)
+EOF
+rm -f "$bench_out"
+
+echo "ep2d-smoke OK"

@@ -1,0 +1,86 @@
+"""Inner SPMD worker for the multi-host EP-2D dispatch entry
+(dryrun_multichip; launched by ``scripts/launch.py`` as 2 processes x
+4 virtual CPU devices — the localhost analogue of a 2-node pod slice
+where DCN crosses processes and ICI stays inside one).
+
+Runs the hierarchical ``ll2d`` MoE decode dispatch over the GLOBAL
+(dp=2, tp=4) mesh — the DCN hop is a genuine cross-process exchange —
+and token-checks it against the zero-communication ``"ar"`` oracle on
+the same replicated batch. Hop impl is ``"xla"``: interpret-mode
+Pallas inside a global-mesh shard_map deadlocks by construction in a
+multi-process run (the kernel gate is a ``threading.Barrier`` sized to
+the full axis env while each process hosts only half the callback
+threads — see tests/multihost_worker.py), and the xla hop carries the
+identical wire payload.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from triton_dist_tpu.utils.distributed import (  # noqa: E402
+    initialize_distributed, dist_print,
+)
+
+initialize_distributed()   # reads COORDINATOR_ADDRESS/NUM_PROCESSES/...
+
+import jax                                       # noqa: E402
+import jax.numpy as jnp                          # noqa: E402
+import numpy as np                               # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import triton_dist_tpu as tdt                    # noqa: E402
+from triton_dist_tpu.layers import ep_moe        # noqa: E402
+from triton_dist_tpu.models.config import ModelConfig  # noqa: E402
+from triton_dist_tpu.ops.ep_a2a import create_ep2d_context  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+# dp is the outer (DCN) axis: each process' 4 local devices form its
+# tp (ICI) group — expert ownership is outer-major over the 8 ranks.
+mesh = tdt.make_mesh(dp=2, tp=4, devices=jax.devices())
+mctx = tdt.MeshContext.from_mesh(mesh)
+cfg = ModelConfig.tiny_moe(hidden_size=32, moe_intermediate_size=16,
+                           num_experts=8, num_experts_per_tok=2)
+ctx2d = create_ep2d_context(mctx, num_experts=cfg.num_experts,
+                            topk=cfg.num_experts_per_tok,
+                            outer_axis="dp", inner_axis="tp",
+                            impl="xla")
+axis = ("dp", "tp")
+params = ep_moe.init(jax.random.PRNGKey(3), cfg)
+specs = {name: ep_moe.param_specs(axis)[name] for name in params}
+# Explicit global placement (the multihost contract: host arrays are
+# identical on every process, so device_put to a cross-process
+# NamedSharding is well defined on each).
+params = {name: jax.device_put(v, NamedSharding(mesh, specs[name]))
+          for name, v in params.items()}
+x = jax.device_put(
+    jax.random.normal(jax.random.PRNGKey(5), (4, cfg.hidden_size),
+                      jnp.float32),
+    NamedSharding(mesh, P(None, None)))
+
+
+def run(transport):
+    f = jax.jit(jax.shard_map(
+        lambda p, v: ep_moe.fwd_decode(
+            p, v, topk=cfg.num_experts_per_tok, axis=axis,
+            transport=transport, ep_ctx=ctx2d),
+        mesh=mesh, in_specs=(specs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))
+    return np.asarray(jax.device_get(f(params, x)))
+
+
+ar = run("ar")            # zero-dispatch oracle (the old fallback)
+ll2d = run("ll2d")        # 2-hop: ICI intra-process, DCN across
+np.testing.assert_allclose(ll2d, ar, rtol=2e-2, atol=2e-2)
+# Decode-level acceptance: the wire quantization must not perturb the
+# greedy "token" (argmax over the hidden readout) on any row.
+assert np.array_equal(ll2d.argmax(-1), ar.argmax(-1)), (
+    ll2d.argmax(-1), ar.argmax(-1))
+dist_print("EP-2D multihost dispatch OK (ll2d == ar across DCN)",
+           allowed_ranks="all")
+
+print(f"RESULT_OK rank={jax.process_index()}", flush=True)

@@ -19,9 +19,16 @@ tokens reach their experts —
   sized at B·K slots per peer (the decode batch's fixed assignment
   count), the reference's ``fast_all_to_all``/``dispatch_kernel_v2``
   shape. Supports hot-expert :func:`replica <init_replicas>` rerouting.
+- ``"ll2d"``: the hierarchical 2-hop ll path for (DCN, ICI) 2-axis
+  meshes (:class:`~triton_dist_tpu.ops.ep_a2a.EP2DContext`): same
+  count-free fixed-slot protocol, but the exchange rides
+  :func:`~triton_dist_tpu.ops.ll_a2a_2d.ll_a2a_2d` — an intra-node ICI
+  shuffle followed by ONE aggregated slab put per peer node over DCN,
+  shrinking DCN puts by the ICI group factor.
 - ``"auto"``: the :mod:`~triton_dist_tpu.tune`-persisted winner for
-  this (mesh, batch, hidden, dtype) key (:func:`tune_transport`), else
-  ``"ll"``.
+  this (mesh-hierarchy, batch, hidden, dtype) key
+  (:func:`tune_transport`), else ``"ll"`` on a flat mesh / ``"ll2d"``
+  on a hierarchical one — never a silent ``"ar"`` fallback.
 """
 
 from __future__ import annotations
@@ -32,11 +39,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from triton_dist_tpu.ops.ep_a2a import EPContext, ep_dispatch, ep_combine
+from triton_dist_tpu.ops.ep_a2a import (EPContext, EP2DContext,
+                                        ep_dispatch, ep_combine)
 from triton_dist_tpu.ops.ep_fused import EPFusedContext, ep_moe_fused
 from triton_dist_tpu.ops.group_gemm import sort_by_expert, grouped_swiglu
 
-DECODE_TRANSPORTS = ("ar", "ragged", "ll", "auto")
+DECODE_TRANSPORTS = ("ar", "ragged", "ll", "ll2d", "auto")
 
 
 def init(key, cfg, dtype=jnp.float32) -> Dict:
@@ -179,6 +187,12 @@ def fwd_decode(params, x, *, topk: int, axis: str = "ep",
       picks fp8 — unlike dispatch/combine, where ``wire_dtype=None``
       means full precision; pick ``"ragged"`` when wire-quantization
       tolerance is unacceptable.
+    - ``"ll2d"``: the same count-free slot protocol over a
+      hierarchical (DCN, ICI) mesh — two single-axis hops with the
+      DCN traffic coalesced to one slab per peer node
+      (:func:`~triton_dist_tpu.ops.ll_a2a_2d.ll_a2a_2d`); needs an
+      :class:`~triton_dist_tpu.ops.ep_a2a.EP2DContext` as ``ep_ctx``.
+      Quantizes once per fabric (two wire round-trips total).
     - ``"auto"``: host-side tune-cache resolution
       (:func:`resolve_transport`).
 
@@ -199,15 +213,27 @@ def fwd_decode(params, x, *, topk: int, axis: str = "ep",
         transport = resolve_transport(
             "auto", ctx=ep_ctx, batch=x.shape[0], hidden=x.shape[1],
             dtype=x.dtype, topk=topk)
-    if transport not in ("ar", "ragged", "ll"):
+    if transport not in ("ar", "ragged", "ll", "ll2d"):
         raise ValueError(f"transport must be one of {DECODE_TRANSPORTS},"
                          f" got {transport!r}")
+    if transport == "ll2d":
+        if not isinstance(ep_ctx, EP2DContext):
+            raise ValueError(
+                "transport='ll2d' needs a hierarchical EP2DContext "
+                "(create_ep2d_context) — flat meshes ride 'll'")
+        if replicas is not None:
+            raise ValueError(
+                "hot-expert replication rides the flat 'll' transport;"
+                " transport='ll2d' does not consult replicas")
+        out = _fwd_decode_ll2d(params, x, topk_ids, topk_w,
+                               ctx=ep_ctx, layer=layer)
+        sh = shared_expert_out(params, x)
+        return out if sh is None else (out + sh.astype(out.dtype))
     if transport in ("ragged", "ll"):
         if ep_ctx is None or not isinstance(ep_ctx, EPContext):
             raise ValueError(
                 f"transport={transport!r} needs a flat EPContext "
-                "(hierarchical 2D decode dispatch stays on the 'ar' "
-                "path)")
+                "(hierarchical 2D meshes ride transport='ll2d')")
         if transport == "ragged":
             out = _fwd_decode_ragged(params, x, topk_ids, topk_w,
                                      ctx=ep_ctx)
@@ -357,34 +383,113 @@ def _fwd_decode_ll(params, x, topk_ids, topk_w, *, ctx: EPContext,
                       topk_w.astype(jnp.float32)).astype(x.dtype)
 
 
+def _fwd_decode_ll2d(params, x, topk_ids, topk_w, *,
+                     ctx: EP2DContext, layer: int = 0):
+    """Hierarchical low-latency decode dispatch: the :func:`_fwd_decode_ll`
+    slot protocol (j = t·K + k, replicated routing, zero rows for
+    non-destinations) with the exchange factored over the 2-axis mesh
+    by :func:`~triton_dist_tpu.ops.ll_a2a_2d.ll_a2a_2d` — ICI shuffle
+    first, then ONE coalesced slab put per peer node over DCN. Global
+    rank order is outer-major (``flat_axis_rank`` over
+    (outer, inner)), matching ``EP2DContext`` expert ownership
+    ``e // experts_per_rank``, so ``dest = flat_e // e_loc`` addresses
+    the wire buffer directly.
+
+    Two wire quantizations per hop direction (once per fabric) — the
+    acceptance bar is greedy-token parity with ``"ar"``, same as the
+    flat ``"ll"`` transport's.
+    """
+    from triton_dist_tpu.ops.ll_a2a_2d import ll_a2a_2d
+    from triton_dist_tpu.parallel.mesh import flat_axis_rank
+
+    mesh = ctx.mesh
+    n = mesh.size(ctx.outer_axis) * mesh.size(ctx.inner_axis)
+    b, d = x.shape
+    k = topk_ids.shape[1]
+    e_loc = params["w_gate"].shape[0]
+    wire = ctx.wire_dtype if ctx.wire_dtype is not None else jnp.int8
+
+    flat_e = topk_ids.reshape(-1).astype(jnp.int32)       # (BK,)
+    dest = flat_e // e_loc                # outer-major global rank
+    rep_tok = jnp.repeat(x, k, axis=0)                    # (BK, d)
+    slots = jnp.arange(b * k)
+    send = jnp.zeros((n, b * k, d), x.dtype).at[dest, slots].set(rep_tok)
+    recv = ll_a2a_2d(send, ctx=mesh, outer_axis=ctx.outer_axis,
+                     inner_axis=ctx.inner_axis, step=2 * layer,
+                     wire_dtype=wire, impl=ctx.impl)      # (n, BK, d)
+
+    _, me = flat_axis_rank((ctx.outer_axis, ctx.inner_axis))
+    # Replicated routing ⇒ every source staged the same slot content;
+    # my copy of the batch is the chunk addressed through me.
+    tok = jnp.take(recv, me, axis=0)                      # (BK, d)
+    mine = dest == me
+    loc = jnp.where(mine, flat_e % e_loc, -1).astype(jnp.int32)
+    sorted_tok, group_sizes, inv = sort_by_expert(tok, loc, e_loc)
+    y = grouped_swiglu(sorted_tok, params["w_gate"], params["w_up"],
+                       params["w_down"], group_sizes)[inv]
+    y = jnp.where(mine[:, None], y, 0).astype(x.dtype)    # (BK, d)
+
+    # Return hop: owners broadcast their rows back through both
+    # fabrics at the opposite slot parity; back[r, j] = slot j as
+    # computed at global rank r.
+    back = ll_a2a_2d(jnp.broadcast_to(y[None], (n, b * k, d)),
+                     ctx=mesh, outer_axis=ctx.outer_axis,
+                     inner_axis=ctx.inner_axis, step=2 * layer + 1,
+                     wire_dtype=wire, impl=ctx.impl)
+    gathered = back[dest, slots].reshape(b, k, d)
+    return jnp.einsum("bkd,bk->bd", gathered.astype(jnp.float32),
+                      topk_w.astype(jnp.float32)).astype(x.dtype)
+
+
 # --- decode-transport autotune + hot-expert replica state -------------------
 
-def _transport_key(ctx: EPContext, *, batch: int, hidden: int, dtype,
+def _transport_key(ctx, *, batch: int, hidden: int, dtype,
                    topk: int) -> str:
     from triton_dist_tpu import tune
 
+    if isinstance(ctx, EP2DContext):
+        axis = f"{ctx.outer_axis}+{ctx.inner_axis}"
+        hier = (f"{ctx.mesh.size(ctx.outer_axis)}"
+                f"x{ctx.mesh.size(ctx.inner_axis)}")
+    else:
+        axis = ctx.axis
+        # Flat mesh = degenerate 1×n hierarchy: the hierarchy shape is
+        # part of the key, so a 2D tuning can never shadow a flat one
+        # (or vice versa) on meshes of equal total size.
+        hier = f"1x{ctx.mesh.size(ctx.axis)}"
     return tune.make_key(
         "ep_decode_transport", mesh=tune.mesh_key(ctx.mesh),
-        axis=ctx.axis, batch=batch, hidden=hidden,
+        axis=axis, hier=hier, batch=batch, hidden=hidden,
         # Canonicalize: jnp.float32 (a type) and np.dtype("float32")
         # must key identically or a tuned winner is never found.
         dtype=str(jnp.dtype(dtype)),
         topk=topk, experts=ctx.num_experts)
 
 
-def resolve_transport(transport: str, *, ctx: Optional[EPContext],
+def resolve_transport(transport: str, *, ctx,
                       batch: int, hidden: int, dtype,
                       topk: int) -> str:
     """Host-side resolution of the decode ``transport`` knob.
 
     Explicit values pass through; ``"auto"`` loads the
     :func:`tune_transport` winner persisted for this
-    (mesh, batch, hidden, dtype) key and falls back to ``"ll"`` (the
-    latency-optimized default the paper's decode path targets) when
-    never tuned — or ``"ar"`` when no EP context exists to dispatch
-    over."""
+    (mesh-hierarchy, batch, hidden, dtype) key and falls back to the
+    latency-optimized default when never tuned — ``"ll"`` on a flat
+    :class:`EPContext`, ``"ll2d"`` on a hierarchical
+    :class:`~triton_dist_tpu.ops.ep_a2a.EP2DContext` (an untuned 2D
+    mesh dispatches over both fabrics rather than silently paying the
+    ``"ar"`` full-reduce) — or ``"ar"`` when no EP context exists to
+    dispatch over."""
     if transport != "auto":
         return transport
+    if isinstance(ctx, EP2DContext):
+        from triton_dist_tpu import tune
+
+        cached = tune.load_autotune_data(_transport_key(
+            ctx, batch=batch, hidden=hidden, dtype=dtype, topk=topk))
+        if cached and cached.get("transport") in ("ar", "ll2d"):
+            return cached["transport"]
+        return "ll2d"
     if ctx is None or not isinstance(ctx, EPContext):
         return "ar"
     from triton_dist_tpu import tune
@@ -396,13 +501,17 @@ def resolve_transport(transport: str, *, ctx: Optional[EPContext],
     return "ll"
 
 
-def tune_transport(mesh, params, ctx: EPContext, *, batch: int,
+def tune_transport(mesh, params, ctx, *, batch: int,
                    topk: int, norm_topk_prob: bool = True, reps: int = 3,
                    use_cache: bool = True) -> str:
-    """OFFLINE ragged-vs-ll sweep for one decode shape: time each
-    transport's jitted replicated-batch dispatch on ``mesh`` and
-    persist the winner under the (mesh, batch, hidden, dtype) key
-    ``transport="auto"`` resolves (the ``tune_schedule`` pattern).
+    """OFFLINE transport sweep for one decode shape: time each
+    candidate's jitted replicated-batch dispatch on ``mesh`` and
+    persist the winner under the (mesh-hierarchy, batch, hidden,
+    dtype) key ``transport="auto"`` resolves (the ``tune_schedule``
+    pattern). A flat :class:`EPContext` sweeps ``ragged`` vs ``ll``; a
+    hierarchical :class:`~triton_dist_tpu.ops.ep_a2a.EP2DContext`
+    sweeps ``ar`` vs ``ll2d`` (the two candidates that exist on a 2D
+    mesh).
 
     ``params`` is one MoE layer's param dict (expert-sharded on the
     mesh or replicated — timing only). Returns the winning transport.
@@ -412,13 +521,16 @@ def tune_transport(mesh, params, ctx: EPContext, *, batch: int,
     import numpy as np
     from triton_dist_tpu import tune
 
+    is2d = isinstance(ctx, EP2DContext)
+    sweep = ("ar", "ll2d") if is2d else ("ragged", "ll")
+    ep_axis = ((ctx.outer_axis, ctx.inner_axis) if is2d else ctx.axis)
     d = params["router"].shape[0]
     dtype = params["w_gate"].dtype
     key = _transport_key(ctx, batch=batch, hidden=d, dtype=dtype,
                          topk=topk)
     if use_cache:
         cached = tune.load_autotune_data(key)
-        if cached and cached.get("transport") in ("ar", "ragged", "ll"):
+        if cached and cached.get("transport") in (("ar",) + sweep):
             return cached["transport"]
 
     x = jax.random.normal(jax.random.PRNGKey(0), (batch, d), dtype)
@@ -428,13 +540,13 @@ def tune_transport(mesh, params, ctx: EPContext, *, batch: int,
     shared = {"w_shared_gate": P(None, None),
               "w_shared_up": P(None, None),
               "w_shared_down": P(None, None), "shared_gate": P(None)}
-    full = {**param_specs(ctx.axis), **shared}
+    full = {**param_specs(ep_axis), **shared}
     specs = {k: full[k] for k in params}
     times = {}
-    for tr in ("ragged", "ll"):
+    for tr in sweep:
         step = jax.jit(jax.shard_map(
             lambda p, v, _tr=tr: fwd_decode(
-                p, v, topk=topk, axis=ctx.axis,
+                p, v, topk=topk, axis=ep_axis,
                 norm_topk_prob=norm_topk_prob, transport=_tr,
                 ep_ctx=ctx),
             mesh=mesh, in_specs=(specs, P(None, None)),

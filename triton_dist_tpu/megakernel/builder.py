@@ -232,7 +232,8 @@ class ModelBuilder:
                  page: Optional[int] = None, profile: bool = False,
                  cost_table: Optional[dict] = None,
                  expert_load=None, kv_quant: Optional[str] = None,
-                 qblock: bool = False, chunk: bool = False):
+                 qblock: bool = False, chunk: bool = False,
+                 counts_rows: Optional[int] = None):
         """``num_cores`` > 1 packs tasks onto per-core queues executed
         over a CORE_PARALLEL grid dimension (TPU megacore; v4/v5p have
         two TensorCores) with cross-core deps enforced by edge
@@ -320,6 +321,13 @@ class ModelBuilder:
         # chunked-prefill contract (ops/chunked_prefill) as megakernel
         # tasks.
         self.chunk = bool(chunk)
+        # Engine-wide moe_counts region height: every builder sharing
+        # one arena must claim the SAME offset AND rows for the
+        # counters, or a smaller builder's next region starts inside a
+        # larger one's counter span (the engine passes the max batch
+        # over all its builders).
+        self.counts_rows = (int(counts_rows) if counts_rows is not None
+                            else None)
         if batch % seq:
             raise ValueError(f"batch rows {batch} not divisible by "
                              f"seq {seq}")
@@ -569,6 +577,26 @@ class ModelBuilder:
         vecalloc("embed", self.vocab_loc * d_t)
         walloc("lm_head_T", d_t, self.vloc_tiles)
 
+        # MoE expert-load counters: one (counts_rows, w) arena region
+        # the router epilogue ACCUMULATES its top-k selection mask
+        # into, every layer, every step — the decode dispatch's
+        # on-device expert telemetry (read back by
+        # engine.expert_counts(); the serving layer diffs snapshots
+        # per tick). Monotonic: arena packs zeroed, so no per-step
+        # reset task is needed. Placed directly after the (batch-
+        # independent) weight region and sized engine-wide, so every
+        # builder sharing the arena claims the SAME [offset, rows)
+        # span — chunk/verify/prefill launches accumulate into the
+        # decode counters instead of scribbling them with activations
+        # (the old layout put moe_counts after the batch-dependent
+        # ar_ws/x regions, so any batched prefill builder's
+        # activation tail aliased the decode builder's counters).
+        self.moe_counts_off = 0
+        if self.moe:
+            self.moe_counts_off = self._alloc(
+                "moe_counts", max(b, self.counts_rows or 0),
+                kind="counter")
+
         # Allreduce workspace + I/O regions.
         ar_max_tiles = max(d_t, 1)
         self.ar_ws_off = self._alloc("ar_ws", self.n * ar_max_tiles * b,
@@ -576,16 +604,6 @@ class ModelBuilder:
         self.ar_max_tiles = ar_max_tiles
         x_off = self._alloc_act("x", d_t)
         self.x_off = x_off
-        # MoE expert-load counters: one (batch, w) arena region the
-        # router epilogue ACCUMULATES its top-k selection mask into,
-        # every layer, every step — the decode dispatch's on-device
-        # expert telemetry (read back by engine.expert_counts(); the
-        # serving layer diffs snapshots per tick). Monotonic: arena
-        # packs zeroed, so no per-step reset task is needed.
-        self.moe_counts_off = 0
-        if self.moe:
-            self.moe_counts_off = self._alloc("moe_counts", b,
-                                              kind="counter")
 
         # Embedding lookup inside the kernel (token ids via prefetch),
         # then an allreduce to sum the vocab-shard contributions.

@@ -150,6 +150,19 @@ class MegaKernelEngine:
                             prefill_seq if prefill_seq > 1 else 1)
         self._kv_quant = (None if self.kv_dtype == "bf16"
                           else self.kv_dtype)
+        # Engine-wide moe_counts height: every builder sharing the
+        # arena claims the same counter span, sized by the LARGEST
+        # row count any of them runs (verify = batch·K rows, chunk =
+        # bucket rows, prefill = batch·seq rows) — so chunked-prefill
+        # and verification traffic accumulates into the decode
+        # counters instead of overlapping them (expert_counts()).
+        counts_rows = batch
+        if self.spec_k:
+            counts_rows = max(counts_rows, batch * self.spec_k)
+        for c in (self.prefill_buckets or ()):
+            counts_rows = max(counts_rows, c)
+        if prefill_seq > 1:
+            counts_rows = max(counts_rows, batch * prefill_seq)
         self.builder = ModelBuilder(cfg, mesh, batch=batch,
                                     max_len=max_len, axis=axis,
                                     tile_w=tile_w, t_tile=t_tile,
@@ -158,7 +171,8 @@ class MegaKernelEngine:
                                     schedule=self.schedule, paged=paged,
                                     page=page, cost_table=cost_table,
                                     profile=self.profile,
-                                    kv_quant=self._kv_quant)
+                                    kv_quant=self._kv_quant,
+                                    counts_rows=counts_rows)
         # Q-block verification builder: the SAME weight layout at
         # batch*K rows (seq=K, one row per drafted candidate), sharing
         # the decode arena — its (bigger) activation tail sizes the
@@ -171,7 +185,7 @@ class MegaKernelEngine:
                 seq=self.spec_k, qblock=True, num_cores=num_cores,
                 strategy=strategy, schedule=self.schedule, paged=True,
                 page=page, cost_table=cost_table,
-                kv_quant=self._kv_quant)
+                kv_quant=self._kv_quant, counts_rows=counts_rows)
         # Prefill-chunk builders: ONE per bucket (the build cache is
         # bounded by the bucket count by construction), each a C-row
         # single-slot chunk launch (batch = seq = C) sharing the
@@ -183,7 +197,8 @@ class MegaKernelEngine:
                 tile_w=tile_w, t_tile=t_tile, seq=c, chunk=True,
                 num_cores=num_cores, strategy=strategy,
                 schedule=self.schedule, paged=True, page=page,
-                cost_table=cost_table, kv_quant=self._kv_quant)
+                cost_table=cost_table, kv_quant=self._kv_quant,
+                counts_rows=counts_rows)
         if cfg.is_hybrid:
             # Hybrid (qwen_next): GDN layers keep a recurrent-state
             # buffer; prefill runs via prefill_chain (decode-only
@@ -230,7 +245,7 @@ class MegaKernelEngine:
                 axis=axis, tile_w=tile_w, t_tile=t_tile,
                 seq=prefill_seq, num_cores=num_cores, strategy=strategy,
                 schedule=self.schedule, paged=paged, page=page,
-                cost_table=cost_table)
+                cost_table=cost_table, counts_rows=counts_rows)
             self.prefill_seq = prefill_seq
             pstep = self.prefill_builder.step_fn()
             self._prefill_step = jax.jit(jax.shard_map(
@@ -417,22 +432,18 @@ class MegaKernelEngine:
     def expert_counts(self) -> np.ndarray:
         """Cumulative per-expert routed-token counts from the arena's
         in-kernel router counters (MoE builds): the router epilogue
-        accumulates its top-k selection mask every layer, every decode
-        step (kernels.moe_weights_body). Returns (num_experts,) int64
-        — monotonic; diff two snapshots for a window. Forces the
-        in-flight step to complete (it reads the arena). Counts cover
-        the full fixed decode batch, parked serving slots included,
-        and are only meaningful for decode-only traffic (a batched
-        prefill builder reuses the activation region)."""
+        accumulates its top-k selection mask every layer, every step
+        (kernels.moe_weights_body). Returns (num_experts,) int64 —
+        monotonic; diff two snapshots for a window. Forces the
+        in-flight step to complete (it reads the arena). Every builder
+        sharing the arena (decode, Q-block verify, prefill-chunk,
+        batched prefill) claims ONE ``moe_counts`` region at the same
+        offset/rows, so the counters stay valid — and inclusive of
+        routed verify/chunk rows — with chunked prefill active."""
         if not self.cfg.is_moe:
             raise ValueError("expert_counts() needs a MoE megakernel")
-        # A spec_k engine's serving traffic rides the verification
-        # step exclusively, so ITS counts region is the live one (the
-        # two builders' regions sit at different offsets of the shared
-        # arena — each is scratch to the other's activations).
-        b = self.verify_builder if self.spec_k else self.builder
-        rows = np.asarray(self._arena[
-            b.moe_counts_off:b.moe_counts_off + b.batch])
+        reg = self.builder.schema.region("moe_counts")
+        rows = np.asarray(self._arena[reg.offset:reg.offset + reg.rows])
         return rows.sum(axis=0)[:self.cfg.num_experts].round(
         ).astype(np.int64)
 

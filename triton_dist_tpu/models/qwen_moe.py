@@ -107,8 +107,9 @@ def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx,
     (the GEMM+AR pairing), EP experts via ``ep_moe.fwd_decode`` with
     the decode ``transport`` knob (``"ar"`` masked-local + psum,
     ``"ragged"`` exact-splits round-trip, ``"ll"`` low-latency
-    count-free quantized exchange, ``"auto"`` tune-cache winner — see
-    :mod:`triton_dist_tpu.layers.ep_moe`). ``replicas`` is the FULL
+    count-free quantized exchange, ``"ll2d"`` the hierarchical 2-hop
+    ICI×DCN variant for an ``EP2DContext``, ``"auto"`` tune-cache
+    winner — see :mod:`triton_dist_tpu.layers.ep_moe`). ``replicas`` is the FULL
     hot-expert replica state (:func:`ep_moe.init_replicas`); ``layer``
     selects its slice and the ll slot parity. ``counts`` (a list)
     collects this layer's per-expert routed counts."""
@@ -131,7 +132,8 @@ def moe_ffn_decode(moe, h, cfg: ModelConfig, *, moe_impl, axis, ep_ctx,
                              norm_topk_prob=cfg.norm_topk_prob,
                              transport=transport or "ar",
                              ep_ctx=(ep_ctx if isinstance(
-                                 ep_ctx, EPContext) else None),
+                                 ep_ctx, (EPContext, EP2DContext))
+                                 else None),
                              replicas=rep_layer, layer=layer,
                              counts=counts)
 

@@ -72,6 +72,9 @@ from triton_dist_tpu.ops.ulysses_fused import (  # noqa: F401
 from triton_dist_tpu.ops.low_latency import (  # noqa: F401
     fast_allgather, ll_a2a, ll_a2a_steps,
 )
+from triton_dist_tpu.ops.ll_a2a_2d import (  # noqa: F401
+    ll_a2a_2d, hop_put_counts, record_dispatch_puts,
+)
 from triton_dist_tpu.ops.moe_reduce import (  # noqa: F401
     moe_reduce_rs, moe_reduce_rs_ref, moe_reduce_ar, moe_reduce_ar_ref,
 )

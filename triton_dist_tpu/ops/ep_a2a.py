@@ -528,12 +528,21 @@ class EP2DContext:
 
     Expert ownership is outer-major: expert ``e`` lives on global rank
     ``e // experts_per_rank`` with rank = dcn_idx·n_ici + ici_idx.
+
+    ``wire_dtype``/``impl`` feed the ``"ll2d"`` decode transport
+    (:func:`triton_dist_tpu.layers.ep_moe.fwd_decode`): the 2-hop wire
+    quant dtype (None = int8) and the per-hop exchange implementation
+    (``"kernel"`` Pallas RDMA, ``"xla"`` the same wire payload through
+    ``lax.all_to_all`` — required inside a global-mesh shard_map of a
+    multi-process interpret run).
     """
     mesh: MeshContext
     outer_axis: str = "dcn"
     inner_axis: str = "ici"
     num_experts: int = 8
     topk: int = 2
+    wire_dtype: Optional[object] = None
+    impl: str = "kernel"
 
     @property
     def experts_per_rank(self) -> int:
@@ -544,14 +553,15 @@ class EP2DContext:
 
 def create_ep2d_context(mesh: MeshContext, *, num_experts: int,
                         topk: int, outer_axis: str = "dcn",
-                        inner_axis: str = "ici") -> EP2DContext:
+                        inner_axis: str = "ici", wire_dtype=None,
+                        impl: str = "kernel") -> EP2DContext:
     n = mesh.size(outer_axis) * mesh.size(inner_axis)
     if num_experts % n:
         raise ValueError(f"num_experts={num_experts} not divisible by "
                          f"{outer_axis}x{inner_axis}={n}")
     return EP2DContext(mesh=mesh, outer_axis=outer_axis,
                        inner_axis=inner_axis, num_experts=num_experts,
-                       topk=topk)
+                       topk=topk, wire_dtype=wire_dtype, impl=impl)
 
 
 @dataclasses.dataclass

@@ -165,10 +165,12 @@ class ServingEngine:
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
-          "ll" | "auto"); default = the engine's ``ep_transport``.
-          "auto" is resolved ONCE here against the tune cache for the
-          actual (mesh, num_slots, hidden, dtype) decode shape, so the
-          jitted decode dispatch never re-specializes. The megakernel
+          "ll" | "ll2d" | "auto"); default = the engine's
+          ``ep_transport``. "auto" is resolved ONCE here against the
+          tune cache for the actual (mesh, num_slots, hidden, dtype)
+          decode shape, so the jitted decode dispatch never
+          re-specializes. A hierarchical (EP2DContext) engine resolves
+          to the 2-hop "ll2d" path unless tuned otherwise. The megakernel
           path serves experts in-kernel (TP regime); the knob is
           recorded but dispatch stays in-kernel.
         - ``replica_slots``: hot-expert replica weight slots per MoE
@@ -382,6 +384,7 @@ class ServingEngine:
                                  f"{DECODE_TRANSPORTS}")
         self.transport = transport
         self.ep = False                  # layer-path EP-MoE decode
+        self.ep2d = False                # hierarchical (ICI×DCN) EP
         self.replicas = None
         self.expert_hist: List[np.ndarray] = []
         self._hist_active = False
@@ -627,12 +630,15 @@ class ServingEngine:
         # the replica state through decode_step_paged alongside the
         # on-device expert-counts output.
         from triton_dist_tpu.layers import ep_moe as _ep_moe
-        from triton_dist_tpu.ops.ep_a2a import EPContext as _EPCtx
+        from triton_dist_tpu.ops.ep_a2a import (EPContext as _EPCtx,
+                                                EP2DContext as _EP2D)
 
         mk = dict(eng.model_kwargs)
         ep_ctx = mk.get("ep_ctx")
         self.ep = (mk.get("moe_impl") == "ep"
                    and isinstance(ep_ctx, _EPCtx))
+        self.ep2d = (mk.get("moe_impl") == "ep"
+                     and isinstance(ep_ctx, _EP2D))
         if self.ep:
             # Key the tune lookup on the EXPERT weight dtype — the
             # same key tune_transport persists under (a mixed-dtype
@@ -668,24 +674,34 @@ class ServingEngine:
                         cfg, slots=self.replica_slots,
                         num_layers=cfg.num_hidden_layers, dtype=dtype),
                     self._replica_shardings)
+        elif self.ep2d:
+            if self.replica_slots:
+                raise ValueError(
+                    "replica_slots needs a flat EPContext and "
+                    "transport='ll' (hierarchical EP2D decode does "
+                    "not consult replicas)")
+            # Same ONCE-here host-side resolution as the flat branch,
+            # at the true decode shape — an untuned hierarchical mesh
+            # resolves "auto" to the 2-hop 'll2d' path, never a silent
+            # 'ar' fallback.
+            dtype = eng.params["layers"][0]["moe"]["w_gate"].dtype
+            tr = self.transport or getattr(eng, "ep_transport",
+                                           None) or "auto"
+            tr = _ep_moe.resolve_transport(
+                tr, ctx=ep_ctx, batch=num_slots,
+                hidden=cfg.hidden_size, dtype=dtype,
+                topk=cfg.num_experts_per_tok)
+            if tr not in ("ar", "ll2d"):
+                raise ValueError(
+                    f"transport={tr!r}: hierarchical (EP2D) decode "
+                    "rides 'ar' or the 2-hop 'll2d' (ragged/ll need a "
+                    "flat EPContext)")
+            self.transport = tr
+            mk["transport"] = tr
         elif self.replica_slots or self.transport:
-            from triton_dist_tpu.ops.ep_a2a import EP2DContext as _EP2D
-
-            if (isinstance(ep_ctx, _EP2D) and not self.replica_slots
-                    and self.transport in ("ar", "auto")):
-                # Hierarchical (2D) EP decode rides the 'ar' path —
-                # the only transport the two-hop geometry supports.
-                self.transport = "ar"
-            elif isinstance(ep_ctx, _EP2D):
-                raise ValueError(
-                    f"transport={self.transport!r}/replica_slots="
-                    f"{self.replica_slots}: hierarchical (EP2D) decode "
-                    "supports only transport='ar' and no replication "
-                    "(ragged/ll need a flat EPContext)")
-            else:
-                raise ValueError(
-                    "transport/replica_slots are EP-MoE decode knobs; "
-                    "this engine serves a non-EP model")
+            raise ValueError(
+                "transport/replica_slots are EP-MoE decode knobs; "
+                "this engine serves a non-EP model")
 
         # Pinned cache out_shardings on the decode dispatch too: every
         # producer of the pool (init device_put, prompt writer, chunk
@@ -922,8 +938,10 @@ class ServingEngine:
                 "in-kernel-tp" if getattr(self.cfg, "is_moe", False)
                 else None)
         else:
-            # self.transport is also set for EP2D engines pinned to
-            # the 'ar' path (self.ep covers flat-EPContext telemetry).
+            # self.transport is also resolved for EP2D engines
+            # ("ll2d" unless tuned otherwise — the one-line signal
+            # that the hierarchical mesh is NOT falling back to 'ar';
+            # self.ep covers flat-EPContext telemetry).
             out["dispatch_transport"] = self.transport
         if self._telemetry_active or self.expert_totals.any():
             out["expert_load"] = self.expert_ewma.tolist()
