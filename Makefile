@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
-	serve-smoke ep-smoke ep2d-smoke disagg-smoke spec-smoke chaos-smoke \
+	serve-smoke ep-smoke ep2d-smoke aggemm-smoke disagg-smoke \
+	spec-smoke chaos-smoke \
 	qblock-smoke obs-smoke tier-smoke fleet-smoke \
 	mega-parity-smoke mkchunk-smoke supervise-smoke apicheck ci \
 	bench-all
@@ -63,6 +64,14 @@ ep-smoke: csrc
 # EP-decode hierarchy section).
 ep2d-smoke: csrc
 	bash scripts/ep2d_smoke.sh
+
+# ag_gemm variant battery: panel/pipelined parity (both real kernels,
+# no interpret fallback) across swizzle x depth x sim-ring, wide-K
+# host-side schedule math, the variant-autotune round-trip, and the
+# non-null bench.py panel/pipelined crossover gate (pipelined must
+# stay within 1.1x of panel at block_m <= 512; docs/perf.md).
+aggemm-smoke: csrc
+	bash scripts/aggemm_smoke.sh
 
 # Disaggregated-serving battery: chunked-prefill bucket gates + page
 # migration on the CPU mesh, a split-role chat e2e, and the non-null

@@ -62,6 +62,13 @@ AG_GEMM_CONFIGS = (
      "block_k": 1024},
     {"variant": "pipelined", "block_m": 128, "block_n": 512,
      "block_k": 2048},
+    # Variant-crossover pairs: both variants measured at block_m
+    # {128, 256, 512} so the panel-vs-streamed crossover is read off
+    # ONE sweep (detail.ag_gemm_variant_crossover), not stitched from
+    # different rounds.
+    {"block_m": 128, "block_n": 256, "block_k": 4096},
+    {"variant": "pipelined", "block_m": 512, "block_n": 256,
+     "block_k": 1024},
 )
 
 # gemm_rs gets the same treatment (round-1 winner first): its detail
@@ -1154,6 +1161,73 @@ def _interpret_fleet() -> dict:
     }
 
 
+def _variant_best_ms(sweep, variant, block_m=None):
+    """Best swept time (ms) for one ag_gemm variant, optionally pinned
+    to one block_m; None — not omitted — when nothing lowered."""
+    ts = [t for t, c, _ in sweep
+          if c.get("variant", "panel") == variant
+          and (block_m is None or c.get("block_m") == block_m)]
+    return round(min(ts) * 1e3, 3) if ts else None
+
+
+def _interpret_ag_variants() -> dict:
+    """Panel-vs-pipelined crossover on the interpret mesh: both
+    variants at block_m {128, 256, 512} on the same sim ring and
+    shape. Interpreter ratios track schedule/body-count overhead, not
+    silicon overlap — but the pipelined variant runs its REAL
+    scoped-VMEM streamed kernel here (no fallback exists), so the
+    comparison is meaningful for gating: the streamed grid has no kk
+    dimension, and a regression that re-bloats its body count or
+    staging shows up as pipelined >> panel.
+
+    Shape: m_loc=512 after the sim-4 split so block_m=512 is a real
+    single-row-tile grid; K=32 with block_k=16 gives each variant two
+    k-steps (the panel as grid bodies, the stream as rotating
+    buffers) while every staged buffer stays <= 64 KB — the interpret
+    harness starves above that.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+    from triton_dist_tpu.parallel.mesh import MeshContext
+    from triton_dist_tpu.utils.testing import spmd
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    mctx = MeshContext.from_mesh(mesh)
+    sim = 4
+    a = jax.random.normal(jax.random.PRNGKey(4), (2048, 32), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(5), (32, 64), jnp.float32)
+    want = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+
+    crossover = {}
+    best = {"panel": None, "pipelined": None}
+    for bm in (128, 256, 512):
+        row = {}
+        for variant in ("panel", "pipelined"):
+            ctx = create_ag_gemm_context(mctx, block_m=bm, block_n=64,
+                                         block_k=16, variant=variant)
+            step = spmd(mesh,
+                        lambda x, w, _c=ctx: ag_gemm(x, w, _c,
+                                                     sim_ranks=sim),
+                        (P(None, None), P(None, None)), P(None, None))
+            got = np.asarray(step(a, b), np.float32)  # warmup + gate
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+            t = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                np.asarray(step(a, b))
+                t = min(t, time.perf_counter() - t0)
+            row[f"{variant}_ms"] = round(t * 1e3, 3)
+            if best[variant] is None or t * 1e3 < best[variant]:
+                best[variant] = round(t * 1e3, 3)
+        crossover[str(bm)] = row
+    return {"ag_gemm_panel_ms": best["panel"],
+            "ag_gemm_pipelined_ms": best["pipelined"],
+            "ag_gemm_variant_crossover": crossover}
+
+
 def _interpret_bench(reason: str) -> None:
     """CPU-only fallback: measure the overlap-schedule family on the
     interpret mesh instead of stalling toward a stale replay.
@@ -1287,6 +1361,13 @@ def _interpret_bench(reason: str) -> None:
               "megakernel_tokens_per_s_prefill_heavy": None,
               "megakernel_prefill_chunk_speedup": None,
               "mega_error": str(e)[:300]}
+    try:
+        av = _interpret_ag_variants()
+    except Exception as e:  # variant sweep must not sink the record
+        # Nulled, NOT omitted: the aggemm_smoke gate greps these keys.
+        av = {"ag_gemm_panel_ms": None, "ag_gemm_pipelined_ms": None,
+              "ag_gemm_variant_crossover": None,
+              "ag_variant_error": str(e)[:300]}
     last, src = _load_last_result()
     out = {
         "metric": "ag_gemm_overlap_efficiency_interpret",
@@ -1317,6 +1398,7 @@ def _interpret_bench(reason: str) -> None:
             **fl,
             **mp,
             **mc,
+            **av,
             # Hardware partials from an earlier run that died mid-sweep
             # (kept: this interpret record is no substitute for them).
             "partial_sweeps": _load_partials(),
@@ -1719,6 +1801,18 @@ def main():
                 if t_attn_xla else None),
             "shape_m_k_n": [m_full, k_dim, n_dim],
             "best_config": best_cfg,
+            # Per-variant bests + the block_m crossover table (nulled,
+            # NOT omitted, when a variant's configs all failed to
+            # lower: the aggemm_smoke gate greps these keys either
+            # way).
+            "ag_gemm_panel_ms": _variant_best_ms(sweep, "panel"),
+            "ag_gemm_pipelined_ms": _variant_best_ms(sweep, "pipelined"),
+            "ag_gemm_variant_crossover": {
+                str(bm): {
+                    "panel_ms": _variant_best_ms(sweep, "panel", bm),
+                    "pipelined_ms": _variant_best_ms(sweep, "pipelined",
+                                                     bm)}
+                for bm in (128, 256, 512)},
             "swept_ms": {
                 (f"{c.get('variant', 'panel')}:"
                  f"{c['block_m']}x{c['block_n']}x{c['block_k']}"):

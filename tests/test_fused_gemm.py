@@ -385,9 +385,11 @@ def test_gemm_rs_2d_four_outer_groups():
     assert_allclose(f(a, b), g(a, b), rtol=1e-4, atol=1e-4)
 
 
-def test_ag_gemm_pipelined_persistent_ws(tp8_mesh, tp8_ctx):
-    """Persistent-workspace threading: call 2 reuses call 1's gather
-    buffer (no zero-fill) and must produce identical results."""
+def test_ag_gemm_pipelined_back_to_back(tp8_mesh, tp8_ctx):
+    """Two pipelined calls in one program (the persistent-context usage
+    the retired ``ws=`` threading existed for): the scoped-VMEM variant
+    has no workspace init to amortize — back-to-back calls just work,
+    each returning its own gathered A."""
     a1 = _rand((256, 32), 19)
     a2 = _rand((256, 32), 20)
     b = _rand((32, 64), 21)
@@ -395,18 +397,56 @@ def test_ag_gemm_pipelined_persistent_ws(tp8_mesh, tp8_ctx):
                                  variant="pipelined")
 
     def two_calls(x1, x2, w):
-        o1, ws = ag_gemm(x1, w, ctx, return_ag=True)
-        o2, ws = ag_gemm(x2, w, ctx, return_ag=True, ws=ws)
-        return o1, o2
+        o1, ag1 = ag_gemm(x1, w, ctx, return_ag=True)
+        o2, ag2 = ag_gemm(x2, w, ctx, return_ag=True)
+        return o1, o2, ag1, ag2
 
     f = spmd(tp8_mesh, two_calls,
              (P("tp", None), P("tp", None), P(None, "tp")),
-             (P(None, "tp"), P(None, "tp")))
-    o1, o2 = f(a1, a2, b)
+             (P(None, "tp"), P(None, "tp"), P(None, None),
+              P(None, None)))
+    o1, o2, ag1, ag2 = f(a1, a2, b)
     g = spmd(tp8_mesh, lambda x, w: ag_gemm_ref(x, w),
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(o1, g(a1, b), rtol=1e-4, atol=1e-4)
     assert_allclose(o2, g(a2, b), rtol=1e-4, atol=1e-4)
+    assert_allclose(ag1, a1)
+    assert_allclose(ag2, a2)
+
+
+def test_ag_gemm_pipelined_sim_runs_real_kernel(monkeypatch):
+    """Regression for the deleted interpret fallback: variant=
+    "pipelined" under sim-ranks must dispatch the REAL pipelined
+    kernel (the old aliased form silently rewrote itself to "panel"
+    under interpret, so the sim parity sweep never tested it)."""
+    import importlib
+
+    import numpy as np
+    from jax.sharding import Mesh
+    from triton_dist_tpu.parallel.mesh import MeshContext
+
+    # the ops package re-exports the ag_gemm FUNCTION under the same
+    # name, so attribute imports shadow the module
+    mod = importlib.import_module("triton_dist_tpu.ops.ag_gemm")
+    calls = []
+    real = mod._ag_gemm_pipelined
+
+    def spy(*args, **kw):
+        calls.append(True)
+        return real(*args, **kw)
+
+    monkeypatch.setattr(mod, "_ag_gemm_pipelined", spy)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((256, 32), 60)
+    b = _rand((32, 64), 61)
+    ctx = create_ag_gemm_context(ctx1, block_m=16, block_n=8,
+                                 variant="pipelined")
+    f = spmd(mesh1, lambda x, w: ag_gemm(x, w, ctx, sim_ranks=4),
+             (P(None, None), P(None, None)), P(None, None))
+    assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)
+    assert calls, ("pipelined variant fell back off the real kernel "
+                   "under sim-ranks interpret")
 
 
 def test_gemm_ar_2d(dp2tp4_mesh, dp2tp4_ctx):

@@ -155,18 +155,22 @@ def _ag_modes():
     return SWIZZLE_MODES
 
 
-@pytest.mark.parametrize("mode,depth",
-                         list(itertools.product(("ag", "identity"),
+@pytest.mark.parametrize("variant,mode,depth",
+                         list(itertools.product(("panel", "pipelined"),
+                                                ("ag", "identity"),
                                                 DEPTHS)))
-def test_ag_gemm_parity(tp8_mesh, tp8_ctx, mode, depth):
+def test_ag_gemm_parity(tp8_mesh, tp8_ctx, variant, mode, depth):
     from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
 
     assert mode in _ag_modes()
     a = _rand((128, 32), 70)
     b = _rand((32, 64), 71)
     # m_loc=16/block_m=8 -> 2 bodies per chunk, so cross-chunk
-    # prefetch (depth >= 2) genuinely engages.
+    # prefetch (depth >= 2) genuinely engages for the panel variant;
+    # block_k=16 -> n_k=2, so the pipelined variant's scoped stream
+    # genuinely double-buffers.
     ctx = create_ag_gemm_context(tp8_ctx, block_m=8, block_n=8,
+                                 block_k=16, variant=variant,
                                  swizzle_mode=mode, prefetch_depth=depth)
     f = spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
              (P("tp", None), P(None, "tp")), P(None, "tp"))
@@ -191,6 +195,54 @@ def test_ag_gemm_swizzled_equals_identity(tp8_mesh, tp8_ctx):
             spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
                  (P("tp", None), P(None, "tp")), P(None, "tp"))(a, b))
     np.testing.assert_array_equal(outs["ag"], outs["identity"])
+
+
+@pytest.mark.parametrize("mode,depth",
+                         list(itertools.product(("ag", "identity"),
+                                                (0, 3))))
+def test_ag_gemm_variant_bit_parity(tp8_mesh, tp8_ctx, mode, depth):
+    """Panel and pipelined must be BIT-identical, not just close: at
+    equal tile sizes both accumulate the same (tm, tk) x (tk, tn)
+    partial products in the same ascending-K order into an f32
+    accumulator — different staging, same arithmetic."""
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+
+    a = _rand((128, 32), 78)
+    b = _rand((32, 64), 79)
+    outs = {}
+    for variant in ("panel", "pipelined"):
+        ctx = create_ag_gemm_context(tp8_ctx, block_m=8, block_n=8,
+                                     block_k=16, variant=variant,
+                                     swizzle_mode=mode,
+                                     prefetch_depth=depth)
+        outs[variant] = np.asarray(
+            spmd(tp8_mesh, lambda x, w: ag_gemm(x, w, ctx),
+                 (P("tp", None), P(None, "tp")), P(None, "tp"))(a, b))
+    np.testing.assert_array_equal(outs["panel"], outs["pipelined"])
+
+
+@pytest.mark.parametrize("ring", (2, 4, 8))
+def test_ag_gemm_sim_ring_sweep(ring):
+    """Both variants across self-ring sizes (the bench's single-chip
+    overlap proxy at each world size): oracle parity per variant and
+    bit-parity between variants on every ring."""
+    from triton_dist_tpu.ops import ag_gemm, create_ag_gemm_context
+
+    mesh1 = _mesh1()
+    ctx1 = MeshContext.from_mesh(mesh1)
+    a = _rand((128, 32), 80)
+    b = _rand((32, 64), 81)
+    outs = {}
+    for variant in ("panel", "pipelined"):
+        ctx = create_ag_gemm_context(ctx1, block_m=8, block_n=8,
+                                     block_k=16, variant=variant)
+        outs[variant] = np.asarray(
+            spmd(mesh1,
+                 lambda x, w: ag_gemm(x, w, ctx, sim_ranks=ring),
+                 (P(None, None), P(None, None)), P(None, None))(a, b))
+        assert_allclose(outs[variant], jnp.dot(a, b),
+                        rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(outs["panel"], outs["pipelined"])
 
 
 @pytest.mark.parametrize("mode,depth",
@@ -377,3 +429,51 @@ def test_ag_gemm_tuned_in_trace_uses_cached_winner(tp8_mesh, tp8_ctx,
              lambda x, w: ag_gemm_tuned(x, w, tp8_ctx, axis="tp"),
              (P("tp", None), P(None, "tp")), P(None, "tp"))
     assert_allclose(f(a, b), jnp.dot(a, b), rtol=1e-4, atol=1e-4)
+
+
+def test_tune_ag_gemm_variant_round_trip(fresh_tune_cache, monkeypatch):
+    """The offline variant sweep's persistent-cache contract: the first
+    call times BOTH variants on the sim ring and persists the winner
+    (plus per-variant partials); the second call returns the cached
+    winner without dispatching a single kernel; resolve_ag_variant
+    ("auto") reads the same record."""
+    import importlib
+
+    mod = importlib.import_module("triton_dist_tpu.ops.ag_gemm")
+    mesh1 = _mesh1()
+    shape = dict(axis="tp", m=32, k=32, n=64, dtype=jnp.float32,
+                 block_m=8, block_n=8, block_k=16)
+
+    dispatched = []
+    real_impl = mod._ag_gemm_impl
+
+    def spy(*a_, **k_):
+        dispatched.append(k_.get("ctx", a_[2] if len(a_) > 2 else None))
+        return real_impl(*a_, **k_)
+
+    monkeypatch.setattr(mod, "_ag_gemm_impl", spy)
+
+    winner = mod.tune_ag_gemm_variant(mesh1, sim_ranks=4, reps=1, **shape)
+    assert winner in ("panel", "pipelined")
+    assert dispatched, "sweep must actually dispatch kernels"
+
+    mctx = MeshContext.from_mesh(mesh1)
+    rec = tune.load_autotune_data(mod._variant_key(mctx, **shape))
+    assert rec["variant"] == winner
+    # Both variants measured: the sweep is a comparison, not a default.
+    assert set(rec["times_ms"]) == {"panel", "pipelined"}
+    for variant in ("panel", "pipelined"):
+        partial = tune.load_autotune_data(tune.make_key(
+            "ag_gemm_variant_partial",
+            base=mod._variant_key(mctx, **shape), cfg=variant))
+        assert partial == {"variant": variant,
+                           "ms": rec["times_ms"][variant]}
+
+    # Cache hit: any dispatch on the second call is a test failure.
+    dispatched.clear()
+    assert mod.tune_ag_gemm_variant(mesh1, sim_ranks=4, reps=1,
+                                    **shape) == winner
+    assert not dispatched
+    assert mod.resolve_ag_variant("auto", mctx, **shape) == winner
+    # Explicit variants bypass the cache entirely.
+    assert mod.resolve_ag_variant("pipelined", mctx, **shape) == "pipelined"

@@ -93,9 +93,17 @@ def test_gpipe_per_rank_flops(tp8_mesh, tp8_ctx):
         in_specs=(P("tp", None, None), P(None, None, None)),
         out_specs=P(None, None, None), check_vma=False))
     cost = f.lower(w, x_mb).compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):   # older jax: one dict per device
+        cost = cost[0] if cost else {}
     flops_pp = (cost or {}).get("flops", 0.0)
     if not flops_pp:
-        pytest.skip("backend reports no flops in cost_analysis")
+        # CPU/interpret backends report no flops in cost_analysis; the
+        # jaxpr cost table counts the SAME per-device schedule (scan
+        # trip counts x dot_general), so the assertion runs everywhere
+        # instead of silently skipping off-silicon.
+        from triton_dist_tpu.tools.perf_model import jaxpr_flops
+        flops_pp = jaxpr_flops(jax.make_jaxpr(f)(w, x_mb))
+    assert flops_pp > 0, "no flops from backend OR jaxpr walk"
     seq_flops = 2.0 * M * MB * D * D * S          # matmuls, whole model
     ticks = M + S - 1
     ideal = seq_flops * ticks / (M * S)

@@ -1,0 +1,192 @@
+"""Host-side schedule math at WIDE K.
+
+The interpret harness starves above ~64 KB per staged buffer, so the
+K=4096 regime — where the panel policy's (tm, K) footprint forces tm
+halving while the streamed policy's footprint stays K-independent —
+can never run as a device test. The staging decisions live in pure
+host functions (``ops/ag_gemm.panel_blocks`` / ``pipelined_blocks``,
+``lang/overlap.stream_plan`` / ``choose_depth``), so the wide-K
+behaviour is unit-tested here with no device buffers at all.
+"""
+
+import importlib
+
+import pytest
+
+from triton_dist_tpu.lang import overlap
+from triton_dist_tpu.tools import perf_model
+
+ag = importlib.import_module("triton_dist_tpu.ops.ag_gemm")
+
+BF16 = 2
+F32 = 4
+K_WIDE = 4096
+
+
+# ---------------------------------------------------------------------------
+# 1. tile policies at K=4096: the panel/streamed footprint divergence
+# ---------------------------------------------------------------------------
+
+def test_panel_tm_halves_under_wide_k_budget():
+    """(tm, K) panel at tm=2048, K=4096, bf16 is 16 MB > the 9 MB
+    budget -> tm halves once to 1024 (8 MB fits)."""
+    tm, tn, tk, n_i, n_j, n_k, n_buf = ag.panel_blocks(
+        2048, 256, 512, m_loc=2048, n_loc=256, kdim=K_WIDE,
+        itemsize=BF16, n_ranks=8)
+    assert tm == 1024
+    assert (n_i, n_j, n_k) == (2, 1, 8)
+    # Even the halved panel cannot double-buffer: 2 x 8 MB > 9 MB.
+    assert n_buf == 1
+
+
+def test_pipelined_tm_survives_wide_k():
+    """Same shape, streamed policy: the (tm, tk) pair footprint does
+    not grow with K, so tm stays at the full 2048 AND the stream
+    double-buffers — the fine-granularity win the panel variant
+    structurally cannot reach at wide K."""
+    tm, tn, tk, n_i, n_j, n_k, n_buf = ag.pipelined_blocks(
+        2048, 256, 512, m_loc=2048, n_loc=256, kdim=K_WIDE,
+        itemsize=BF16, n_ranks=8)
+    assert (tm, tn, tk) == (2048, 256, 512)
+    assert (n_i, n_j, n_k) == (1, 1, 8)
+    assert n_buf == 2
+
+
+def test_pipelined_tk_budget_halving():
+    """tk halves until a double-buffered (tm,tk)+(tk,tn) pair fits the
+    budget: 2*(8+8)*4096*4 B = 512 KB > 128 KB -> 4096 -> 2048 -> 1024
+    (2*(8+8)*1024*4 = 128 KB fits)."""
+    tm, tn, tk, _, _, n_k, n_buf = ag.pipelined_blocks(
+        8, 8, K_WIDE, m_loc=8, n_loc=8, kdim=K_WIDE, itemsize=F32,
+        n_ranks=4, budget=128 * 1024)
+    assert (tm, tn) == (8, 8)
+    assert tk == 1024 and n_k == 4
+    assert n_buf == 2
+
+
+def test_pipelined_tk_floors_at_8():
+    """The budget clamp never shrinks tk below the lane width: an
+    impossible budget floors tk at 8 rather than degenerating."""
+    *_, tk, _, _, n_k, n_buf = ag.pipelined_blocks(
+        8, 8, K_WIDE, m_loc=8, n_loc=8, kdim=K_WIDE, itemsize=F32,
+        n_ranks=4, budget=1)
+    assert tk == 8 and n_k == K_WIDE // 8
+    assert n_buf == 1  # nothing double-buffers under a 1-byte budget
+
+
+@pytest.mark.parametrize("policy", ["panel", "pipelined"])
+def test_ragged_m_snaps_to_divisor(policy):
+    """m_loc=192 with block_m=128: 128 does not divide 192, so tm
+    snaps down through the halving chain to 64 in both policies."""
+    fn = ag.panel_blocks if policy == "panel" else ag.pipelined_blocks
+    tm, _, _, n_i, _, _, _ = fn(128, 8, 512, m_loc=192, n_loc=8,
+                                kdim=K_WIDE, itemsize=BF16, n_ranks=8)
+    assert tm == 64 and n_i == 3
+
+
+@pytest.mark.parametrize("policy", ["panel", "pipelined"])
+def test_non_divisible_tn_raises(policy):
+    """tn has no snapping chain — a non-divisor block_n is a config
+    error, surfaced eagerly on the host."""
+    fn = ag.panel_blocks if policy == "panel" else ag.pipelined_blocks
+    with pytest.raises(ValueError, match="must\n?.*divide"):
+        fn(8, 8, 512, m_loc=16, n_loc=100, kdim=K_WIDE,
+           itemsize=BF16, n_ranks=8)
+
+
+def test_pipelined_non_divisible_tk_raises():
+    """A prime K that the halving chain cannot reach raises rather
+    than silently mis-tiling (tk floors at 8 without dividing 4097)."""
+    with pytest.raises(ValueError, match="divide"):
+        ag.pipelined_blocks(8, 8, 512, m_loc=16, n_loc=8, kdim=4097,
+                            itemsize=BF16, n_ranks=8)
+
+
+def test_vmem_model_matches_pipelined_policy():
+    """The autotuner prunes on ``perf_model.ag_gemm_pipelined_vmem_bytes``
+    — it must equal the footprint the policy actually allocates
+    (n_buf pairs + f32 acc + double-buffered out) at every wide-K
+    corner, or pruning diverges from reality."""
+    shapes = [
+        (2048, 256, 512, 2048, 256, K_WIDE, BF16),
+        (8, 8, K_WIDE, 8, 8, K_WIDE, F32),
+        (128, 8, 512, 192, 8, K_WIDE, BF16),
+        (256, 128, 256, 256, 128, 1024, BF16),
+    ]
+    for bm, bn, bk, m_loc, n_loc, kdim, isz in shapes:
+        tm, tn, tk, _, _, _, n_buf = ag.pipelined_blocks(
+            bm, bn, bk, m_loc=m_loc, n_loc=n_loc, kdim=kdim,
+            itemsize=isz, n_ranks=8)
+        want = (n_buf * (tm * tk + tk * tn) * isz
+                + tm * tn * 4 + 2 * tm * tn * isz)
+        got = perf_model.ag_gemm_pipelined_vmem_bytes(
+            bm, bn, bk, m_loc, kdim, n_loc, dtype_bytes=isz)
+        assert got == want, (bm, bn, bk, m_loc, n_loc, kdim, isz)
+
+
+# ---------------------------------------------------------------------------
+# 2. stream_plan: the host mirror of stream_scoped's DMA schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("total,depth", [
+    (8, 1), (8, 2), (8, 3), (1, 2), (2, 3), (0, 2),
+    (K_WIDE // 512, 2),
+])
+def test_stream_plan_starts_each_panel_once(total, depth):
+    lead, stages = overlap.stream_plan(total, depth)
+    assert len(stages) == total
+    started = list(lead) + [s for st in stages for s in st]
+    assert sorted(started) == list(range(total))
+
+
+@pytest.mark.parametrize("total,depth", [(8, 2), (8, 3), (16, 2)])
+def test_stream_plan_buffer_safety(total, depth):
+    """At step t the consumer reads buffer t % depth; any start issued
+    at step t targets a panel whose buffer slot was last consumed at a
+    STRICTLY earlier step — no in-flight DMA ever lands on the buffer
+    being read."""
+    lead, stages = overlap.stream_plan(total, depth)
+    for p in lead:
+        assert p < depth - 1          # lead loads fill slots 0..d-2
+    for t, st in enumerate(stages):
+        for p in st:
+            assert p == t + depth - 1
+            assert p % depth != t % depth
+
+
+def test_stream_plan_depth1_is_stage_and_wait():
+    lead, stages = overlap.stream_plan(5, 1)
+    assert lead == ()
+    assert stages == tuple((t,) for t in range(5))
+
+
+def test_stream_plan_rejects_bad_args():
+    with pytest.raises(ValueError):
+        overlap.stream_plan(-1, 2)
+    with pytest.raises(ValueError):
+        overlap.stream_plan(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# 3. choose_depth at the wide-K boundary
+# ---------------------------------------------------------------------------
+
+def test_choose_depth_wide_k_budget_walkdown():
+    """An 8 MB wide-K panel cannot double-buffer in 9 MB: explicit
+    depth 3 walks down to 1, never rejects."""
+    panel = 1024 * K_WIDE * BF16
+    assert overlap.choose_depth(3, panel, 9 * 1024 * 1024, None, 8) == 1
+
+
+def test_choose_depth_chunk_len_none_skips_body_guard():
+    """chunk_len=None (within-body staging) keeps depth 2 even where a
+    single-body-per-chunk grid would force cross-chunk staging to 1."""
+    pair = 64 * 1024
+    budget = 9 * 1024 * 1024
+    assert overlap.choose_depth(0, pair, budget, None, 8) == 2
+    assert overlap.choose_depth(0, pair, budget, 1, 8) == 1
+
+
+def test_choose_depth_clamps_to_panel_count():
+    assert overlap.choose_depth(3, 1024, 9 * 1024 * 1024, None, 1) == 1
+    assert overlap.choose_depth(3, 1024, 9 * 1024 * 1024, None, 2) == 2

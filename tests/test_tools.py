@@ -35,6 +35,37 @@ def test_perf_model_sanity():
     assert 0.0 < b <= 1.0
 
 
+def test_jaxpr_flops_counts_dots_through_structure():
+    """The synthetic flops table (CPU cost_analysis fallback): exact
+    2*m*k*n per dot_general, scan bodies multiplied by trip count,
+    cond branches maxed, nested jit recursed."""
+    from triton_dist_tpu.tools.perf_model import jaxpr_flops
+
+    a = jnp.ones((16, 32))
+    b = jnp.ones((32, 8))
+
+    plain = jax.make_jaxpr(lambda x, y: x @ y)(a, b)
+    assert jaxpr_flops(plain) == 2.0 * 16 * 32 * 8
+
+    def scanned(x, y):
+        def body(c, _):
+            return c, x @ y
+        return jax.lax.scan(body, 0.0, None, length=5)[1]
+
+    assert jaxpr_flops(jax.make_jaxpr(scanned)(a, b)) == 5 * 2.0 * 16 * 32 * 8
+
+    def branched(p, x, y):
+        return jax.lax.cond(p, lambda: (x @ y).sum(),
+                            lambda: jnp.float32(0.0))
+
+    # max over branches: the dot branch dominates the scalar one.
+    assert (jaxpr_flops(jax.make_jaxpr(branched)(True, a, b))
+            == 2.0 * 16 * 32 * 8)
+
+    nested = jax.make_jaxpr(jax.jit(lambda x, y: x @ y))(a, b)
+    assert jaxpr_flops(nested) == 2.0 * 16 * 32 * 8
+
+
 # ---------------------------------------------------------------------------
 # Topology introspection (tools/topology.py)
 # ---------------------------------------------------------------------------

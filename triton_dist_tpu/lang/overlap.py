@@ -28,7 +28,12 @@ module is the one place the three reusable pieces live:
     two-buffer cross-chunk prefetch. ``depth`` panels are in flight at
     once (1 = stage-and-wait, 2 = classic double buffering, 3 = deeper
     pipelining for when one panel of lead time cannot cover the
-    arrival/HBM latency).
+    arrival/HBM latency). :func:`stream_scoped` packages the same
+    buffer-parity/semaphore algebra as a *scoped-VMEM block stream*
+    (``pl.run_scoped`` scratch allocated per grid body, the
+    ``paged_flash_decode`` per-parity prefetch idiom) — the staging
+    core of the pipelined ``ag_gemm`` variant; :func:`stream_plan` is
+    its staging schedule as a pure host function.
 
 (c) **Coalesced signalling** — :func:`a2a_slot` (the handshake-free
     arrival-slot arithmetic shared by every all-to-all-shaped sender/
@@ -66,6 +71,8 @@ __all__ = [
     "pump_ring_event",
     "PanelStager",
     "choose_depth",
+    "stream_plan",
+    "stream_scoped",
     "drain_sends",
 ]
 
@@ -308,6 +315,106 @@ class PanelStager:
         if self.depth == 1:
             return range(0)
         return range(min(self.depth - 1, max(n_i, 1)))
+
+
+def stream_plan(total: int, depth: int):
+    """Staging schedule of a depth-``depth`` block stream over ``total``
+    blocks, as pure host data (the plan :func:`stream_scoped` executes).
+
+    Returns ``(lead, stages)``:
+
+    - ``lead``: block indices staged BEFORE the stream loop (the cold
+      lead loads — ``PanelStager.lead_range`` specialized to a stream
+      whose source needs no arrival certification);
+    - ``stages``: per step ``t`` of the loop, the tuple of block
+      indices whose staging DMA is issued at ``t``'s prefetch site
+      (right after block ``t``'s wait). ``depth == 1`` degenerates to
+      stage-and-wait: block ``t`` is staged at step ``t`` itself.
+
+    Invariants the unit tests pin down (and the kernels rely on):
+    every block in ``range(total)`` is staged exactly once, and block
+    ``q``'s buffer (``q % depth``) is never restaged before step
+    ``q - depth``'s compute finished (the prefetch site of ``q`` is
+    step ``q - depth + 1``, strictly after).
+    """
+    if total < 0:
+        raise ValueError(f"total must be >= 0, got {total}")
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    if depth == 1:
+        return (), tuple((t,) for t in range(total))
+    lead = tuple(range(min(depth - 1, total)))
+    stages = tuple(
+        ((t + depth - 1,) if t + depth - 1 < total else ())
+        for t in range(total))
+    return lead, stages
+
+
+def stream_scoped(*, total: int, depth: int, buffers: dict,
+                  start: Callable, body: Callable) -> None:
+    """Depth-buffered block stream over scoped VMEM — the
+    buffer-parity/semaphore core of the pipelined ``ag_gemm`` variant
+    (and the generalization the ``paged_flash_decode`` per-parity page
+    prefetch hand-rolls at depth 2).
+
+    Allocates, inside ``pl.run_scoped`` (so the buffers live only for
+    this grid body), one ``(depth,) + shape`` VMEM rotating buffer and
+    one ``(depth,)`` DMA-semaphore array per named stream, wraps each
+    in a :class:`PanelStager`, and drives the staging plan of
+    :func:`stream_plan`: lead blocks staged cold, then per step ``t``
+    wait block ``t`` on every stream, issue block ``t + depth - 1``'s
+    prefetch behind it, and hand the resident blocks to ``body``.
+
+    ``buffers``: ordered ``{name: (block_shape, dtype)}``.
+    ``start(t, stagers)``: issue block ``t``'s staging copies — call
+    ``stagers[name].start(src_ref, t)`` for every stream (the caller
+    owns source selection, e.g. ``pl.when`` branching between a local
+    input and a ring workspace). ``t`` may be traced.
+    ``body(t, stagers)``: consume block ``t`` via
+    ``stagers[name].read(t)`` — every stream's block ``t`` is resident.
+
+    Scoped scratch is per-body: all DMAs started here complete before
+    the scope closes (the final waits), so nothing leaks across grid
+    bodies — which is exactly why the source's *arrival* (ring chunk
+    certification) must be handled by the caller before the stream
+    runs (``choose_depth(chunk_len=None)`` is the matching depth
+    resolver).
+    """
+    if total <= 0:
+        return
+    names = list(buffers)
+
+    def scoped(*refs):
+        stagers = {name: PanelStager(refs[2 * ix], refs[2 * ix + 1], depth)
+                   for ix, name in enumerate(names)}
+
+        def wait(t):
+            for name in names:
+                stagers[name].wait(t)
+
+        if depth > 1:
+            for t, _ in zip(range(depth - 1), range(total)):
+                start(jnp.int32(t), stagers)
+
+        def step(t, carry):
+            if depth == 1:
+                start(t, stagers)
+            wait(t)
+            if depth > 1:
+                @pl.when(t + (depth - 1) < total)
+                def _():
+                    start(t + (depth - 1), stagers)
+            body(t, stagers)
+            return carry
+
+        jax.lax.fori_loop(0, total, step, 0)
+
+    scratch = []
+    for name in names:
+        shape, dtype = buffers[name]
+        scratch.append(pltpu.VMEM((depth,) + tuple(shape), dtype))
+        scratch.append(pltpu.SemaphoreType.DMA((depth,)))
+    pl.run_scoped(scoped, *scratch)
 
 
 def drain_sends(send_sem, ref, slots: Sequence[int]) -> None:

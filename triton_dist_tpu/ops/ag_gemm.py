@@ -17,10 +17,28 @@ rows of chunk ``c = (me - k) % n``:
   works on it — compute hides the transfer of the *next* chunk.
 
 A chunks ride manual RDMA into an HBM workspace (Pallas pipelining must
-not prefetch not-yet-arrived data); A row-tiles are staged per K-block
-into VMEM manually, B tiles and C tiles use pipelined BlockSpecs. The
-inner ``kk`` grid dimension tiles the contraction so arbitrary K fits
-VMEM, accumulating in float32.
+not prefetch not-yet-arrived data). Two kernel variants share that ring
+engine and differ in how A reaches the MXU:
+
+- ``"panel"``: full-K (tm, K) row panels staged into rotating VMEM
+  buffers (:class:`overlap.PanelStager`), with cross-chunk prefetch at
+  the ring boundary; the ``kk`` grid dimension slices the resident
+  panel. K is bounded by the VMEM panel budget (tm shrinks as K grows).
+- ``"pipelined"``: (tm, tk) x (tk, tn) A/B block pairs streamed through
+  scoped VMEM double buffers (:func:`overlap.stream_scoped` —
+  ``pl.run_scoped`` scratch + per-parity DMA semaphores, the
+  ``paged_flash_decode`` prefetch idiom) inside each grid body, the
+  contraction a ``fori_loop`` over K blocks. Finer, chunk-arrival-
+  granular overlap, VMEM footprint independent of K, and — unlike its
+  retired predecessor — no ``input_output_aliases`` trick: the RDMA
+  workspace is a plain second output, so Mosaic's multiple buffering
+  is unconstrained and the kernel runs for real under interpret and in
+  the sim-ranks sweeps (the old aliased form snapshot-copied under
+  interpret and silently fell back to "panel").
+
+Accumulation is float32 in both. ``ag_gemm_tuned`` autotunes the
+variant alongside the block/overlap knobs; :func:`tune_ag_gemm_variant`
+is the offline sweep that persists the crossover per shape.
 """
 
 from __future__ import annotations
@@ -64,16 +82,12 @@ class AGGemmContext:
     # busy loop is the only skew source that works on both backends.
     straggler_rank: int = -1
     straggler_delay_iters: int = 0
-    # Kernel variant: "panel" (default — full-K A panel staged per row
-    # tile; fastest measured single-chip) or "pipelined" (A rides the
-    # BlockSpec pipeline from the RDMA-fed aliased workspace; finer
-    # chunk-arrival granularity, currently slower on hardware because
-    # aliasing constrains Mosaic's multiple buffering). NOTE: "pipelined"
-    # needs >= 2 grid bodies per ring chunk (its arrival wait runs one
-    # body early) and falls back to "panel" below that; it also requires
-    # swizzle_mode "ag" (its pipeline prefetches chunk k's A block before
-    # the body runs, so step 0 must be the pre-placed local chunk) and
-    # falls back to "panel" under "identity".
+    # Kernel variant: "panel" (full-K A panel staged per row tile) or
+    # "pipelined" (A/B block pairs streamed through scoped-VMEM double
+    # buffers — K-independent footprint, finer-granularity overlap).
+    # Both run the real kernel on every backend (interpret included)
+    # and under both swizzle modes on any grid — there is no fallback;
+    # ag_gemm_tuned sweeps the variant per (mesh, M, N, K, dtype) key.
     variant: str = "panel"
     # Overlap-engine knobs (lang/overlap.py): chunk-traversal order and
     # panel prefetch depth (0 = auto, 1..3 = stage-and-wait / double /
@@ -317,46 +331,44 @@ def _ag_gemm_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, send_sem,
                 local_sem, a_ref.at[pl.ds(0, m_loc)] if sim else a_ref, 1)
 
 
-def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
-                       m_loc: int, n_ranks: int,
-                       straggler_rank: int = -1,
-                       straggler_delay_iters: int = 0, sim: bool = False):
-    """Fully-pipelined variant: A blocks arrive through the regular
-    Pallas double-buffered pipeline reading the RDMA-fed workspace
-    (``a_ws`` is the *aliased output* of the pipelined input ``a_pipe``).
+def _ag_gemm_pipelined_kernel(a_ref, b_ref, o_ref, a_ws, acc_v, send_sem,
+                              recv_sem, local_sem, *, axis: str,
+                              ctx: MeshContext, m_loc: int, tm: int,
+                              tk: int, tn: int, n_k: int, n_buf: int,
+                              n_ranks: int, mode: str, write_ag: bool,
+                              straggler_rank: int = -1,
+                              straggler_delay_iters: int = 0,
+                              sim: bool = False):
+    """Scoped-VMEM streamed variant: each grid body computes one
+    (tm, tn) output tile by streaming (tm, tk) A / (tk, tn) B block
+    pairs through ``overlap.stream_scoped`` double buffers — a
+    ``pl.run_scoped`` allocation whose staging DMAs start AND complete
+    within this body, so no aliasing and no BlockSpec lookahead hazard:
+    chunk ``k``'s arrival is certified at its FIRST body (ring event
+    ``k``), strictly before any block of it is staged. Works on any
+    grid (one body per chunk included) and under both swizzle modes.
 
-    The arrival hazard — the pipeline prefetches the next grid step's A
-    block before that step's body runs — is closed by waiting for chunk
-    ``k+1``'s arrival one body *early* (at the second-to-last body of
-    chunk ``k``), so the data is in HBM before its first prefetch is
-    issued. Requires >= 2 bodies per chunk (host falls back to the
-    panel variant otherwise).
-
-    ``sim=True`` (single-chip overlap proxy): the ring is driven with
-    self-targeted puts whose source is an extra ``a_any`` input holding
-    the full A — same schedule, semaphores, and per-step traffic, peer
-    = self, wire = HBM.
+    ``sim=True`` (single-chip overlap proxy): ``a_ref`` holds the full
+    A and the ring is driven with self-targeted puts sourcing the true
+    chunks from it — same schedule, semaphores, and per-step traffic,
+    peer = self, wire = HBM.
     """
-    if sim:
-        a_any, o_ref, a_ws, acc_v, send_sem, recv_sem = refs
-    else:
-        a_any = None
-        o_ref, a_ws, acc_v, send_sem, recv_sem = refs
     k = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
-    kk = pl.program_id(3)
     n_i = pl.num_programs(1)
     n_j = pl.num_programs(2)
-    n_k = pl.num_programs(3)
     me = dl.rank(axis)
     n = n_ranks
+    c = overlap.chunk_at(k, me, n, mode)
     right = jax.lax.rem(me + 1, n)
+    lin = i * n_j + j                       # body index within chunk k
+    chunk_len = n_i * n_j
+    own_step = 0 if mode == "ag" else me
 
     chunk_of = lambda r: a_ws.at[pl.ds(r * m_loc, m_loc)]
-    sim_chunk = lambda r: a_any.at[pl.ds(r * m_loc, m_loc)] if sim else None
-    lin = (i * n_j + j) * n_k + kk          # body index within chunk k
-    chunk_len = n_i * n_j * n_k
+    sim_src = ((lambda r: a_ref.at[pl.ds(r * m_loc, m_loc)])
+               if sim else None)
 
     first = jnp.logical_and(k == 0, lin == 0)
 
@@ -364,37 +376,66 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
     def _():
         _straggler_spin(acc_v, me, straggler_rank, straggler_delay_iters)
         dl.barrier_tile(axis, ctx=ctx)
+        if write_ag:
+            src0 = (a_ref.at[pl.ds(0, m_loc)] if sim else a_ref)
+            pltpu.make_async_copy(src0, chunk_of(me), local_sem).start()
         if n > 1:
             if sim:
                 nxt = jax.lax.rem(me - 1 + n, n)
-                dl.remote_put(sim_chunk(nxt), chunk_of(nxt),
-                              send_sem.at[0], recv_sem.at[0], me,
-                              axis=axis, ctx=ctx)
+                dl.remote_put(sim_src(nxt), chunk_of(nxt), send_sem.at[0],
+                              recv_sem.at[0], me, axis=axis, ctx=ctx)
             else:
-                # Ring kick-off: send my chunk (pre-placed by the host).
-                dl.remote_put(chunk_of(me), chunk_of(me), send_sem.at[0],
+                # Ring kick-off (event 0): my chunk is sent straight
+                # from the input — the workspace needs no pre-placement
+                # (and therefore no zero-fill and no aliasing).
+                dl.remote_put(a_ref, chunk_of(me), send_sem.at[0],
                               recv_sem.at[0], right, axis=axis, ctx=ctx)
+            if mode == "identity":
+                overlap.pump_ring(range(1, n), me=me, world=n, right=right,
+                                  chunk_of=chunk_of, send_sem=send_sem,
+                                  recv_sem=recv_sem, axis=axis, ctx=ctx,
+                                  sim_src_of=sim_src)
 
-    # Early wait: during chunk k's second-to-last body, process ring
-    # event k+1 — certify chunk k+1's arrival (slot k) and forward it —
-    # before the pipeline prefetches chunk k+1's first A block.
-    @pl.when(jnp.logical_and(k < n - 1, lin == chunk_len - 2))
-    def _():
-        overlap.pump_ring_event(k + 1, me=me, world=n, right=right,
-                                chunk_of=chunk_of, send_sem=send_sem,
-                                recv_sem=recv_sem, axis=axis, ctx=ctx,
-                                sim_src_of=sim_chunk if sim else None)
+    if mode == "ag" and n > 1:
+        @pl.when(jnp.logical_and(k > 0, lin == 0))
+        def _():
+            # Ring event k at chunk k's first body: certify chunk c's
+            # arrival (slot k-1) and forward it right (slot k). All
+            # staging below is in-body, so certify-at-first-body is
+            # hazard-free — there is no pipeline lookahead to outrun.
+            overlap.pump_ring_event(k, me=me, world=n, right=right,
+                                    chunk_of=chunk_of, send_sem=send_sem,
+                                    recv_sem=recv_sem, axis=axis, ctx=ctx,
+                                    sim_src_of=sim_src)
 
-    @pl.when(kk == 0)
-    def _():
-        acc_v[...] = jnp.zeros_like(acc_v)
+    def start(t, st):
+        """Stage block pair ``t``: A from the local input for my own
+        chunk (no workspace round-trip), from the ring workspace for
+        every other; B always from its (ANY-space) operand."""
+        @pl.when(k == own_step)
+        def _():
+            base = me * m_loc if sim else 0
+            st["a"].start(a_ref.at[pl.ds(base + i * tm, tm),
+                                   pl.ds(t * tk, tk)], t)
 
-    acc_v[...] += jnp.dot(a_pipe[...], b_ref[...],
-                          preferred_element_type=jnp.float32)
+        @pl.when(k != own_step)
+        def _():
+            st["a"].start(a_ws.at[pl.ds(c * m_loc + i * tm, tm),
+                                  pl.ds(t * tk, tk)], t)
 
-    @pl.when(kk == n_k - 1)
-    def _():
-        o_ref[...] = acc_v[...].astype(o_ref.dtype)
+        st["b"].start(b_ref.at[pl.ds(t * tk, tk), pl.ds(j * tn, tn)], t)
+
+    def body(t, st):
+        acc_v[...] += jnp.dot(st["a"].read(t), st["b"].read(t),
+                              preferred_element_type=jnp.float32)
+
+    acc_v[...] = jnp.zeros_like(acc_v)
+    overlap.stream_scoped(
+        total=n_k, depth=n_buf,
+        buffers={"a": ((tm, tk), a_ref.dtype),
+                 "b": ((tk, tn), b_ref.dtype)},
+        start=start, body=body)
+    o_ref[...] = acc_v[...].astype(o_ref.dtype)
 
     last = jnp.logical_and(k == n - 1, lin == chunk_len - 1)
 
@@ -402,94 +443,88 @@ def _ag_gemm_kernel_v2(a_pipe, b_ref, *refs, axis: str, ctx: MeshContext,
     def _():
         overlap.drain_sends(send_sem, chunk_of(0), range(n - 1))
 
+    if write_ag:
+        @pl.when(last)
+        def _():
+            dl.wait_arrivals(
+                local_sem, a_ref.at[pl.ds(0, m_loc)] if sim else a_ref, 1)
 
-def _ag_gemm_v2(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
-                out_dtype, tm, tn, tk, n_i, n_j, n_k, sim=False,
-                ws=None):
+
+def _ag_gemm_pipelined(a, b, ctx: AGGemmContext, n, m_loc, kdim, n_loc,
+                       out_dtype, tm, tn, tk, n_i, n_j, n_k, n_buf,
+                       sim=False, write_ag=False):
     mesh = ctx.mesh
     m_full = n * m_loc
-    me = jax.lax.axis_index(ctx.axis)
-    # Pre-place the local chunk so chunk k=0's pipeline reads are valid
-    # from the first body. In sim mode the "local chunk" is slice `me`
-    # (= 0) of the full input; the rest arrives via the self-ring.
-    # With a caller-threaded persistent workspace (``ws``) the
-    # (n-1)/n-of-the-buffer zero-fill disappears — only the local chunk
-    # is (re)written, in place via the input/output alias (reference
-    # ctx-owned symmetric tensors, allgather_gemm.py:449-511).
-    local = (jax.lax.dynamic_slice(a, (me * m_loc, 0), (m_loc, kdim))
-             if sim else a)
-    base = jnp.zeros((m_full, kdim), a.dtype) if ws is None else ws
-    a_ws_init = jax.lax.dynamic_update_slice(base, local,
-                                             (me * m_loc, 0))
 
-    def a_index(k, i, j, kk):
-        me_ = jax.lax.axis_index(ctx.axis)
-        c = jax.lax.rem(me_ - k + n, n)
-        return (c * n_i + i, kk)
+    def c_index(k, i, j):
+        me = jax.lax.axis_index(ctx.axis)
+        c = overlap.chunk_at(k, me, n, ctx.swizzle_mode)
+        return (c * n_i + i, j)
 
     kernel = functools.partial(
-        _ag_gemm_kernel_v2, axis=ctx.axis, ctx=mesh, m_loc=m_loc,
-        n_ranks=n, straggler_rank=ctx.straggler_rank,
+        _ag_gemm_pipelined_kernel, axis=ctx.axis, ctx=mesh, m_loc=m_loc,
+        tm=tm, tk=tk, tn=tn, n_k=n_k, n_buf=n_buf, n_ranks=n,
+        mode=ctx.swizzle_mode, write_ag=write_ag,
+        straggler_rank=ctx.straggler_rank,
         straggler_delay_iters=ctx.straggler_delay_iters, sim=sim)
-
-    in_specs = [
-        pl.BlockSpec((tm, tk), a_index, memory_space=pltpu.VMEM),
-        pl.BlockSpec((tk, tn), lambda k, i, j, kk: (kk, j),
-                     memory_space=pltpu.VMEM),
-    ]
-    operands = [a_ws_init, b]
-    if sim:
-        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # a_any
-        operands.append(a)
 
     out, a_full = core_call(
         kernel,
         comm=True,
-        grid=(n, n_i, n_j, n_k),
+        grid=(n, n_i, n_j),
         out_shape=(jax.ShapeDtypeStruct((m_full, n_loc), out_dtype),
                    jax.ShapeDtypeStruct((m_full, kdim), a.dtype)),
-        in_specs=in_specs,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # a (manual RDMA + stream)
+            pl.BlockSpec(memory_space=pl.ANY),  # b (manually streamed)
+        ],
         out_specs=(
-            pl.BlockSpec((tm, tn),
-                         lambda k, i, j, kk: (
-                             (jax.lax.rem(jax.lax.axis_index(ctx.axis)
-                                          - k + n, n)) * n_i + i, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((tm, tn), c_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # a_ws (plain output)
         ),
         scratch_shapes=[
             pltpu.VMEM((tm, tn), jnp.float32),          # acc_v
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # send_sem
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),  # recv_sem
+            pltpu.SemaphoreType.DMA(()),                # local_sem
         ],
-        input_output_aliases={0: 1},  # a_ws_init → a_ws output
         cost_estimate=pl.CostEstimate(
             flops=2 * m_full * kdim * n_loc,
             bytes_accessed=(m_full * kdim + kdim * n_loc * n * n_i
                             + m_full * n_loc) * a.dtype.itemsize,
             transcendentals=0,
         ),
-    )(*operands)
+    )(a, b)
     return out, a_full
 
 
-def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize,
-                  n_ranks: int):
-    """Shared tile-size policy for the panel-staging kernels: clamp tm
-    to the VMEM panel budget, check divisibility, resolve the requested
-    ``prefetch_depth`` against the budget and the grid geometry
-    (:func:`overlap.choose_depth` — depth >= 2 enables the cross-chunk
-    prefetch path; depth is clamped, never rejected, so one tuned config
-    stays runnable across shapes)."""
-    tm = min(ctx.block_m, m_loc)
-    tn = min(ctx.block_n, n_loc)
-    tk = min(ctx.block_k, kdim)
-    # The A panel is (tm, K) in VMEM; clamp tm so it stays within a
-    # ~9 MB budget for any K (block_k bounds only the B tiles; the rest
-    # of the ~16 MB VMEM holds double-buffered B, the accumulator, and
-    # the output tile).
-    panel_budget = 9 * 1024 * 1024
-    while tm > 8 and tm * kdim * itemsize > panel_budget:
+# VMEM staging budget shared by both tile policies: the A panel (or
+# A/B block-pair set) must fit here; the rest of the ~16 MB VMEM holds
+# the pipelined B tiles (panel variant), the accumulator, and the
+# output tile.
+PANEL_BUDGET = 9 * 1024 * 1024
+
+
+def panel_blocks(block_m, block_n, block_k, m_loc, n_loc, kdim, itemsize,
+                 n_ranks: int, prefetch_depth: int = 0,
+                 budget: int = PANEL_BUDGET):
+    """Tile-size policy of the panel-staging kernels, as a pure host
+    function (unit-testable at any K — wide-K behaviour matters most:
+    the interpret harness cannot allocate wide-K device buffers, but
+    this arithmetic is where the staging decisions live): clamp tm to
+    the VMEM panel budget (the A panel is (tm, K) — tm halves as K
+    grows), snap tm to a divisor of the ragged local M, check tn/tk
+    divisibility, and resolve the requested ``prefetch_depth`` against
+    the budget and the grid geometry (:func:`overlap.choose_depth` —
+    depth >= 2 enables the cross-chunk prefetch path; depth is clamped,
+    never rejected, so one tuned config stays runnable across shapes).
+
+    Returns ``(tm, tn, tk, n_i, n_j, n_k, n_buf)``.
+    """
+    tm = min(block_m, m_loc)
+    tn = min(block_n, n_loc)
+    tk = min(block_k, kdim)
+    while tm > 8 and tm * kdim * itemsize > budget:
         tm //= 2
     while tm > 1 and m_loc % tm:
         tm //= 2
@@ -499,10 +534,55 @@ def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize,
             f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
     n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
     panel_bytes = tm * kdim * itemsize
-    n_buf = overlap.choose_depth(ctx.prefetch_depth, panel_bytes,
-                                 panel_budget, n_i * n_j * n_k,
-                                 n_ranks * n_i)
+    n_buf = overlap.choose_depth(prefetch_depth, panel_bytes, budget,
+                                 n_i * n_j * n_k, n_ranks * n_i)
     return tm, tn, tk, n_i, n_j, n_k, n_buf
+
+
+def pipelined_blocks(block_m, block_n, block_k, m_loc, n_loc, kdim,
+                     itemsize, n_ranks: int, prefetch_depth: int = 0,
+                     budget: int = PANEL_BUDGET):
+    """Tile-size policy of the scoped-VMEM streamed variant, as a pure
+    host function. The stream holds ``n_buf`` (tm, tk) + (tk, tn)
+    block pairs — VMEM footprint independent of K, so tm never shrinks
+    with K (the panel policy's defining constraint). tm and tk snap
+    down to divisors of their ragged dims; tk additionally halves
+    until a double-buffered pair fits the budget (K is streamed, so a
+    smaller tk costs no extra HBM traffic — just finer DMAs). The
+    depth resolves via ``choose_depth(chunk_len=None)``: staging is
+    within-body (no cross-chunk arrival certification), so only the
+    stream length ``n_k`` and the budget clamp it.
+
+    Returns ``(tm, tn, tk, n_i, n_j, n_k, n_buf)``.
+    """
+    tm = min(block_m, m_loc)
+    tn = min(block_n, n_loc)
+    tk = min(block_k, kdim)
+    while tm > 1 and m_loc % tm:
+        tm //= 2
+    while tk > 8 and kdim % tk:
+        tk //= 2
+    while (tk > 8 and 2 * (tm + tn) * tk * itemsize > budget
+           and kdim % (tk // 2) == 0):
+        tk //= 2
+    if m_loc % tm or n_loc % tn or kdim % tk:
+        raise ValueError(
+            f"block sizes (block_m={tm}, block_n={tn}, block_k={tk}) must "
+            f"divide (M_loc={m_loc}, N_loc={n_loc}, K={kdim})")
+    n_i, n_j, n_k = m_loc // tm, n_loc // tn, kdim // tk
+    pair_bytes = (tm * tk + tk * tn) * itemsize
+    n_buf = overlap.choose_depth(prefetch_depth, pair_bytes, budget,
+                                 None, n_k)
+    return tm, tn, tk, n_i, n_j, n_k, n_buf
+
+
+def _panel_blocks(ctx: AGGemmContext, m_loc, n_loc, kdim, itemsize,
+                  n_ranks: int):
+    """:func:`panel_blocks` with the knobs read off an
+    :class:`AGGemmContext`."""
+    return panel_blocks(ctx.block_m, ctx.block_n, ctx.block_k, m_loc,
+                        n_loc, kdim, itemsize, n_ranks,
+                        ctx.prefetch_depth)
 
 
 def _ag_gemm_2d_kernel(a_ref, b_ref, o_ref, a_ws, a_panel, acc_v, isend,
@@ -777,7 +857,7 @@ def _ag_gemm_2d(a, b, ctx: AGGemmContext, *, return_ag: bool = False):
 
 
 def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
-            force_kernel: bool = False, sim_ranks: int = 0, ws=None):
+            force_kernel: bool = False, sim_ranks: int = 0):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map) —
     see :func:`_ag_gemm_impl` for the full contract.
 
@@ -803,11 +883,11 @@ def ag_gemm(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
                 straggler_delay_iters=skew.iters)
         return _ag_gemm_impl(a, b, ctx, return_ag=return_ag,
                              force_kernel=force_kernel,
-                             sim_ranks=sim_ranks, ws=ws)
+                             sim_ranks=sim_ranks)
 
 
 def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
-                  force_kernel: bool = False, sim_ranks: int = 0, ws=None):
+                  force_kernel: bool = False, sim_ranks: int = 0):
     """Overlapped per-shard AllGather(A) @ B (call inside shard_map).
 
     ``a``: (M_loc, K) sharded on dim 0 along ``ctx.axis``;
@@ -829,20 +909,16 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
     gather then spans both axes with outer hops relayed under inner
     rings (see :func:`_ag_gemm_2d_kernel`).
 
-    ``ws`` (pipelined variant): a caller-threaded persistent gather
-    workspace — pass the previous call's ``return_ag`` array (seeded by
-    ``shmem.symm_tensor``) to skip the per-call workspace zero-fill:
-    ``out, ws = ag_gemm(a, b, ctx, return_ag=True, ws=ws)``. The
-    reference's context-owned symmetric tensors
-    (``allgather_gemm.py:449-511``) as functional threading.
+    ``ctx.variant`` picks the kernel — ``"panel"`` (full-K row panels,
+    cross-chunk prefetch) or ``"pipelined"`` (scoped-VMEM streamed A/B
+    block pairs, K-independent footprint). Both run the real kernel on
+    every backend, interpret and sim-ranks included — there is no
+    variant fallback.
     """
     if isinstance(ctx.axis, (tuple, list)):
         if sim_ranks or force_kernel:
             raise ValueError("sim_ranks/force_kernel apply to the "
                              "single-axis form only")
-        if ws is not None:
-            raise ValueError("ws (persistent workspace) is not "
-                             "supported on the hierarchical path")
         return _ag_gemm_2d(a, b, dataclasses.replace(
             ctx, axis=tuple(ctx.axis)), return_ag=return_ag)
     mesh = ctx.mesh
@@ -866,34 +942,18 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_ag else c
 
+    if ctx.variant == "pipelined":
+        tm, tn, tk, n_i, n_j, n_k, n_buf = pipelined_blocks(
+            ctx.block_m, ctx.block_n, ctx.block_k, m_loc, n_loc, kdim,
+            a.dtype.itemsize, n, ctx.prefetch_depth)
+        out, a_full = _ag_gemm_pipelined(
+            a, b, ctx, n, m_loc, kdim, n_loc, out_dtype, tm, tn, tk,
+            n_i, n_j, n_k, n_buf, sim=sim, write_ag=return_ag)
+        return (out, a_full) if return_ag else out
+
     tm, tn, tk, n_i, n_j, n_k, n_buf = _panel_blocks(
         ctx, m_loc, n_loc, kdim, a.dtype.itemsize, n)
     m_full = n * m_loc
-
-    from triton_dist_tpu.utils.distributed import use_interpret
-
-    # Sim-on-interpreter falls back to the panel kernel: the pipelined
-    # variant reads A through a BlockSpec over the ALIASED workspace
-    # input, and the interpret path snapshot-copies aliased operands —
-    # the self-ring's put-delivered chunks land in the output ref where
-    # the pipelined reads can never see them (real multi-rank interpret
-    # discharges through ref state and is unaffected; hardware aliases
-    # for real).
-    pipelined = (ctx.variant == "pipelined" and n_i * n_j * n_k >= 2
-                 and ctx.swizzle_mode == "ag"
-                 and not (sim and use_interpret()))
-    if ws is not None and not pipelined:
-        raise ValueError(
-            "ws (persistent workspace) applies to the pipelined "
-            "variant only (with >= 2 grid bodies and the 'ag' "
-            "schedule — this grid falls back to the panel kernel, "
-            "whose workspace is an output with no init cost to "
-            "amortize)")
-    if pipelined:
-        out, a_full = _ag_gemm_v2(a, b, ctx, n, m_loc, kdim, n_loc,
-                                  out_dtype, tm, tn, tk, n_i, n_j, n_k,
-                                  sim=sim, ws=ws)
-        return (out, a_full) if return_ag else out
 
     def c_index(k, i, j, kk):
         me = jax.lax.axis_index(ctx.axis)
@@ -948,11 +1008,12 @@ def _ag_gemm_impl(a, b, ctx: AGGemmContext, *, return_ag: bool = False,
 
 def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
                   configs=None, **kw):
-    """Autotuned ag_gemm: sweeps block configs AND the overlap-engine
-    knobs (``swizzle_mode``, ``prefetch_depth``) on first use per
-    (mesh shape, M/K/N, dtype) key and persists the winner (reference:
-    ``@triton_dist.tune.autotune`` on ``ag_gemm``,
-    ``allgather_gemm.py:565-569``)."""
+    """Autotuned ag_gemm: sweeps block configs, the overlap-engine
+    knobs (``swizzle_mode``, ``prefetch_depth``) AND the kernel
+    ``variant`` (panel vs pipelined — autotune, not a default, picks
+    the crossover) on first use per (mesh shape, M/K/N, dtype) key and
+    persists the winner (reference: ``@triton_dist.tune.autotune`` on
+    ``ag_gemm``, ``allgather_gemm.py:565-569``)."""
     from triton_dist_tpu.autotuner import autotune
 
     if configs is None:
@@ -972,18 +1033,31 @@ def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
              "prefetch_depth": 1},
             {"block_m": 256, "block_n": 256, "block_k": 512,
              "swizzle_mode": "identity"},
+            # Variant sweep: the scoped-VMEM streamed kernel at the
+            # block_m range where fine granularity should win (its tm
+            # never shrinks with K — panel's does).
+            {"block_m": 128, "block_n": 256, "block_k": 512,
+             "variant": "pipelined"},
+            {"block_m": 256, "block_n": 256, "block_k": 512,
+             "variant": "pipelined"},
+            {"block_m": 512, "block_n": 512, "block_k": 512,
+             "variant": "pipelined", "prefetch_depth": 3},
         ]
 
     def _prune(cfg, a_, b_):
         """Perf-model pruning (reference prunes the sweep with
         gemm_perf_model.py before timing): veto configs whose modeled
         VMEM footprint cannot lower — no wasted compiles."""
-        from triton_dist_tpu.tools.perf_model import ag_gemm_vmem_bytes
+        from triton_dist_tpu.tools.perf_model import (
+            ag_gemm_pipelined_vmem_bytes, ag_gemm_vmem_bytes)
 
-        return ag_gemm_vmem_bytes(
+        model = (ag_gemm_pipelined_vmem_bytes
+                 if cfg.get("variant", "panel") == "pipelined"
+                 else ag_gemm_vmem_bytes)
+        return model(
             cfg.get("block_m", 256), cfg.get("block_n", 256),
             cfg.get("block_k", 512), a_.shape[0], a_.shape[1],
-            b_.shape[1] , a_.dtype.itemsize) <= 14 * 1024 * 1024
+            b_.shape[1], a_.dtype.itemsize) <= 14 * 1024 * 1024
 
     @autotune("ag_gemm", configs,
               key_fn=lambda a_, b_, **kk: {
@@ -992,10 +1066,127 @@ def ag_gemm_tuned(a, b, mesh: MeshContext, *, axis: str = "tp",
                   "mesh": mesh_key(mesh)},
               prune_fn=_prune)
     def _run(a_, b_, block_m=256, block_n=256, block_k=512,
-             swizzle_mode="ag", prefetch_depth=0):
+             swizzle_mode="ag", prefetch_depth=0, variant="panel"):
         ctx = create_ag_gemm_context(mesh, axis, block_m, block_n,
                                      block_k, swizzle_mode=swizzle_mode,
-                                     prefetch_depth=prefetch_depth)
+                                     prefetch_depth=prefetch_depth,
+                                     variant=variant)
         return ag_gemm(a_, b_, ctx, **kw)
 
     return _run(a, b)
+
+
+def _variant_key(mctx: MeshContext, *, axis, m, k, n, dtype, block_m,
+                 block_n, block_k):
+    from triton_dist_tpu import tune
+
+    return tune.make_key(
+        "ag_gemm_variant", mesh=mesh_key(mctx), axis=str(axis), m=m,
+        k=k, n=n, dtype=str(jnp.dtype(dtype)), block_m=block_m,
+        block_n=block_n, block_k=block_k)
+
+
+def resolve_ag_variant(variant: str, mctx: MeshContext, *, axis, m, k,
+                       n, dtype, block_m=256, block_n=256,
+                       block_k=512) -> str:
+    """Host-side resolution of the ``variant`` knob: explicit values
+    pass through; ``"auto"`` loads the :func:`tune_ag_gemm_variant`
+    winner persisted for this (mesh, per-shard M/K/N, dtype, blocks)
+    key and falls back to ``"panel"`` when never tuned."""
+    if variant != "auto":
+        return variant
+    from triton_dist_tpu import tune
+
+    cached = tune.load_autotune_data(_variant_key(
+        mctx, axis=axis, m=m, k=k, n=n, dtype=dtype, block_m=block_m,
+        block_n=block_n, block_k=block_k))
+    if cached and cached.get("variant") in ("panel", "pipelined"):
+        return cached["variant"]
+    return "panel"
+
+
+def tune_ag_gemm_variant(mesh, *, axis="tp", m, k, n,
+                         dtype=jnp.bfloat16, block_m=256, block_n=256,
+                         block_k=512, sim_ranks: int = 0, reps: int = 3,
+                         use_cache: bool = True) -> str:
+    """OFFLINE variant sweep for one ag_gemm shape (the
+    ``tune_transport`` pattern, ``layers/ep_moe.py``): time each
+    variant's jitted shard_map dispatch on ``mesh`` (a
+    ``jax.sharding.Mesh``) — over real ranks when the axis is sharded,
+    over a ``sim_ranks`` self-ring on one chip — and persist the
+    winner under the (mesh, per-shard M/K/N, dtype, blocks) key that
+    :func:`resolve_ag_variant` reads for ``variant="auto"``.
+
+    ``m``/``k``/``n`` are the PER-SHARD op shapes: A is (m, k) per
+    rank, B (k, n) — the shapes ``ag_gemm`` sees inside shard_map (and
+    the shapes ``ag_gemm_tuned`` keys on).
+
+    Every candidate's time persists as a per-config partial the moment
+    it is measured (key suffixed ``cfg=<variant>``), so an interrupted
+    on-chip sweep leaves its completed measurements behind — the
+    bench's ``_note_partial`` discipline. Returns the winning variant.
+    """
+    import time as _time
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu import tune
+
+    mctx = MeshContext.from_mesh(mesh)
+    world = mctx.size(axis)
+    sweep = ("panel", "pipelined")
+    key = _variant_key(mctx, axis=axis, m=m, k=k, n=n, dtype=dtype,
+                       block_m=block_m, block_n=block_n, block_k=block_k)
+    if use_cache:
+        cached = tune.load_autotune_data(key)
+        if cached and cached.get("variant") in sweep:
+            return cached["variant"]
+
+    a = jax.random.normal(jax.random.PRNGKey(0),
+                          (m * world, k)).astype(dtype)
+    b_arr = jax.random.normal(jax.random.PRNGKey(1),
+                              (k, n * world)).astype(dtype)
+    times = {}
+    for variant in sweep:
+        ctx = create_ag_gemm_context(mctx, axis, block_m, block_n,
+                                     block_k, variant=variant)
+        if world > 1:
+            in_specs = (P(axis, None), P(None, axis))
+            out_specs = P(None, axis)
+            sim = 0
+        else:
+            in_specs = (P(None, None), P(None, None))
+            out_specs = P(None, None)
+            sim = sim_ranks
+        step = jax.jit(jax.shard_map(
+            lambda a_, b_, _ctx=ctx, _sim=sim: ag_gemm(
+                a_, b_, _ctx, sim_ranks=_sim,
+                force_kernel=not (world > 1 or _sim)),
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False))
+        try:
+            np.asarray(step(a, b_arr))        # compile + warmup
+            best = float("inf")
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                np.asarray(step(a, b_arr))
+                best = min(best, _time.perf_counter() - t0)
+        except Exception:
+            # Deterministic failure-skip (the autotuner's policy): a
+            # variant that cannot compile/run here simply loses.
+            continue
+        times[variant] = best
+        tune.store_autotune_data(
+            tune.make_key("ag_gemm_variant_partial", base=key,
+                          cfg=variant),
+            {"variant": variant, "ms": round(best * 1e3, 3)}, best)
+    if not times:
+        return "panel"
+    winner = min(times, key=times.get)
+    tune.store_autotune_data(
+        key, {"variant": winner,
+              "times_ms": {v: round(t * 1e3, 3)
+                           for v, t in times.items()}},
+        times[winner])
+    return winner

@@ -17,11 +17,13 @@ same Ref in the same kernel on the peer core). So:
   ``make_async_remote_copy(device_id=...)``.
 
 PERSISTENT CONTEXTS (reference ctx-owned symmetric tensors,
-``allgather_gemm.py:449-511``): thread the workspace functionally —
-seed with ``symm_tensor``, pass it back in each call
-(``ag_gemm(..., return_ag=True, ws=ws)``); the kernel's input/output
-alias makes the update in place, so steady-state calls skip the
-workspace init entirely. The per-invocation entry barrier itself is
+``allgather_gemm.py:449-511``): ops whose workspace must persist
+across calls thread it functionally — seed with ``symm_tensor``, pass
+it back in each call, alias it to an output. ``ag_gemm`` no longer
+needs this: both its variants expose the ring workspace as a plain
+second output with no init cost to amortize (the old aliased-pipeline
+variant, which pre-placed the local chunk into a zero-filled
+workspace, is gone). The per-invocation entry barrier itself is
 irreducible on TPU (``docs/primitives.md`` rule 3 — semaphore register
 aliasing across kernels); to amortize IT, fuse the loop into one
 kernel (``ops/low_latency.ll_a2a_steps``, the megakernel).

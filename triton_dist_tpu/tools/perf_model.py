@@ -119,6 +119,60 @@ def gemm_time_model_s(m: int, k: int, n: int, block_m: int, block_n: int,
     return max(t_compute, t_mem)
 
 
+def _sub_jaxprs(params):
+    """Yield every jaxpr nested in an eqn's params (pjit/scan/remat
+    hold ClosedJaxprs or Jaxprs under varying keys; duck-typed so it
+    survives jax version drift)."""
+    def is_jaxpr(v):
+        return hasattr(v, "eqns") or hasattr(getattr(v, "jaxpr", None),
+                                             "eqns")
+    for v in params.values():
+        if is_jaxpr(v):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                if is_jaxpr(item):
+                    yield item
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Deterministic matmul-FLOP count from a jaxpr — the synthetic
+    cost table for backends whose ``compile().cost_analysis()`` reports
+    no flops (CPU, interpret): 2*out_size*contraction per
+    ``dot_general``, multiplied through ``scan`` trip counts, the MAX
+    over ``cond`` branches, and recursing into every nested call
+    (pjit, shard_map, remat, custom-derivative wrappers). A ``while``
+    body counts once — a lower bound, documented rather than guessed.
+
+    Inside a ``shard_map`` the inner jaxpr is the per-rank program, so
+    the count is per-device flops — exactly what schedule tests assert
+    on (each PP rank must compute ~1/S of the sequential total).
+    """
+    inner = getattr(jaxpr, "jaxpr", jaxpr)    # ClosedJaxpr -> Jaxpr
+    total = 0.0
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            (lhs_c, _), _ = eqn.params["dimension_numbers"]
+            lhs_shape = eqn.invars[0].aval.shape
+            contract = 1
+            for d in lhs_c:
+                contract *= lhs_shape[d]
+            out_size = 1
+            for s in eqn.outvars[0].aval.shape:
+                out_size *= s
+            total += 2.0 * out_size * contract
+            continue
+        if name == "cond":
+            total += max((jaxpr_flops(b)
+                          for b in eqn.params["branches"]), default=0.0)
+            continue
+        mult = eqn.params.get("length", 1) if name == "scan" else 1
+        for sub in _sub_jaxprs(eqn.params):
+            total += mult * jaxpr_flops(sub)
+    return total
+
+
 def ag_gemm_vmem_bytes(block_m: int, block_n: int, block_k: int,
                        m_loc: int, kdim: int, n_loc: int,
                        dtype_bytes: int = 2,
@@ -141,3 +195,31 @@ def ag_gemm_vmem_bytes(block_m: int, block_n: int, block_k: int,
     acc = tm * tn * 4
     out = 2 * tm * tn * dtype_bytes
     return n_buf * panel + b_tiles + acc + out
+
+
+def ag_gemm_pipelined_vmem_bytes(block_m: int, block_n: int,
+                                 block_k: int, m_loc: int, kdim: int,
+                                 n_loc: int, dtype_bytes: int = 2,
+                                 panel_budget: int = 9 * 1024 * 1024
+                                 ) -> int:
+    """Model of the pipelined (scoped-VMEM streamed) ag_gemm variant's
+    footprint: ``n_buf`` rotating (tm, tk) + (tk, tn) block pairs, the
+    f32 accumulator, and the double-buffered output tile — independent
+    of K (the panel model's footprint grows with K; this one streams
+    K). Mirrors ``ops/ag_gemm.pipelined_blocks``'s tk budget clamp."""
+    tm = min(block_m, m_loc)
+    while tm > 1 and m_loc % tm:
+        tm //= 2
+    tn = min(block_n, n_loc)
+    tk = min(block_k, kdim)
+    while tk > 8 and kdim % tk:
+        tk //= 2
+    while (tk > 8 and 2 * (tm + tn) * tk * dtype_bytes > panel_budget
+           and kdim % (tk // 2) == 0):
+        tk //= 2
+    pair = (tm * tk + tk * tn) * dtype_bytes
+    n_buf = 2 if (kdim // max(tk, 1) > 1
+                  and 2 * pair <= panel_budget) else 1
+    acc = tm * tn * 4
+    out = 2 * tm * tn * dtype_bytes
+    return n_buf * pair + acc + out
