@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: csrc test quick race verify-faults bench-smoke bench-megakernel \
 	serve-smoke ep-smoke ep2d-smoke aggemm-smoke disagg-smoke \
 	spec-smoke chaos-smoke \
-	qblock-smoke obs-smoke tier-smoke fleet-smoke \
+	qblock-smoke obs-smoke tier-smoke fleet-smoke slo-smoke \
 	mega-parity-smoke mkchunk-smoke supervise-smoke apicheck ci \
 	bench-all
 
@@ -129,6 +129,17 @@ tier-smoke: csrc
 # serving").
 fleet-smoke: csrc
 	bash scripts/fleet_smoke.sh
+
+# Multi-tenant SLO battery: EDF/DRR/aging units on a fake clock,
+# per-tenant backpressure + decode quotas, preemption token-exactness
+# through both eviction paths, the noisy-neighbor isolation gate, the
+# router's class/over-quota shed order, the multi-tenant chaos soak,
+# a bit-identical-streams chat e2e with --slo --tenants 2, and the
+# non-null slo_attainment / tenant_interactive_p99_ttft_ms /
+# slo_preemptions bench gate (>= 2x interactive isolation at >= 0.8x
+# bulk throughput; docs/serving.md, "Multi-tenant SLO scheduling").
+slo-smoke: csrc
+	bash scripts/slo_smoke.sh
 
 # Megakernel serving-parity battery: quantized-KV token agreement +
 # capacity gates, Q-block speculation token-exact vs the non-spec mk

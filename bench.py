@@ -1161,6 +1161,109 @@ def _interpret_fleet() -> dict:
     }
 
 
+def _interpret_slo() -> dict:
+    """Multi-tenant SLO scheduling on the CPU mesh — the
+    ``slo_attainment`` / ``tenant_interactive_p99_ttft_ms`` /
+    ``slo_preemptions`` surface (non-null gate in
+    scripts/slo_smoke.sh): the SAME seeded mixed-tenant trace (a bulk
+    batch flood plus periodic interactive arrivals with deadlines)
+    served twice on a fake tick clock — once FIFO, once through the
+    SLO layer with preemption armed. The measurement is the isolation
+    ratio: interactive p99 TTFT must improve >= 2x under SLO while the
+    bulk tenant's tokens/s degrades <= 20% (ISSUE 20's acceptance
+    bar), with every stream bit-identical to ``Engine.serve`` and the
+    decode jit cache at one entry. Absolute tick counts track the CPU
+    dispatch; the ratio and the non-null presence are the gates."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from triton_dist_tpu.models import Engine, ModelConfig
+    from triton_dist_tpu.serving import ServingEngine
+
+    cfg = ModelConfig.tiny(vocab_size=64, hidden_size=32,
+                           intermediate_size=32, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=4,
+                           head_dim=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    eng = Engine(cfg, mesh, mode="xla", max_len=32, seed=0)
+
+    def run_trace(slo):
+        clock = [0.0]
+        srv = ServingEngine(eng, num_slots=2, page=4,
+                            clock=lambda: clock[0], slo=slo)
+        bulk = [srv.submit([i + 1, 2, 3], max_new_tokens=12,
+                           tenant="bulk") for i in range(4)]
+        chat, tick, t0 = [], 0, time.perf_counter()
+        while not srv._drained() or len(chat) < 4:
+            if tick % 2 == 0 and len(chat) < 4:
+                # Deadline 12 ticks out: comfortably past the ~6-tick
+                # service time, close enough that a chat stuck >= 2
+                # ticks behind the flood enters the preemption margin.
+                # The FIFO baseline gets the tenant label only — a
+                # scheduler that ignores deadlines would otherwise
+                # EXPIRE these requests, not serve them late.
+                kw = ({"deadline": clock[0] + 12.0}
+                      if slo is not None else {})
+                chat.append(srv.submit([40 + len(chat), 7],
+                                       max_new_tokens=4,
+                                       tenant="chat", **kw))
+            srv.step()
+            clock[0] += 1.0
+            tick += 1
+            assert tick < 500, "slo bench trace failed to drain"
+        wall = time.perf_counter() - t0
+        for h in bulk + chat:
+            n = h.request.max_new_tokens
+            ids = jnp.asarray(np.tile(np.asarray(
+                [list(h.request.prompt)], np.int32), (1, 1)))
+            want = np.asarray(eng.serve(ids, gen_len=n))[0].tolist()
+            assert h.tokens == want, (
+                f"slo={slo is not None}: stream diverged from the "
+                f"serve oracle for {h.request.request_id}")
+        assert srv.decode_cache_size() == 1, (
+            "SLO scheduling re-specialized the decode dispatch")
+        st = srv.stats()
+        lat = st["latency"]["per_tenant"]["chat"]["ttft_ms"]
+        # Batch throughput over the full serving window — last-finish
+        # would penalize the REORDERING itself (batch inherently
+        # finishes later when interactive runs first), not lost work.
+        return {
+            "p99_ttft": lat["p99"], "ticks": tick, "wall": wall,
+            "bulk_tokens_per_tick": 4 * 12 / tick, "stats": st,
+        }
+
+    fifo = run_trace(None)
+    slo = run_trace({"specs": [{"name": "chat", "weight": 2.0}],
+                     "preempt_margin_s": 10.0})
+    isolation = fifo["p99_ttft"] / max(slo["p99_ttft"], 1e-9)
+    bulk_ratio = (slo["bulk_tokens_per_tick"]
+                  / max(fifo["bulk_tokens_per_tick"], 1e-9))
+    st = slo["stats"]
+    assert isolation >= 2.0, (
+        f"interactive isolation only {isolation:.2f}x (need >= 2x)")
+    assert bulk_ratio >= 0.8, (
+        f"bulk throughput degraded to {bulk_ratio:.2f} (floor 0.8)")
+    assert st["slo_preemptions"] >= 1
+    return {
+        "slo_attainment": st["slo_attainment"],
+        "tenant_interactive_p99_ttft_ms": st[
+            "latency"]["per_tenant"]["chat"]["ttft_ms"]["p99"],
+        "slo_preemptions": st["slo_preemptions"],
+        "slo_detail": {
+            "interactive_isolation_x": round(isolation, 2),
+            "fifo_interactive_p99_ttft_ms": fifo["p99_ttft"],
+            "bulk_throughput_ratio": round(bulk_ratio, 3),
+            "fifo_ticks": fifo["ticks"], "slo_ticks": slo["ticks"],
+            "slo_wall_ms": round(slo["wall"] * 1e3, 1),
+            "tenants": {t: {k: v[k] for k in
+                            ("admitted", "released", "preempted",
+                             "met", "missed")}
+                        for t, v in st["slo"]["tenants"].items()},
+        },
+    }
+
+
 def _variant_best_ms(sweep, variant, block_m=None):
     """Best swept time (ms) for one ag_gemm variant, optionally pinned
     to one block_m; None — not omitted — when nothing lowered."""
@@ -1346,6 +1449,14 @@ def _interpret_bench(reason: str) -> None:
               "router_affinity_hit_rate": None,
               "fleet_error": str(e)[:300]}
     try:
+        so = _interpret_slo()
+    except Exception as e:  # slo bench must not sink the record
+        # Nulled, NOT omitted: the slo_smoke gate greps these keys.
+        so = {"slo_attainment": None,
+              "tenant_interactive_p99_ttft_ms": None,
+              "slo_preemptions": None,
+              "slo_error": str(e)[:300]}
+    try:
         mp = _interpret_mega_parity()
     except Exception as e:  # mk parity bench must not sink the record
         # Nulled, NOT omitted: the mega_parity_smoke gate greps these.
@@ -1396,6 +1507,7 @@ def _interpret_bench(reason: str) -> None:
             **sp,
             **ti,
             **fl,
+            **so,
             **mp,
             **mc,
             **av,

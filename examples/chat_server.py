@@ -149,6 +149,24 @@ def main():
                          "spans) and a metrics.json snapshot into DIR "
                          "on exit and on SIGTERM, and print the "
                          "one-line 'obs:' latency summary")
+    ap.add_argument("--slo", action="store_true",
+                    help="layer path: arm the multi-tenant SLO "
+                         "scheduling layer — per-tenant bounded "
+                         "queues, deadline classes, weighted fair "
+                         "share, and priority preemption "
+                         "(docs/serving.md, 'Multi-tenant SLO "
+                         "scheduling'). Prompts carry a tenant via "
+                         "an '@NAME ' line prefix or --tenants")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="--slo: label stdin prompts with tenants "
+                         "t0..t{N-1} round-robin (lines with an "
+                         "explicit '@NAME ' prefix keep their own)")
+    ap.add_argument("--tenant-quota", action="append", default=[],
+                    metavar="NAME=TOKENS",
+                    help="--slo: give NAME a decode-token quota "
+                         "bucket refilling at TOKENS/s (repeatable; "
+                         "an exhausted tenant queues, it is never "
+                         "failed)")
     ap.add_argument("--megakernel", action="store_true")
     ap.add_argument("--mk-model", default="dense",
                     choices=["dense", "moe", "hybrid"],
@@ -173,7 +191,7 @@ def main():
 
     import triton_dist_tpu as tdt
     from triton_dist_tpu.models import Engine, ModelConfig, qwen_moe
-    from triton_dist_tpu.serving import ServingEngine
+    from triton_dist_tpu.serving import QueueFullError, ServingEngine
 
     import jax.numpy as jnp
 
@@ -226,6 +244,23 @@ def main():
                  "--trace-out/--park-after-idle (those drive one "
                  "engine; the router has scale_to/kill_fleet drills "
                  "instead)")
+    if args.slo and args.megakernel:
+        sys.exit("--slo arbitrates the layer path's decode slots; the "
+                 "megakernel's persistent lane schedules its own "
+                 "(see docs/serving.md)")
+    if (args.tenants or args.tenant_quota) and not args.slo:
+        sys.exit("--tenants/--tenant-quota need --slo (they configure "
+                 "the SLO scheduling layer)")
+    slo_specs = []
+    for q in args.tenant_quota:
+        name, sep, tok = q.partition("=")
+        if not sep or not name:
+            sys.exit(f"--tenant-quota {q!r}: expected NAME=TOKENS")
+        try:
+            slo_specs.append({"name": name,
+                              "decode_quota": float(tok)})
+        except ValueError:
+            sys.exit(f"--tenant-quota {q!r}: TOKENS must be a number")
     # Layer-path serving knobs shared by every engine construction
     # below: attention impl, quantized KV pools, speculative decode.
     telemetry = args.telemetry or ("spans" if args.trace_out
@@ -235,7 +270,8 @@ def main():
                     spec_k=args.spec_k if args.spec else 0,
                     telemetry=telemetry,
                     kv_tiers=({"host_pages": args.tier_host_pages}
-                              if args.kv_tiers else None))
+                              if args.kv_tiers else None),
+                    slo=({"specs": slo_specs} if args.slo else None))
     def build_disagg(cfg, params, model_kw):
         """Two engines over split tp halves (or one colocated role at
         tp=1) sharing ONE weight pytree, wrapped in the disaggregated
@@ -566,11 +602,24 @@ def main():
 
     print(f"serving {cfg.model_name} (vocab {cfg.vocab_size}); one "
           "prompt of space-separated token ids per line:", flush=True)
+    n_prompts = 0
     for lineno, line in enumerate(sys.stdin, 1):
-        if not line.split():
+        parts = line.split()
+        if not parts:
             continue
+        # '@NAME ' prefix routes the prompt to that tenant (--slo);
+        # otherwise --tenants N labels prompts t0..t{N-1} round-robin.
+        tenant = None
+        if parts[0].startswith("@") and len(parts[0]) > 1:
+            tenant = parts[0][1:]
+            parts = parts[1:]
+            if not parts:
+                continue
+        elif args.tenants:
+            tenant = f"t{n_prompts % args.tenants}"
+        n_prompts += 1
         try:
-            ids = [int(t) % cfg.vocab_size for t in line.split()]
+            ids = [int(t) % cfg.vocab_size for t in parts]
         except ValueError as e:
             print(f"error: line {lineno} is not space-separated token "
                   f"ids ({e})", file=sys.stderr, flush=True)
@@ -583,10 +632,11 @@ def main():
 
         try:
             srv.submit(ids, max_new_tokens=args.gen_len,
-                       stream_cb=stream)
-        except ValueError as e:
-            # Too long for the configured capacity: skip the request,
-            # keep the server alive (old behaviour, same message spot).
+                       stream_cb=stream, tenant=tenant)
+        except (ValueError, QueueFullError) as e:
+            # Too long for the configured capacity (or a tenant's own
+            # backpressure): skip the request, keep the server alive
+            # (old behaviour, same message spot).
             print(f" [skipped: {e}]", flush=True)
             continue
         run_serving()
@@ -639,6 +689,22 @@ def main():
                  f"affinity-hit-rate="
                  f"{'n/a' if ar is None else f'{ar:.2f}'} "
                  f"live={st['live_fleets']}/{len(srv.fleets)}")
+    if args.slo:
+        at = st.get("slo_attainment")
+        tn = (st.get("slo") or {}).get("tenants") or {}
+        per_lat = ((st.get("latency") or {}).get("per_tenant")
+                   or {})
+        line += (f", slo: attainment="
+                 f"{'n/a' if at is None else f'{at:.2f}'} "
+                 f"preemptions={st['slo_preemptions']} "
+                 f"tenants={len(tn)}")
+        for name in sorted(tn):
+            t = tn[name]
+            p99 = ((per_lat.get(name) or {}).get("ttft_ms")
+                   or {}).get("p99")
+            line += (f" {name}(released={t['released']} "
+                     f"preempted={t['preempted']} p99-ttft="
+                     f"{'n/a' if p99 is None else f'{p99:.0f}ms'})")
     if (st["retries"] or st["failovers"] or st["restored_requests"]
             or args.checkpoint_dir):
         line += (f", ft: retries={st['retries']} "

@@ -351,6 +351,77 @@ def check_invariants(srv) -> None:
                 f"slot {h.slot}")
     _check_tiers(srv)
     _check_arena(srv)
+    _check_slo(srv)
+
+
+def _check_slo(srv) -> None:
+    """Tenant-fairness sweep (engines built with ``slo=...``):
+
+    - **single ownership**: a tenant-queued handle is ``"queued"``,
+      holds no slot, and is never simultaneously in the scheduler
+      queue or a slot (the relocation in ``SLOScheduler.submit`` /
+      ``pump`` must move, not copy);
+    - **bounded queues**: each tenant queue within its spec's
+      ``max_queue``;
+    - **bucket sanity**: the admission token bucket stays inside
+      [0, burst];
+    - **quota conservation**: ``tokens == granted - charged`` — the
+      decode-quota bucket algebra neither mints nor leaks quota;
+    - **no starvation under aging**: no quota-eligible queued handle
+      has waited beyond ``slo.starve_limit_s`` (aging promotes it to
+      the interactive rank long before that);
+    - **preemption debt**: every park-path preemptee is still parked
+      (and in the engine's parked registry) — it WILL be auto-resumed,
+      so "preempted requests always reach a terminal status" holds.
+    """
+    slo = getattr(srv, "slo", None)
+    if slo is None:
+        return
+    in_sched = {id(h) for h in srv.sched.queue}
+    in_slots = {id(h) for h in srv.sched.slots.values()}
+    now = srv.sched.now()
+    for st in slo.registry.states():
+        name = st.spec.name
+        if len(st.queue) > st.spec.max_queue:
+            raise InvariantViolation(
+                f"tenant {name!r} queue {len(st.queue)} over its "
+                f"bound {st.spec.max_queue}")
+        if not (-1e-9 <= st.bucket <= st.spec.burst + 1e-9):
+            raise InvariantViolation(
+                f"tenant {name!r} admission bucket {st.bucket} left "
+                f"[0, {st.spec.burst}]")
+        if st.spec.decode_quota is not None:
+            if abs(st.tokens - (st.granted - st.charged)) > 1e-6:
+                raise InvariantViolation(
+                    f"tenant {name!r} quota not conserved: bucket "
+                    f"{st.tokens} != granted {st.granted} - charged "
+                    f"{st.charged}")
+            if st.tokens > st.quota_burst + 1e-9:
+                raise InvariantViolation(
+                    f"tenant {name!r} quota bucket {st.tokens} over "
+                    f"its depth {st.quota_burst}")
+        for h in st.queue:
+            rid = h.request.request_id
+            if h.status != "queued" or h.slot is not None:
+                raise InvariantViolation(
+                    f"tenant-queued request {rid} is {h.status!r} "
+                    f"with slot {h.slot}")
+            if id(h) in in_sched or id(h) in in_slots:
+                raise InvariantViolation(
+                    f"request {rid} owned by tenant {name!r} queue "
+                    "AND the scheduler (dual ownership)")
+            if st.quota_ok() and (now - h.queued_at
+                                  > slo.starve_limit_s):
+                raise InvariantViolation(
+                    f"request {rid} (tenant {name!r}) starved: queued "
+                    f"{now - h.queued_at:.3f}s > starve limit "
+                    f"{slo.starve_limit_s}s with quota available")
+    for h in slo._parked_by_slo:
+        rid = h.request.request_id
+        if h.status != "parked" or rid not in srv._parked:
+            raise InvariantViolation(
+                f"SLO-preempted request {rid} lost its park "
+                f"(status={h.status!r}) — the auto-resume debt broke")
 
 
 def _check_arena(srv) -> None:
@@ -532,6 +603,9 @@ def check_fleet_invariants(router, tracked=None) -> None:
             note(h, f"fleet{f.id}-slot")
         for h in f.engine._parked.values():
             note(h, f"fleet{f.id}-parked")
+        if getattr(f.engine, "slo", None) is not None:
+            for h in f.engine.slo.queued_handles():
+                note(h, f"fleet{f.id}-slo-queue")
     for h in tracked or ():
         if not h.done and h.request.request_id not in seen:
             raise InvariantViolation(
@@ -584,7 +658,8 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
              prompt_reuse_p: float = 0.3,
              restore_at: Optional[int] = None,
              max_drain_steps: Optional[int] = None,
-             park_p: float = 0.0) -> ChaosReport:
+             park_p: float = 0.0,
+             tenants: Sequence[str] = ()) -> ChaosReport:
     """Drive ``ticks`` serving steps of seeded mixed traffic under
     ``n_faults`` seeded fault events, checking every invariant after
     every tick, then drain fault-free and verify terminal resolution +
@@ -606,6 +681,16 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
     flow through the same token-exactness gate as everything else, so
     a park/resume byte drift fails the soak. Anything still parked
     when the soak ends resumes before the drain.
+
+    ``tenants`` non-empty labels each submission with a seeded-random
+    tenant from the list and a seeded-random ``slo_class`` — the
+    multi-tenant soak mode for engines built with ``slo=...`` (the
+    per-tick sweep then exercises the tenant-fairness invariants:
+    quota conservation, bounded queues, no starvation, preemption
+    debt). The extra rng draws are gated on the parameter, so a
+    ``tenants=()`` soak's schedule stays byte-identical to the
+    pre-SLO soaks. Greedy decoding means scheduling order never
+    changes tokens — the oracle gate is unchanged.
     """
     rng = np.random.RandomState(seed)
     srv = factory()
@@ -649,10 +734,17 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
             prompt = [int(x) for x in rng.randint(0, vocab, n)]
             prior_prompts.append(prompt)
         gen = int(gen_choices[int(rng.randint(len(gen_choices)))])
+        kw = {}
+        if tenants:
+            # Gated draws: a tenants=() soak never reaches these, so
+            # its schedule stays byte-identical to pre-SLO soaks.
+            kw["tenant"] = str(tenants[int(rng.randint(len(tenants)))])
+            kw["slo_class"] = ("interactive", "standard",
+                              "batch")[int(rng.randint(3))]
         from triton_dist_tpu.serving.scheduler import QueueFullError
 
         try:
-            h = srv.submit(prompt, max_new_tokens=gen)
+            h = srv.submit(prompt, max_new_tokens=gen, **kw)
         except QueueFullError:
             return      # backpressure is correct behaviour, not a bug
         tracked.append((tuple(prompt), gen, h))
@@ -809,8 +901,8 @@ def run_soak(factory: Callable[[], object], *, seed: int = 0,
             for k in ("done", "failed", "timeout")}},
         counters={k: srv.stats_counters[k] for k in
                   ("retries", "failovers", "comm_timeouts",
-                   "preemptions", "restored_requests", "parks",
-                   "resumes")},
+                   "preemptions", "slo_preemptions",
+                   "restored_requests", "parks", "resumes")},
         invariant_checks=invariant_checks,
         token_exact_requests=token_exact,
         restored_at=restored_tick)

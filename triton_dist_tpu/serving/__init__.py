@@ -19,10 +19,17 @@ from triton_dist_tpu.serving.spec import (  # noqa: F401
     accept_greedy,
 )
 from triton_dist_tpu.serving.scheduler import (  # noqa: F401
+    DEADLINE_CLASSES,
     QueueFullError,
     Request,
     RequestHandle,
     Scheduler,
+    deadline_class,
+)
+from triton_dist_tpu.serving.slo import (  # noqa: F401
+    SLOScheduler,
+    TenantRegistry,
+    TenantSpec,
 )
 from triton_dist_tpu.serving.server import (  # noqa: F401
     ServingEngine, load_checkpoint, save_checkpoint,

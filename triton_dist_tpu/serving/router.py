@@ -62,7 +62,8 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from triton_dist_tpu.serving.scheduler import (
-    QueueFullError, Request, RequestHandle,
+    _CLASS_RANK, QueueFullError, Request, RequestHandle,
+    deadline_class,
 )
 
 __all__ = ["FleetRouter", "ShedError"]
@@ -163,6 +164,10 @@ class FleetRouter:
             "router_retries": 0, "comm_timeouts": 0,
             "integrity_failures": 0,
         }
+        # Per-tenant shed breakdown (one tenant's flood spends its own
+        # shed budget — docs/serving.md, "Multi-tenant SLO
+        # scheduling"); keys appear on first shed.
+        self.shed_by_tenant: Dict[str, int] = {}
         for _ in range(fleets):
             self.fleets.append(self._make_fleet(factory()))
 
@@ -253,13 +258,22 @@ class FleetRouter:
             break
         return run
 
-    def _route_order(self, prompt) -> Tuple[List[_Fleet], Dict[int, int]]:
+    def _route_order(self, prompt, tenant=None
+                     ) -> Tuple[List[_Fleet], Dict[int, int]]:
         """Deterministic target order for one prompt. Affinity mode:
         longest resident prefix run first, then least loaded, then
         lowest fleet id (the spillover order when the preferred fleet
         is saturated). Affinity off: plain round-robin rotation with
         load as the tiebreak — the spread-only baseline the affinity
-        ablation measures against."""
+        ablation measures against.
+
+        When any fleet is armed with an SLO layer the order is also
+        TENANT-aware: between equal prefix runs, a fleet already
+        holding the same tenant's work sorts later — one tenant's
+        flood spreads across the fleet instead of piling up behind
+        its own backlog. With SLO off the sort key is unchanged, so
+        the pre-existing deterministic routing stays byte-identical.
+        """
         cands = self._routable_fleets()
         if not self.affinity:
             if cands:
@@ -268,9 +282,28 @@ class FleetRouter:
             return cands, {f.id: 0 for f in cands}
         runs = {f.id: self._affinity_run(f.engine, prompt)
                 for f in cands}
-        order = sorted(cands, key=lambda f: (-runs[f.id],
-                                             self._load(f), f.id))
+        if tenant is not None and any(
+                getattr(f.engine, "slo", None) is not None
+                for f in cands):
+            tload = {f.id: self._tenant_load(f, tenant) for f in cands}
+            order = sorted(cands, key=lambda f: (
+                -runs[f.id], tload[f.id], self._load(f), f.id))
+        else:
+            order = sorted(cands, key=lambda f: (-runs[f.id],
+                                                 self._load(f), f.id))
         return order, runs
+
+    def _tenant_load(self, f: "_Fleet", tenant) -> int:
+        """In-system request count for one tenant on one fleet
+        (queued + running + SLO-tenant-queued)."""
+        e = f.engine
+        n = sum(1 for h in e.sched.queue if h.request.tenant == tenant)
+        n += sum(1 for h in e.sched.running()
+                 if h.request.tenant == tenant)
+        if getattr(e, "slo", None) is not None:
+            n += sum(1 for h in e.slo.queued_handles()
+                     if h.request.tenant == tenant)
+        return n
 
     # -- retryable router ops ------------------------------------------
 
@@ -364,7 +397,17 @@ class FleetRouter:
             h.slot = None
             h.status = "queued"
             h.queued_at = sch.now()
-            (sch.queue.appendleft if head else sch.queue.append)(h)
+            slo = getattr(f.engine, "slo", None)
+            if slo is not None and not head:
+                # SLO-armed fleet: land in the TENANT queue so class
+                # ordering / DRR / quotas apply to routed requests too.
+                # Head insertions (failover handoffs, resumes) keep the
+                # direct front-of-queue contract — they already ran.
+                st = slo.registry.state(h.request.tenant, sch.now())
+                slo.adopt(f.engine, h)
+                st.admitted += 1
+            else:
+                (sch.queue.appendleft if head else sch.queue.append)(h)
             sch.counters["queue_peak"] = max(
                 sch.counters["queue_peak"], len(sch.queue))
 
@@ -381,11 +424,17 @@ class FleetRouter:
         from triton_dist_tpu.resilience import faults
         from triton_dist_tpu.resilience.watchdog import CommTimeoutError
 
-        order, runs = self._route_order(h.request.prompt)
+        order, runs = self._route_order(h.request.prompt,
+                                        h.request.tenant)
         for f in order:
             sch = f.engine.sched
             if len(sch.queue) >= sch.max_queue:
                 continue                      # saturated: spill over
+            slo = getattr(f.engine, "slo", None)
+            if slo is not None and not head:
+                st = slo.registry.state(h.request.tenant, sch.now())
+                if len(st.queue) >= st.spec.max_queue:
+                    continue    # tenant-saturated here: spill over
             try:
                 self._run_router_op(
                     "fleet_route",
@@ -422,9 +471,17 @@ class FleetRouter:
     def _overflow(self, h: RequestHandle, *, degrade: bool,
                   force_queue: bool = False) -> None:
         """Every fleet rejected ``h``: hold it in the router queue, or
-        shed by deadline class when that is full too (batch first;
-        interactive sheds only in fleet-loss mode — otherwise the
-        caller gets backpressure to retry). ``force_queue`` — a
+        shed when that is full too. The shed order is **(class, tenant
+        over-quota first)**: the victim is the lowest-deadline-class
+        request among the router queue PLUS the incoming one, with an
+        over-fair-share tenant's requests first within a class and the
+        newest arrival as the deterministic tiebreak — so one tenant's
+        batch flood spends its own shed budget, and a higher-class
+        arrival displaces a queued lower-class request instead of
+        being dropped. When the incoming request IS the victim, the
+        pre-existing class policy applies: batch sheds terminally,
+        interactive/standard shed only in fleet-loss mode (``degrade``)
+        and otherwise raise backpressure. ``force_queue`` — a
         voluntary drain rehoming its backlog — always queues: an
         operator's ``scale_to`` must never terminate traffic."""
         if force_queue or len(self.queue) < self.max_queue:
@@ -433,17 +490,69 @@ class FleetRouter:
             h.queued_at = self.obs.now()
             self.queue.append(h)
             return
-        batch = h.request.deadline is None
-        if batch:
+        counts = self._tenant_counts()
+        hkey = (h.request.tenant if h.request.tenant is not None
+                else "default")
+        counts[hkey] = counts.get(hkey, 0) + 1   # the incoming one
+        n_tenants = len(counts)
+        total = sum(counts.values())
+
+        def over_quota(x: RequestHandle) -> bool:
+            if n_tenants <= 1:
+                return False
+            key = (x.request.tenant if x.request.tenant is not None
+                   else "default")
+            return counts.get(key, 0) > total / n_tenants + 1e-9
+
+        cands = list(enumerate(self.queue)) + [(len(self.queue), h)]
+        victim = max(cands, key=lambda it: (
+            _CLASS_RANK[deadline_class(it[1].request)],
+            over_quota(it[1]), it[0]))[1]
+        if victim is not h:
+            self.queue.remove(victim)
+            self._shed(victim, "displaced: router and fleet queues "
+                               f"saturated and a higher-class request "
+                               f"({h.request.request_id}) arrived")
+            h.slot = None
+            h.status = "queued"
+            h.queued_at = self.obs.now()
+            self.queue.append(h)
+            return
+        cls = deadline_class(h.request)
+        if cls == "batch":
             self._shed(h, "router and fleet queues saturated "
                           "(batch class)")
         elif degrade:
             self._shed(h, "fleet loss: router and fleet queues "
-                          "saturated (interactive class)")
+                          f"saturated ({cls} class)")
         else:
             raise QueueFullError(
                 f"router queue full ({self.max_queue}) and every "
                 "fleet saturated; retry later")
+
+    def _tenant_counts(self) -> Dict[str, int]:
+        """In-system request count per tenant (router queue + every
+        live fleet's queued/running/SLO-queued) — the fair-share
+        denominator the shed order reads."""
+        counts: Dict[str, int] = {}
+
+        def bump(x: RequestHandle):
+            key = (x.request.tenant if x.request.tenant is not None
+                   else "default")
+            counts[key] = counts.get(key, 0) + 1
+
+        for x in self.queue:
+            bump(x)
+        for f in self._live_fleets():
+            e = f.engine
+            for x in e.sched.queue:
+                bump(x)
+            for x in e.sched.running():
+                bump(x)
+            if getattr(e, "slo", None) is not None:
+                for x in e.slo.queued_handles():
+                    bump(x)
+        return counts
 
     def _shed(self, h: RequestHandle, reason: str) -> None:
         h.status = "shed"
@@ -452,11 +561,13 @@ class FleetRouter:
         h.finished_at = self.obs.now()
         h.slot = None
         self.counters["shed_requests"] += 1
+        key = (h.request.tenant if h.request.tenant is not None
+               else "default")
+        self.shed_by_tenant[key] = self.shed_by_tenant.get(key, 0) + 1
         self.obs.event(
             "shed", request_id=h.request.request_id,
             tenant=h.request.tenant,
-            deadline_class=("batch" if h.request.deadline is None
-                            else "interactive"))
+            deadline_class=deadline_class(h.request))
 
     # -- health --------------------------------------------------------
 
@@ -893,7 +1004,7 @@ class FleetRouter:
             "tokens_generated", "decode_dispatches", "retries",
             "failovers", "restored_requests", "offloaded_pages",
             "prefetched_pages", "tier_hits", "tier_misses",
-            "parks", "resumes")}
+            "parks", "resumes", "slo_preemptions")}
         parked_sessions = 0
         tier_pages = 0
         any_tiers = False
@@ -948,5 +1059,35 @@ class FleetRouter:
             if hits + misses else None)
         out["fleet_ttft_ms"] = (merged.summary()
                                 if merged is not None else None)
+        # Multi-tenant SLO aggregation: per-fleet quota views merge
+        # into one cross-fleet tenant table + the fleet-wide
+        # attainment fraction. Nulled, never omitted, with SLO off.
+        out["shed_by_tenant"] = dict(self.shed_by_tenant)
+        views = [(f.id, f.engine.slo.stats()) for f in self.fleets
+                 if getattr(f.engine, "slo", None) is not None]
+        if views:
+            met = sum(v["slo_met"] for _, v in views)
+            missed = sum(v["slo_missed"] for _, v in views)
+            tenants: Dict[str, Dict[str, float]] = {}
+            for _, v in views:
+                for name, tv in v["tenants"].items():
+                    agg_t = tenants.setdefault(name, {k: 0 for k in (
+                        "queued", "admitted", "rejected", "released",
+                        "preempted", "met", "missed",
+                        "charged_tokens")})
+                    for k in agg_t:
+                        agg_t[k] += tv[k]
+            out["slo"] = {
+                "fleets": {fid: v for fid, v in views},
+                "tenants": tenants,
+                "preemptions": sum(v["slo_preemptions"]
+                                   for _, v in views),
+                "attainment": (met / (met + missed)
+                               if (met + missed) else None),
+            }
+            out["slo_attainment"] = out["slo"]["attainment"]
+        else:
+            out["slo"] = None
+            out["slo_attainment"] = None
         out["latency"] = self.obs.latency_summary()
         return out

@@ -38,7 +38,25 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["Request", "RequestHandle", "QueueFullError", "Scheduler"]
+__all__ = ["Request", "RequestHandle", "QueueFullError", "Scheduler",
+           "DEADLINE_CLASSES", "deadline_class"]
+
+# SLO deadline classes, priority-ordered (docs/serving.md,
+# "Multi-tenant SLO scheduling"). Rank 0 preempts rank 2, never the
+# reverse; EDF orders WITHIN a class, the rank orders across them.
+DEADLINE_CLASSES = ("interactive", "standard", "batch")
+_CLASS_RANK = {c: i for i, c in enumerate(DEADLINE_CLASSES)}
+
+
+def deadline_class(request: "Request") -> str:
+    """Canonical deadline class of a request: an explicit
+    ``slo_class`` wins; otherwise deadline-bearing requests are
+    ``"interactive"`` and unbounded ones ``"batch"`` — the same split
+    the fleet router's shed policy has always used, now named."""
+    c = getattr(request, "slo_class", None)
+    if c is not None:
+        return c
+    return "interactive" if request.deadline is not None else "batch"
 
 
 class QueueFullError(RuntimeError):
@@ -56,10 +74,14 @@ class Request:
     host sees it. Sampling fields mirror ``Engine.serve`` (temperature
     0 = greedy); seeds fold per-request steps, so a request samples the
     same tokens whether it is served alone or in a shared batch.
-    ``tenant`` is a free-form grouping tag for the telemetry layer —
-    latency histograms (TTFT / inter-token) aggregate per tenant in
-    addition to the global series (docs/observability.md); it never
-    affects scheduling.
+    ``tenant`` is a free-form grouping tag: the telemetry layer keys
+    latency histograms (TTFT / inter-token) per tenant in addition to
+    the global series (docs/observability.md), and when the engine is
+    built with ``slo=...`` it also selects the tenant's bounded queue /
+    quota buckets. ``slo_class`` pins the deadline class explicitly
+    (one of :data:`DEADLINE_CLASSES`); ``None`` derives it from the
+    deadline via :func:`deadline_class`. Without an SLO layer both
+    fields are telemetry-only and never affect scheduling.
     """
 
     prompt: Sequence[int]
@@ -72,6 +94,7 @@ class Request:
     seed: int = 0
     stream_cb: Optional[Callable[[int, "RequestHandle"], None]] = None
     tenant: Optional[str] = None
+    slo_class: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -170,6 +193,11 @@ class Scheduler:
     def submit(self, request: Request) -> RequestHandle:
         """Admit into the wait queue, or raise :class:`QueueFullError`
         (backpressure) when it is at ``max_queue``."""
+        if (request.slo_class is not None
+                and request.slo_class not in DEADLINE_CLASSES):
+            raise ValueError(
+                f"slo_class must be one of {DEADLINE_CLASSES}, "
+                f"got {request.slo_class!r}")
         if len(self.queue) >= self.max_queue:
             self.counters["rejected"] += 1
             raise QueueFullError(
@@ -243,15 +271,21 @@ class Scheduler:
     def timeout_victims(self) -> List[RequestHandle]:
         """Who a hung collective (CommTimeoutError on the shared decode
         dispatch) should fail: every running request past its deadline,
-        else the eldest running request — one victim guarantees
-        progress, the server and the other requests survive."""
+        else ONE victim chosen class-aware — batch before standard
+        before interactive (an interactive session should be the last
+        thing a wedged dispatch takes down), eldest ``started_at``
+        within a class, slot id as the deterministic final tiebreak.
+        One victim guarantees progress; the server and the other
+        requests survive."""
         victims = [h for h in self.running()
                    if h.request.deadline is not None
                    and self.now() >= h.request.deadline]
         if not victims:
             alive = self.running()
             if alive:
-                victims = [min(alive, key=lambda h: h.started_at)]
+                victims = [min(alive, key=lambda h: (
+                    -_CLASS_RANK[deadline_class(h.request)],
+                    h.started_at, h.slot))]
         return victims
 
     @property

@@ -161,7 +161,8 @@ class ServingEngine:
                  spec_ngram: int = 3, retry=None,
                  telemetry: str = "counters",
                  telemetry_capacity: int = 4096,
-                 kv_tiers=None, park_quant: Optional[str] = None):
+                 kv_tiers=None, park_quant: Optional[str] = None,
+                 slo=None):
         """EP-MoE decode knobs (no-ops for dense models):
 
         - ``transport``: EP decode dispatch path ("ar" | "ragged" |
@@ -359,6 +360,25 @@ class ServingEngine:
         # the scatter overlaps the decode dispatches in between.
         self._parked: dict = {}
         self._resuming: List = []
+        # Multi-tenant SLO arbitration (docs/serving.md, "Multi-tenant
+        # SLO scheduling"): when armed, submissions land in per-tenant
+        # bounded queues and the SLOScheduler releases them into the
+        # continuous-batching queue each tick — quotas, deadline
+        # classes, DRR fair share, and priority preemption.
+        from triton_dist_tpu.serving.slo import SLOScheduler
+
+        if slo is None or slo is False:
+            self.slo = None
+        elif isinstance(slo, SLOScheduler):
+            self.slo = slo
+        elif slo is True:
+            self.slo = SLOScheduler()
+        elif isinstance(slo, dict):
+            self.slo = SLOScheduler(**slo)
+        else:
+            raise TypeError(
+                "slo must be an SLOScheduler, a kwargs dict, True, or "
+                f"None — got {type(slo).__name__}")
         # Router-time predictive prefetch (docs/serving.md, "Fleet
         # serving"): prefix payloads whose tier_transfer already ran
         # at ROUTE time — the admission-time fetch consumes them
@@ -414,7 +434,7 @@ class ServingEngine:
             "tier_hits": 0, "tier_misses": 0, "offloaded_pages": 0,
             "prefetched_pages": 0, "parks": 0, "resumes": 0,
             "router_prefetched_pages": 0, "worker_prefetched_pages": 0,
-            "integrity_failures": 0,
+            "integrity_failures": 0, "slo_preemptions": 0,
         }
         self.prefill_buckets = (tuple(sorted(set(int(b) for b in
                                                  prefill_buckets)))
@@ -845,7 +865,10 @@ class ServingEngine:
                 f"prompt {len(request.prompt)} + gen "
                 f"{request.max_new_tokens} exceeds capacity "
                 f"{min(cap, self.max_len)}")
-        h = self.sched.submit(request)
+        if self.slo is not None:
+            h = self.slo.submit(self, request)
+        else:
+            h = self.sched.submit(request)
         self.obs.event("submit", request_id=h.request.request_id,
                        tenant=h.request.tenant,
                        prompt_tokens=len(h.request.prompt),
@@ -863,6 +886,15 @@ class ServingEngine:
             self._fail(h, "timeout", TimeoutError(
                 f"request {h.request.request_id} missed deadline "
                 f"{h.request.deadline} (now {now})"))
+        if self.slo is not None:
+            for h in self.slo.expired(now):
+                self._fail(h, "timeout", TimeoutError(
+                    f"request {h.request.request_id} missed deadline "
+                    f"{h.request.deadline} (now {now})"))
+            # Arbitration before admission: preempt if an interactive
+            # deadline is in danger, then release up to the free slot
+            # capacity (class rank -> DRR -> EDF) into sched.queue.
+            self.slo.pump(self)
         stalled: List[RequestHandle] = []
         for h in self.sched.admit():
             # Queue-wait closes at slot assignment, measured from the
@@ -889,7 +921,7 @@ class ServingEngine:
     def _drained(self) -> bool:
         """Nothing left to serve (subclasses add their in-flight
         state — e.g. pending migrations)."""
-        return self.sched.idle
+        return self.sched.idle and (self.slo is None or self.slo.idle)
 
     def run(self, *, max_steps: int = 100000, on_tick=None) -> None:
         """Drive :meth:`step` until queue and slots drain. ``on_tick``
@@ -925,7 +957,9 @@ class ServingEngine:
         bench read)."""
         out = dict(self.stats_counters)
         out.update(self.sched.counters)
-        out["queue_depth"] = len(self.sched.queue)
+        out["queue_depth"] = len(self.sched.queue) + (
+            len(self.slo.queued_handles()) if self.slo is not None
+            else 0)
         out["live_slots"] = int(self._live.sum())
         out["prefill_cache_size"] = self.prefill_cache_size()
         out["prefill_buckets"] = (list(self._prefiller.chunker.buckets)
@@ -1024,6 +1058,13 @@ class ServingEngine:
             out["tiers"] = None
             out["tier_pages"] = None
             out["kv_hot_hit_rate"] = None
+        # Multi-tenant SLO surface: per-tenant quota/attainment view +
+        # the aggregate attainment fraction — nulled, not omitted,
+        # when the layer is off (slo_preemptions rides the plain
+        # counters above either way).
+        out["slo"] = self.slo.stats() if self.slo is not None else None
+        out["slo_attainment"] = (out["slo"]["attainment"]
+                                 if self.slo is not None else None)
         # Telemetry surface: histogram summaries (TTFT / inter-token /
         # per-op, per-tenant groups) — None in telemetry="off", keeping
         # the key present either way (nulled, not omitted).
@@ -1088,11 +1129,15 @@ class ServingEngine:
                 "request_id": r.request_id, "eos_id": r.eos_id,
                 "deadline": r.deadline, "temperature": r.temperature,
                 "top_k": r.top_k, "seed": r.seed, "tenant": r.tenant,
+                "slo_class": r.slo_class,
             },
             "status": status or ("running" if keep_slot else "queued"),
             "tokens": [int(t) for t in h.tokens],
             "slot": h.slot if keep_slot else None,
             "decode_steps": h.decode_steps,
+            # SLO-preempted park victims are owed an auto-resume — the
+            # restoring process re-adopts the debt.
+            "slo_parked": bool(getattr(h, "_slo_parked", False)),
         }
 
     def checkpoint(self) -> dict:
@@ -1164,6 +1209,9 @@ class ServingEngine:
                       for h in inflight]
                    + [self._ser_handle(h, keep_slot=False)
                       for h in self.sched.queue]
+                   + [self._ser_handle(h, keep_slot=False)
+                      for h in (self.slo.queued_handles()
+                                if self.slo is not None else ())]
                    + [self._ser_handle(h, keep_slot=False,
                                        status="parked")
                       for h in self._parked.values()])
@@ -1218,7 +1266,9 @@ class ServingEngine:
                 "checkpoint/engine plan mismatch (snapshot vs this "
                 f"engine): {bad} — restore needs an identically-"
                 "configured engine over the same weights")
-        if self.sched.slots or self.sched.queue or self._parked:
+        if self.sched.slots or self.sched.queue or self._parked \
+                or (self.slo is not None
+                    and self.slo.queued_handles()):
             raise RuntimeError(
                 "restore() needs an idle engine (fresh process / "
                 "drained loop); this one has live slots, a queue, or "
@@ -1306,6 +1356,13 @@ class ServingEngine:
                 # arrives with the tier snapshot below; resume() works
                 # exactly as in the original process.
                 self._parked[req.request_id] = h
+                if hs.get("slo_parked") and self.slo is not None:
+                    # Re-adopt the auto-resume debt: an SLO-preempted
+                    # park victim must still reach a terminal status.
+                    h._slo_parked = True
+                    self.slo._parked_by_slo.append(h)
+            elif self.slo is not None:
+                self.slo.adopt(self, h)
             else:
                 self.sched.queue.append(h)
             handles.append(h)
@@ -2817,6 +2874,8 @@ class ServingEngine:
     def _emit(self, h: RequestHandle, tok: int):
         h.tokens.append(int(tok))
         self.stats_counters["tokens_generated"] += 1
+        if self.slo is not None:
+            self.slo.on_token(h)
         if self.obs.enabled:
             # TTFT / inter-token latency edges, on the engine clock.
             # Host-side stamping only — one clock read per token.
@@ -2885,6 +2944,8 @@ class ServingEngine:
             request_id=h.request.request_id, slot=slot,
             tenant=h.request.tenant, status=status,
             tokens=len(h.tokens), decode_steps=h.decode_steps)
+        if self.slo is not None:
+            self.slo.on_retire(self, h)
 
     def _fail(self, h: RequestHandle, status: str, error):
         self._retire(h, status, error)

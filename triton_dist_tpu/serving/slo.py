@@ -1,0 +1,542 @@
+"""Multi-tenant SLO scheduling: quotas, deadline classes, preemption.
+
+Reference: ROADMAP Open item 6(c) — at production scale the scheduler
+arbitrates TENANTS, not just requests. T3 (arXiv 2401.16677) and the
+source paper (arXiv 2504.19442) both make the same argument for
+overlap at the kernel level: latency-critical work must keep flowing
+AROUND bulk work, or the overlap wins never reach the user. This
+module is that argument applied one layer up — a host-side admission /
+fair-share / preemption layer that slots between request submission
+and the continuous-batching :class:`~triton_dist_tpu.serving.
+scheduler.Scheduler`, built on machinery the stack already has:
+
+- **Per-tenant bounded queues** with token-bucket admission (``rate``/
+  ``burst`` submissions) and a decode-token quota bucket
+  (``decode_quota`` tokens/s) — a flooding tenant gets ITS OWN
+  :class:`QueueFullError` backpressure while other tenants admit.
+- **Deadline classes** (:data:`~triton_dist_tpu.serving.scheduler.
+  DEADLINE_CLASSES`: interactive / standard / batch) with
+  earliest-deadline-first ordering within a class and aging across
+  classes (a queued batch request's effective priority rises with
+  wait, so nothing starves).
+- **Deficit round-robin** across tenants: each release cycle tops a
+  tenant's deficit by ``quantum * weight``; a release costs 1 — decode
+  slots divide in weight proportion without any per-slot pinning.
+- **Priority preemption**: when an interactive request would miss its
+  deadline and no slot is free, the lowest-priority running request
+  is evicted — through :meth:`ServingEngine.park` when ``kv_tiers``
+  is armed (KV offloaded wholesale, resumed bit-exact), else through
+  the deterministic re-prefill contract (``prompt + tokens[:-1]``
+  rebuilds the cache, the last token re-enters via decode). Either
+  path is token-exact BY CONSTRUCTION, so preemption is invisible in
+  the streams — only in the latency histograms.
+
+The layer is pure host bookkeeping: it reorders which handles reach
+``sched.queue`` and never introduces a new dispatch shape, so the
+fixed-decode-shape jit-cache gate (``decode_cache_size() == 1``)
+holds with SLO scheduling and preemption active.
+
+Determinism: all state advances on the engine's injected clock (every
+method takes ``now`` or reads ``engine.sched.now()``); the DRR cursor
+and EDF keys break ties on a monotonic submission sequence number —
+two runs over the same trace release in the same order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from triton_dist_tpu.serving.scheduler import (
+    _CLASS_RANK, DEADLINE_CLASSES, QueueFullError, Request,
+    RequestHandle, deadline_class)
+
+__all__ = ["TenantSpec", "TenantRegistry", "SLOScheduler"]
+
+_DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's static contract.
+
+    ``weight`` scales the DRR fair share (2.0 = twice the decode-slot
+    share of a weight-1 tenant). ``max_queue`` bounds the tenant's
+    wait queue — the per-tenant backpressure edge. ``rate``/``burst``
+    is a token bucket on SUBMISSIONS (``None`` = unlimited);
+    ``decode_quota`` is a refill rate in decode TOKENS per second
+    (``None`` = unmetered) with bucket depth ``quota_burst``
+    (default: one second of quota) — a tenant whose bucket is empty
+    stays queued until refill, it is never failed.
+    """
+
+    name: str
+    weight: float = 1.0
+    max_queue: int = 16
+    rate: Optional[float] = None
+    burst: int = 8
+    decode_quota: Optional[float] = None
+    quota_burst: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {self.max_queue}")
+        if self.rate is not None and self.rate <= 0:
+            raise ValueError(f"rate must be > 0, got {self.rate}")
+        if self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+        if self.decode_quota is not None and self.decode_quota <= 0:
+            raise ValueError(
+                f"decode_quota must be > 0, got {self.decode_quota}")
+
+
+class _TenantState:
+    """Live accounting for one tenant (registry-internal)."""
+
+    def __init__(self, spec: TenantSpec, now: float):
+        self.spec = spec
+        self.queue: List[RequestHandle] = []
+        self.bucket = float(spec.burst)          # admission bucket
+        qb = (spec.quota_burst if spec.quota_burst is not None
+              else spec.decode_quota)
+        self.quota_burst = float(qb) if qb is not None else None
+        # Decode-token bucket algebra: tokens == granted - charged at
+        # all times (the chaos quota-conservation invariant). The
+        # initial fill counts as granted.
+        self.tokens = float(qb) if qb is not None else 0.0
+        self.granted = self.tokens
+        self.charged = 0
+        self.refilled_at = now
+        self.deficit = 0.0                       # DRR residual
+        self.admitted = 0
+        self.rejected = 0
+        self.released = 0
+        self.preempted = 0
+        self.met = 0
+        self.missed = 0
+
+    def refill(self, now: float):
+        dt = max(now - self.refilled_at, 0.0)
+        self.refilled_at = now
+        if self.spec.rate is not None:
+            self.bucket = min(self.bucket + self.spec.rate * dt,
+                              float(self.spec.burst))
+        if self.spec.decode_quota is not None:
+            add = min(self.spec.decode_quota * dt,
+                      max(self.quota_burst - self.tokens, 0.0))
+            self.tokens += add
+            self.granted += add
+
+    def quota_ok(self) -> bool:
+        """Can this tenant release a request into a decode slot?"""
+        return self.spec.decode_quota is None or self.tokens >= 1.0
+
+
+class TenantRegistry:
+    """Tenant table: specs plus live buckets/queues, registration-
+    ordered (the DRR ring iterates in this order — deterministic).
+    Unknown tenants (including ``tenant=None`` → ``"default"``)
+    auto-register from the ``default`` template spec."""
+
+    def __init__(self, specs: Sequence = (), *,
+                 default: Optional[TenantSpec] = None):
+        if default is None:
+            default = TenantSpec(_DEFAULT_TENANT)
+        elif isinstance(default, dict):
+            default = TenantSpec(**{"name": _DEFAULT_TENANT, **default})
+        self.default = default
+        self._states: Dict[str, _TenantState] = {}
+        self.order: List[str] = []
+        for spec in specs:
+            if isinstance(spec, dict):
+                spec = TenantSpec(**spec)
+            self.register(spec)
+
+    def register(self, spec: TenantSpec, now: float = 0.0):
+        if spec.name in self._states:
+            raise ValueError(f"tenant {spec.name!r} already registered")
+        self._states[spec.name] = _TenantState(spec, now)
+        self.order.append(spec.name)
+
+    def state(self, tenant: Optional[str],
+              now: float = 0.0) -> _TenantState:
+        key = tenant if tenant is not None else _DEFAULT_TENANT
+        st = self._states.get(key)
+        if st is None:
+            self.register(dataclasses.replace(self.default, name=key),
+                          now)
+            st = self._states[key]
+        return st
+
+    def states(self):
+        return [self._states[n] for n in self.order]
+
+    def refill(self, now: float):
+        for st in self.states():
+            st.refill(now)
+
+
+class SLOScheduler:
+    """The arbitration layer (module docstring). One instance per
+    :class:`~triton_dist_tpu.serving.server.ServingEngine`, armed via
+    ``ServingEngine(slo=...)``; it holds no engine reference — every
+    engine-touching method takes the engine, so a fleet of engines
+    can share a construction recipe without sharing state.
+
+    Knobs: ``quantum`` (DRR top-up per ring visit, scaled by tenant
+    weight), ``age_boost_s`` (a queued request's effective class rank
+    drops by one per this many seconds of wait — the no-starvation
+    aging; ``None`` disables), ``preempt_margin_s`` (an interactive
+    request within this margin of its deadline, with no free slot,
+    triggers preemption), ``starve_limit_s`` (the chaos invariant's
+    bound: a quota-eligible queued request older than this is a
+    starvation violation).
+    """
+
+    def __init__(self, registry: Optional[TenantRegistry] = None, *,
+                 specs: Sequence = (), default=None,
+                 quantum: float = 1.0, age_boost_s: Optional[float] = 5.0,
+                 preempt_margin_s: float = 0.25,
+                 starve_limit_s: float = 60.0):
+        if registry is not None and (specs or default is not None):
+            raise ValueError("pass a registry OR specs/default, not both")
+        self.registry = (registry if registry is not None
+                         else TenantRegistry(specs, default=default))
+        if quantum <= 0:
+            raise ValueError(f"quantum must be > 0, got {quantum}")
+        self.quantum = float(quantum)
+        self.age_boost_s = age_boost_s
+        self.preempt_margin_s = float(preempt_margin_s)
+        self.starve_limit_s = float(starve_limit_s)
+        self.counters = {
+            "slo_released": 0, "slo_preemptions": 0,
+            "slo_rejected_queue": 0, "slo_rejected_rate": 0,
+            "slo_met": 0, "slo_missed": 0,
+        }
+        self._cursor = 0          # DRR ring position (into registry.order)
+        self._fresh = True        # top up deficit on arrival at a tenant
+        self._seq = 0             # EDF / FIFO tiebreak stamp
+        # Victims evicted through the park path, owed an auto-resume
+        # when slot pressure subsides (the "preempted requests always
+        # reach a terminal status" invariant depends on this).
+        self._parked_by_slo: List[RequestHandle] = []
+
+    # -- admission ----------------------------------------------------
+
+    def submit(self, engine, request: Request) -> RequestHandle:
+        """Tenant-gated admission: bounded per-tenant queue, then the
+        submission token bucket, then the underlying scheduler's
+        global bound — any failure is a :class:`QueueFullError` naming
+        the tenant (backpressure, not a crash). The handle lands in
+        the TENANT queue; :meth:`pump` releases it."""
+        now = engine.sched.now()
+        st = self.registry.state(request.tenant, now)
+        st.refill(now)
+        key = request.tenant if request.tenant is not None \
+            else _DEFAULT_TENANT
+        if len(st.queue) >= st.spec.max_queue:
+            st.rejected += 1
+            self.counters["slo_rejected_queue"] += 1
+            engine.sched.counters["rejected"] += 1
+            raise QueueFullError(
+                f"tenant {key!r} queue full ({st.spec.max_queue}); "
+                "retry later")
+        if st.spec.rate is not None:
+            if st.bucket < 1.0:
+                st.rejected += 1
+                self.counters["slo_rejected_rate"] += 1
+                engine.sched.counters["rejected"] += 1
+                raise QueueFullError(
+                    f"tenant {key!r} rate-limited "
+                    f"({st.spec.rate}/s, burst {st.spec.burst}); "
+                    "retry later")
+            st.bucket -= 1.0
+        h = engine.sched.submit(request)
+        # sched.submit appended to its global queue — relocate into
+        # the tenant queue (id assignment / submitted counters stay
+        # the scheduler's, so stats() is one source of truth).
+        popped = engine.sched.queue.pop()
+        assert popped is h
+        self._enqueue(st, h)
+        st.admitted += 1
+        return h
+
+    def adopt(self, engine, h: RequestHandle):
+        """Take ownership of an already-submitted queued handle
+        (checkpoint restore / preemption re-entry) — no admission
+        checks, no bucket charge."""
+        st = self.registry.state(h.request.tenant, engine.sched.now())
+        self._enqueue(st, h)
+
+    def _enqueue(self, st: _TenantState, h: RequestHandle):
+        if getattr(h, "_slo_seq", None) is None:
+            h._slo_seq = self._seq
+            self._seq += 1
+        st.queue.append(h)
+
+    # -- class / ordering helpers -------------------------------------
+
+    def _rank(self, h: RequestHandle, now: float) -> int:
+        """Effective class rank: the static class, minus one per
+        ``age_boost_s`` of queue wait (aging — the no-starvation
+        mechanism), floored at interactive."""
+        r = _CLASS_RANK[deadline_class(h.request)]
+        if self.age_boost_s is not None and r > 0:
+            r = max(r - int((now - h.queued_at) / self.age_boost_s), 0)
+        return r
+
+    @staticmethod
+    def _edf_key(h: RequestHandle):
+        d = h.request.deadline
+        return (d if d is not None else float("inf"), h._slo_seq)
+
+    # -- the tick hook ------------------------------------------------
+
+    def expired(self, now: float) -> List[RequestHandle]:
+        """Tenant-queued handles past their deadline (the engine fails
+        them — they never touched a slot), mirroring
+        ``Scheduler.expired`` for the global queue."""
+        out = []
+        for st in self.registry.states():
+            dead = [h for h in st.queue
+                    if h.request.deadline is not None
+                    and now >= h.request.deadline]
+            for h in dead:
+                st.queue.remove(h)
+            out += dead
+        return out
+
+    def pump(self, engine):
+        """One tick of arbitration, called by ``ServingEngine.step``
+        before scheduler admission: refill buckets, preempt if an
+        interactive deadline is in danger, release up to the free
+        slot capacity into ``sched.queue`` (class rank → DRR across
+        tenants → EDF within), then resume park-path preemptees once
+        pressure subsides."""
+        now = engine.sched.now()
+        self.registry.refill(now)
+        self._maybe_preempt(engine, now)
+        free = len(engine.sched.free_slots()) - len(engine.sched.queue)
+        while free > 0:
+            h = self._next(now)
+            if h is None:
+                break
+            st = self.registry.state(h.request.tenant, now)
+            st.released += 1
+            self.counters["slo_released"] += 1
+            engine.sched.queue.append(h)
+            free -= 1
+        self._maybe_unpark(engine)
+
+    def _next(self, now: float) -> Optional[RequestHandle]:
+        """Pop the next release: the best effective class rank present
+        across quota-eligible tenants, deficit-round-robin over the
+        tenant ring at that rank, EDF within the winner's queue."""
+        states = self.registry.states()
+        if not states:
+            return None
+        target = None
+        for st in states:
+            if not st.queue or not st.quota_ok():
+                continue
+            r = min(self._rank(h, now) for h in st.queue)
+            target = r if target is None else min(target, r)
+        if target is None:
+            return None
+        # Enough ring rotations that the smallest weight's deficit
+        # reaches a full release cost even for fractional weights.
+        minw = min(st.spec.weight for st in states)
+        rounds = int(1.0 / (self.quantum * minw)) + 2
+        n = len(states)
+        for _ in range(rounds * n):
+            self._cursor %= n
+            st = states[self._cursor]
+            cands = ([h for h in st.queue if self._rank(h, now) == target]
+                     if st.quota_ok() else [])
+            if not cands:
+                st.deficit = 0.0       # no hoarding while absent
+                self._cursor += 1
+                self._fresh = True
+                continue
+            if self._fresh:
+                st.deficit += self.quantum * st.spec.weight
+                self._fresh = False
+            if st.deficit < 1.0:
+                self._cursor += 1
+                self._fresh = True
+                continue
+            st.deficit -= 1.0
+            h = min(cands, key=self._edf_key)
+            st.queue.remove(h)
+            return h
+        return None
+
+    # -- preemption ---------------------------------------------------
+
+    def _urgent(self, now: float) -> Optional[RequestHandle]:
+        """The most deadline-pressed queued interactive request inside
+        the preemption margin, if any (quota-eligible tenants only —
+        an over-quota tenant cannot spend preemptions either)."""
+        best = None
+        for st in self.registry.states():
+            if not st.quota_ok():
+                continue
+            for h in st.queue:
+                d = h.request.deadline
+                if d is None or deadline_class(h.request) != "interactive":
+                    continue
+                if now + self.preempt_margin_s < d:
+                    continue
+                if best is None or self._edf_key(h) < self._edf_key(best):
+                    best = h
+        return best
+
+    def _maybe_preempt(self, engine, now: float):
+        if engine.mega:
+            # The persistent lane schedules its own slots; eviction
+            # mid-lane is the arena-tier limitation (ROADMAP item 3).
+            return
+        if self._urgent(now) is None:
+            return
+        if len(engine.sched.free_slots()) > len(engine.sched.queue):
+            return                     # a slot is free — admit handles it
+        cands = [h for h in engine.sched.running()
+                 if h.status == "running"
+                 and _CLASS_RANK[deadline_class(h.request)] > 0]
+        if not cands:
+            return                     # nothing strictly lower-priority
+        victim = max(cands, key=lambda h: (
+            _CLASS_RANK[deadline_class(h.request)],
+            h.started_at if h.started_at is not None else 0.0,
+            h.slot))
+        self._evict(engine, victim, now)
+
+    def _evict(self, engine, victim: RequestHandle, now: float):
+        """Preempt one running request. Park path when the tier store
+        is armed (KV offloaded, resumed bit-exact, auto-resume owed);
+        else the deterministic re-prefill path — slot, mirrors, and
+        pages free, the handle re-enters its TENANT queue so class
+        ordering applies to its re-admission too."""
+        slot = victim.slot
+        parked = False
+        if engine.tiers is not None and victim.tokens:
+            try:
+                engine.park(victim)
+                victim._slo_parked = True
+                self._parked_by_slo.append(victim)
+                parked = True
+            except Exception:
+                parked = False         # tier full / transfer dropped —
+                #                        fall through to re-prefill
+        if not parked:
+            engine.sched.slots.pop(slot, None)
+            victim.slot = None
+            engine._live[slot] = 0
+            engine._lens[slot] = 0
+            engine._toks[slot] = 0
+            if engine.manager is not None:
+                engine.manager.free_slot(slot)
+            victim.status = "queued"
+            victim.queued_at = now
+            self.adopt(engine, victim)
+        st = self.registry.state(victim.request.tenant, now)
+        st.preempted += 1
+        self.counters["slo_preemptions"] += 1
+        engine.stats_counters["preemptions"] += 1
+        engine.stats_counters["slo_preemptions"] += 1
+        engine.obs.event("preempt", request_id=victim.request.request_id,
+                         slot=slot, tenant=victim.request.tenant,
+                         reason="slo",
+                         path="park" if parked else "re-prefill")
+
+    def _maybe_unpark(self, engine):
+        """Auto-resume park-path preemptees once free capacity exists
+        beyond everything already released — they must reach a
+        terminal status without operator intervention."""
+        while (self._parked_by_slo
+               and len(engine.sched.free_slots())
+               > len(engine.sched.queue)):
+            h = self._parked_by_slo.pop(0)
+            if h.status != "parked":
+                continue               # retired / operator-resumed
+            h._slo_parked = False
+            engine.resume(h)
+
+    # -- engine callbacks ---------------------------------------------
+
+    def on_token(self, h: RequestHandle):
+        """Charge one decode token to the tenant's quota bucket (may
+        run negative for tokens already in flight — refill pays the
+        debt before the tenant releases again)."""
+        st = self.registry.state(h.request.tenant)
+        st.charged += 1
+        if st.spec.decode_quota is not None:
+            st.tokens -= 1.0
+
+    def on_retire(self, engine, h: RequestHandle):
+        """Terminal transition: fold the request into the per-tenant
+        SLO attainment ledger (deadline-bearing requests only) and
+        drop any preemption-tracking reference."""
+        if getattr(h, "_slo_parked", False):
+            h._slo_parked = False
+        if h in self._parked_by_slo:
+            self._parked_by_slo.remove(h)
+        st = self.registry.state(h.request.tenant)
+        if h in st.queue:              # failed while tenant-queued
+            st.queue.remove(h)
+        if h.request.deadline is None:
+            return
+        ok = (h.status == "done" and h.finished_at is not None
+              and h.finished_at <= h.request.deadline)
+        if ok:
+            st.met += 1
+            self.counters["slo_met"] += 1
+        else:
+            st.missed += 1
+            self.counters["slo_missed"] += 1
+
+    # -- surface ------------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        return (not any(st.queue for st in self.registry.states())
+                and not self._parked_by_slo)
+
+    def queued_handles(self) -> List[RequestHandle]:
+        """Every tenant-queued handle, release-order-stable (for
+        checkpoints — serialized as QUEUED, re-adopted on restore)."""
+        out = []
+        for st in self.registry.states():
+            out += sorted(st.queue, key=self._edf_key)
+        return out
+
+    def stats(self) -> dict:
+        """Per-tenant quota/queue/attainment view + the aggregate
+        ``attainment`` fraction (None until a deadline-bearing request
+        retires) — ``ServingEngine.stats()["slo"]``, aggregated across
+        fleets by ``FleetRouter.stats()``."""
+        per = {}
+        for st in self.registry.states():
+            quota = st.spec.decode_quota
+            per[st.spec.name] = {
+                "queued": len(st.queue), "admitted": st.admitted,
+                "rejected": st.rejected, "released": st.released,
+                "preempted": st.preempted,
+                "met": st.met, "missed": st.missed,
+                "weight": st.spec.weight,
+                "charged_tokens": st.charged,
+                "quota_tokens": (round(st.tokens, 3)
+                                 if quota is not None else None),
+            }
+        met = self.counters["slo_met"]
+        missed = self.counters["slo_missed"]
+        out = dict(self.counters)
+        out["tenants"] = per
+        out["attainment"] = (met / (met + missed)
+                             if (met + missed) else None)
+        return out
